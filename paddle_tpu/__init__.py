@@ -1,0 +1,145 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built new on JAX/XLA/Pallas/pjit (NOT a port): eager tensors with define-by-run autograd
+over jax.vjp tapes, a static Program/Executor path compiled by XLA, mesh-based
+distributed training (DP/TP/PP/SP/EP + ZeRO sharding + semi-auto SPMD), AMP, DataLoader,
+and the paddle.* API surface users of the reference expect.  See SURVEY.md for the
+component-by-component mapping to the reference (PaddlePaddle @ /root/reference)."""
+from __future__ import annotations
+
+import jax as _jax
+
+# float64/int64 parity with Paddle (reference default int dtype is int64; fp64 kernels
+# exist on every backend).  Creation ops still default to float32.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core import dtype as _dtype_mod  # noqa: E402
+from paddle_tpu.core.dtype import (  # noqa: F401,E402
+    bfloat16, bool_, complex64, complex128, finfo, float8_e4m3fn, float8_e5m2,
+    float16, float32, float64, get_default_dtype, iinfo, int8, int16, int32, int64,
+    set_default_dtype, uint8,
+)
+
+bool = bool_  # paddle.bool
+dtype = _dtype_mod.convert_dtype
+
+from paddle_tpu.core.device import (  # noqa: F401,E402
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace, XPUPlace,
+    get_device, set_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_custom_device,
+)
+
+from paddle_tpu.tensor import Tensor, Parameter, is_tensor  # noqa: F401,E402
+from paddle_tpu.tensor.creation import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor.math import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor.logic import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor.linalg import (  # noqa: F401,E402
+    norm, dist, einsum, tensordot,
+)
+from paddle_tpu.tensor import linalg  # noqa: F401,E402
+from paddle_tpu.tensor.random import (  # noqa: F401,E402
+    bernoulli, binomial, gaussian, get_rng_state, multinomial, normal, poisson,
+    rand, randint, randint_like, randn, randperm, seed, set_rng_state,
+    standard_gamma, standard_normal, uniform, default_generator,
+)
+from paddle_tpu.tensor.math import matmul  # noqa: F401,E402  (canonical)
+
+from paddle_tpu.autograd.engine import (  # noqa: F401,E402
+    enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+from paddle_tpu import autograd  # noqa: F401,E402
+
+# subpackages loaded lazily to keep import light and avoid cycles
+import importlib as _importlib
+
+_LAZY = {
+    "nn", "optimizer", "io", "amp", "distributed", "vision", "metric", "jit",
+    "static", "device", "framework", "hapi", "profiler", "incubate", "sparse",
+    "fft", "signal", "text", "audio", "quantization", "distribution", "geometric",
+    "utils", "inference", "callbacks", "hub", "onnx", "version", "sysconfig",
+    "base",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(f"paddle_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    if name == "save":
+        from paddle_tpu.framework.io import save as _s
+
+        return _s
+    if name == "load":
+        from paddle_tpu.framework.io import load as _l
+
+        return _l
+    if name == "summary":
+        from paddle_tpu.hapi.model_summary import summary as _sm
+
+        return _sm
+    if name == "flops":
+        from paddle_tpu.hapi.dynamic_flops import flops as _fl
+
+        return _fl
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def enable_static():
+    from paddle_tpu import static as _st
+
+    _st._enable_static()
+
+
+def disable_static():
+    from paddle_tpu import static as _st
+
+    _st._disable_static()
+
+
+def in_dynamic_mode():
+    try:
+        from paddle_tpu import static as _st
+
+        return not _st._static_mode_enabled()
+    except Exception:
+        return True
+
+
+def in_static_mode():
+    return not in_dynamic_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def disable_signal_handler():
+    pass
+
+
+def device_count():
+    from paddle_tpu.core.device import device_count as _dc
+
+    return _dc()
+
+
+def get_flags(flags=None):
+    from paddle_tpu.framework import flags as _flags
+
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from paddle_tpu.framework import flags as _flags
+
+    return _flags.set_flags(flags)
+
+
+def set_printoptions(**kwargs):
+    import numpy as _np
+
+    _np.set_printoptions(**{k: v for k, v in kwargs.items() if k in (
+        "precision", "threshold", "edgeitems", "linewidth", "suppress")})

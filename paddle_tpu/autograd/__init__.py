@@ -1,0 +1,119 @@
+"""Autograd public API (python/paddle/autograd parity)."""
+from paddle_tpu.autograd.engine import (  # noqa: F401
+    GradNode,
+    apply,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward"""
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (paddle/fluid/eager/pylayer)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (python/paddle/autograd/py_layer.py).
+
+    Subclass implements ``forward(ctx, *args)`` and ``backward(ctx, *grads)`` using
+    paddle_tpu eager ops.  The backward is spliced into the tape via a GradNode whose
+    vjp delegates to the user's backward."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax
+
+        from paddle_tpu.autograd.engine import GradNode, is_grad_enabled, no_grad
+        from paddle_tpu.tensor.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+
+        tensor_inputs = [
+            a for a in args if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if not is_grad_enabled() or not tensor_inputs:
+            return outputs
+
+        out_tensors = [o for o in outs if isinstance(o, Tensor)]
+
+        def vjp_fn(cotangents):
+            cts = jax.tree_util.tree_leaves(
+                cotangents, is_leaf=lambda x: x is None
+            )
+            grads_in = [
+                Tensor(c) if c is not None else None for c in cts
+            ]
+            with no_grad():
+                res = cls.backward(ctx, *(g for g in grads_in))
+            res = [res] if isinstance(res, Tensor) or res is None else list(res)
+            flat = []
+            it = iter(res)
+            for a in args:
+                if isinstance(a, Tensor) and not a.stop_gradient:
+                    g = next(it, None)
+                    flat.append(None if g is None else (g.data if isinstance(g, Tensor) else g))
+            return tuple(flat)
+
+        out_avals = [(tuple(o.shape), o.dtype) for o in out_tensors]
+        leaves_struct = jax.tree_util.tree_structure([0] * len(out_tensors))
+        node = GradNode(cls.__name__, vjp_fn, tuple(tensor_inputs), out_avals, leaves_struct)
+        for i, o in enumerate(out_tensors):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = i
+        return outputs
+
+
+class LegacyPyLayer(PyLayer):
+    pass
+
+
+def set_grad_enabled_ctx(mode):
+    return set_grad_enabled(mode)

@@ -1,0 +1,414 @@
+"""Eager autograd engine.
+
+TPU-native re-design of the reference's eager AD stack
+(paddle/fluid/eager/grad_node_info.h:197 GradNodeBase, backward.cc:105 RunBackward):
+instead of generated per-op C++ GradNodes, every differentiable eager op call records ONE
+``GradNode`` holding the ``jax.vjp`` closure of its jnp-level implementation.  Residuals
+are concrete ``jax.Array``s held by the closure (device memory, like Paddle's
+TensorWrapper saved inputs), and ``backward()`` is a dependency-counted ready-queue walk
+that calls each node's vjp and routes cotangents upstream — the same algorithm as
+``RunBackward``'s GradTensorHolder loop, minus the C++.
+
+``create_graph=True`` re-enters the tape while running vjp closures (they are pure jax
+functions of the cotangents), which is what gives double-grad for ``paddle.grad``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "apply",
+    "run_backward",
+    "grad",
+]
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    """paddle.set_grad_enabled: sets the mode immediately AND is usable as a context
+    manager that restores the previous mode on exit."""
+    prev = is_grad_enabled()
+    _tls.grad_enabled = bool(mode)
+    return _GradStateGuard(prev)
+
+
+class _GradStateGuard:
+    def __init__(self, prev):
+        self._prev = prev
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+class _GradModeCtx(contextlib.ContextDecorator):
+    """Context manager + decorator (paddle.no_grad supports both)."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._stack = []
+
+    def __enter__(self):
+        self._stack.append(is_grad_enabled())
+        _tls.grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *a):
+        _tls.grad_enabled = self._stack.pop()
+        return False
+
+    def __call__(self, func=None):
+        if func is None:
+            return _GradModeCtx(self._mode)
+        return super().__call__(func)
+
+
+def no_grad(func=None):
+    ctx = _GradModeCtx(False)
+    if func is not None and callable(func):
+        return ctx(func)
+    return ctx
+
+
+def enable_grad(func=None):
+    ctx = _GradModeCtx(True)
+    if func is not None and callable(func):
+        return ctx(func)
+    return ctx
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    Attributes:
+      name:      op name (for debugging / profiler).
+      vjp_fn:    callable(cotangent_pytree) -> tuple of cotangents, one per diff input.
+      inputs:    the differentiable input Tensors (order matches vjp_fn outputs).
+      out_avals: list of (shape, dtype) per output leaf — to build zero cotangents.
+      out_treedef: pytree structure of the op outputs.
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "raw_fn",
+        "inputs",
+        "out_avals",
+        "out_treedef",
+        "_pending",
+        "__weakref__",
+    )
+
+    def __init__(self, name, vjp_fn, inputs, out_avals, out_treedef, raw_fn=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.raw_fn = raw_fn  # original jnp fn of the diff inputs (for double grad)
+        self.inputs = inputs
+        self.out_avals = out_avals
+        self.out_treedef = out_treedef
+        self._pending = None  # idx -> accumulated cotangent during a backward pass
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={len(self.out_avals)}>"
+
+    # -- cotangent accumulation ------------------------------------------------
+    def _acc(self, idx, value):
+        if self._pending is None:
+            self._pending = {}
+        cur = self._pending.get(idx)
+        self._pending[idx] = value if cur is None else cur + value
+
+    def _take_cotangents(self, as_tensor=False):
+        import jax.numpy as jnp
+
+        leaves = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            v = self._pending.get(i) if self._pending else None
+            if v is None:
+                if dtype == jax.dtypes.float0:
+                    v = np.zeros(shape, jax.dtypes.float0)
+                else:
+                    v = jnp.zeros(shape, dtype)
+            if as_tensor:
+                from paddle_tpu.tensor.tensor import Tensor
+
+                if not isinstance(v, Tensor):
+                    v = Tensor(v)
+            leaves.append(v)
+        self._pending = None
+        return jax.tree_util.tree_unflatten(self.out_treedef, leaves)
+
+    def release(self):
+        """Free residuals after backward (retain_graph=False), like Paddle clearing
+        TensorWrappers."""
+        self.vjp_fn = None
+        self.inputs = ()
+        self._pending = None
+
+
+def _is_diff_dtype(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.issubdtype(
+        np.dtype(dtype), np.complexfloating
+    )
+
+
+def apply(name: str, fn: Callable, *args, **kwargs):
+    """Run an eager op through the tape.
+
+    ``fn`` receives ``args``/``kwargs`` with every Tensor leaf replaced by its raw
+    ``jax.Array`` and must return an array or pytree of arrays.  Differentiable inputs
+    are the floating/complex Tensors with ``stop_gradient=False``; everything else is
+    closed over as a constant (matching the reference's generated ``*_ad_func`` wiring,
+    eager_gen.py:316).
+    """
+    from paddle_tpu.tensor.tensor import Tensor  # local: avoid import cycle
+
+    is_tensor = lambda x: isinstance(x, Tensor)
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=is_tensor)
+
+    diff_pos = []
+    if is_grad_enabled():
+        for i, leaf in enumerate(leaves):
+            if is_tensor(leaf) and not leaf.stop_gradient and _is_diff_dtype(leaf.dtype):
+                diff_pos.append(i)
+    requires = bool(diff_pos)
+
+    const_leaves = [l.data if is_tensor(l) else l for l in leaves]
+
+    if not requires:
+        a, kw = jax.tree_util.tree_unflatten(treedef, const_leaves)
+        out = fn(*a, **kw)
+        return _wrap_outputs(out, None)
+
+    diff_datas = [const_leaves[i] for i in diff_pos]
+
+    def raw_fn(*xs):
+        sub = list(const_leaves)
+        for p, x in zip(diff_pos, xs):
+            sub[p] = x
+        a, kw = jax.tree_util.tree_unflatten(treedef, sub)
+        return fn(*a, **kw)
+
+    out_data, vjp_fn = jax.vjp(raw_fn, *diff_datas)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out_data)
+    out_avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
+    node = GradNode(
+        name, vjp_fn, tuple(leaves[i] for i in diff_pos), out_avals, out_treedef,
+        raw_fn=raw_fn,
+    )
+    return _wrap_outputs(out_data, node)
+
+
+def _wrap_outputs(out_data, node):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out_data)
+    wrapped = []
+    for i, leaf in enumerate(out_leaves):
+        t = Tensor(leaf, stop_gradient=(node is None or not _is_diff_dtype(leaf.dtype)))
+        if node is not None and not t.stop_gradient:
+            t._grad_node = node
+            t._out_index = i
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+# ---------------------------------------------------------------------------------
+# Backward engine
+# ---------------------------------------------------------------------------------
+
+
+def _collect_graph(start_nodes):
+    """DFS collect reachable nodes and per-node dependency count (number of reachable
+    consumer nodes), mirroring RunBackward's node_in_degree_map (backward.cc:151)."""
+    visited = set()
+    deps = {}
+    stack = list(start_nodes)
+    for n in start_nodes:
+        deps.setdefault(id(n), 0)
+    nodes = {}
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        nodes[id(node)] = node
+        for inp in node.inputs:
+            up = getattr(inp, "_grad_node", None)
+            if up is not None and up.vjp_fn is not None:
+                deps[id(up)] = deps.get(id(up), 0) + 1
+                stack.append(up)
+    return nodes, deps
+
+
+def _accumulate_grad(tensor, value, create_graph):
+    """Deposit a cotangent into a leaf tensor's .grad, running user hooks."""
+    from paddle_tpu.tensor.tensor import Tensor
+
+    if isinstance(value, np.ndarray) and value.dtype == jax.dtypes.float0:
+        return
+    g = value if isinstance(value, Tensor) else Tensor(value, stop_gradient=not create_graph)
+    for hook in getattr(tensor, "_grad_hooks", ()) or ():
+        res = hook(g)
+        if res is not None:
+            g = res
+    if tensor.grad is None:
+        tensor._grad = g
+    else:
+        tensor._grad = Tensor(tensor._grad.data + g.data, stop_gradient=not create_graph)
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=False,
+                 accumulate_into_leaves=True, grad_targets=None):
+    """Core backward walk.  If ``grad_targets`` is given (paddle.grad), returns the
+    cotangents for those tensors instead of (only) writing ``.grad``."""
+    import jax.numpy as jnp
+    from paddle_tpu.tensor.tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    target_ids = {id(t): t for t in (grad_targets or ())}
+    captured = {}
+
+    start_nodes = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; tensor "
+                    f"shape is {t.shape}"
+                )
+            g_data = jnp.ones(t.shape, t.dtype)
+        else:
+            g_data = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            g_data = Tensor(g_data)
+        node = getattr(t, "_grad_node", None)
+        if node is not None and node.vjp_fn is not None:
+            node._acc(t._out_index, g_data)
+            start_nodes.append(node)
+        if node is None or id(t) in target_ids or getattr(t, "_retain_grads", False):
+            if not t.stop_gradient:
+                if id(t) in target_ids:
+                    captured[id(t)] = captured.get(id(t), 0) + g_data
+                if node is None or getattr(t, "_retain_grads", False):
+                    _accumulate_grad(t, g_data, create_graph)
+
+    nodes, deps = _collect_graph(start_nodes)
+    ready = [n for n in start_nodes if deps.get(id(n), 0) == 0]
+    seen_ready = {id(n) for n in ready}
+    processed = set()
+
+    while ready:
+        node = ready.pop()
+        if id(node) in processed or node.vjp_fn is None:
+            continue
+        processed.add(id(node))
+        cot = node._take_cotangents(as_tensor=create_graph)
+
+        if create_graph and node.raw_fn is not None:
+            # Differentiate through BOTH the cotangents and the primal inputs: re-derive
+            # the vjp on the tape so the returned grads keep a path back to the primals
+            # (double grad).  The whole walk stays in Tensors so connectivity survives.
+            raw = node.raw_fn
+
+            def grad_fn(c, *primals):
+                return jax.vjp(raw, *primals)[1](c)
+
+            in_grads = apply(f"{node.name}_grad", grad_fn, cot, *node.inputs)
+        elif create_graph:
+            in_grads = apply(f"{node.name}_grad", lambda c: node.vjp_fn(c), cot)
+        else:
+            in_grads = node.vjp_fn(cot)
+
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            up = getattr(inp, "_grad_node", None)
+            if id(inp) in target_ids:
+                prev = captured.get(id(inp))
+                captured[id(inp)] = g if prev is None else prev + g
+            if up is not None and up.vjp_fn is not None and id(up) in nodes:
+                if getattr(inp, "_retain_grads", False):
+                    _accumulate_grad(inp, g, create_graph)
+                up._acc(inp._out_index, g)
+                deps[id(up)] -= 1
+                if deps[id(up)] <= 0 and id(up) not in seen_ready:
+                    seen_ready.add(id(up))
+                    ready.append(up)
+            elif up is None or up.vjp_fn is None:
+                if accumulate_into_leaves or getattr(inp, "_retain_grads", False):
+                    _accumulate_grad(inp, g, create_graph)
+        if not retain_graph and not create_graph:
+            node.release()
+
+    if grad_targets is not None:
+        out = []
+        for t in grad_targets:
+            v = captured.get(id(t))
+            out.append(None if v is None else (v if isinstance(v, Tensor) else Tensor(v, stop_gradient=not create_graph)))
+        return out
+    return None
+
+
+def _lift(cot):
+    """Wrap raw cotangent arrays as Tensors so create_graph re-enters the tape."""
+    from paddle_tpu.tensor.tensor import Tensor
+
+    return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=False), cot)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad (python/paddle/autograd via egr::Backward general_grad.h)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    res = run_backward(
+        list(outputs),
+        grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        accumulate_into_leaves=False,
+        grad_targets=list(inputs),
+    )
+    if not allow_unused:
+        for t, g in zip(inputs, res):
+            if g is None:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the graph; "
+                    "set allow_unused=True to return None for it."
+                )
+    return res

@@ -1,0 +1,125 @@
+"""Data types for paddle_tpu.
+
+Mirrors the reference's phi DataType surface (paddle/phi/common/data_type.h) as a thin
+veneer over numpy/jax dtypes. Paddle semantics preserved: default float dtype float32,
+default integer dtype int64, names exposed as ``paddle_tpu.float32`` etc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes as _ml
+
+    bfloat16 = np.dtype(_ml.bfloat16)
+    float8_e4m3fn = np.dtype(_ml.float8_e4m3fn)
+    float8_e5m2 = np.dtype(_ml.float8_e5m2)
+except Exception:  # pragma: no cover
+    bfloat16 = np.dtype("float32")
+    float8_e4m3fn = np.dtype("float32")
+    float8_e5m2 = np.dtype("float32")
+
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle legacy VarType aliases
+    "FP16": float16,
+    "FP32": float32,
+    "FP64": float64,
+    "BF16": bfloat16,
+    "INT8": int8,
+    "INT16": int16,
+    "INT32": int32,
+    "INT64": int64,
+    "BOOL": bool_,
+    "UINT8": uint8,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, paddle name) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _NAME2DTYPE:
+            return _NAME2DTYPE[dtype]
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    if d == float8_e4m3fn:
+        return "float8_e4m3fn"
+    if d == float8_e5m2:
+        return "float8_e5m2"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype — affects float creation ops without explicit dtype."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError(
+            "set_default_dtype only supports floating dtypes, got %s" % dtype_name(d)
+        )
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def finfo(dtype):
+    import ml_dtypes
+
+    return ml_dtypes.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))

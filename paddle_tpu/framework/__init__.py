@@ -1,0 +1,12 @@
+"""paddle.framework parity: flags, dtype helpers, seeds, io."""
+from paddle_tpu.framework import flags  # noqa: F401
+from paddle_tpu.core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from paddle_tpu.tensor.random import seed  # noqa: F401
+
+
+def get_flags(f=None):
+    return flags.get_flags(f)
+
+
+def set_flags(f):
+    return flags.set_flags(f)

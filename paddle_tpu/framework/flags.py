@@ -1,0 +1,68 @@
+"""Global flag registry (paddle/common/flags.cc + flags_native.cc parity).
+
+Flags are settable via ``paddle.set_flags({...})`` or ``FLAGS_*`` env vars, mirroring
+PHI_DEFINE_EXPORTED_* semantics.  Only flags meaningful on TPU are consumed; unknown
+flags are stored (so user scripts that set CUDA-era flags keep working)."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_use_system_allocator": False,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": 0,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_log_memory_stats": False,
+    "FLAGS_enable_async_trace": False,
+    "FLAGS_use_stride_kernel": True,
+    "FLAGS_set_to_1d": False,
+    "FLAGS_enable_pir_api": True,
+}
+
+_flags: Dict[str, Any] = {}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        if isinstance(val, str):
+            return val.lower() in ("1", "true", "yes", "on")
+        return bool(val)
+    if isinstance(cur, int) and not isinstance(cur, bool):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+def get_flags(names=None):
+    if names is None:
+        names = list(_DEFAULTS)
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        if n in _flags:
+            out[n] = _flags[n]
+        elif n in os.environ:
+            d = _DEFAULTS.get(n, "")
+            out[n] = _coerce(d, os.environ[n])
+        else:
+            out[n] = _DEFAULTS.get(n)
+    return out
+
+
+def set_flags(values: Dict[str, Any]):
+    for k, v in values.items():
+        d = _DEFAULTS.get(k)
+        _flags[k] = _coerce(d, v) if d is not None else v
+
+
+def get_flag(name, default=None):
+    return get_flags([name]).get(name, default)

@@ -1,0 +1,121 @@
+"""Tensor package: functional ops + method patching onto Tensor
+(python/paddle/tensor/__init__.py + tensor_method_patch parity)."""
+from paddle_tpu.tensor.tensor import Tensor, Parameter, is_tensor  # noqa: F401
+from paddle_tpu.tensor import (  # noqa: F401
+    creation,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random,
+)
+
+_METHOD_SOURCES = [math, manipulation, logic, linalg, creation]
+
+# names that must NOT be patched as methods
+_SKIP = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "assign", "tril_indices", "triu_indices", "create_parameter",
+    "broadcast_shape", "slice",
+}
+
+
+def _patch_methods():
+    import types
+
+    patched = set(dir(Tensor))
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            # only functions DEFINED in this module — not imports leaking through
+            # (e.g. the autograd engine's `apply`)
+            if getattr(fn, "__module__", None) != mod.__name__:
+                continue
+            if name in patched:
+                continue
+            setattr(Tensor, name, fn)
+            patched.add(name)
+
+
+_patch_methods()
+
+
+def _tensor_apply(self, func):
+    """paddle Tensor.apply(callable): returns callable(self) as a new tensor."""
+    out = func(self)
+    return out if isinstance(out, Tensor) else Tensor(out)
+
+
+def _tensor_apply_(self, func):
+    out = func(self)
+    return self._in_place(out if isinstance(out, Tensor) else Tensor(out))
+
+
+Tensor.apply = _tensor_apply
+Tensor.apply_ = _tensor_apply_
+
+
+# ---- operator dunders (python/paddle/tensor/tensor_method_patch math ops) ----
+def _rbin(fn):
+    def op(self, other):
+        return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+
+    return op
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = math.add
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _rbin(math.subtract)
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = math.multiply
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _rbin(math.divide)
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _rbin(math.floor_divide)
+Tensor.__mod__ = math.remainder
+Tensor.__rmod__ = _rbin(math.remainder)
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _rbin(math.pow)
+Tensor.__matmul__ = lambda self, other: math.matmul(self, other)
+Tensor.__rmatmul__ = _rbin(lambda a, b: math.matmul(a, b))
+Tensor.__neg__ = math.neg
+Tensor.__abs__ = math.abs
+Tensor.__pos__ = lambda self: self
+Tensor.__invert__ = lambda self: logic.bitwise_not(self) if "int" in str(self.dtype) else logic.logical_not(self)
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__and__ = lambda self, o: logic.bitwise_and(self, o)
+Tensor.__or__ = lambda self, o: logic.bitwise_or(self, o)
+Tensor.__xor__ = lambda self, o: logic.bitwise_xor(self, o)
+Tensor.__lshift__ = logic.bitwise_left_shift
+Tensor.__rshift__ = logic.bitwise_right_shift
+Tensor.__hash__ = lambda self: id(self)
+
+# paddle attribute-style helpers
+Tensor.item_size = property(lambda self: self.dtype.itemsize)
+Tensor.T = property(lambda self: manipulation.transpose(self, list(range(self.ndim))[::-1]))
+Tensor.mT = property(lambda self: manipulation.swapaxes(self, -1, -2))
+Tensor.real = property(lambda self: math.real(self))
+Tensor.imag = property(lambda self: math.imag(self))
+
+Tensor.is_floating_point = lambda self: bool(
+    __import__("numpy").issubdtype(self.dtype, __import__("numpy").floating)
+)
+Tensor.is_complex = lambda self: bool(
+    __import__("numpy").issubdtype(self.dtype, __import__("numpy").complexfloating)
+)
+Tensor.is_integer = lambda self: bool(
+    __import__("numpy").issubdtype(self.dtype, __import__("numpy").integer)
+)
+Tensor.element_size = lambda self: self.dtype.itemsize
+Tensor.num_elements = lambda self: self.size
+Tensor.numel = lambda self: self.size

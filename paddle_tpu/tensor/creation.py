@@ -1,0 +1,219 @@
+"""Creation ops (python/paddle/tensor/creation.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.core import device as _device
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return _dtype.get_default_dtype() if default_float else None
+    return _dtype.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data.data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = _dtype.get_default_dtype()
+        else:
+            dtype = _dtype.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x.data, dtype=_dtype.convert_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x.data, dtype=_dtype.convert_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(
+        jnp.full_like(x.data, fill_value, dtype=_dtype.convert_dtype(dtype) if dtype else None)
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else _dtype.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, _dtype.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.logspace(val(start), val(stop), int(val(num)), base=val(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a.data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base.at[jnp.diag_indices(n)].set(padding_value).at[
+                (jnp.arange(a.shape[0]), jnp.arange(a.shape[0]) + offset)
+                if offset >= 0
+                else (jnp.arange(a.shape[0]) - offset, jnp.arange(a.shape[0]))
+            ].set(a)
+        return jnp.diag(a, k=offset)
+
+    return apply("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        out = out.at[..., r, c].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply("diag_embed", f, input)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dtype.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dtype.convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    data = x.data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(data)
+    output.set_value(data)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply("complex", lambda r, i: r + 1j * i, real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply("polar", lambda r, t: r * jnp.exp(1j * t), abs, angle)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    shape = _shape(shape)
+    dt = _dtype.convert_dtype(dtype)
+    p = Parameter(jnp.zeros(shape, dt), name=name)
+    if default_initializer is not None:
+        default_initializer(p)
+    elif not is_bias and _dtype.is_floating_point(dt):
+        # default: Xavier/Glorot normal (python/paddle/base/framework default_initializer)
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[1] if len(shape) > 1 else 1
+        std = float(np.sqrt(2.0 / max(fan_in + fan_out, 1)))
+        from paddle_tpu.tensor.random import _key
+
+        p._data = (jax.random.normal(_key(), shape, jnp.float32) * std).astype(dt)
+    return p
+
+
+def clone_no_grad(x):
+    return Tensor(x.data)

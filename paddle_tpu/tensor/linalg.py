@@ -1,0 +1,266 @@
+"""Linear algebra (python/paddle/tensor/linalg.py + paddle.linalg namespace parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and p is None:
+            return jnp.linalg.norm(a.reshape(-1))
+        pp = 2 if p is None or p == "fro" else p
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        if isinstance(ax, tuple) and pp == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if pp == np.inf or pp == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == -np.inf or pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pp), axis=ax, keepdims=keepdim), 1.0 / pp)
+
+    return apply("norm", f, _t(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+    return apply(
+        "matrix_norm",
+        lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply("sub", jnp.subtract, _t(x), _t(y)), p)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), _t(x))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return apply("slogdet", f, _t(x))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, rtol=tol), _t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply("cholesky", f, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2).conj(), z, lower=False)
+
+    return apply("cholesky_solve", f, _t(x), _t(y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def f(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, eye, lower=True)
+        return jnp.swapaxes(z, -1, -2).conj() @ z
+
+    return apply("cholesky_inverse", f, _t(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x.data)
+    outs = (Tensor(lu_mat), Tensor((piv + 1).astype(np.int32)))
+    if get_infos:
+        return outs + (Tensor(np.zeros((), np.int32)),)
+    return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_mat = x.data
+    piv = y.data - 1
+    n = lu_mat.shape[-2]
+    P = jnp.eye(n, dtype=lu_mat.dtype)
+    perm = jnp.arange(n)
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+    L = jnp.tril(lu_mat, -1) + jnp.eye(n, dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat)
+    return Tensor(P), Tensor(L), Tensor(U)
+
+
+def qr(x, mode="reduced", name=None):
+    def f(a):
+        q, r = jnp.linalg.qr(a, mode="reduced" if mode == "reduced" else "complete")
+        return q, r
+
+    if mode == "r":
+        return apply("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), _t(x))
+    return apply("qr", f, _t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(
+        "svd", lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), _t(x)
+    )
+
+
+def svdvals(x, name=None):
+    return apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), _t(x))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, vt = jnp.linalg.svd(x.data, full_matrices=False)
+    k = min(q, s.shape[-1])
+    return Tensor(u[..., :k]), Tensor(s[..., :k]), Tensor(jnp.swapaxes(vt, -1, -2)[..., :k])
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = x.data
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), _t(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply("solve", f, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        ),
+        _t(x),
+        _t(y),
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = x.numpy(), y.numpy()
+    sol, res, rank_, sv = np.linalg.lstsq(a, b, rcond=rcond)
+    return (
+        Tensor(sol),
+        Tensor(res if res.size else np.zeros((0,), a.dtype)),
+        Tensor(np.asarray(rank_, np.int64)),
+        Tensor(sv),
+    )
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda lst: jnp.linalg.multi_dot(lst), [_t(i) for i in x])
+
+
+def matrix_exp(x, name=None):
+    return apply("matrix_exp", jax.scipy.linalg.expm, _t(x))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        Q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i][..., None, None] * (
+                v[..., :, None] @ v[..., None, :]
+            )
+            return Q @ H
+
+        for i in range(n):
+            Q = body(i, Q)
+        return Q[..., :, :n]
+
+    return apply("householder_product", f, _t(x), _t(tau))
+
+
+def einsum(equation, *operands):
+    ops = [_t(o) for o in operands]
+    return apply("einsum", lambda lst: jnp.einsum(equation, *lst), list(ops))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(axes, Tensor):
+        ax = axes.tolist()
+    if isinstance(ax, (list, tuple)) and len(ax) == 2 and isinstance(ax[0], (list, tuple)):
+        ax = (tuple(ax[0]), tuple(ax[1]))
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), _t(x), _t(y))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))

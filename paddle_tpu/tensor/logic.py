@@ -1,0 +1,280 @@
+"""Comparison / logical / bitwise ops + search + stat
+(python/paddle/tensor/{logic,search,stat}.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        x = _t(x)
+        if isinstance(y, (int, float, bool)):
+            return apply(name, lambda a: fn(a, y), x)
+        return apply(name, fn, x, _t(y))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, _t(x))
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, _t(x))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# ------------------------------------------------------------------------- search
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = _dtype.convert_dtype(dtype)
+    return apply(
+        "argmax", lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(dt), _t(x)
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = _dtype.convert_dtype(dtype)
+    return apply(
+        "argmin", lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(dt), _t(x)
+    )
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply("argsort", f, _t(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(
+        "sort", lambda a: jnp.sort(a, axis=axis, stable=stable, descending=descending), _t(x)
+    )
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, kk)
+        else:
+            v, i = jax.lax.top_k(-am, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax)
+
+    return apply("topk", f, _t(x))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    xx = x if isinstance(x, (int, float, bool)) else _t(x)
+    yy = y if isinstance(y, (int, float, bool)) else _t(y)
+    tensors = [t for t in (xx, yy) if isinstance(t, Tensor)]
+
+    def f(c, *rest):
+        it = iter(rest)
+        a = next(it) if isinstance(xx, Tensor) else xx
+        b = next(it) if isinstance(yy, Tensor) else yy
+        return jnp.where(c, a, b)
+
+    return apply("where", f, _t(condition), *tensors)
+
+
+def where_(x, condition, y, name=None):
+    return x._in_place(where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.argwhere(x.numpy())
+    if as_tuple:
+        return tuple(Tensor(arr[:, i].astype(np.int64)) for i in range(arr.shape[1]))
+    return Tensor(arr.astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(dt)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(dt)
+
+    return apply("searchsorted", f, _t(sorted_sequence), _t(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        sv = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax).astype(jnp.int64)
+        v = jax.lax.index_in_dim(sv, k - 1, axis=ax, keepdims=keepdim)
+        i = jax.lax.index_in_dim(si, k - 1, axis=ax, keepdims=keepdim)
+        return v, i
+
+    return apply("kthvalue", f, _t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = a.ndim - 1 if axis == -1 else axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        sorted_a = jnp.sort(am, axis=-1)
+        n = sorted_a.shape[-1]
+        eq = sorted_a[..., 1:] == sorted_a[..., :-1]
+
+        def run_len(row_eq):
+            def body(carry, e):
+                run = jnp.where(e, carry + 1, 0)
+                return run, run
+
+            _, runs = jax.lax.scan(body, jnp.zeros((), jnp.int32), row_eq)
+            return runs
+
+        runs = jnp.concatenate(
+            [jnp.zeros(am.shape[:-1] + (1,), jnp.int32),
+             jnp.apply_along_axis(run_len, -1, eq) if eq.size else jnp.zeros(am.shape[:-1] + (0,), jnp.int32)],
+            axis=-1,
+        )
+        best = jnp.argmax(runs, axis=-1)
+        vals = jnp.take_along_axis(sorted_a, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(am == vals[..., None], axis=-1)
+        # paddle returns LAST occurrence index
+        idx = am.shape[-1] - 1 - jnp.argmax(jnp.flip(am == vals[..., None], -1), axis=-1)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply("mode", f, _t(x))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, i):
+        am = jnp.moveaxis(a, axis, 0)
+        out = am.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_fill", f, _t(x), _t(index))
+
+
+# --------------------------------------------------------------------------- stat
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "std",
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "var",
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+        ax = _axis(axis)
+        if ax is None:
+            flat = a.reshape(-1)
+            n = flat.shape[0]
+            s = jnp.sort(flat)
+            v = s[(n - 1) // 2]
+            i = jnp.argsort(flat)[(n - 1) // 2]
+            return v, i.astype(jnp.int64)
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax)
+        k = (a.shape[ax] - 1) // 2
+        v = jax.lax.index_in_dim(s, k, axis=ax, keepdims=keepdim)
+        i = jax.lax.index_in_dim(si, k, axis=ax, keepdims=keepdim)
+        return v, i.astype(jnp.int64)
+
+    return apply("median", f, _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(
+        "nanmedian", lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), _t(x)
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qs = q.data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        "quantile",
+        lambda a: jnp.quantile(a, qs, axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        _t(x),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qs = q.data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, qs, axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        _t(x),
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        "cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _t(x)
+    )

@@ -1,0 +1,577 @@
+"""Shape/layout manipulation ops (python/paddle/tensor/manipulation.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, shape), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._in_place(reshape(x, shape))
+
+
+view = reshape
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return apply("flatten", f, _t(x))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._in_place(flatten(x, start_axis, stop_axis))
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return apply("transpose", lambda a: jnp.transpose(a, perm), _t(x))
+
+
+def t(input, name=None):
+    return apply("t", lambda a: a.T, _t(input))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)), _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), _t(x))
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis) if isinstance(axis, (list, tuple, Tensor)) else [int(axis)]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply("squeeze", f, _t(x))
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._in_place(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis) if isinstance(axis, (list, tuple, Tensor)) else [int(axis)]
+    return apply("unsqueeze", lambda a: jnp.expand_dims(a, tuple(axes)), _t(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._in_place(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    xs = [_t(i) for i in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dtypes = {t.dtype for t in xs}
+    if len(dtypes) > 1:
+        common = jnp.result_type(*[t.data for t in xs])
+        xs = [t.astype(common) for t in xs]
+    return apply("concat", lambda lst: jnp.concatenate(lst, axis=ax), xs)
+
+
+def stack(x, axis=0, name=None):
+    xs = [_t(i) for i in x]
+    return apply("stack", lambda lst: jnp.stack(lst, axis=axis), xs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = _ints(num_or_sections)
+        total = a.shape[ax]
+        known = [s for s in secs if s != -1]
+        secs = [s if s != -1 else total - int(np.sum(known)) for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=ax))
+
+    return list(apply("split", f, _t(x)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    outs = split(input, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), _t(x))
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+
+    def f(a):
+        tgt = list(shape)
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tgt)
+
+    return apply("expand", f, _t(x))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):
+    datas = jnp.broadcast_arrays(*[t.data for t in input])
+    shapes = [d.shape for d in datas]
+    return [expand(t, s) for t, s in zip(input, shapes)]
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis) if isinstance(axis, (list, tuple)) else [int(axis)]
+    return apply("flip", lambda a: jnp.flip(a, tuple(axes)), _t(x))
+
+
+def rot90(x, k=1, axes=[0, 1], name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k, tuple(_ints(axes))), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts)
+    ax = None if axis is None else _ints(axis)
+    return apply("roll", lambda a: jnp.roll(a, sh, ax), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=ax), _t(x), _t(index))
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx]
+
+    return apply("gather_nd", f, _t(x), _t(index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return apply("scatter", f, _t(x), _t(index), _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._in_place(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply("scatter_nd_add", f, _t(x), _t(index), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _ints(shape)
+
+    def f(i, u):
+        a = jnp.zeros(shape, u.dtype)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply("scatter_nd", f, _t(index), _t(updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, i: jnp.take(a, i, axis=axis), _t(x), _t(index))
+
+
+def index_sample(x, index, name=None):
+    return apply(
+        "index_sample",
+        lambda a, i: jnp.take_along_axis(a, i, axis=1),
+        _t(x),
+        _t(index),
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[i].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_add", f, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i.data for i in indices)
+
+    def f(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+
+    return apply("index_put", f, _t(x), _t(value))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(a, i):
+        if broadcast:
+            # paddle semantics: broadcast indices against arr on all non-axis dims
+            ax = axis % a.ndim
+            tgt = list(
+                np.broadcast_shapes(
+                    tuple(d for k, d in enumerate(a.shape) if k != ax),
+                    tuple(d for k, d in enumerate(i.shape) if k != ax),
+                )
+            )
+            tgt.insert(ax, i.shape[ax])
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(a, i, axis=axis)
+
+    return apply("take_along_axis", f, _t(arr), _t(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if not np.isscalar(v) else v
+        if reduce == "assign":
+            return _scatter_along_axis(a, i, v, axis, "set")
+        if reduce in ("add", "sum"):
+            return _scatter_along_axis(a, i, v, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return _scatter_along_axis(a, i, v, axis, "mul")
+        if reduce == "amax":
+            return _scatter_along_axis(a, i, v, axis, "max")
+        if reduce == "amin":
+            return _scatter_along_axis(a, i, v, axis, "min")
+        raise ValueError(f"unknown reduce {reduce}")
+
+    if np.isscalar(values):
+        values = Tensor(jnp.full((1,) * arr.ndim, values, arr.dtype))
+    return apply("put_along_axis", f, _t(arr), _t(indices), _t(values))
+
+
+def _scatter_along_axis(a, i, v, axis, mode):
+    idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)]) for k, s in enumerate(i.shape)]
+    idx[axis] = i
+    v = jnp.broadcast_to(v, i.shape)
+    at = a.at[tuple(idx)]
+    return getattr(at, {"set": "set", "add": "add", "mul": "multiply", "max": "max", "min": "min"}[mode])(v)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shape — eager only (like reference's masked_select on GPU)
+    data = x.data[mask.data]
+    return Tensor(data)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) else value
+    return apply("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), _t(x), _t(mask))
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._in_place(masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    def f(a, m, v):
+        flat_m = m.reshape(-1)
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)]
+        return jnp.where(flat_m, src, a.reshape(-1)).reshape(a.shape)
+
+    return apply("masked_scatter", f, _t(x), _t(mask), _t(value))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    vals, idx, inv, cnt = np.unique(
+        x.numpy(), return_index=True, return_inverse=True, return_counts=True, axis=axis
+    )
+    out = [Tensor(vals)]
+    if return_index:
+        out.append(Tensor(idx.astype(np.int64)))
+    if return_inverse:
+        out.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        out.append(Tensor(cnt.astype(np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = x.numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if arr.shape[ax] == 0:
+        vals = arr
+        counts = np.array([], np.int64)
+        inv = np.array([], np.int64)
+    else:
+        sl = [slice(None)] * arr.ndim
+        sl[ax] = slice(1, None)
+        sl2 = [slice(None)] * arr.ndim
+        sl2[ax] = slice(None, -1)
+        neq = np.any(arr[tuple(sl)] != arr[tuple(sl2)], axis=tuple(i for i in range(arr.ndim) if i != ax)) if arr.ndim > 1 else arr[1:] != arr[:-1]
+        keep = np.concatenate([[True], neq])
+        vals = np.compress(keep, arr, axis=ax)
+        grp = np.cumsum(keep) - 1
+        counts = np.bincount(grp)
+        inv = grp
+    out = [Tensor(vals)]
+    if return_inverse:
+        out.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        out.append(Tensor(counts.astype(np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def slice(input, axes, starts, ends):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins_slice(s, e)
+        return a[tuple(idx)]
+
+    return apply("slice", f, _t(input))
+
+
+import builtins as _builtins
+
+builtins_slice = _builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply("strided_slice", f, _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else [0] * len(shape)
+
+    def f(a):
+        sl = tuple(
+            builtins_slice(o, o + (s if s != -1 else a.shape[i] - o))
+            for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return a[sl]
+
+    return apply("crop", f, _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply(
+            "repeat_interleave",
+            lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=int(np.sum(repeats.numpy()))),
+            _t(x),
+            repeats,
+        )
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), _t(x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        size = index_num // nshards
+        lo = shard_id * size
+        in_shard = (a >= lo) & (a < lo + size)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply("shard_index", f, _t(input))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        x.numpy().reshape(-1)[offset:],
+        shape=_ints(shape),
+        strides=[s * x.numpy().dtype.itemsize for s in _ints(stride)],
+    )
+    return Tensor(arr.copy())
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        outs = jnp.array_split(x.data, num_or_indices, axis=axis)
+        sizes = [o.shape[axis] for o in outs]
+        return split(x, sizes, axis)
+    idx = _ints(num_or_indices)
+    sizes, prev = [], 0
+    for i in idx:
+        sizes.append(i - prev)
+        prev = i
+    sizes.append(x.shape[axis] - prev)
+    return split(x, sizes, axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return apply("hstack", lambda lst: jnp.hstack(lst), [_t(i) for i in x])
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda lst: jnp.vstack(lst), [_t(i) for i in x])
+
+
+def dstack(x, name=None):
+    return apply("dstack", lambda lst: jnp.dstack(lst), [_t(i) for i in x])
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def column_stack(x, name=None):
+    return apply("column_stack", lambda lst: jnp.column_stack(lst), [_t(i) for i in x])
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def numel(x, name=None):
+    return Tensor(np.asarray(x.size, np.int64))
+
+
+def rank(input):
+    return Tensor(np.asarray(input.ndim, np.int32))
+
+
+def shape(input):
+    return Tensor(np.asarray(input.shape, np.int32))
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def chunk_eval(*a, **k):  # pragma: no cover - NLP legacy
+    raise NotImplementedError
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+def unflatten(x, axis, shape, name=None):
+    shape = _ints(shape)
+
+    def f(a):
+        ax = axis % a.ndim
+        return jnp.reshape(a, a.shape[:ax] + tuple(shape) + a.shape[ax + 1 :])
+
+    return apply("unflatten", f, _t(x))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast_(x, dtype):
+    return x._in_place(x.astype(dtype))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics: `pad` is per-dim [lo, hi] pairs starting
+    from the last dimension (like torch) when len(pad) < 2*ndim, else full spec."""
+    pad = _ints(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # pairs apply to the LAST k dims, innermost (last dim) first
+            k = len(pad) // 2
+            cfg = [(0, 0)] * (nd - k) + [
+                (pad[2 * i], pad[2 * i + 1]) for i in reversed(range(k))
+            ]
+        if data_format in ("NHWC", "NLC", "NDHWC") and len(pad) != 2 * nd and mode != "constant":
+            cfg = [cfg[0]] + cfg[2:] + [cfg[1]]
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return apply("pad", f, _t(x))

@@ -1,0 +1,493 @@
+"""Math ops (python/paddle/tensor/math.py parity), implemented over jnp through the
+autograd tape.  Every op is ``apply(name, jnp_impl, *tensors, **static)`` — the jnp impl
+is what gets traced/compiled by XLA when called under jit, and what jax.vjp
+differentiates in eager mode."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ----------------------------------------------------------------- binary elementwise
+def _binary(name, fn):
+    def op(x, y, name=None):
+        if isinstance(y, Tensor) or isinstance(x, Tensor):
+            pass
+        x = _t(x)
+        if isinstance(y, (int, float, bool, complex)):
+            return apply(name, lambda a: fn(a, y), x)
+        y = _t(y)
+        return apply(name, fn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+pow = _binary("pow", jnp.power)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+copysign = _binary("copysign", jnp.copysign)
+heaviside = _binary("heaviside", jnp.heaviside)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32)))
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+
+divide_ = divide
+true_divide = divide
+
+
+def multiply_(x, y, name=None):
+    return x._in_place(multiply(x, y))
+
+
+def add_(x, y, name=None):
+    return x._in_place(add(x, y))
+
+
+def subtract_(x, y, name=None):
+    return x._in_place(subtract(x, y))
+
+
+# ----------------------------------------------------------------- unary elementwise
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply(name, fn, _t(x))
+
+    op.__name__ = name
+    return op
+
+
+abs = _unary("abs", jnp.abs)
+absolute = abs
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+sgn = sign
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+negative = neg
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+gammaln = lgamma
+i0 = _unary("i0", lambda x: jax.scipy.special.i0(x))
+i0e = _unary("i0e", lambda x: jax.scipy.special.i0e(x))
+i1 = _unary("i1", lambda x: jax.scipy.special.i1(x))
+i1e = _unary("i1e", lambda x: jax.scipy.special.i1e(x))
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+isreal = _unary("isreal", jnp.isreal)
+exponent = _unary("exponent", lambda x: jnp.floor(jnp.log2(jnp.abs(x))))
+
+
+def logit(x, eps=None, name=None):
+    def f(x):
+        xx = jnp.clip(x, eps, 1 - eps) if eps is not None else x
+        return jnp.log(xx / (1 - xx))
+
+    return apply("logit", f, _t(x))
+
+
+def round(x, decimals=0, name=None):
+    return apply("round", lambda a: jnp.round(a, decimals), _t(x))
+
+
+def rint(x, name=None):
+    return apply("rint", jnp.rint, _t(x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        _t(x),
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+def multiplex(inputs, index, name=None):
+    return apply(
+        "multiplex",
+        lambda ins, idx: jnp.stack(ins, 0)[idx.reshape(-1), jnp.arange(ins[0].shape[0])],
+        [_t(i) for i in inputs],
+        _t(index),
+    )
+
+
+# --------------------------------------------------------------------- scale/clip
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = float(scale) if not isinstance(scale, Tensor) else scale
+
+    def f(x, *rest):
+        sc = rest[0] if rest else s
+        return x * sc + bias if bias_after_scale else (x + bias) * sc
+
+    if isinstance(s, Tensor):
+        out = apply("scale", f, _t(x), s)
+    else:
+        out = apply("scale", f, _t(x))
+    return out
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return x._in_place(globals()["scale"](x, scale, bias, bias_after_scale))
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, mn, mx), _t(x))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return x._in_place(clip(x, min, max))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+# ------------------------------------------------------------------- reductions
+def _reduce(name, fn, dtype_arg=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        return apply(name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+    op.__name__ = name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = _dtype.convert_dtype(dtype) if dtype else None
+    return apply(
+        "sum", lambda a: jnp.sum(a, axis=_axis(axis), keepdims=keepdim, dtype=dt), _t(x)
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = _dtype.convert_dtype(dtype) if dtype else None
+    return apply(
+        "prod", lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim, dtype=dt), _t(x)
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("max", lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("min", lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+amax = max
+amin = min
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply("all", lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply("any", lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+# ------------------------------------------------------------------- cumulative
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = _dtype.convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+
+    return apply("cumsum", f, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = _dtype.convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=dt)
+        return jnp.cumprod(a, axis=int(dim), dtype=dt)
+
+    return apply("cumprod", f, _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, aa, axis=ax)
+        n = aa.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == (ax % aa.ndim) else 1 for i in range(aa.ndim)])
+        eq = aa == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(_dtype.convert_dtype(dtype))
+
+    return apply("cummax", f, _t(x))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, aa, axis=ax)
+        n = aa.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == (ax % aa.ndim) else 1 for i in range(aa.ndim)])
+        eq = aa == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(_dtype.convert_dtype(dtype))
+
+    return apply("cummin", f, _t(x))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate as jsi  # noqa: F401
+
+    def f(y, *rest):
+        xx = rest[0] if rest else None
+        d = dx if dx is not None else 1.0
+        yl = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+        yr = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+        if xx is not None:
+            xl = jax.lax.slice_in_dim(xx, 0, xx.shape[axis] - 1, axis=axis)
+            xr = jax.lax.slice_in_dim(xx, 1, xx.shape[axis], axis=axis)
+            d = xr - xl
+        return jnp.cumsum((yl + yr) / 2.0 * d, axis=axis)
+
+    if x is not None:
+        return apply("cumulative_trapezoid", f, _t(y), _t(x))
+    return apply("cumulative_trapezoid", f, _t(y))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    out = cumulative_trapezoid(y, x, dx, axis)
+    return apply("trapezoid_last", lambda a: jax.lax.index_in_dim(a, a.shape[axis] - 1, axis=axis, keepdims=False), out)
+
+
+# ------------------------------------------------------------------------ matmul &co
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", f, _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, _t(x), _t(y))
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, _t(x), _t(vec))
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), _t(input), _t(x), _t(y)
+    )
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return apply("add_n", lambda xs: jax.tree_util.tree_reduce(jnp.add, xs), [_t(i) for i in inputs])
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, _t(x), _t(y))
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            for i, d in enumerate(a.shape):
+                if d == 3:
+                    ax = i
+                    break
+        return jnp.cross(a, b, axis=ax)
+
+    return apply("cross", f, _t(x), _t(y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset, axis1, axis2), _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset, axis1, axis2), _t(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [_t(x)]
+    if prepend is not None:
+        tensors.append(_t(prepend))
+    if append is not None:
+        tensors.append(_t(append))
+
+    def f(a, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None and len(rest) == (2 if prepend is not None else 1) else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", f, *tensors)
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, _t(x))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(a)), float(jnp.max(a)))
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi), density=density)
+        return h if density else h.astype(jnp.int64)
+
+    return apply("histogram", f, _t(input))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply(
+            "bincount", lambda a, w: jnp.bincount(a, w, minlength=minlength), _t(x), _t(weights)
+        )
+    return apply("bincount", lambda a: jnp.bincount(a, minlength=minlength), _t(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    return x._in_place(add(x, value))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        "isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y)
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        "allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y)
+    )
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))

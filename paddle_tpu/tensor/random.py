@@ -1,0 +1,204 @@
+"""Random ops (python/paddle/tensor/random.py parity).
+
+Paddle has a global seed (paddle.seed) with stateful draws; JAX is functional.  Bridge:
+a process-global ``Generator`` holds a jax PRNG key and splits per draw — eager code gets
+Paddle semantics, while jit-traced graphs should thread keys explicitly (the static
+Program path seeds per-run)."""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Tensor
+from paddle_tpu.tensor.creation import _shape, _dt
+
+
+class Generator:
+    """Stateful PRNG bridging Paddle's global-seed model onto jax keys.  Key creation
+    is lazy so that ``import paddle_tpu`` never initializes the jax backend."""
+
+    def __init__(self, seed_=0):
+        self._lock = threading.Lock()
+        self._seed = int(seed_)
+        self._key = None
+
+    def manual_seed(self, s):
+        self._seed = int(s)
+        self._key = None
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        self._ensure()
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
+    def next_key(self):
+        with self._lock:
+            self._ensure()
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(s):
+    """paddle.seed"""
+    default_generator.manual_seed(s)
+    np.random.seed(s % (2**32))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def _key():
+    return default_generator.next_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(_key(), tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        shp = np.broadcast_shapes(
+            tuple(np.shape(m)), tuple(np.shape(s))
+        )
+        return Tensor(jax.random.normal(_key(), shp, _dtype.get_default_dtype()) * s + m)
+    return Tensor(
+        jax.random.normal(_key(), _shape(shape or [1]), _dtype.get_default_dtype()) * std + mean
+    )
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (jax.random.normal(_key(), tuple(x.shape), x.dtype) * std + mean).astype(x.dtype)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    k = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.normal(k, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_gamma(alpha, name=None):
+    a = alpha.data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(_key(), a))
+
+
+def standard_exponential(shape, dtype=None, name=None):
+    return Tensor(jax.random.exponential(_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(_key(), _shape(shape), low, high, _dtype.convert_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dtype.convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.randint(_key(), tuple(x.shape), low, high, jnp.int64).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), n).astype(_dtype.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(_key(), x.data).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_key(), x.data).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count.data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob.data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(_key(), c.astype(jnp.float32), p).astype(jnp.int64))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    if x.data.ndim == 1:
+        out = jax.random.choice(
+            _key(), x.data.shape[0], (num_samples,), replace=replacement,
+            p=x.data / jnp.sum(x.data),
+        )
+        return Tensor(out.astype(jnp.int64))
+    keys = jax.random.split(_key(), x.data.shape[0])
+    outs = [
+        jax.random.choice(k, x.data.shape[1], (num_samples,), replace=replacement,
+                          p=x.data[i] / jnp.sum(x.data[i]))
+        for i, k in enumerate(keys)
+    ]
+    return Tensor(jnp.stack(outs).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(_key(), tuple(x.shape), x.dtype) / lam).astype(x.dtype)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(
+        jnp.exp(jax.random.normal(_key(), _shape(shape or [1]), _dtype.get_default_dtype()) * std + mean)
+    )
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._data = (loc + scale * jax.random.cauchy(_key(), tuple(x.shape), x.dtype)).astype(x.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(_key(), tuple(x.shape), jnp.float32, 1e-7, 1.0)
+    x._data = jnp.ceil(jnp.log(u) / jnp.log1p(-probs)).astype(x.dtype)
+    return x

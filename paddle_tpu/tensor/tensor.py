@@ -1,0 +1,368 @@
+"""Eager Tensor.
+
+The TPU-native analog of ``core.eager.Tensor`` (paddle/fluid/pybind/eager.cc) — a thin
+Python object wrapping one ``jax.Array`` plus autograd metadata (AutogradMeta ≡ the
+``_grad_node``/``_out_index``/``_grad`` fields here).  All math lives in functional
+modules and is monkey-patched on (mirroring python/paddle/tensor/tensor_method_patch).
+
+Paddle semantics preserved:
+  * ``stop_gradient`` defaults to True for raw tensors, False for ``Parameter``.
+  * ``.backward()`` seeds ones and walks the tape; ``.grad`` is a Tensor or None.
+  * ``.numpy()``, ``.item()``, ``astype``, ``clone``/``detach`` behave as in Paddle.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import device as _device
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.autograd import engine as _engine
+
+
+def _to_jax(data, dtype=None, place=None):
+    if isinstance(data, Tensor):
+        arr = data.data
+    elif isinstance(data, jax.Array):
+        arr = data
+    else:
+        if isinstance(data, np.ndarray):
+            np_arr = data
+        elif isinstance(data, (bool, int, float, complex, list, tuple, range)):
+            np_arr = np.asarray(data)
+        else:
+            np_arr = np.asarray(data)
+        if dtype is None:
+            # paddle defaults: python floats -> default float dtype; ints stay int64
+            if np_arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+                np_arr = np_arr.astype(_dtype.get_default_dtype())
+        arr = jnp.asarray(np_arr)
+    if dtype is not None:
+        dt = _dtype.convert_dtype(dtype)
+        if arr.dtype != dt:
+            arr = arr.astype(dt)
+    if place is not None:
+        dev = place.jax_device() if isinstance(place, _device.Place) else place
+        arr = jax.device_put(arr, dev)
+    return arr
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "_grad_hooks",
+        "_retain_grads",
+        "name",
+        "_version",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        self._data = _to_jax(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_hooks = None
+        self._retain_grads = False
+        self.name = name or ""
+        self._version = 0
+
+    # ------------------------------------------------------------------ basics
+    @property
+    def data(self) -> jax.Array:
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = _to_jax(value)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = lambda self: self._data.ndim
+    ndimension = lambda self: self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return _device.current_place()
+        if dev.platform == "cpu":
+            return _device.CPUPlace(dev.id)
+        return _device.TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (value if isinstance(value, Tensor) else Tensor(value))
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={_dtype.dtype_name(self.dtype)}"
+            f"{grad_s},\n       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous."
+            )
+        return bool(self.numpy().item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **kw):
+        return self._data.__dlpack__(*a, **kw)
+
+    # --------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def __init__(h, hooks, fn):
+                h._hooks, h._fn = hooks, fn
+
+            def remove(h):
+                if h._fn in h._hooks:
+                    h._hooks.remove(h._fn)
+
+        return _Handle(self._grad_hooks, hook)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad.data))
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return _engine.apply("clone", jnp.copy, self)
+
+    # ------------------------------------------------------------- conversion
+    def astype(self, dtype) -> "Tensor":
+        dt = _dtype.convert_dtype(dtype)
+        return _engine.apply("cast", lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def _to(self, device=None, dtype=None, blocking=None):
+        arr = self._data
+        if dtype is not None:
+            arr = arr.astype(_dtype.convert_dtype(dtype))
+        if device is not None:
+            place = (
+                device
+                if isinstance(device, _device.Place)
+                else _device._place_from_str(str(device))
+            )
+            arr = jax.device_put(arr, place.jax_device())
+        t = Tensor(arr, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        return t
+
+    def to(self, *args, **kwargs):
+        device = kwargs.pop("device", None)
+        dtype = kwargs.pop("dtype", None)
+        blocking = kwargs.pop("blocking", None)
+        for a in args:
+            if isinstance(a, (str, _device.Place)):
+                s = str(a)
+                if s in _dtype._NAME2DTYPE:
+                    dtype = a
+                else:
+                    device = a
+            elif isinstance(a, np.dtype) or (isinstance(a, type) and issubclass(a, np.generic)):
+                dtype = a
+            elif isinstance(a, bool):
+                blocking = a
+        return self._to(device=device, dtype=dtype, blocking=blocking)
+
+    def cpu(self):
+        return self._to(device="cpu")
+
+    def tpu(self, device_id=0):
+        return self._to(device=f"tpu:{device_id}")
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # ------------------------------------------------------------- in-place
+    def set_value(self, value):
+        new = _to_jax(value)
+        if tuple(new.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {new.shape} vs {self._data.shape}"
+            )
+        self._data = new.astype(self._data.dtype)
+        self._version += 1
+        return self
+
+    def copy_(self, other, blocking=True):
+        self._data = _to_jax(other).astype(self._data.dtype)
+        self._version += 1
+        return self
+
+    def _in_place(self, new_tensor: "Tensor"):
+        """Adopt the result of an out-of-place op as this tensor's new value, keeping
+        autograd correct (the tensor becomes the op's output on the tape)."""
+        self._data = new_tensor._data
+        self._grad_node = new_tensor._grad_node
+        self._out_index = new_tensor._out_index
+        self.stop_gradient = new_tensor.stop_gradient and self.stop_gradient
+        self._version += 1
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        self._version += 1
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # ---------------------------------------------------------------- indexing
+    def __getitem__(self, idx):
+        idx = _clean_index(idx)
+        return _engine.apply("getitem", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _clean_index(idx)
+        if isinstance(value, Tensor):
+            out = _engine.apply(
+                "setitem",
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)),
+                self,
+                value,
+            )
+        else:
+            out = _engine.apply(
+                "setitem", lambda x: x.at[idx].set(value), self
+            )
+        self._in_place(out)
+
+    # pickling -----------------------------------------------------------------
+    def __reduce__(self):
+        return (_rebuild_tensor, (self.numpy(), str(self.dtype), self.stop_gradient, self.name))
+
+
+def _rebuild_tensor(arr, dtype, stop_gradient, name):
+    t = Tensor(arr, dtype=dtype, stop_gradient=stop_gradient)
+    t.name = name
+    return t
+
+
+def _clean_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i.data
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py EagerParamBase)."""
+
+    def __init__(self, data, dtype=None, place=None, trainable=True, name=None):
+        super().__init__(data, dtype=dtype, place=place, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

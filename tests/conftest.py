@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU platform (the reference's fake_cpu_device /
+CustomCPU-plugin testing model, SURVEY.md §4): sharding/collective code paths are
+exercised without TPU hardware.  Set PADDLE_TPU_TEST_REAL=1 to run on the real chip.
+
+NOTE: jax may already be imported at interpreter startup (axon tunnel site hook), so
+env vars are too late here — use jax.config.update, which works until the backend is
+actually initialized.
+"""
+import os
+
+import jax
+
+if os.environ.get("PADDLE_TPU_TEST_REAL", "0") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+# numeric tests compare against float64 numpy references; keep MXU-passes at highest
+# precision (the per-op tolerance policy: bench/perf paths use bf16 explicitly).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

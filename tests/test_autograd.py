@@ -1,0 +1,189 @@
+"""Autograd engine tests: backward walk, accumulation, paddle.grad, hooks, PyLayer,
+double grad (mirrors reference eager AD tests, paddle/fluid/eager/backward.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_multi_use_accumulation(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3 + x * 4  # dy/dx = 7
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_deep_graph(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        y = x
+        for _ in range(20):
+            y = y * 1.1
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.1 ** 20, rtol=1e-5)
+
+    def test_diamond(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        a = x * 2
+        b = a + 1
+        c = a * 3
+        out = (b * c).sum()  # out = (2x+1)(6x); d/dx = 2*6x + (2x+1)*6 = 12x+12x+6 = 24x+6
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [30.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient True
+        out = (x * y).sum()
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_non_scalar_backward_raises(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype("float32")
+        b = np.random.rand(4, 5).astype("float32")
+        x = paddle.to_tensor(a, stop_gradient=False)
+        w = paddle.to_tensor(b, stop_gradient=False)
+        out = paddle.matmul(x, w).sum()
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((3, 5)), rtol=1e-5)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        out = (x + b).sum()
+        out.backward()
+        np.testing.assert_allclose(b.grad.numpy(), [2.0, 2.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward(retain_graph=True)
+        y.backward(retain_graph=False)
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+        @paddle.no_grad()
+        def f(v):
+            return v * 3
+
+        assert f(x).stop_gradient
+
+    def test_int_inputs_not_differentiated(self):
+        x = paddle.to_tensor([1, 2], stop_gradient=False)  # int64
+        y = x + 1
+        assert y.stop_gradient
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad does not populate .grad
+
+    def test_grad_multiple_inputs(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0], stop_gradient=False)
+        z = x * y
+        gx, gy = paddle.grad(z, [x, y])
+        np.testing.assert_allclose(gx.numpy(), [3.0])
+        np.testing.assert_allclose(gy.numpy(), [2.0])
+
+    def test_grad_unused_raises_and_allow_unused(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        z = paddle.to_tensor([5.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z])
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+    def test_double_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x  # y=x^3, y'=3x^2, y''=6x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [12.0])
+
+
+class TestHooks:
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 2)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        h.remove()
+        x.clear_grad()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_pylayer_two_inputs(self):
+        class Mul(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b
+
+            @staticmethod
+            def backward(ctx, gy):
+                a, b = ctx.saved_tensor
+                return gy * b, gy * a
+
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        Mul.apply(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [3.0])
+        np.testing.assert_allclose(b.grad.numpy(), [2.0])

@@ -1,0 +1,200 @@
+"""Tensor basics: creation, dtype, indexing, methods (mirrors the reference's
+test/legacy_test tensor API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert t.shape == [3]
+        assert t.dtype == np.float32
+        np.testing.assert_allclose(t.numpy(), [1, 2, 3])
+
+    def test_int_default_dtype(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert t.dtype == np.int64
+
+    def test_scalar(self):
+        t = paddle.to_tensor(3.14)
+        assert t.shape == []
+        assert abs(t.item() - 3.14) < 1e-6
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == np.int64
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_ops(self):
+        x = paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full_like(x, 2.5).numpy(), np.full((2, 3), 2.5))
+
+    def test_dtype_cast(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        y = x.astype("int32")
+        assert y.dtype == np.int32
+        z = x.astype(paddle.bfloat16)
+        assert z.dtype == paddle.bfloat16
+
+
+class TestMethods:
+    def test_patched_methods(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(x.sum().numpy(), 10.0)
+        np.testing.assert_allclose(x.mean().numpy(), 2.5)
+        np.testing.assert_allclose(x.reshape([4]).numpy(), [1, 2, 3, 4])
+        np.testing.assert_allclose(x.transpose([1, 0]).numpy(), [[1, 3], [2, 4]])
+        np.testing.assert_allclose(x.exp().numpy(), np.exp(x.numpy()), rtol=1e-6)
+        assert x.matmul(x).shape == [2, 2]
+
+    def test_operators(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((x + y).numpy(), [4, 6])
+        np.testing.assert_allclose((x - y).numpy(), [-2, -2])
+        np.testing.assert_allclose((x * y).numpy(), [3, 8])
+        np.testing.assert_allclose((y / x).numpy(), [3, 2])
+        np.testing.assert_allclose((2 - x).numpy(), [1, 0])
+        np.testing.assert_allclose((x ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((x @ y).numpy(), 11.0)
+        assert (x < y).all().item()
+
+    def test_indexing(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        np.testing.assert_allclose(x[0].numpy(), [0, 1, 2, 3])
+        np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 2]])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1, 1] = 5.0
+        assert x.numpy()[1, 1] == 5.0
+        x[0] = paddle.ones([3])
+        np.testing.assert_allclose(x.numpy()[0], [1, 1, 1])
+
+    def test_item_and_shape(self):
+        x = paddle.to_tensor([[1.0]])
+        assert x.item() == 1.0
+        assert x.ndim == 2
+        assert x.size == 1
+
+    def test_clone_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x.detach()
+        assert y.stop_gradient
+        z = x.clone()
+        assert not z.stop_gradient
+
+    def test_pickle_roundtrip(self):
+        import pickle
+
+        x = paddle.to_tensor([[1.0, 2.0]])
+        y = pickle.loads(pickle.dumps(x))
+        np.testing.assert_allclose(x.numpy(), y.numpy())
+
+
+class TestManipulation:
+    def test_concat_stack_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        c = paddle.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.stack([a, b], axis=0)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        parts = paddle.split(c, [1, 3], axis=0)
+        assert parts[1].shape == [3, 3]
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_squeeze_unsqueeze_tile_expand(self):
+        x = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.squeeze(x, axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(x, 0).shape == [1, 1, 3, 1]
+        assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype("float32"))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+        out = paddle.scatter(
+            paddle.zeros([4, 3]), idx, paddle.ones([2, 3]), overwrite=True
+        )
+        assert out.numpy()[0].sum() == 3
+
+    def test_where_topk_sort(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0])
+        v, i = paddle.topk(x, 2)
+        np.testing.assert_allclose(v.numpy(), [3, 2])
+        np.testing.assert_allclose(i.numpy(), [0, 2])
+        np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 2, 3])
+        w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(w.numpy(), [3, 0, 2])
+
+    def test_pad(self):
+        x = paddle.ones([2, 2])
+        y = paddle.tensor.manipulation.pad(x, [1, 1], value=0.0)
+        assert y.shape == [2, 4]
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.rand([4])
+        paddle.seed(7)
+        b = paddle.rand([4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_distributions(self):
+        assert paddle.randn([100]).numpy().std() > 0.3
+        u = paddle.uniform([100], min=0.0, max=1.0)
+        assert 0 <= u.numpy().min() and u.numpy().max() <= 1
+        r = paddle.randint(0, 10, [50])
+        assert r.dtype == np.int64 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestLinalg:
+    def test_matmul_norm_inv(self):
+        a = np.random.rand(3, 3).astype("float32") + np.eye(3, dtype="float32") * 3
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            paddle.matmul(x, x).numpy(), a @ a, rtol=1e-5
+        )
+        np.testing.assert_allclose(paddle.norm(x).numpy(), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(x).numpy(), np.linalg.inv(a), rtol=1e-4, atol=1e-5
+        )
+
+    def test_einsum(self):
+        x = paddle.ones([2, 3])
+        y = paddle.ones([3, 4])
+        out = paddle.einsum("ij,jk->ik", x, y)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 4), 3.0))
+
+    def test_svd_eigh(self):
+        a = np.random.rand(4, 4).astype("float32")
+        sym = a + a.T
+        w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(
+            (v.numpy() @ np.diag(w.numpy()) @ v.numpy().T), sym, rtol=1e-4, atol=1e-4
+        )
