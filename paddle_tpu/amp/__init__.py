@@ -1,0 +1,18 @@
+"""AMP: auto_cast + GradScaler + decorate
+(reference: python/paddle/amp/auto_cast.py:462 amp_guard, grad_scaler.py, amp_lists.py).
+
+TPU-first: the native mixed-precision dtype is bfloat16 (no loss scaling needed — bf16
+has fp32's exponent range).  'float16' requests are honored but bf16 is the default and
+GradScaler degrades to a pass-through unless fp16 is forced.  O1 = white/black-list
+autocast wired into the eager tape; O2 = params cast + master weights in the optimizer.
+"""
+from paddle_tpu.amp.auto_cast import (  # noqa: F401
+    amp_guard,
+    auto_cast,
+    decorate,
+    is_auto_cast_enabled,
+    white_list,
+    black_list,
+)
+from paddle_tpu.amp.grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from paddle_tpu.amp import debugging  # noqa: F401

@@ -1,0 +1,167 @@
+"""auto_cast implementation.
+
+The eager tape (autograd.engine.apply) consults this module's thread-local state before
+dispatching each op: white-list ops get their floating inputs cast to the amp dtype,
+black-list ops to float32 — the same per-op O1 logic the reference generates into every
+``*_ad_func`` via amp_auto_cast.h, done once generically here."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+# reference amp_lists.py: ops that are numerically safe + fast in low precision
+WHITE_LIST = {
+    "matmul", "linear", "bmm", "mm", "mv", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "addmm",
+    "scaled_dot_product_attention", "flash_attention", "lstm", "gru", "rnn_tanh",
+    "simple_rnn_cell", "lstm_cell", "gru_cell",
+}
+# ops kept in fp32 for numeric safety
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "binary_cross_entropy",
+    "bce_with_logits", "kl_div", "mse_loss", "l1_loss", "smooth_l1_loss",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "mean", "sum", "cumsum", "logsumexp", "norm", "softmax_with_cross_entropy",
+    "ctc_loss", "sigmoid_focal_loss", "reciprocal", "cosine_similarity",
+}
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "amp"):
+        _tls.amp = {"enable": False, "dtype": None, "level": "O1",
+                    "white": WHITE_LIST, "black": BLACK_LIST}
+    return _tls.amp
+
+
+def is_auto_cast_enabled():
+    return _state()["enable"]
+
+
+def get_amp_dtype():
+    return _state()["dtype"]
+
+
+def amp_state():
+    return _state()
+
+
+def white_list():
+    return set(_state()["white"])
+
+
+def black_list():
+    return set(_state()["black"])
+
+
+def _resolve_dtype(dtype):
+    from paddle_tpu.core.dtype import bfloat16, convert_dtype, float16
+
+    if dtype is None:
+        return bfloat16
+    d = convert_dtype(dtype)
+    return d
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast context manager."""
+    if level not in ("O0", "OD", "O1", "O2"):
+        raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+    st = _state()
+    prev = dict(st)
+    st["enable"] = enable and level != "O0"
+    st["dtype"] = _resolve_dtype(dtype)
+    st["level"] = level
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    st["white"] = white
+    st["black"] = black
+    try:
+        yield
+    finally:
+        st.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def cast_op_inputs(op_name, leaves):
+    """Called by the eager tape: returns leaves with amp casting applied, or the
+    original list when amp is off / op unlisted."""
+    st = _state()
+    if not st["enable"]:
+        return leaves
+    from paddle_tpu.tensor.tensor import Tensor
+
+    amp_dtype = st["dtype"]
+    level = st["level"]
+    base = op_name.split("_grad")[0]
+    # dtype-management ops must never be re-cast (astype itself dispatches through the
+    # tape — casting its input would recurse forever under O2)
+    if base in ("cast", "clone", "getitem", "setitem"):
+        return leaves
+    in_white = base in st["white"]
+    in_black = base in st["black"]
+    if level == "O2":
+        target = np.dtype("float32") if in_black else amp_dtype
+    else:  # O1/OD
+        if in_white:
+            target = amp_dtype
+        elif in_black:
+            target = np.dtype("float32")
+        else:
+            return leaves
+
+    from paddle_tpu.core.dtype import is_floating_point
+
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor) and is_floating_point(leaf.dtype):
+            if leaf.dtype != target and leaf.dtype != np.dtype("float64"):
+                leaf = leaf.astype(target)
+        out.append(leaf)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate: cast model params to the amp dtype for O2 pure-low-precision
+    training.  Master weights live in the optimizer (fp32 shadows, automatic for
+    low-precision params)."""
+    from paddle_tpu.nn.layer.layers import Layer
+    from paddle_tpu.nn.layer.norm import _BatchNormBase, LayerNorm
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        amp_dtype = _resolve_dtype(dtype)
+        excluded = (_BatchNormBase, LayerNorm)
+        if excluded_layers:
+            excluded = excluded + tuple(
+                l if isinstance(l, type) else type(l) for l in excluded_layers
+            )
+        from paddle_tpu.core.dtype import is_floating_point
+
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and is_floating_point(p.dtype):
+                        p._data = p.data.astype(amp_dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

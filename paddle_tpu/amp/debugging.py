@@ -1,0 +1,98 @@
+"""AMP debugging utilities (reference: python/paddle/amp/debugging.py — tensor
+checker, operator stats collection, nan/inf tracking)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import flags as _flags
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or ())
+        self.skipped_op_list = set(skipped_op_list or ())
+
+
+_checker_config = None
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    global _checker_config
+    _checker_config = config
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    global _checker_config
+    _checker_config = None
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Scan a tensor for nan/inf (the per-op hook behind FLAGS_check_nan_inf)."""
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    if not np.issubdtype(np.dtype(arr.dtype), np.floating):
+        return False
+    a32 = arr.astype(jnp.float32)
+    num_nan = int(jnp.sum(jnp.isnan(a32)))
+    num_inf = int(jnp.sum(jnp.isinf(a32)))
+    if num_nan or num_inf:
+        raise RuntimeError(
+            f"[check_nan_inf] op={op_type} var={var_name}: {num_nan} nan, "
+            f"{num_inf} inf in tensor of shape {list(arr.shape)}"
+        )
+    return False
+
+
+_op_stats = {}
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """paddle.amp.debugging.enable_operator_stats_collection context."""
+    from paddle_tpu.autograd import engine
+
+    _op_stats.clear()
+    orig = engine.apply
+
+    def wrapped(name, fn, *args, **kwargs):
+        out = orig(name, fn, *args, **kwargs)
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                key = (name, str(leaf.dtype))
+                _op_stats[key] = _op_stats.get(key, 0) + 1
+        return out
+
+    engine.apply = wrapped
+    try:
+        yield
+    finally:
+        engine.apply = orig
+
+
+def enable_operator_stats_collection():
+    raise NotImplementedError("use `with collect_operator_stats():` instead")
+
+
+def print_operator_stats():
+    print("<op>  <dtype>  <count>")
+    for (name, dtype), count in sorted(_op_stats.items()):
+        print(f"{name}  {dtype}  {count}")
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename, **kw):
+    raise NotImplementedError("accuracy_compare tooling not yet implemented")

@@ -33,6 +33,7 @@ __all__ = [
 ]
 
 _tls = threading.local()
+_amp_cast = None  # lazily bound to amp.auto_cast.cast_op_inputs
 
 
 def is_grad_enabled() -> bool:
@@ -165,9 +166,11 @@ class GradNode:
 
 
 def _is_diff_dtype(dtype) -> bool:
-    return np.issubdtype(np.dtype(dtype), np.floating) or np.issubdtype(
-        np.dtype(dtype), np.complexfloating
-    )
+    # NOTE: ml_dtypes types (bfloat16, fp8) have numpy kind 'V'; np.issubdtype would
+    # misclassify them, so use the framework's set-based check.
+    from paddle_tpu.core.dtype import is_complex, is_floating_point
+
+    return is_floating_point(dtype) or is_complex(dtype)
 
 
 def apply(name: str, fn: Callable, *args, **kwargs):
@@ -183,6 +186,16 @@ def apply(name: str, fn: Callable, *args, **kwargs):
 
     is_tensor = lambda x: isinstance(x, Tensor)
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=is_tensor)
+
+    global _amp_cast
+    if _amp_cast is None:
+        try:
+            from paddle_tpu.amp.auto_cast import cast_op_inputs as _amp_cast_fn
+
+            _amp_cast = _amp_cast_fn
+        except ImportError:  # pragma: no cover
+            _amp_cast = lambda n, l: l
+    leaves = _amp_cast(name, leaves)
 
     diff_pos = []
     if is_grad_enabled():

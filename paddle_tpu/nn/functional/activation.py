@@ -1,0 +1,202 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _unary(op_name, fn):
+    def op(x, name=None):
+        return apply(op_name, fn, _t(x))
+
+    op.__name__ = op_name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def relu_(x, name=None):
+    return x._in_place(relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._in_place(elu(x, alpha))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply(
+        "selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x)
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        "hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x)
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        _t(x),
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        _t(x),
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from paddle_tpu.core.dtype import convert_dtype
+
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", f, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._in_place(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from paddle_tpu.core.dtype import convert_dtype
+
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply("log_softmax", f, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.tensor.random import _key
+
+    k = _key()
+
+    def f(a):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) if hasattr(jnp, "put_along_axis") else y_hard.at[..., 0].set(0)
+            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            return oh + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply("gumbel_softmax", f, _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        nd = a.ndim
+        if data_format.endswith("C") and nd > 1:
+            shape = [1] * nd
+            shape[-1] = w.size
+        else:
+            shape = [1] * nd
+            if nd > 1:
+                shape[1] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply("prelu", f, _t(x), _t(weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from paddle_tpu.tensor.random import _key
+
+    if not training:
+        return apply("rrelu", lambda a: jnp.where(a > 0, a, a * ((lower + upper) / 2)), _t(x))
+    k = _key()
+    return apply(
+        "rrelu",
+        lambda a: jnp.where(
+            a > 0, a, a * jax.random.uniform(k, a.shape, a.dtype, lower, upper)
+        ),
+        _t(x),
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", f, _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply("glu", f, _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(
+        "thresholded_relu", lambda a: jnp.where(a > threshold, a, value), _t(x)
+    )

@@ -1,0 +1,97 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` is the hot path of every LLM config (reference:
+python/paddle/nn/functional/flash_attention.py over third_party/flashattn).  On TPU the
+fused kernel is a Pallas flash-attention (paddle_tpu.ops.flash_attention); this module
+routes to it when shapes allow, falling back to the XLA-fused naive composition."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
+              dropout_key=None):
+    """[B, L, H, D] layout (paddle flash_attention layout)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    # -> [B, H, L, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
+        scores = jnp.where(cmask, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        else:
+            scores = scores + mask
+    p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention: [batch, seq, heads, head_dim]."""
+    use_dropout = dropout_p > 0.0 and training
+    dk = None
+    if use_dropout:
+        from paddle_tpu.tensor.random import _key
+
+        dk = _key()
+
+    # Fast path: Pallas flash attention (TPU), no mask / causal-only, no dropout.
+    if attn_mask is None and not use_dropout:
+        try:
+            from paddle_tpu.ops.flash_attention import flash_attention_blhd, available
+
+            if available(query.shape):
+                return apply(
+                    "flash_attention",
+                    lambda q, k, v: flash_attention_blhd(q, k, v, causal=is_causal),
+                    _t(query), _t(key), _t(value),
+                )
+        except Exception:
+            pass
+
+    def f(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_ref(q, k, v, m, dropout_p if use_dropout else 0.0, is_causal,
+                         dropout_key=dk)
+
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    return apply("scaled_dot_product_attention", f, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """python/paddle/nn/functional/flash_attention.py: returns (out, softmax)."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+def flash_attn_unpadded(*a, **k):  # pragma: no cover - varlen path
+    raise NotImplementedError("varlen flash attention not yet implemented on TPU")
+
+
+def sparse_attention(*a, **k):  # pragma: no cover
+    raise NotImplementedError

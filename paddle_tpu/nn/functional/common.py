@@ -1,0 +1,353 @@
+"""Common functionals: linear, dropout, embedding, interpolate, fold/unfold, similarity
+(python/paddle/nn/functional/common.py + input.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply, is_grad_enabled
+from paddle_tpu.tensor.manipulation import pad as _pad_op
+from paddle_tpu.tensor.tensor import Tensor
+
+pad = _pad_op  # re-export with paddle.nn.functional.pad semantics
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped [in, out] (reference phi matmul+add fused kernel)."""
+    if bias is not None:
+        return apply(
+            "linear", lambda a, w, b: jnp.matmul(a, w) + b, _t(x), _t(weight), _t(bias)
+        )
+    return apply("linear", jnp.matmul, _t(x), _t(weight))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    from paddle_tpu.tensor.random import _key
+
+    k = _key()
+
+    def f(a):
+        if axis is None:
+            keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = [a.shape[i] if i in axes else 1 for i in range(a.ndim)]
+            keep = jax.random.bernoulli(k, 1.0 - p, tuple(mask_shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply("dropout", f, _t(x))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    from paddle_tpu.tensor.random import _key
+
+    k = _key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        A = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2))).astype(np.float32)
+        B = -A * p * alpha_p
+        return (A * jnp.where(keep, a, alpha_p) + B).astype(a.dtype)
+
+    return apply("alpha_dropout", f, _t(x))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows; padding_idx rows get zero grad (reference embedding_grad kernel)."""
+
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply("embedding", f, _t(x), _t(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        "one_hot", lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), _t(x)
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / l.shape[-1]
+
+    if prior_dist is not None:
+        return apply("label_smooth", f, _t(label), _t(prior_dist))
+    return apply("label_smooth", f, _t(label))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.clip(na * nb, eps, None)
+
+    return apply("cosine_similarity", f, _t(x1), _t(x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim), 1.0 / p
+        )
+
+    return apply("pairwise_distance", f, _t(x), _t(y))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply("normalize", f, _t(x))
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """paddle.nn.functional.interpolate — nearest/bilinear/bicubic/trilinear/area/linear
+    via jax.image.resize (XLA-fusable on TPU)."""
+    x = _t(x)
+    nd = x.ndim
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    spatial = nd - 2
+    in_spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        out_spatial = [int(d * s) for d, s in zip(in_spatial, scale_factor)]
+
+    method = {
+        "nearest": "nearest",
+        "bilinear": "bilinear",
+        "bicubic": "bicubic",
+        "trilinear": "trilinear",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+    if method == "trilinear":
+        method = "linear"
+    linear_family = mode in ("bilinear", "trilinear", "linear", "area")
+
+    def _interp_axis_ac(a, ax, out_size):
+        # align_corners=True 1-D linear interpolation along axis `ax`
+        in_size = a.shape[ax]
+        if out_size == 1 or in_size == 1:
+            coords = jnp.zeros((out_size,))
+        else:
+            coords = jnp.linspace(0.0, in_size - 1.0, out_size)
+        lo = jnp.floor(coords).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, in_size - 1)
+        frac = (coords - lo).astype(a.dtype)
+        a_lo = jnp.take(a, lo, axis=ax)
+        a_hi = jnp.take(a, hi, axis=ax)
+        shape = [1] * a.ndim
+        shape[ax] = -1
+        return a_lo + (a_hi - a_lo) * frac.reshape(shape)
+
+    def f(a):
+        if channel_last:
+            full = (a.shape[0],) + tuple(out_spatial) + (a.shape[-1],)
+        else:
+            full = (a.shape[0], a.shape[1]) + tuple(out_spatial)
+        if mode == "nearest":
+            return jax.image.resize(a, full, method="nearest")
+        if align_corners and linear_family:
+            out = a
+            spatial_axes = (
+                range(1, 1 + len(out_spatial)) if channel_last else range(2, 2 + len(out_spatial))
+            )
+            for i, ax in enumerate(spatial_axes):
+                out = _interp_axis_ac(out, ax, out_spatial[i])
+            return out
+        if align_corners and not linear_family:
+            raise NotImplementedError(
+                "align_corners=True is only supported for linear/bilinear/trilinear "
+                "modes on TPU"
+            )
+        return jax.image.resize(a, full, method=method)
+
+    return apply("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            a = a.reshape(n, oc, r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, h, w, r, r, oc)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, oc)
+
+    return apply("pixel_shuffle", f, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 2, 4, 5, 1, 3)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply("pixel_unshuffle", f, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply("channel_shuffle", f, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (NCHW) -> [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pads = [paddings] * 4
+    elif len(paddings) == 2:
+        pads = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    else:
+        pads = list(paddings)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+        oh = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = a[:, :, i * dh : i * dh + oh * sh : sh, j * dw : j * dw + ow * sw : sw]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+        return out.reshape(n, c * kh * kw, oh * ow)
+
+    return apply("unfold", f, _t(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    oh_, ow_ = (output_sizes, output_sizes) if isinstance(output_sizes, int) else output_sizes
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pads = [paddings] * 4
+    elif len(paddings) == 2:
+        pads = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    else:
+        pads = list(paddings)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        ph, pw = oh_ + pads[0] + pads[2], ow_ + pads[1] + pads[3]
+        noh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+        now = (pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, noh, now)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh : i * dh + noh * sh : sh, j * dw : j * dw + now * sw : sw].add(a[:, :, i, j])
+        return out[:, :, pads[0] : ph - pads[2], pads[1] : pw - pads[3]]
+
+    return apply("fold", f, _t(x))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("bilinear", f, *args)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+
+    x = _t(x)
+    ml = maxlen if maxlen is not None else int(np.max(x.numpy()))
+
+    def f(a):
+        r = jnp.arange(ml)
+        return (r[None, :] < a[..., None]).astype(convert_dtype(dtype))
+
+    return apply("sequence_mask", f, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-era API, not yet implemented")

@@ -1,0 +1,147 @@
+"""Convolution functionals over jax.lax.conv_general_dilated — XLA maps these directly
+onto the MXU (reference: paddle/phi/kernels/gpu/conv_kernel.cu et al.)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # nested [[lo,hi],...]
+    return [(int(p[0]), int(p[1])) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format,
+             transpose=False, output_padding=0):
+    sp = "DHW"[3 - n :]
+    if data_format in (f"NC{sp}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + sp
+    else:
+        lhs_spec = "N" + sp + "C"
+    rhs_spec = "OI" + sp  # paddle kernel layout [out_c, in_c/groups, *k]
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec)
+    )
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    pad = _padding(padding, n)
+
+    if not transpose:
+        def f(a, w, *rest):
+            out = jax.lax.conv_general_dilated(
+                a, w, strides, pad,
+                lhs_dilation=(1,) * n,
+                rhs_dilation=dilations,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+            if rest:
+                b = rest[0]
+                bshape = [1] * out.ndim
+                bshape[lhs_spec.index("C")] = b.shape[0]
+                out = out + b.reshape(bshape)
+            return out
+    else:
+        opad = _tuple(output_padding, n)
+
+        def f(a, w, *rest):
+            # conv_transpose = lhs-dilated conv with flipped kernel, swapped I/O chans.
+            k_sp = [w.shape[2 + i] for i in range(n)]
+            if isinstance(pad, str):
+                pads = [(0, 0)] * n if pad == "VALID" else None
+                if pads is None:
+                    raise ValueError("SAME padding unsupported for transpose conv")
+            else:
+                pads = pad
+            tpad = [
+                (dilations[i] * (k_sp[i] - 1) - pads[i][0],
+                 dilations[i] * (k_sp[i] - 1) - pads[i][1] + opad[i])
+                for i in range(n)
+            ]
+            # weight [in_c, out_c/groups, *k] for paddle transpose layout
+            w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            if groups > 1:
+                ic, ocg = w.shape[0], w.shape[1]
+                w_g = w_flip.reshape((groups, ic // groups, ocg) + tuple(k_sp))
+                w_g = jnp.swapaxes(w_g, 1, 2)
+                w_t = w_g.reshape((groups * ocg, ic // groups) + tuple(k_sp))
+            else:
+                w_t = jnp.swapaxes(w_flip, 0, 1)
+            dn2 = jax.lax.conv_dimension_numbers(
+                tuple(a.shape), tuple(w_t.shape), (lhs_spec, rhs_spec, out_spec)
+            )
+            out = jax.lax.conv_general_dilated(
+                a, w_t, (1,) * n, tpad,
+                lhs_dilation=strides,
+                rhs_dilation=dilations,
+                dimension_numbers=dn2,
+                feature_group_count=groups,
+            )
+            if rest:
+                b = rest[0]
+                bshape = [1] * out.ndim
+                bshape[lhs_spec.index("C")] = b.shape[0]
+                out = out + b.reshape(bshape)
+            return out
+
+    args = [_t(x), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("conv%dd%s" % (n, "_transpose" if transpose else ""), f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format,
+                    transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format,
+                    transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format,
+                    transpose=True, output_padding=output_padding)

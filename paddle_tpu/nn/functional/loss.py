@@ -1,0 +1,417 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """paddle.nn.functional.cross_entropy (softmax_with_cross_entropy fused kernel)."""
+
+    def f(logits, lab, *rest):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            if rest:  # per-class weights apply inside the soft sum
+                w = rest[0]
+                wshape = [1] * logp.ndim
+                wshape[axis % logp.ndim] = -1
+                logp = logp * w.reshape(wshape)
+            per = -jnp.sum(soft * logp, axis=axis)
+            valid = jnp.ones_like(per, dtype=bool)
+        else:
+            idx = lab
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            valid = idx != ignore_index
+            safe = jnp.where(valid, idx, 0).astype(jnp.int32)
+            picked = jnp.take_along_axis(
+                logp, safe[..., None].astype(jnp.int32), axis=axis
+            )[..., 0]
+            if label_smoothing > 0:
+                smooth_term = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth_term
+            per = -jnp.where(valid, picked, 0.0)
+            if rest:  # class weights
+                w = rest[0]
+                wsel = jnp.where(valid, jnp.take(w, safe, axis=0), 0.0)
+                per = per * wsel
+                if reduction == "mean":
+                    return jnp.sum(per) / jnp.clip(jnp.sum(wsel), 1e-10, None)
+        if reduction == "mean":
+            denom = jnp.clip(jnp.sum(valid.astype(per.dtype)), 1.0, None)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from paddle_tpu.nn.functional.activation import softmax as _softmax
+    from paddle_tpu.tensor.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, *rest):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0] if logp.ndim == lab.ndim + 1 else jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        per = -jnp.where(valid, picked, 0.0)
+        if rest:
+            w = rest[0]
+            wsel = jnp.where(valid, jnp.take(w, safe, axis=0), 0.0)
+            per = per * wsel
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.clip(jnp.sum(wsel), 1e-10, None)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.clip(jnp.sum(valid.astype(per.dtype)), 1.0, None)
+        return _reduce(per, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("nll_loss", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(
+        "mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), _t(input), _t(label)
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label)
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        v = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(v, reduction)
+
+    return apply("smooth_l1_loss", f, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        v = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            v = v * rest[0]
+        return _reduce(v, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        it = iter(rest)
+        max_val = jnp.clip(-z, 0, None)
+        if pos_weight is not None:
+            pw = next(it) if weight is None else rest[-1]
+            log_w = (pw - 1) * y + 1
+            v = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            v = (1 - y) * z + jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val
+        if weight is not None:
+            v = v * rest[0]
+        return _reduce(v, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, q):
+        if log_target:
+            v = jnp.exp(q) * (q - logp)
+        else:
+            v = q * (jnp.log(jnp.clip(q, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(v) / logp.shape[0]
+        return _reduce(v, reduction)
+
+    return apply("kl_div", f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.clip(-y * (a - b) + margin, 0, None), reduction),
+        _t(input), _t(other), _t(label),
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        "hinge_embedding_loss",
+        lambda a, y: _reduce(
+            jnp.where(y == 1, a, jnp.clip(margin - a, 0, None)), reduction
+        ),
+        _t(input), _t(label),
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.clip(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12, None
+        )
+        v = jnp.where(y == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(v, reduction)
+
+    return apply("cosine_embedding_loss", f, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v + epsilon), p), -1), 1 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
+
+    return apply("triplet_margin_loss", f, _t(input), _t(positive), _t(negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin, swap=swap,
+                                   reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        pn = distance_function(positive, negative)
+        from paddle_tpu.tensor.math import minimum
+
+        dn = minimum(dn, pn)
+    return apply(
+        "triplet_margin_with_distance_loss",
+        lambda a, b: _reduce(jnp.clip(a - b + margin, 0, None), reduction),
+        dp, dn,
+    )
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(z, y, *rest):
+        v = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        v = jnp.mean(v, axis=-1)
+        if rest:
+            v = v * rest[0]
+        return _reduce(v, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("multi_label_soft_margin_loss", f, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply(
+        "soft_margin_loss",
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        _t(input), _t(label),
+    )
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        _t(input), _t(label),
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+    Reference uses warpctc (third_party/warpctc); this is the XLA-native equivalent."""
+
+    def f(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] (paddle layout), lab: [B, S]
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq: blank, l1, blank, l2, ... blank  -> 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        ext_len = 2 * lab_len + 1
+        neg_inf = -1e30
+        # alpha init
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = jnp.where(lab_len > 0, lab[:, 0], blank)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(B), first_lab], neg_inf)
+        )
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_prev2 = jnp.where(same_as_prev2, neg_inf, a_prev2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None] & (t > 0), new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(T))
+        idx_last = jnp.clip(ext_len - 1, 0, 2 * S)
+        idx_prev = jnp.clip(ext_len - 2, 0, 2 * S)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0],
+            jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0],
+        )
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.clip(in_len.astype(loss.dtype), 1, None)
+        return _reduce(loss, reduction)
+
+    return apply(
+        "ctc_loss", f, _t(log_probs), _t(labels), _t(input_lengths), _t(label_lengths)
+    )
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = 2.0 * jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.clip(z, 0, None) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        pt = p * y + (1 - p) * (1 - y)
+        at = alpha * y + (1 - alpha) * (1 - y)
+        v = at * jnp.power(1 - pt, gamma) * ce
+        if rest:
+            v = v / rest[0]
+        return _reduce(v, reduction)
+
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply("sigmoid_focal_loss", f, *args)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.clip(var, epsilon, None)
+        v = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            v = v + 0.5 * np.log(2 * np.pi)
+        return _reduce(v, reduction)
+
+    return apply("gaussian_nll_loss", f, _t(input), _t(label), _t(variance))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, y):
+        if log_input:
+            v = jnp.exp(z) - y * z
+        else:
+            v = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * np.pi * (y + epsilon))
+            v = v + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(v, reduction)
+
+    return apply("poisson_nll_loss", f, _t(input), _t(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1)) + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+        sim = a @ p.T
+        ymat = (y[:, None] == y[None, :]).astype(sim.dtype)
+        ymat = ymat / jnp.sum(ymat, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(ymat * logp, 1))
+        return ce + reg
+
+    return apply("npair_loss", f, _t(anchor), _t(positive), _t(labels))
+
+
+def mv_loss(*a, **k):  # pragma: no cover
+    raise NotImplementedError

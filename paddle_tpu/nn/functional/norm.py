@@ -1,0 +1,190 @@
+"""Normalization functionals (reference: phi batch_norm/layer_norm/group_norm kernels +
+python/paddle/nn/functional/norm.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply, no_grad
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Functional batch norm.  In training mode, running stats are updated in place on
+    the provided Tensors (Paddle semantics: r = m*r + (1-m)*batch_stat)."""
+    x = _t(x)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        with no_grad():
+            bm = jnp.mean(x.data, axis=axes)
+            bv = jnp.var(x.data, axis=axes)
+            running_mean._data = (momentum * running_mean.data + (1 - momentum) * bm).astype(running_mean.dtype)
+            running_var._data = (momentum * running_var.data + (1 - momentum) * bv).astype(running_var.dtype)
+
+    def f(a, *rest):
+        it = iter(rest)
+        if use_batch:
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+        else:
+            shape = [1] * a.ndim
+            shape[channel_axis] = -1
+            m = next(it).reshape(shape)
+            v = next(it).reshape(shape)
+        y = (a - m) * jax.lax.rsqrt(v + epsilon)
+        shape = [1] * a.ndim
+        shape[channel_axis] = -1
+        if weight is not None:
+            y = y * next(it).reshape(shape)
+        if bias is not None:
+            y = y + next(it).reshape(shape)
+        return y
+
+    args = [x]
+    if not use_batch:
+        args += [_t(running_mean), _t(running_var)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("batch_norm", f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n = len(normalized_shape)
+
+    def f(a, *rest):
+        axes = tuple(range(a.ndim - n, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        y = (a - m) * jax.lax.rsqrt(v + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            y = y * next(it)
+        if bias is not None:
+            y = y + next(it)
+        return y
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (paddle.incubate.nn.functional.fused_rms_norm analog) — the LLM-stack
+    hot op; fused by XLA, with a Pallas kernel in ops/pallas for long rows."""
+
+    def f(a, *rest):
+        v = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = (a.astype(jnp.float32) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+        if rest:
+            y = y * rest[0]
+        return y
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("rms_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *rest):
+        channel_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        if channel_axis != 1:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        r = a.reshape((n, g, c // g) + a.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        y = ((r - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        it = iter(rest)
+        shape = [1] * a.ndim
+        shape[1] = c
+        if weight is not None:
+            y = y * next(it).reshape(shape)
+        if bias is not None:
+            y = y + next(it).reshape(shape)
+        if channel_axis != 1:
+            y = jnp.moveaxis(y, 1, -1)
+        return y
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("group_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    def f(a, *rest):
+        channel_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        axes = tuple(i for i in range(2, a.ndim)) if channel_axis == 1 else tuple(
+            i for i in range(1, a.ndim - 1)
+        )
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        y = (a - m) * jax.lax.rsqrt(v + eps)
+        it = iter(rest)
+        shape = [1] * a.ndim
+        shape[channel_axis] = a.shape[channel_axis]
+        if weight is not None:
+            y = y * next(it).reshape(shape)
+        if bias is not None:
+            y = y + next(it).reshape(shape)
+        return y
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("instance_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        channel_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        am = jnp.moveaxis(sq, channel_axis, -1)
+        c = am.shape[-1]
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(am, [(0, 0)] * (am.ndim - 1) + [(pad_lo, pad_hi)])
+        win = sum(
+            jax.lax.slice_in_dim(padded, i, i + c, axis=-1) for i in range(size)
+        )
+        div = jnp.power(k + alpha * win, beta)
+        return a / jnp.moveaxis(div, -1, channel_axis)
+
+    return apply("local_response_norm", f, _t(x))

@@ -1,0 +1,280 @@
+"""Pooling functionals over jax.lax.reduce_window (reference: phi pool kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [(int(p[0]), int(p[1])) for p in padding]
+
+
+def _ceil_extra(size, k, s, lo, hi):
+    """Extra high-side padding so reduce_window emits the ceil-mode output size."""
+    eff = size + lo + hi
+    out_floor = (eff - k) // s + 1
+    out_ceil = -(-(eff - k) // s) + 1
+    if out_ceil > out_floor:
+        return (out_ceil - 1) * s + k - eff
+    return 0
+
+
+def _pool(x, kernel, stride, padding, n, op, data_format, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pads(padding, n)
+    sp = "DHW"[3 - n :]
+    channel_last = data_format in (f"N{sp}C", "NHWC", "NLC", "NDHWC")
+
+    def f(a):
+        pp = pad
+        if not isinstance(pp, str):
+            if ceil_mode:
+                spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+                pp = [
+                    (lo, hi + _ceil_extra(spatial[i], kernel[i], stride[i], lo, hi))
+                    for i, (lo, hi) in enumerate(pp)
+                ]
+            if channel_last:
+                pp = [(0, 0)] + pp + [(0, 0)]
+            else:
+                pp = [(0, 0), (0, 0)] + pp
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pp)
+        # avg
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pp)
+        if exclusive and not count_include_pad and not isinstance(pp, str):
+            ones = jnp.ones(a.shape, a.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pp)
+            return s / cnt
+        return s / float(np.prod(kernel))
+
+    return apply(f"{op}_pool{n}d", f, _t(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, data_format)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format)
+    return out
+
+
+def _pool_mask(x, out, kernel, stride, padding, n, data_format):
+    """Argmax indices (flattened over the spatial plane of the UNPADDED input),
+    matching paddle's max_pool return_mask semantics."""
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pads(padding, n)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * n
+    sp = "DHW"[3 - n :]
+    channel_last = data_format in (f"N{sp}C",)
+    a = x.data if isinstance(x, Tensor) else x
+    if channel_last:
+        a = jnp.moveaxis(a, -1, 1)
+    spatial = a.shape[2:]
+    neg = jnp.asarray(-jnp.inf, a.dtype)
+    padded = jnp.pad(a, [(0, 0), (0, 0)] + [(lo, hi) for lo, hi in pad],
+                     constant_values=neg)
+    out_spatial = tuple(out.shape[2:]) if not channel_last else tuple(out.shape[1:-1])
+    # ceil_mode in _pool may imply windows past the padded edge; extend to cover
+    extra = [
+        max(0, (out_spatial[d] - 1) * stride[d] + kernel[d] - padded.shape[2 + d])
+        for d in range(n)
+    ]
+    if any(extra):
+        padded = jnp.pad(padded, [(0, 0), (0, 0)] + [(0, e) for e in extra],
+                         constant_values=neg)
+    # extract each in-window offset as a strided slice -> [N, C, prod(k), *out_spatial]
+    import itertools
+
+    slices = []
+    flat_rows = []  # absolute flat index (unpadded plane) per offset per position
+    for offs in itertools.product(*[range(k) for k in kernel]):
+        idx = [slice(None), slice(None)]
+        coord_axes = []
+        for d, o in enumerate(offs):
+            start = o
+            stop = o + (out_spatial[d] - 1) * stride[d] + 1
+            idx.append(slice(start, stop, stride[d]))
+            pos = jnp.arange(out_spatial[d]) * stride[d] + o - pad[d][0]
+            coord_axes.append(pos)
+        slices.append(padded[tuple(idx)])
+        flat = 0
+        for d in range(n):
+            shape = [1] * n
+            shape[d] = -1
+            flat = flat * spatial[d] + coord_axes[d].reshape(shape)
+        flat_rows.append(jnp.broadcast_to(flat, out_spatial))
+    stacked = jnp.stack(slices, axis=2)  # [N, C, K, *out]
+    winner = jnp.argmax(stacked, axis=2)  # [N, C, *out]
+    flat_idx = jnp.stack(flat_rows, axis=0)  # [K, *out]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(flat_idx, stacked.shape[:2] + flat_idx.shape),
+        winner[:, :, None], axis=2,
+    )[:, :, 0]
+    if channel_last:
+        mask = jnp.moveaxis(mask, 1, -1)
+    return Tensor(mask.astype(jnp.int64))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode, exclusive)
+
+
+def _adaptive(x, output_size, n, op, data_format):
+    output_size = _tuple(output_size, n)
+    sp = "DHW"[3 - n :]
+    channel_last = data_format in (f"N{sp}C",)
+
+    def f(a):
+        spatial_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for i, ax in enumerate(spatial_axes):
+            tgt = output_size[i]
+            if tgt is None:
+                continue
+            size = out.shape[ax]
+            if size % tgt == 0:
+                k = size // tgt
+                new_shape = out.shape[:ax] + (tgt, k) + out.shape[ax + 1 :]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if op == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # general case: per-output-bin gather (start/end per bin)
+                starts = [int(np.floor(j * size / tgt)) for j in range(tgt)]
+                ends = [int(np.ceil((j + 1) * size / tgt)) for j in range(tgt)]
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if op == "max" else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(f"adaptive_{op}_pool{n}d", f, _t(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def _adaptive_max_with_mask(x, output_size, n, data_format):
+    out = _adaptive(x, output_size, n, "max", data_format)
+    sizes = _tuple(output_size, n)
+    in_spatial = tuple(x.shape[2:]) if data_format.startswith("NC") else tuple(x.shape[1:-1])
+    if any(s % t != 0 for s, t in zip(in_spatial, sizes)):
+        raise NotImplementedError(
+            "adaptive_max_pool return_mask requires input sizes divisible by "
+            "output_size on TPU"
+        )
+    kernel = tuple(s // t for s, t in zip(in_spatial, sizes))
+    mask = _pool_mask(x, out, kernel, kernel, 0, n, data_format)
+    return out, mask
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 1, "NCL")
+    return _adaptive(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 2, "NCHW")
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 3, "NCDHW")
+    return _adaptive(x, output_size, 3, "max", "NCDHW")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    p = float(norm_type)
+    xt = _t(x)
+    powed = apply("lp_pow", lambda a: jnp.power(jnp.abs(a), p), xt)
+    s = _pool(powed, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode,
+              exclusive=False)
+    k = kernel_size if isinstance(kernel_size, int) else int(np.prod(kernel_size))
+    return apply("lp_root", lambda a: jnp.power(a * k, 1.0 / p), s)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+    xt = _t(x)
+    powed = apply("lp_pow", lambda a: jnp.power(jnp.abs(a), p), xt)
+    s = _pool(powed, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode,
+              exclusive=False)
+    k = kernel_size ** 2 if isinstance(kernel_size, int) else int(np.prod(_tuple(kernel_size, 2)))
+    return apply("lp_root", lambda a: jnp.power(a * k, 1.0 / p), s)

@@ -1,0 +1,418 @@
+"""Layer base class (reference: python/paddle/nn/layer/layers.py:354 ``class Layer``).
+
+Same user contract as the reference — parameter/buffer/sublayer registries, hooks,
+``state_dict``, ``to()``, train/eval — while parameters are ``Parameter`` tensors whose
+storage is jax.Arrays, so a Layer doubles as a pytree-of-arrays provider for jit/pjit
+paths (``functional_state`` / ``functional_call`` below are the TPU-native addition that
+static mode and pipelining build on)."""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import numpy as np
+
+from paddle_tpu.autograd import engine as _engine
+from paddle_tpu.core import dtype as _dtype
+from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+
+class ParamAttr:
+    """python/paddle/base/param_attr.py — declarative parameter config."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        do_model_average=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if callable(attr):  # bare initializer
+            return ParamAttr(initializer=attr)
+        return ParamAttr()
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+_layer_name_counters = collections.defaultdict(int)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = _dtype.convert_dtype(dtype)
+        cls_name = self.__class__.__name__.lower()
+        _layer_name_counters[cls_name] += 1
+        self._full_name = name_scope or f"{cls_name}_{_layer_name_counters[cls_name]}"
+        self._parameters = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._sub_layers = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------ registration
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            params[name] = value
+            buffers and buffers.pop(name, None)
+            layers and layers.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__() before assigning sublayers")
+            layers[name] = value
+            params and params.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra += list(d)
+        return list(super().__dir__()) + extra
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            raise TypeError("register_buffer expects a Tensor")
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        elif name in self._non_persistable_buffer_names_set:
+            self._non_persistable_buffer_names_set.remove(name)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        """Create + initialize a Parameter (layers.py create_parameter)."""
+        from paddle_tpu.nn import initializer as I
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = _dtype.convert_dtype(dtype) if dtype else self._dtype
+        import jax.numpy as jnp
+
+        auto_name = attr.name or (
+            f"{self._full_name}.{'b' if is_bias else 'w'}_{len(self._parameters)}"
+        )
+        p = Parameter(
+            jnp.zeros(tuple(int(s) for s in shape), dtype),
+            trainable=attr.trainable,
+            name=auto_name,
+        )
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        with _engine.no_grad():
+            init(p)
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros((), _dtype.convert_dtype(dtype) if dtype else self._dtype))
+
+    # ------------------------------------------------------------- iteration
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def children(self):
+        return [l for _, l in self.named_children()]
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------- run modes
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # --------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # --------------------------------------------------------------- calling
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # --------------------------------------------------------------- state
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            # skip non-persistable buffers
+            owner = self
+            if "." in name:
+                for part in name.split(".")[:-1]:
+                    owner = owner._sub_layers.get(part, owner) if hasattr(owner, "_sub_layers") else owner
+            if short in getattr(owner, "_non_persistable_buffer_names_set", ()):
+                continue
+            dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        import jax.numpy as jnp
+
+        missing, unexpected = [], []
+        own = self.state_dict()
+        for name, t in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            v = state_dict[name]
+            arr = v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(arr.shape) != tuple(t.data.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loading {tuple(arr.shape)} into "
+                    f"{tuple(t.data.shape)}"
+                )
+            t._data = arr.astype(t.data.dtype)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+
+        from paddle_tpu.core import device as _device
+
+        if dtype is not None:
+            dtype = _dtype.convert_dtype(dtype)
+        dev = None
+        if device is not None:
+            place = (
+                device
+                if isinstance(device, _device.Place)
+                else _device._place_from_str(str(device))
+            )
+            dev = place.jax_device()
+        for t in list(self.parameters()) + list(self.buffers()):
+            arr = t.data
+            if dtype is not None and _dtype.is_floating_point(arr.dtype):
+                arr = arr.astype(dtype)
+            if dev is not None:
+                arr = jax.device_put(arr, dev)
+            t._data = arr
+        if dtype is not None:
+            self._dtype = dtype
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # --------------------------------------------------- TPU-native additions
+    def functional_state(self):
+        """Return (param_arrays, buffer_arrays) as flat name->jax.Array dicts — the
+        pytree handed to jit/pjit-compiled training steps."""
+        params = {n: p.data for n, p in self.named_parameters()}
+        buffers = {n: b.data for n, b in self.named_buffers()}
+        return params, buffers
+
+    def load_functional_state(self, params=None, buffers=None):
+        if params:
+            for n, p in self.named_parameters():
+                if n in params:
+                    p._data = params[n]
+        if buffers:
+            for n, b in self.named_buffers():
+                if n in buffers:
+                    b._data = buffers[n]
+
+    def functional_call(self, params, buffers, *inputs, **kwargs):
+        """Run forward with parameter/buffer values swapped in from flat dicts (pure
+        w.r.t. the passed arrays) — used by jit/static/pipeline paths to turn this
+        stateful Layer into a jax-transformable function."""
+        old_p = {n: p._data for n, p in self.named_parameters()}
+        old_b = {n: b._data for n, b in self.named_buffers()}
+        try:
+            self.load_functional_state(params, buffers)
+            return self(*inputs, **kwargs)
+        finally:
+            self.load_functional_state(old_p, old_b)
+
+    def __repr__(self):
+        extra = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n".join(
+                ["  " + line for line in mod_str.split("\n")]
+            )
+            extra.append(f"  ({name}): {mod_str.strip()}")
+        main = self.__class__.__name__
+        if extra:
+            return main + "(\n" + "\n".join(extra) + "\n)"
+        return main + "()"

@@ -1,0 +1,376 @@
+"""RNN layers (python/paddle/nn/layer/rnn.py parity): SimpleRNN / LSTM / GRU + cells.
+
+The time loop is ONE ``jax.lax.scan`` per layer/direction inside a single tape op —
+compiler-friendly control flow on TPU (vs. the reference's fused cudnn RNN kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _uniform_init(shape, hidden_size):
+    from paddle_tpu.tensor.random import _key
+
+    std = 1.0 / np.sqrt(hidden_size)
+    return jax.random.uniform(_key(), tuple(shape), jnp.float32, -std, std)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = I.Assign(_uniform_init([hidden_size, input_size], hidden_size))
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Assign(_uniform_init([hidden_size, hidden_size], hidden_size)))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([hidden_size], hidden_size)))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([hidden_size], hidden_size)))
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh, activation="tanh"):
+        z = x @ wih.T + bih + h @ whh.T + bhh
+        return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wih, whh, bih, bhh):
+            nh = self._step(x, h, wih, whh, bih, bhh, self.activation)
+            return nh, nh
+
+        out, h = apply("simple_rnn_cell", f, _t(inputs), _t(states), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=I.Assign(_uniform_init([4 * hidden_size, input_size], hidden_size)))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Assign(_uniform_init([4 * hidden_size, hidden_size], hidden_size)))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([4 * hidden_size], hidden_size)))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([4 * hidden_size], hidden_size)))
+
+    @staticmethod
+    def _step(x, h, c, wih, whh, bih, bhh):
+        gates = x @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        nc = f * c + i * g
+        nh = o * jnp.tanh(nc)
+        return nh, nc
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs), self.get_initial_states(inputs))
+        h, c = states
+
+        def f(x, h, c, wih, whh, bih, bhh):
+            nh, nc = self._step(x, h, c, wih, whh, bih, bhh)
+            return nh, (nh, nc)
+
+        out, new_states = apply("lstm_cell", f, _t(inputs), _t(h), _t(c),
+                                self.weight_ih, self.weight_hh, self.bias_ih,
+                                self.bias_hh)
+        return out, new_states
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=I.Assign(_uniform_init([3 * hidden_size, input_size], hidden_size)))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Assign(_uniform_init([3 * hidden_size, hidden_size], hidden_size)))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([3 * hidden_size], hidden_size)))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Assign(_uniform_init([3 * hidden_size], hidden_size)))
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh):
+        xg = x @ wih.T + bih
+        hg = h @ whh.T + bhh
+        xr, xz, xn = jnp.split(xg, 3, axis=-1)
+        hr, hz, hn = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1 - z) * n + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wih, whh, bih, bhh):
+            nh = self._step(x, h, wih, whh, bih, bhh)
+            return nh, nh
+
+        out, h = apply("gru_cell", f, _t(inputs), _t(states), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu.tensor.manipulation as M
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for tt in rng:
+            x_t = M.squeeze(
+                M.slice(inputs, [time_axis], [tt], [tt + 1]), axis=time_axis
+            )
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = M.stack(outputs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu.tensor.manipulation as M
+
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        return M.concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Stacked multi-layer (bi)directional RNN with ONE lax.scan per layer*direction."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 activation=None, proj_size=0, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        g = {"LSTM": 4, "GRU": 3}.get(self.MODE.split("_")[0], 1)
+        self._gate_mult = g
+        self.activation = activation or ("tanh" if self.MODE == "RNN_TANH" else "relu")
+
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                wih = self.create_parameter(
+                    [g * hidden_size, in_size], weight_ih_attr,
+                    default_initializer=I.Assign(_uniform_init([g * hidden_size, in_size], hidden_size)))
+                whh = self.create_parameter(
+                    [g * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=I.Assign(_uniform_init([g * hidden_size, hidden_size], hidden_size)))
+                bih = self.create_parameter(
+                    [g * hidden_size], bias_ih_attr, is_bias=True,
+                    default_initializer=I.Assign(_uniform_init([g * hidden_size], hidden_size)))
+                bhh = self.create_parameter(
+                    [g * hidden_size], bias_hh_attr, is_bias=True,
+                    default_initializer=I.Assign(_uniform_init([g * hidden_size], hidden_size)))
+                self.add_parameter(f"weight_ih{sfx}", wih)
+                self.add_parameter(f"weight_hh{sfx}", whh)
+                self.add_parameter(f"bias_ih{sfx}", bih)
+                self.add_parameter(f"bias_hh{sfx}", bhh)
+                self._all_weights.append((f"weight_ih{sfx}", f"weight_hh{sfx}",
+                                          f"bias_ih{sfx}", f"bias_hh{sfx}"))
+
+    def _cell_scan(self, mode, activation):
+        is_lstm = mode == "LSTM"
+
+        def run(x_seq, h0, c0, wih, whh, bih, bhh, reverse):
+            # x_seq: [T, B, I] (time-major inside)
+            xs = jnp.flip(x_seq, 0) if reverse else x_seq
+
+            def step(carry, x):
+                if is_lstm:
+                    h, c = carry
+                    nh, nc = LSTMCell._step(x, h, c, wih, whh, bih, bhh)
+                    return (nh, nc), nh
+                h = carry
+                if mode == "GRU":
+                    nh = GRUCell._step(x, h, wih, whh, bih, bhh)
+                else:
+                    nh = SimpleRNNCell._step(x, h, wih, whh, bih, bhh, activation)
+                return nh, nh
+
+            init = (h0, c0) if is_lstm else h0
+            last, ys = jax.lax.scan(step, init, xs)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            return last, ys
+
+        return run
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE.split("_")[0]
+        is_lstm = mode == "LSTM"
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        run = self._cell_scan(mode, self.activation)
+        weights = [self._parameters[n] for group in self._all_weights for n in group]
+
+        st_tensors = []
+        if initial_states is not None:
+            if is_lstm:
+                st_tensors = [initial_states[0], initial_states[1]]
+            else:
+                st_tensors = [initial_states]
+
+        time_major = self.time_major
+        dropout = self.dropout
+        training = self.training
+        dk = None
+        if dropout > 0 and training and nl > 1:
+            from paddle_tpu.tensor.random import _key
+
+            dk = _key()
+
+        def f(x, *rest):
+            it = iter(rest)
+            if initial_states is not None:
+                if is_lstm:
+                    h0_all, c0_all = next(it), next(it)
+                else:
+                    h0_all = next(it)
+            ws = list(it)
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            B = x.shape[1]
+            if initial_states is None:
+                h0_all = jnp.zeros((nl * nd, B, hs), x.dtype)
+                c0_all = jnp.zeros((nl * nd, B, hs), x.dtype)
+            elif not is_lstm:
+                c0_all = jnp.zeros((nl * nd, B, hs), x.dtype)
+            out = x
+            last_h, last_c = [], []
+            key = dk
+            for layer in range(nl):
+                outs_d = []
+                for d in range(nd):
+                    i = layer * nd + d
+                    wih, whh, bih, bhh = ws[4 * i : 4 * i + 4]
+                    (last, ys) = run(out, h0_all[i], c0_all[i], wih, whh, bih, bhh,
+                                     reverse=bool(d))
+                    if is_lstm:
+                        last_h.append(last[0])
+                        last_c.append(last[1])
+                        outs_d.append(ys)
+                    else:
+                        last_h.append(last)
+                        outs_d.append(ys)
+                out = jnp.concatenate(outs_d, axis=-1) if nd == 2 else outs_d[0]
+                if dropout > 0 and training and layer < nl - 1 and key is not None:
+                    key, sub = jax.random.split(key)
+                    keep = jax.random.bernoulli(sub, 1.0 - dropout, out.shape)
+                    out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            h_n = jnp.stack(last_h, 0)
+            if is_lstm:
+                return out, h_n, jnp.stack(last_c, 0)
+            return out, h_n
+
+        res = apply(f"{mode.lower()}", f, _t(inputs), *st_tensors, *weights)
+        if is_lstm:
+            out, h_n, c_n = res
+            return out, (h_n, c_n)
+        out, h_n = res
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation=activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,131 @@
+"""paddle.nn.utils (weight_norm / spectral_norm / parameter vector helpers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from paddle_tpu.tensor.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec.data[offset : offset + n].reshape(tuple(p.shape)).astype(p.data.dtype)
+        offset += n
+
+
+class _WeightNorm:
+    """Reparameterize weight = g * v / ||v|| along dim (paddle.nn.utils.weight_norm)."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    @staticmethod
+    def _norm(v, dim):
+        if dim is None:
+            return jnp.linalg.norm(v.reshape(-1))
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=False))
+
+    def compute(self, layer):
+        from paddle_tpu.autograd.engine import apply
+
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        dim = self.dim
+
+        def f(gv, vv):
+            if dim is None:
+                return gv * vv / jnp.linalg.norm(vv.reshape(-1))
+            norm = self._norm(vv, dim)
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * (gv / jnp.clip(norm, 1e-12, None)).reshape(shape)
+
+        return apply("weight_norm", f, g, v)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    w = getattr(layer, name)
+    wn = _WeightNorm(name, dim)
+    g0 = _WeightNorm._norm(np.asarray(w.numpy()), dim) if dim is not None else np.linalg.norm(w.numpy())
+    delattr(layer, name)
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g0)))
+    layer.add_parameter(name + "_v", Parameter(w.data))
+    layer._weight_norm = wn
+
+    hook_layer = layer
+
+    def pre_hook(l, inputs):
+        object.__setattr__(hook_layer, name, wn.compute(hook_layer))
+        return None
+
+    layer._wn_hook = layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, name, wn.compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    wn = layer._weight_norm
+    w = wn.compute(layer).detach()
+    layer._wn_hook.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, Parameter(w.data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    import jax
+
+    from paddle_tpu.autograd.engine import apply, no_grad
+    from paddle_tpu.tensor.random import _key
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    w_mat_shape = (w.shape[dim], int(np.prod([s for i, s in enumerate(w.shape) if i != dim])))
+    u0 = jax.random.normal(_key(), (w_mat_shape[0],), jnp.float32)
+    v0 = jax.random.normal(_key(), (w_mat_shape[1],), jnp.float32)
+    delattr(layer, name)
+    layer.add_parameter(name + "_orig", Parameter(w.data))
+    layer.register_buffer(name + "_u", Tensor(u0 / jnp.linalg.norm(u0)))
+    layer.register_buffer(name + "_v", Tensor(v0 / jnp.linalg.norm(v0)))
+
+    def compute(l):
+        worig = l._parameters[name + "_orig"]
+        u = l._buffers[name + "_u"]
+        v = l._buffers[name + "_v"]
+        wm = jnp.moveaxis(worig.data, dim, 0).reshape(w_mat_shape)
+        uu, vv = u.data, v.data
+        with no_grad():
+            for _ in range(n_power_iterations):
+                vv = wm.T @ uu
+                vv = vv / jnp.clip(jnp.linalg.norm(vv), eps, None)
+                uu = wm @ vv
+                uu = uu / jnp.clip(jnp.linalg.norm(uu), eps, None)
+            u._data, v._data = uu, vv
+
+        def f(wo):
+            wmat = jnp.moveaxis(wo, dim, 0).reshape(w_mat_shape)
+            sigma = uu @ wmat @ vv
+            return wo / sigma
+
+        return apply("spectral_norm", f, worig)
+
+    def pre_hook(l, inputs):
+        object.__setattr__(l, name, compute(l))
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, name, compute(layer))
+    return layer

@@ -1,0 +1,19 @@
+"""paddle.optimizer namespace."""
+from paddle_tpu.optimizer import lr  # noqa: F401
+from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
+from paddle_tpu.optimizer.optimizers import (  # noqa: F401
+    ASGD,
+    LBFGS,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    NAdam,
+    RAdam,
+    RMSProp,
+    Rprop,
+    SGD,
+)

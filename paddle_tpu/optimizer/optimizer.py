@@ -1,0 +1,240 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127 ``class
+Optimizer`` with _create_accumulators/_append_optimize_op).
+
+TPU-native design: each optimizer defines a pure ``_update(param, grad, state, lr)``
+over jax arrays.  Eager ``step()`` applies it per-parameter under no_grad; the SAME
+function is reused by the jitted fused train-step path (optimizer fusion == XLA fusing
+the whole update into one executable, matching the reference's fused/multi_tensor
+kernels like fused_adamw)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import no_grad
+from paddle_tpu.nn.clip import ClipGradBase
+from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+
+class LRSchedulerRef:
+    pass
+
+
+class Optimizer:
+    _accum_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        from paddle_tpu.optimizer.lr import LRScheduler
+
+        self._learning_rate = learning_rate
+        self._parameter_list = self._flatten_params(parameters)
+        self._grad_clip = grad_clip
+        self._name = name
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._l2_coeff = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+            self._l2_coeff = 0.0
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = collections.defaultdict(dict)
+        self._global_step = 0
+        self._is_lr_scheduler = isinstance(learning_rate, LRScheduler)
+
+    @staticmethod
+    def _flatten_params(parameters):
+        if parameters is None:
+            return None
+        out = []
+        for p in parameters:
+            if isinstance(p, dict):  # param group
+                out.extend(p["params"])
+            else:
+                out.append(p)
+        return out
+
+    # ------------------------------------------------------------------- lr
+    def get_lr(self):
+        if self._is_lr_scheduler:
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if self._is_lr_scheduler:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+        self._is_lr_scheduler = True
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # ------------------------------------------------------------- accumulators
+    def _get_accumulator(self, name, param):
+        store = self._accumulators[name]
+        if id(param) not in store:
+            store[id(param)] = self._init_accumulator(name, param)
+        return store[id(param)]
+
+    def _init_accumulator(self, name, param):
+        return jnp.zeros(tuple(param.shape), self._acc_dtype(param))
+
+    def _acc_dtype(self, param):
+        # moments in fp32 even for bf16 params (master-weight style, like the
+        # reference's multi_precision kernels)
+        d = np.dtype(param.dtype)
+        if d in (np.dtype("float16"),) or "bfloat16" in str(d):
+            return jnp.float32
+        return param.data.dtype
+
+    # ---------------------------------------------------------------- stepping
+    def _update(self, p, g, state, lr):
+        """Return (new_param, new_state). Pure jnp — overridden per optimizer."""
+        raise NotImplementedError
+
+    def _decay_grad(self, p, g):
+        """L2 regularization folded into grad (paddle L2Decay semantics); decoupled
+        decay (AdamW) overrides _update instead."""
+        if self._l2_coeff and getattr(self, "_decoupled", False) is False:
+            return g + self._l2_coeff * p.data.astype(g.dtype)
+        return g
+
+    @no_grad()
+    def step(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "Optimizer created without parameters; pass parameters=model.parameters()"
+            )
+        params_grads = [
+            (p, p.grad) for p in self._parameter_list
+            if not p.stop_gradient and p.grad is not None and getattr(p, "trainable", True)
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._global_step += 1
+        for p, g in params_grads:
+            g_data = g.data if isinstance(g, Tensor) else g
+            low_precision = np.dtype(p.dtype) == np.dtype("float16") or "bfloat16" in str(p.dtype)
+            if g_data.dtype != jnp.float32 and low_precision:
+                g_data = g_data.astype(jnp.float32)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else lr
+            g_data = self._decay_grad(p, g_data)
+            state = {name: self._get_accumulator(name, p) for name in self._accum_names}
+            if low_precision:
+                # master weights: fp32 shadow copy accumulates updates (reference
+                # multi_precision / master_weight path in fused adam kernels)
+                master = self._accumulators["master_weight"].get(id(p))
+                if master is None:
+                    master = p.data.astype(jnp.float32)
+                holder = _ArrayParam(master, name=getattr(p, "name", ""))
+                new_p, new_state = self._update(holder, g_data, state, plr)
+                self._accumulators["master_weight"][id(p)] = new_p.astype(jnp.float32)
+            else:
+                new_p, new_state = self._update(p, g_data, state, plr)
+            p._data = new_p.astype(p.data.dtype)
+            for name, v in new_state.items():
+                self._accumulators[name][id(p)] = v
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ------------------------------------------------------------------ state
+    def _state_names(self):
+        # master_weight is created lazily by step() for low-precision params; it must
+        # round-trip through checkpoints or fp32 precision is lost on resume
+        return tuple(self._accum_names) + ("master_weight",)
+
+    def state_dict(self):
+        sd = {}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                pname = p.name or f"param_{i}"
+                for name in self._state_names():
+                    if id(p) in self._accumulators[name]:
+                        sd[f"{pname}_{name}"] = Tensor(self._accumulators[name][id(p)])
+        sd["global_step"] = self._global_step
+        if self._is_lr_scheduler:
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if self._is_lr_scheduler and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                pname = p.name or f"param_{i}"
+                for name in self._state_names():
+                    key = f"{pname}_{name}"
+                    if key in state_dict:
+                        v = state_dict[key]
+                        self._accumulators[name][id(p)] = (
+                            v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                        )
+
+    # ------------------------------------------------- jit/fused-step support
+    def functional_update(self, params: dict, grads: dict, states: dict, lr):
+        """Pure update over flat dicts of arrays — called inside jitted train steps
+        (static mode / distributed fused path).  states layout:
+        {acc_name: {param_name: array}}."""
+        new_params, new_states = {}, {n: {} for n in self._accum_names}
+        for k, p_arr in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p_arr
+                for n in self._accum_names:
+                    new_states[n][k] = states[n][k]
+                continue
+            g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
+            if self._l2_coeff and not getattr(self, "_decoupled", False):
+                g = g + self._l2_coeff * p_arr.astype(g.dtype)
+            holder = _ArrayParam(p_arr, name=k)
+            st = {n: states[n][k] for n in self._accum_names}
+            np_, ns = self._update(holder, g, st, lr)
+            new_params[k] = np_.astype(p_arr.dtype)
+            for n, v in ns.items():
+                new_states[n][k] = v
+        return new_params, new_states
+
+    def functional_init_states(self, params: dict):
+        return {
+            n: {k: jnp.zeros(v.shape, jnp.float32 if v.dtype == jnp.bfloat16 else v.dtype)
+                for k, v in params.items()}
+            for n in self._accum_names
+        }
+
+
+class _ArrayParam:
+    """Duck-typed param wrapper so _update can be reused on raw arrays."""
+
+    __slots__ = ("data", "name")
+
+    def __init__(self, data, name=""):
+        self.data = data
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)

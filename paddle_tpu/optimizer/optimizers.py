@@ -1,0 +1,376 @@
+"""Concrete optimizers (python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py
+parity; update math mirrors the reference's phi kernels, e.g. adamw_kernel.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    _accum_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, p, g, state, lr):
+        return p.data - lr * g.astype(p.data.dtype), {}
+
+
+class Momentum(Optimizer):
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rescale = rescale_grad
+
+    def _update(self, p, g, state, lr):
+        g = g * self._rescale
+        v = state["velocity"] * self._momentum + g
+        if self._use_nesterov:
+            new_p = p.data - lr * (g + self._momentum * v).astype(p.data.dtype)
+        else:
+            new_p = p.data - lr * v.astype(p.data.dtype)
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        if amsgrad:
+            self._accum_names = ("moment1", "moment2", "moment2_max")
+
+    def _update(self, p, g, state, lr):
+        t = self._global_step
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        if self._amsgrad:
+            vmax = jnp.maximum(state.get("moment2_max", v), v)
+            vhat = vmax / (1 - self._beta2 ** t)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - self._beta2 ** t)
+            new_state = {"moment1": m, "moment2": v}
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return p.data.astype(jnp.float32) - upd, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: paddle/phi/kernels/gpu/adamw_kernel.cu)."""
+
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, p, g, state, lr):
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None:
+            pname = getattr(p, "name", "") or ""
+            if not self._apply_decay_param_fun(pname):
+                decay = 0.0
+        p32 = p.data.astype(jnp.float32)
+        p_decayed = p32 * (1.0 - lr * decay)
+        t = self._global_step
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        if self._amsgrad:
+            vmax = jnp.maximum(state.get("moment2_max", v), v)
+            vhat = vmax / (1 - self._beta2 ** t)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - self._beta2 ** t)
+            new_state = {"moment1": m, "moment2": v}
+        return p_decayed - lr * mhat / (jnp.sqrt(vhat) + self._eps), new_state
+
+
+class Adamax(Optimizer):
+    _accum_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update(self, p, g, state, lr):
+        t = self._global_step
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        upd = lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        return p.data.astype(jnp.float32) - upd, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_accumulator(self, name, param):
+        return jnp.full(tuple(param.shape), self._init_value, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        acc = state["moment"] + jnp.square(g)
+        return p.data.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    _accum_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _update(self, p, g, state, lr):
+        sg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) / jnp.sqrt(sg + self._eps)
+        su = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return p.data.astype(jnp.float32) - lr * upd, {
+            "avg_squared_grad": sg, "avg_squared_update": su,
+        }
+
+
+class RMSProp(Optimizer):
+    _accum_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update(self, p, g, state, lr):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + lr * g / denom
+        return p.data.astype(jnp.float32) - mom, {
+            "mean_square": ms, "mean_grad": mg, "momentum_acc": mom,
+        }
+
+
+class NAdam(Optimizer):
+    _accum_names = ("moment1", "moment2", "mu_product")
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_accumulator(self, name, param):
+        if name == "mu_product":
+            return jnp.ones((), jnp.float32)
+        return super()._init_accumulator(name, param)
+
+    def _update(self, p, g, state, lr):
+        t = self._global_step
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = v / (1 - self._beta2 ** t)
+        return (
+            p.data.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + self._eps),
+            {"moment1": m, "moment2": v, "mu_product": mu_prod},
+        )
+
+
+class RAdam(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update(self, p, g, state, lr):
+        t = self._global_step
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2.0 * t * self._beta2 ** t / (1 - self._beta2 ** t)
+        if rho_t > 4:
+            vhat = jnp.sqrt(v / (1 - self._beta2 ** t))
+            r = np.sqrt(
+                ((rho_t - 4) * (rho_t - 2) * rho_inf)
+                / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
+            )
+            upd = lr * r * mhat / (vhat + self._eps)
+        else:
+            upd = lr * mhat
+        return p.data.astype(jnp.float32) - upd, {"moment1": m, "moment2": v}
+
+
+class Lamb(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, g, state, lr):
+        t = self._global_step
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        decay = self._lamb_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            decay = 0.0
+        p32 = p.data.astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + decay * p32
+        w_norm = jnp.linalg.norm(p32.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p32 - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    _accum_names = ("d", "ys")
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._batch_num = batch_num
+
+    def _update(self, p, g, state, lr):
+        # simplified averaged-SGD: maintain running average direction
+        d = state["d"] - state["ys"] + g
+        ys = g
+        return p.data.astype(jnp.float32) - lr * d / self._batch_num, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    _accum_names = ("prev_grad", "lr_scale")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _init_accumulator(self, name, param):
+        if name == "lr_scale":
+            return jnp.full(tuple(param.shape), self.get_lr(), jnp.float32)
+        return super()._init_accumulator(name, param)
+
+    def _update(self, p, g, state, lr):
+        sign = jnp.sign(g * state["prev_grad"])
+        scale = jnp.where(
+            sign > 0, state["lr_scale"] * self._eta_plus,
+            jnp.where(sign < 0, state["lr_scale"] * self._eta_minus, state["lr_scale"]),
+        )
+        scale = jnp.clip(scale, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        return (
+            p.data.astype(jnp.float32) - scale * jnp.sign(g_eff),
+            {"prev_grad": g_eff, "lr_scale": scale},
+        )
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-wolfe free (fixed-lr) line search
+    (python/paddle/optimizer/lbfgs.py, simplified closure API)."""
+
+    _accum_names = ()
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history = history_size
+        self._tol_grad = tolerance_grad
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32) for a in arrs])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        grads = [p.grad.data for p in params]
+        q = self._flat(grads)
+        if self._prev_flat_grad is not None and self._s:
+            pass
+        # two-loop recursion
+        alphas = []
+        g = q
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, g)
+            alphas.append((a, rho, s, y))
+            g = g - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            g = g * (jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, g)
+            g = g + s * (a - b)
+        direction = -g
+        lr = self.get_lr()
+        flat_old = self._flat([p.data for p in params])
+        offset = 0
+        for p in params:
+            n = p.size
+            upd = direction[offset : offset + n].reshape(tuple(p.shape))
+            p._data = (p.data.astype(jnp.float32) + lr * upd).astype(p.data.dtype)
+            offset += n
+        flat_new = self._flat([p.data for p in params])
+        # refresh history
+        loss2 = closure()
+        new_grads = self._flat([p.grad.data for p in params])
+        self._s.append(flat_new - flat_old)
+        self._y.append(new_grads - q)
+        if len(self._s) > self._history:
+            self._s.pop(0)
+            self._y.pop(0)
+        self._prev_flat_grad = new_grads
+        return loss

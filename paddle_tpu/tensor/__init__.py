@@ -107,15 +107,11 @@ Tensor.mT = property(lambda self: manipulation.swapaxes(self, -1, -2))
 Tensor.real = property(lambda self: math.real(self))
 Tensor.imag = property(lambda self: math.imag(self))
 
-Tensor.is_floating_point = lambda self: bool(
-    __import__("numpy").issubdtype(self.dtype, __import__("numpy").floating)
-)
-Tensor.is_complex = lambda self: bool(
-    __import__("numpy").issubdtype(self.dtype, __import__("numpy").complexfloating)
-)
-Tensor.is_integer = lambda self: bool(
-    __import__("numpy").issubdtype(self.dtype, __import__("numpy").integer)
-)
+from paddle_tpu.core import dtype as _dt
+
+Tensor.is_floating_point = lambda self: _dt.is_floating_point(self.dtype)
+Tensor.is_complex = lambda self: _dt.is_complex(self.dtype)
+Tensor.is_integer = lambda self: _dt.is_integer(self.dtype)
 Tensor.element_size = lambda self: self.dtype.itemsize
 Tensor.num_elements = lambda self: self.size
 Tensor.numel = lambda self: self.size

@@ -15,14 +15,14 @@ def _t(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
-def _cmp(name, fn):
+def _cmp(op_name, fn):
     def op(x, y, name=None):
         x = _t(x)
         if isinstance(y, (int, float, bool)):
-            return apply(name, lambda a: fn(a, y), x)
-        return apply(name, fn, x, _t(y))
+            return apply(op_name, lambda a: fn(a, y), x)
+        return apply(op_name, fn, x, _t(y))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

@@ -28,17 +28,15 @@ def _axis(axis):
 
 
 # ----------------------------------------------------------------- binary elementwise
-def _binary(name, fn):
+def _binary(op_name, fn):
     def op(x, y, name=None):
-        if isinstance(y, Tensor) or isinstance(x, Tensor):
-            pass
         x = _t(x)
         if isinstance(y, (int, float, bool, complex)):
-            return apply(name, lambda a: fn(a, y), x)
+            return apply(op_name, lambda a: fn(a, y), x)
         y = _t(y)
-        return apply(name, fn, x, y)
+        return apply(op_name, fn, x, y)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -82,11 +80,11 @@ def subtract_(x, y, name=None):
 
 
 # ----------------------------------------------------------------- unary elementwise
-def _unary(name, fn):
+def _unary(op_name, fn):
     def op(x, name=None):
-        return apply(name, fn, _t(x))
+        return apply(op_name, fn, _t(x))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -221,11 +219,11 @@ def lerp(x, y, weight, name=None):
 
 
 # ------------------------------------------------------------------- reductions
-def _reduce(name, fn, dtype_arg=False):
+def _reduce(op_name, fn, dtype_arg=False):
     def op(x, axis=None, keepdim=False, name=None):
-        return apply(name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+        return apply(op_name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), _t(x))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

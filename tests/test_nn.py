@@ -1,0 +1,349 @@
+"""nn.Layer zoo tests (mirrors reference test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_registration_and_params(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        assert len(net.parameters()) == 4
+        out = net(paddle.randn([3, 4]))
+        assert out.shape == [3, 2]
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(4, 4)
+        sd = net.state_dict()
+        net2 = nn.Linear(4, 4)
+        net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        net(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+
+    def test_grad_flows_through_layer(self):
+        net = nn.Linear(3, 1)
+        x = paddle.randn([5, 3])
+        loss = net(x).sum()
+        loss.backward()
+        assert net.weight.grad is not None
+        assert net.weight.grad.shape == [3, 1]
+
+
+class TestCoreLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(3, 2)
+        x = np.random.rand(4, 3).astype("float32")
+        out = lin(paddle.to_tensor(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor([[1, 2], [0, 3]])
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[1, 0], np.zeros(4))
+
+    def test_conv2d_shape_and_grad(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        out = conv(x)
+        assert out.shape == [2, 8, 16, 16]
+        out.sum().backward()
+        assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+    def test_conv2d_matches_torch_semantics(self):
+        # cross-check against torch CPU (baked into image) for numeric parity
+        import torch
+
+        x = np.random.rand(1, 2, 8, 8).astype("float32")
+        w = np.random.rand(4, 2, 3, 3).astype("float32")
+        conv = nn.Conv2D(2, 4, 3, padding=1, bias_attr=False)
+        conv.weight.set_value(w)
+        out = conv(paddle.to_tensor(x)).numpy()
+        ref = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(w), padding=1
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_transpose(self):
+        import torch
+
+        x = np.random.rand(1, 4, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")  # [in, out, kh, kw]
+        conv = nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1, bias_attr=False)
+        conv.weight.set_value(w)
+        out = conv(paddle.to_tensor(x)).numpy()
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(4)
+        x = paddle.randn([8, 4, 5, 5])
+        bn.train()
+        out = bn(x)
+        # normalized output: near zero mean/unit var per channel
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(4), atol=1e-5)
+        # running stats moved off init
+        assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [8, 4, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([2, 4, 8])
+        out = ln(x)
+        np.testing.assert_allclose(
+            out.numpy().mean(-1), np.zeros((2, 4)), atol=1e-5
+        )
+        np.testing.assert_allclose(out.numpy().std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        out = rn(x)
+        rms = np.sqrt((out.numpy() ** 2).mean(-1))
+        np.testing.assert_allclose(rms, np.ones(2), atol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.randn([2, 4, 3, 3]))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_pooling(self):
+        x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(aap.numpy()[0, 0], [[7.5]])
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.train()
+        out = d(x)
+        frac = (out.numpy() == 0).mean()
+        assert 0.4 < frac < 0.6
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_sequential_and_layerlist(self):
+        seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        assert seq(paddle.ones([1, 2])).shape == [1, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(list(ll.parameters())) == 6
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor(
+            np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], "float32"), stop_gradient=False
+        )
+        labels = paddle.to_tensor([0, 1])
+        loss = F.cross_entropy(logits, labels)
+        # reference computation
+        lg = logits.numpy()
+        p = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 1], [0, 1]]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        labels = paddle.to_tensor([0, -100, 2, -100])
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        l0 = F.cross_entropy(logits[0:1], labels[0:1])
+        l2 = F.cross_entropy(logits[2:3], labels[2:3])
+        np.testing.assert_allclose(
+            loss.numpy(), (l0.numpy() + l2.numpy()) / 2, rtol=1e-5
+        )
+
+    def test_soft_label_and_smoothing(self):
+        logits = paddle.randn([3, 4])
+        soft = paddle.nn.functional.softmax(paddle.randn([3, 4]))
+        loss = F.cross_entropy(logits, soft, soft_label=True)
+        assert loss.size == 1
+        loss2 = F.cross_entropy(logits, paddle.to_tensor([0, 1, 2]), label_smoothing=0.1)
+        assert loss2.size == 1
+
+    def test_mse_l1_bce(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([1.5, 1.5])
+        np.testing.assert_allclose(F.mse_loss(a, b).numpy(), 0.25, rtol=1e-6)
+        np.testing.assert_allclose(F.l1_loss(a, b).numpy(), 0.5, rtol=1e-6)
+        p = paddle.to_tensor([0.8, 0.3])
+        y = paddle.to_tensor([1.0, 0.0])
+        ref = -(np.log(0.8) + np.log(0.7)) / 2
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(p, y).numpy(), ref, rtol=1e-5
+        )
+
+    def test_kl_nll(self):
+        logp = F.log_softmax(paddle.randn([3, 5]))
+        lab = paddle.to_tensor([1, 2, 3])
+        assert F.nll_loss(logp, lab).size == 1
+        q = F.softmax(paddle.randn([3, 5]))
+        assert F.kl_div(logp, q).size == 1
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 6, 16])
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_encoder_decoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        src = paddle.randn([2, 5, 16])
+        mem = enc(src)
+        assert mem.shape == [2, 5, 16]
+        dec_layer = nn.TransformerDecoderLayer(16, 4, 32)
+        dec = nn.TransformerDecoder(dec_layer, 2)
+        tgt = paddle.randn([2, 3, 16])
+        out = dec(tgt, mem)
+        assert out.shape == [2, 3, 16]
+
+    def test_attention_grad(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = paddle.randn([1, 4, 8])
+        mha(x).sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_causal_sdpa_matches_masked(self):
+        q = paddle.randn([1, 5, 2, 4])
+        out_causal = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        # build explicit causal mask [1, 1, 5, 5]
+        m = np.tril(np.ones((5, 5), bool))[None, None]
+        out_masked = F.scaled_dot_product_attention(
+            q, q, q, attn_mask=paddle.to_tensor(m)
+        )
+        np.testing.assert_allclose(
+            out_causal.numpy(), out_masked.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestRNN:
+    def test_lstm_shapes_and_grad(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([3, 6, 4])  # [batch, time, feat]
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 6, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+        out.sum().backward()
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 8]
+
+    def test_simple_rnn_matches_manual(self):
+        rnn = nn.SimpleRNN(2, 3)
+        x = np.random.rand(1, 4, 2).astype("float32")
+        out, h = rnn(paddle.to_tensor(x))
+        wih = rnn._parameters["weight_ih_l0"].numpy()
+        whh = rnn._parameters["weight_hh_l0"].numpy()
+        bih = rnn._parameters["bias_ih_l0"].numpy()
+        bhh = rnn._parameters["bias_hh_l0"].numpy()
+        ht = np.zeros((1, 3), "float32")
+        for t in range(4):
+            ht = np.tanh(x[:, t] @ wih.T + bih + ht @ whh.T + bhh)
+        np.testing.assert_allclose(out.numpy()[:, -1], ht, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        out, (h, c) = cell(paddle.randn([2, 4]))
+        assert out.shape == [2, 8] and c.shape == [2, 8]
+
+
+class TestInitializers:
+    def test_constant_normal_uniform(self):
+        from paddle_tpu.nn import initializer as I
+
+        lin = nn.Linear(10, 10, weight_attr=nn.ParamAttr(initializer=I.Constant(2.0)))
+        np.testing.assert_allclose(lin.weight.numpy(), np.full((10, 10), 2.0))
+        lin2 = nn.Linear(100, 100, weight_attr=nn.ParamAttr(initializer=I.Normal(0, 0.02)))
+        assert abs(lin2.weight.numpy().std() - 0.02) < 0.005
+        lin3 = nn.Linear(100, 100, weight_attr=nn.ParamAttr(initializer=I.Uniform(-1, 1)))
+        assert lin3.weight.numpy().min() >= -1 and lin3.weight.numpy().max() <= 1
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn import initializer as I
+
+        lin = nn.Linear(16, 16, weight_attr=nn.ParamAttr(initializer=I.Orthogonal()))
+        w = lin.weight.numpy()
+        np.testing.assert_allclose(w @ w.T, np.eye(16), atol=1e-4)
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        g1 = paddle.to_tensor(np.full((4,), 3.0, "float32"))
+        g2 = paddle.to_tensor(np.full((4,), 4.0, "float32"))
+        p1, p2 = paddle.create_parameter([4], "float32"), paddle.create_parameter([4], "float32")
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_clip_by_value(self):
+        g = paddle.to_tensor([-2.0, 0.5, 2.0])
+        p = paddle.create_parameter([3], "float32")
+        out = nn.ClipGradByValue(1.0)([(p, g)])
+        np.testing.assert_allclose(out[0][1].numpy(), [-1.0, 0.5, 1.0])
+
+
+class TestWeightNorm:
+    def test_weight_norm(self):
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+        lin = nn.Linear(4, 6)
+        w0 = lin.weight.numpy().copy()
+        weight_norm(lin, dim=1)
+        out = lin(paddle.ones([1, 4]))
+        assert out.shape == [1, 6]
+        remove_weight_norm(lin)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)

@@ -1,0 +1,232 @@
+"""Optimizer/LR/AMP tests (mirrors reference test/legacy_test optimizer tests +
+test/amp)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _quadratic_problem():
+    """min ||w - 3||^2 — every optimizer should drive w toward 3."""
+    w = paddle.create_parameter([4], "float32")
+    w.set_value(np.zeros(4, "float32"))
+    return w
+
+
+def _run(opt_cls, steps=300, **kw):
+    w = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - 3.0) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        np.testing.assert_allclose(_run(paddle.optimizer.SGD, learning_rate=0.1),
+                                   np.full(4, 3.0), atol=1e-3)
+
+    def test_momentum(self):
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.Momentum, learning_rate=0.05, momentum=0.9),
+            np.full(4, 3.0), atol=1e-3)
+
+    def test_adam(self):
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.Adam, learning_rate=0.1), np.full(4, 3.0), atol=1e-2)
+
+    def test_adamw(self):
+        w = _run(paddle.optimizer.AdamW, learning_rate=0.1, weight_decay=0.01)
+        np.testing.assert_allclose(w, np.full(4, 3.0), atol=0.1)
+
+    def test_rmsprop_adagrad_adadelta(self):
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.RMSProp, learning_rate=0.05), np.full(4, 3.0), atol=0.05)
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.Adagrad, steps=500, learning_rate=0.5),
+            np.full(4, 3.0), atol=0.05)
+        out = _run(paddle.optimizer.Adadelta, steps=500, learning_rate=10.0)
+        assert np.all(np.abs(out - 3.0) < np.abs(0.0 - 3.0))  # moved toward target
+
+    def test_lamb_nadam_radam(self):
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.Lamb, learning_rate=0.03, lamb_weight_decay=0.0),
+            np.full(4, 3.0), atol=0.1)
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.NAdam, learning_rate=0.1), np.full(4, 3.0), atol=0.05)
+        np.testing.assert_allclose(
+            _run(paddle.optimizer.RAdam, learning_rate=0.1), np.full(4, 3.0), atol=0.05)
+
+    def test_adam_matches_reference_formula(self):
+        w = paddle.create_parameter([1], "float32")
+        w.set_value(np.array([1.0], "float32"))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2.0).sum().backward()  # grad = 2
+        opt.step()
+        # manual: m=0.2 v=0.004; mhat=2, vhat=4; upd=0.1*2/(2+eps)=0.1
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-5)
+
+    def test_grad_clip_in_optimizer(self):
+        w = paddle.create_parameter([4], "float32")
+        w.set_value(np.zeros(4, "float32"))
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w],
+            grad_clip=nn.ClipGradByGlobalNorm(0.1),
+        )
+        (w * 100.0).sum().backward()
+        opt.step()
+        # clipped update norm == 0.1
+        np.testing.assert_allclose(np.linalg.norm(w.numpy()), 0.1, rtol=1e-4)
+
+    def test_optimizer_state_dict(self):
+        w = paddle.create_parameter([2], "float32", name="w0")
+        opt = paddle.optimizer.Adam(parameters=[w])
+        (w * 1.0).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        assert sd["global_step"] == 1
+        opt2 = paddle.optimizer.Adam(parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 1
+        np.testing.assert_allclose(
+            np.asarray(opt2._accumulators["moment1"][id(w)]),
+            np.asarray(opt._accumulators["moment1"][id(w)]),
+        )
+
+    def test_master_weights_bf16(self):
+        w = paddle.create_parameter([4], "float32")
+        w.set_value(np.zeros(4, "float32"))
+        w._data = w.data.astype(paddle.bfloat16)
+        opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w])
+        for _ in range(10):
+            (w.astype("float32") * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        # bf16 param alone can't represent 10 * 1e-3 accumulation exactly; the fp32
+        # master must be exact
+        master = np.asarray(opt._accumulators["master_weight"][id(w)])
+        np.testing.assert_allclose(master, np.full(4, -0.01), rtol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sch())
+            sch.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        sch = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(sch() - 1.0) < 1e-6
+        for _ in range(10):
+            sch.step()
+        assert abs(sch()) < 1e-6
+
+    def test_warmup(self):
+        sch = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                               end_lr=0.1)
+        assert sch() == 0.0
+        for _ in range(10):
+            sch.step()
+        np.testing.assert_allclose(sch(), 0.1, rtol=1e-6)
+
+    def test_scheduler_drives_optimizer(self):
+        w = paddle.create_parameter([1], "float32")
+        sch = paddle.optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sch, parameters=[w])
+        assert opt.get_lr() == 0.5
+        sch.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_piecewise_noam_poly(self):
+        pw = paddle.optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.05, 0.01])
+        vals = []
+        for _ in range(7):
+            vals.append(pw())
+            pw.step()
+        assert vals[0] == 0.1 and vals[4] == 0.05 and vals[6] == 0.01
+        noam = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        noam.step()
+        assert noam() > 0
+        poly = paddle.optimizer.lr.PolynomialDecay(0.1, decay_steps=10, end_lr=0.0)
+        for _ in range(10):
+            poly.step()
+        np.testing.assert_allclose(poly(), 0.0, atol=1e-8)
+
+    def test_reduce_on_plateau(self):
+        sch = paddle.optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        sch.step(1.0)
+        sch.step(1.0)
+        sch.step(1.0)
+        sch.step(1.0)
+        assert sch() < 0.1
+
+
+class TestAMP:
+    def test_autocast_o1_matmul_bf16(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1"):
+            y = paddle.matmul(x, x)
+            assert y.dtype == paddle.bfloat16
+            # black list op stays fp32
+            z = paddle.nn.functional.softmax(y.astype("float32"))
+            assert z.dtype == np.dtype("float32")
+        # outside: no casting
+        y2 = paddle.matmul(x, x)
+        assert y2.dtype == np.dtype("float32")
+
+    def test_autocast_custom_lists(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+            y = paddle.matmul(x, x)
+            assert y.dtype == np.dtype("float32")
+
+    def test_grad_scaler_passthrough_and_dynamic(self):
+        w = paddle.create_parameter([2], "float32")
+        w.set_value(np.zeros(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        loss = (w * 1.0).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [2.0, 2.0])  # scaled grads
+        scaler.step(opt)  # unscales then steps
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), -0.1 * np.ones(2), atol=1e-6)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = paddle.create_parameter([2], "float32")
+        w.set_value(np.ones(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        (w * 1.0).sum().backward()
+        w.grad._data = w.grad.data.at[0].set(np.inf)
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), np.ones(2))  # step skipped
+        assert scaler.get_init_loss_scaling() == 2.0  # halved
+
+    def test_decorate_o2(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        model = paddle.amp.decorate(model, level="O2")
+        assert model[0].weight.dtype == paddle.bfloat16
+        assert model[1].weight.dtype == np.dtype("float32")  # LayerNorm excluded
+
+    def test_o2_training_converges(self):
+        model = nn.Linear(4, 1)
+        model = paddle.amp.decorate(model, level="O2")
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        x = paddle.randn([16, 4]).astype("bfloat16")
+        for _ in range(50):
+            with paddle.amp.auto_cast(level="O2"):
+                loss = (model(x) ** 2).mean()
+            loss.astype("float32").backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.astype("float32").numpy()) < 0.1
