@@ -1,0 +1,46 @@
+"""paddle.distributed — collectives, groups, hybrid fleet, and semi-auto parallel.
+
+Reference surface: python/paddle/distributed/__init__.py.  See SURVEY.md §2.6/§5.8 for
+the component mapping (NCCL rings → named mesh axes, ProcessGroup → Group-as-submesh,
+SPMD rules → GSPMD propagation)."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.parallel_env import (  # noqa: F401
+    ParallelEnv, barrier, get_rank, get_world_size, init_parallel_env, is_initialized,
+    world_mesh,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, batch_isend_irecv, broadcast, get_group, irecv, is_available,
+    isend, new_group, recv, reduce, reduce_scatter, scatter, send,
+)
+from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
+    DistAttr, DistModel, Partial, Placement, ProcessMesh, Replicate, Shard, Strategy,
+    dtensor_from_fn, get_mesh, reshard, set_mesh, shard_dataloader, shard_layer,
+    shard_optimizer, shard_tensor, to_static, unshard_dtensor,
+)
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+from paddle_tpu.distributed import communication  # noqa: F401
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed import sharding  # noqa: F401
+
+ParallelMode = type("ParallelMode", (), {"DATA_PARALLEL": 0, "TENSOR_PARALLEL": 1,
+                                         "PIPELINE_PARALLEL": 2, "SHARDING_PARALLEL": 3})
+
+
+def get_backend():
+    return "xla"
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference parallel.py spawn.  Single-controller SPMD drives every device from
+    this process, so spawn degenerates to a direct call (the launcher handles
+    multi-host)."""
+    init_parallel_env()
+    return func(*args)
+
+
+def launch():
+    from paddle_tpu.distributed.launch.main import launch as _launch
+
+    return _launch()

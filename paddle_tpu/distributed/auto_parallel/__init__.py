@@ -1,0 +1,10 @@
+from paddle_tpu.distributed.auto_parallel.api import (  # noqa: F401
+    DistAttr, DistModel, Strategy, dtensor_from_fn, reshard, shard_dataloader,
+    shard_layer, shard_optimizer, shard_tensor, to_static, unshard_dtensor,
+)
+from paddle_tpu.distributed.auto_parallel.placement_type import (  # noqa: F401
+    Partial, Placement, Replicate, Shard,
+)
+from paddle_tpu.distributed.auto_parallel.process_mesh import (  # noqa: F401
+    ProcessMesh, get_mesh, set_mesh,
+)

@@ -1,0 +1,342 @@
+"""Semi-auto parallel API (reference: python/paddle/distributed/auto_parallel/api.py —
+shard_tensor:205, reshard:727, shard_layer:828, to_static:2715, DistModel:2132).
+
+TPU-native stance (SURVEY.md §7.6): a "DistTensor" is just an eager Tensor whose
+jax.Array carries a ``NamedSharding`` over the ProcessMesh.  Every eager op and every
+jitted step then flows through GSPMD, which performs the SPMD-rule propagation + reshard
+insertion the reference generates C++ for (dist_api_gen.py).  Only ``Partial`` needs
+framework bookkeeping: its pending-reduction contributions live stacked on a hidden
+leading axis until a reshard materializes them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel.placement_type import (
+    Partial, Placement, Replicate, Shard, to_partition_spec,
+)
+from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+__all__ = [
+    "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer", "shard_optimizer",
+    "unshard_dtensor", "DistAttr", "Strategy", "to_static", "DistModel",
+    "shard_dataloader",
+]
+
+
+def _normalize_placements(placements, mesh):
+    out = []
+    for pl in placements:
+        if isinstance(pl, Placement):
+            out.append(pl)
+        elif pl is None:
+            out.append(Replicate())
+        elif isinstance(pl, str):
+            if pl.startswith("x") or pl == "replicate":
+                out.append(Replicate())
+            else:
+                out.append(Shard(int(pl)))
+        else:
+            out.append(Shard(int(pl)))
+    while len(out) < mesh.ndim:
+        out.append(Replicate())
+    return out
+
+
+def _axis_size(mesh: ProcessMesh, entry) -> int:
+    names = entry if isinstance(entry, tuple) else (entry,)
+    n = 1
+    for nm in names:
+        n *= mesh.jax_mesh.shape[nm]
+    return n
+
+
+def _put(arr: jax.Array, mesh: ProcessMesh, placements) -> jax.Array:
+    spec = to_partition_spec(placements, mesh, arr.ndim)
+    # XLA shards evenly; a dim the axis doesn't divide falls back to replicated on
+    # that axis (value-identical — the reference pads uneven shards instead).
+    entries = [
+        e if (e is None or arr.shape[d] % _axis_size(mesh, e) == 0) else None
+        for d, e in enumerate(spec)
+    ]
+    return jax.device_put(arr, NamedSharding(mesh.jax_mesh, P(*entries)))
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Reference api.py:205.  Returns a Tensor whose storage is globally laid out per
+    ``placements``; value semantics are unchanged (same global value, new layout)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    placements = _normalize_placements(placements, mesh)
+    partial_dims = [i for i, pl in enumerate(placements) if isinstance(pl, Partial)]
+    if partial_dims:
+        # each rank along the partial mesh dims contributes the SAME local value (the
+        # reference's shard_tensor-with-Partial bring-up); stack contributions on a
+        # hidden leading axis so the pending sum is explicit.
+        n = 1
+        for d in partial_dims:
+            n *= mesh.shape[d]
+        arr = jnp.broadcast_to(t.data[None], (n,) + tuple(t.data.shape))
+        rest = [pl for pl in placements if not isinstance(pl, Partial)]
+        spec = to_partition_spec(rest, mesh, t.data.ndim)
+        names = tuple(mesh.dim_names[d] for d in partial_dims)
+        full_spec = P(names if len(names) > 1 else names[0], *spec)
+        arr = jax.device_put(arr, NamedSharding(mesh.jax_mesh, full_spec))
+        out = _mk_like(t, arr, stop_gradient)
+        out._dist_mesh, out._dist_placements = mesh, placements
+        out._partial_hidden = True
+        return out
+    arr = _put(t.data, mesh, placements)
+    out = _mk_like(t, arr, stop_gradient)
+    out._dist_mesh, out._dist_placements = mesh, placements
+    return out
+
+
+def _mk_like(t: Tensor, arr, stop_gradient=None):
+    cls = Parameter if isinstance(t, Parameter) else Tensor
+    if cls is Parameter:
+        out = Parameter(arr, trainable=not t.stop_gradient)
+    else:
+        out = Tensor(arr, stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient)
+    out.name = t.name
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    return out
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+    """Reference api.py:727 + the C++ reshard engine
+    (phi/core/distributed/auto_parallel/reshard/) — every transition in the reference's
+    test matrix (p_to_r, s_to_r, r_to_s, s_to_s, p_to_s, r_to_p, …) reduces here to at
+    most a pending-sum materialization plus one device_put; XLA emits the actual
+    collective program (all_gather / reduce_scatter / all_to_all) from the layout delta.
+    """
+    placements = _normalize_placements(placements, mesh)
+    t = dist_tensor
+    arr = t.data
+    src_placements = getattr(t, "_dist_placements", None)
+
+    if getattr(t, "_partial_hidden", False):
+        src_partial = [
+            pl.reduce_type for pl in (src_placements or []) if isinstance(pl, Partial)
+        ]
+        rt = src_partial[0] if src_partial else "sum"
+        if any(isinstance(pl, Partial) for pl in placements):
+            return t  # p -> p: keep pending, nothing to do
+        else:
+            red = {"sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min}[rt]
+            arr = red(arr, axis=0)
+            out = _mk_like(t, _put(arr, mesh, placements))
+            out._dist_mesh, out._dist_placements = mesh, placements
+            return out
+    if any(isinstance(pl, Partial) for pl in placements):
+        # r/s -> p: value becomes one rank's contribution, zeros elsewhere (reference
+        # r_to_p semantics: rank0 keeps the value).
+        partial_dims = [i for i, pl in enumerate(placements) if isinstance(pl, Partial)]
+        n = 1
+        for d in partial_dims:
+            n *= mesh.shape[d]
+        stacked = jnp.concatenate(
+            [arr[None], jnp.zeros((n - 1,) + tuple(arr.shape), arr.dtype)], axis=0
+        )
+        rest = [pl for pl in placements if not isinstance(pl, Partial)]
+        spec = to_partition_spec(rest, mesh, arr.ndim)
+        names = tuple(mesh.dim_names[d] for d in partial_dims)
+        full_spec = P(names if len(names) > 1 else names[0], *spec)
+        out = _mk_like(t, jax.device_put(stacked, NamedSharding(mesh.jax_mesh, full_spec)))
+        out._dist_mesh, out._dist_placements = mesh, placements
+        out._partial_hidden = True
+        return out
+
+    out = _mk_like(t, _put(arr, mesh, placements))
+    out._dist_mesh, out._dist_placements = mesh, placements
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    arr = dist_tensor.data
+    if getattr(dist_tensor, "_partial_hidden", False):
+        arr = jnp.sum(arr, axis=0)
+    mesh = getattr(dist_tensor, "_dist_mesh", None)
+    if mesh is not None:
+        arr = jax.device_put(arr, NamedSharding(mesh.jax_mesh, P(*[None] * arr.ndim)))
+    return _mk_like(dist_tensor, arr)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Reference api.py:828 — apply shard_fn(name, layer, mesh) over sublayers; default
+    replicates every parameter onto the mesh."""
+    def _default(name, sublayer, mesh):
+        for pname, param in list(sublayer._parameters.items()):
+            if param is not None:
+                sublayer._parameters[pname] = shard_tensor(
+                    param, mesh, [Replicate()] * mesh.ndim
+                )
+
+    fn = shard_fn or _default
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh)
+        )
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh)
+        )
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference api.py shard_optimizer: optimizer states inherit (or shard_fn
+    overrides) the parameter layouts — ZeRO falls out of the accumulator shardings."""
+    orig_init = optimizer._init_accumulator
+
+    def _init(name, param):
+        st = orig_init(name, param)
+        mesh = getattr(param, "_dist_mesh", None)
+        if shard_fn is not None:
+            st = shard_fn(name, param, st)
+        elif mesh is not None and hasattr(st, "shape"):
+            if tuple(st.shape) == tuple(param.data.shape):
+                st = jax.device_put(st, param.data.sharding)
+        return st
+
+    optimizer._init_accumulator = _init
+    return optimizer
+
+
+class DistAttr:
+    """Legacy dist_attr facade (reference auto_parallel/api.py DistAttr)."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+class Strategy:
+    """Reference auto_parallel/strategy.py — config bag; consumed by to_static."""
+
+    class _Cfg:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        c = config or {}
+
+        def cfg(section, **defaults):
+            defaults.update(c.get(section, {}))
+            return Strategy._Cfg(**defaults)
+
+        self.sharding = cfg("sharding", enable=False, stage=1, degree=-1)
+        self.amp = cfg("amp", enable=False, dtype="bfloat16", level="O1")
+        self.recompute = cfg("recompute", enable=False)
+        self.pipeline = cfg("pipeline", enable=False, schedule_mode="1F1B",
+                            accumulate_steps=1)
+        self.gradient_merge = cfg("gradient_merge", enable=False, k_steps=1)
+
+
+class DistModel:
+    """Reference api.py:2132 — the static-graph auto-parallel trainer.  Here: one
+    pjit-compiled functional train/eval step over the params' shardings."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None,
+                 metrics=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def dist_main_program(self, mode=None):  # parity shim
+        return None
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self.network.set_state_dict(state_dict)
+
+    def _build_train_fn(self):
+        from paddle_tpu.static.functionalize import build_train_step
+
+        self._train_fn = build_train_step(
+            self.network, self._loss, self._optimizer,
+            recompute=self._strategy.recompute.enable,
+        )
+        return self._train_fn
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._train_fn is None:
+                self._build_train_fn()
+            return self._train_fn(*args)
+        out = self.network(*args[:1] if self._mode == "predict" else args[:1])
+        if self._mode == "eval" and self._loss is not None:
+            return self._loss(out, *args[1:])
+        return out
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Reference api.py:2715."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Reference api.py shard_dataloader — wrap a loader so yielded batches are laid
+    out over the mesh (batch dim sharded on ``shard_dims``)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    if shard_dims is None:
+        dim = "dp" if "dp" in mesh.dim_names else mesh.dim_names[0]
+    else:
+        dim = shard_dims if isinstance(shard_dims, str) else mesh.dim_names[shard_dims]
+    mesh_dim = mesh.dim_names.index(dim)
+
+    def _shard(x):
+        if isinstance(x, Tensor):
+            pls: list = [Replicate()] * mesh.ndim
+            pls[mesh_dim] = Shard(0)
+            return shard_tensor(x, mesh, pls)
+        return x
+
+    class _Wrapper:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __iter__(self):
+            for batch in self._dl:
+                yield jax.tree_util.tree_map(
+                    _shard, batch, is_leaf=lambda x: isinstance(x, Tensor)
+                )
+
+        def __len__(self):
+            return len(self._dl)
+
+        def __getattr__(self, item):
+            return getattr(self._dl, item)
+
+    return _Wrapper(dataloader)

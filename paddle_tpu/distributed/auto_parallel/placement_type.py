@@ -1,0 +1,97 @@
+"""Placement types (reference: phi/core/distributed/auto_parallel/placement_types.h,
+python/paddle/distributed/auto_parallel/placement_type.py).
+
+``Shard(d)`` / ``Replicate()`` lower losslessly to ``PartitionSpec`` entries.
+``Partial(op)`` is a *pending reduction* over a mesh dim; a Tensor carries it as
+bookkeeping (``Tensor._partial_axes``) — its global array holds per-device contributions
+stacked on a hidden leading axis, and reshard materializes the reduction (see api.py).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        rt = getattr(reduce_type, "name", reduce_type)
+        if hasattr(rt, "lower"):
+            rt = rt.lower()
+        else:
+            rt = {0: "sum", 1: "max", 2: "min", 4: "avg"}.get(rt, "sum")
+        self.reduce_type = rt
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def to_partition_spec(placements, mesh, ndim) -> P:
+    """placements[i] describes mesh dim i (reference convention).  Build the
+    tensor-dim-indexed PartitionSpec; Partial dims contribute no spec entry."""
+    entries: list = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = name
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (name,)
+            else:
+                entries[pl.dim] = (cur, name)
+    return P(*entries)

@@ -1,0 +1,116 @@
+"""ProcessMesh — the reference's auto-parallel mesh abstraction
+(python/paddle/distributed/auto_parallel/process_mesh.py) realized directly as a
+``jax.sharding.Mesh``: process ids become device positions in the mesh array, dim names
+become mesh axis names, and placements lower to ``PartitionSpec``s (GSPMD does the SPMD
+propagation the reference implements by hand in phi/infermeta/spmd_rules/)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh = [None]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is None and shape is not None and process_ids is not None:
+            mesh = np.asarray(process_ids).reshape(shape)
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}"
+            )
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devices = np.asarray(jax.devices(), dtype=object)
+        n = len(devices)
+        picked = np.empty(arr.shape, dtype=object)
+        for idx, pid in np.ndenumerate(arr):
+            picked[idx] = devices[int(pid) % n]
+        self._jax_mesh = Mesh(picked, tuple(self._dim_names))
+
+    # -- reference API surface ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(x) for x in self._ids.flatten()]
+
+    processes = process_ids
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, dim_name) -> int:
+        return self._ids.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh: move ``dim_name`` first; with ``index``, slice it away."""
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._ids, axis, 0)
+        names = [dim_name] + [d for d in self._dim_names if d != dim_name]
+        if index is not None:
+            return ProcessMesh(moved[index], names[1:])
+        return ProcessMesh(moved, names)
+
+    def get_group(self, dim_name=None):
+        from paddle_tpu.distributed.collective import Group
+
+        if dim_name is None or self.ndim == 1:
+            return Group(self.process_ids, axis_name=self._dim_names[0], mesh=self._jax_mesh)
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._ids, axis, -1)
+        ranks = [int(x) for x in moved.reshape(-1, self._ids.shape[axis])[0]]
+        return Group(ranks, axis_name=dim_name, mesh=self._jax_mesh)
+
+    # -- TPU-native ---------------------------------------------------------------
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._dim_names == other._dim_names
+            and np.array_equal(self._ids, other._ids)
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._ids.tobytes(), self._ids.shape))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        self._prev = _global_mesh[0]
+        _global_mesh[0] = self
+        return self
+
+    def __exit__(self, *a):
+        _global_mesh[0] = self._prev
+        return False
+
+
+def set_mesh(mesh: ProcessMesh):
+    _global_mesh[0] = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh[0]

@@ -1,0 +1,397 @@
+"""Communication groups and eager collectives.
+
+TPU-native re-design of the reference's ProcessGroup stack
+(paddle/phi/core/distributed/collective/process_group.h:48, python collective.py:150-245):
+a ``Group`` is not a comm ring — it's a *named slice of the device mesh*.  Collectives are
+XLA programs (``jax.shard_map`` + ``lax.p*``) compiled over that slice, so they ride ICI
+with XLA's latency-hiding scheduler instead of NCCL streams.
+
+Eager semantics under single-controller SPMD: an eager Tensor is one *global* jax.Array.
+Two cases:
+
+* data **sharded over the group's mesh axis** — the true distributed case; collectives
+  run via shard_map (psum/all_gather/... on the axis).
+* data **replicated** — every "rank" holds the same value, so reductions follow the
+  replicated algebra (sum → x·n, max/min/avg → x, prod → x^n), matching what N identical
+  processes would compute.  This mirrors how the reference's Gloo-CPU fallback makes
+  collective tests runnable without GPUs (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import parallel_env as _env
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["Group", "new_group", "get_group", "ReduceOp", "is_available"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A set of ranks = a 1-D submesh with axis name ``g`` (or a named axis of the
+    hybrid mesh when created by fleet's topology)."""
+
+    def __init__(self, ranks, gid=0, mesh=None, axis_name="g"):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis_name = axis_name
+        if mesh is None:
+            devs = np.asarray(jax.devices(), dtype=object)[self.ranks]
+            mesh = Mesh(devs, (axis_name,))
+        self.mesh = mesh
+
+    @property
+    def axis_names(self):
+        return (self.axis_name,)
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        return self.get_group_rank(jax.process_index())
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, global_rank):
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def is_member(self):
+        return jax.process_index() in self.ranks or jax.process_count() == 1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name!r})"
+
+
+_group_registry: dict[int, Group] = {}
+_next_gid = [1]
+
+
+def _world_group() -> Group:
+    if 0 not in _group_registry:
+        mesh = _env.world_mesh()
+        _group_registry[0] = Group(
+            list(range(jax.device_count())), gid=0, mesh=mesh, axis_name="world"
+        )
+    return _group_registry[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """Reference: python/paddle/distributed/collective.py:245."""
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(sorted(ranks), gid=gid)
+    _group_registry[gid] = g
+    return g
+
+
+def get_group(gid=0) -> Group:
+    if gid == 0:
+        return _world_group()
+    return _group_registry[gid]
+
+
+def _resolve_group(group) -> Group:
+    return group if group is not None else _world_group()
+
+
+def is_available() -> bool:
+    return True
+
+
+# ---------------------------------------------------------------------------------
+# collective execution helpers
+# ---------------------------------------------------------------------------------
+
+
+def _sharded_axis(arr: jax.Array, group: Group):
+    """If ``arr`` is laid out over the group's mesh axis, return (mesh, spec); else
+    None — the replicated path applies."""
+    sh = arr.sharding
+    if isinstance(sh, NamedSharding) and group.axis_name in sh.mesh.axis_names:
+        spec = sh.spec
+        if any(
+            (a == group.axis_name) or (isinstance(a, tuple) and group.axis_name in a)
+            for a in spec
+            if a is not None
+        ):
+            return sh.mesh, spec
+    return None
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_spmd(mesh, in_specs, out_specs, kind, axis):
+    """One compiled program per (mesh, layout, op-kind, axis) — eager collectives in a
+    training loop must not re-trace every call (the reference caches comm rings the
+    same way, comm_context_manager.cc)."""
+    body = _SPMD_BODIES[kind](axis)
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+
+
+def _run_spmd_cached(mesh, in_specs, out_specs, kind, axis, *arrs):
+    return _compiled_spmd(mesh, in_specs, out_specs, kind, axis)(*arrs)
+
+
+def _reduce_replicated(data, op, n):
+    if op == ReduceOp.SUM:
+        return data * n
+    if op == ReduceOp.PROD:
+        return data**n
+    return data  # MAX / MIN / AVG of n identical copies
+
+
+def _make_reduce_body(op):
+    def maker(axis):
+        def body(x):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(x, axis)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(x, axis)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(x, axis)
+            if op == ReduceOp.PROD:
+                return jnp.exp(
+                    jax.lax.psum(jnp.log(x.astype(jnp.float32)), axis)
+                ).astype(x.dtype)
+            return jax.lax.pmean(x, axis)  # AVG
+
+        return body
+
+    return maker
+
+
+def _make_bcast_body(srk):
+    def maker(axis):
+        def body(x):
+            full = jax.lax.all_gather(x, axis, axis=0, tiled=False)
+            return full[srk]
+
+        return body
+
+    return maker
+
+
+def _make_a2a_body(axis):
+    def body(x):
+        n = jax.lax.axis_size(axis)
+        return jax.lax.all_to_all(
+            x.reshape((n, x.shape[0] // n) + x.shape[1:]), axis, 0, 0, tiled=False
+        ).reshape(x.shape)
+
+    return body
+
+
+_SPMD_BODIES = {
+    ("reduce", ReduceOp.SUM): _make_reduce_body(ReduceOp.SUM),
+    ("reduce", ReduceOp.MAX): _make_reduce_body(ReduceOp.MAX),
+    ("reduce", ReduceOp.MIN): _make_reduce_body(ReduceOp.MIN),
+    ("reduce", ReduceOp.PROD): _make_reduce_body(ReduceOp.PROD),
+    ("reduce", ReduceOp.AVG): _make_reduce_body(ReduceOp.AVG),
+    "a2a": _make_a2a_body,
+}
+
+
+def _reduce_sharded(data, op, mesh, spec, axis):
+    # out keeps the input layout: in the global view each rank's shard now holds the
+    # reduced value (global array = concatenation of per-rank results, like the
+    # reference where every rank's local tensor becomes the sum).
+    return _run_spmd_cached(mesh, (P(*spec),), P(*spec), ("reduce", op), axis, data)
+
+
+def _collective_reduce(t: Tensor, op, group) -> jax.Array:
+    group = _resolve_group(group)
+    hit = _sharded_axis(t.data, group)
+    if hit is None:
+        return _reduce_replicated(t.data, op, group.nranks)
+    mesh, spec = hit
+    return _reduce_sharded(t.data, op, mesh, spec, group.axis_name)
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference: python/paddle/distributed/communication/all_reduce.py.  In-place."""
+    tensor._data = _collective_reduce(tensor, op, group)
+    return _Work(tensor)
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SPMD note: every shard computes the reduction (XLA has no rooted reduce on
+    mesh axes); result is bitwise-identical on dst, matching the contract."""
+    tensor._data = _collective_reduce(tensor, op, group)
+    return _Work(tensor)
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
+    """Reference: communication/all_gather.py — gathers per-rank shards into a list."""
+    group = _resolve_group(group)
+    hit = _sharded_axis(tensor.data, group)
+    if hit is None:
+        parts = [jnp.array(tensor.data) for _ in range(group.nranks)]
+    else:
+        # sharded over the axis on some dim d: the global array already is the
+        # concatenation — slice it back into per-rank pieces.
+        mesh, spec = hit
+        d = next(
+            i for i, a in enumerate(spec)
+            if a == group.axis_name or (isinstance(a, tuple) and group.axis_name in a)
+        )
+        full = jax.device_put(
+            tensor.data, NamedSharding(mesh, P(*[None] * tensor.data.ndim))
+        )
+        parts = jnp.split(full, group.nranks, axis=d)
+    tensor_list.extend(Tensor(p) for p in parts)
+    return _Work(tensor_list)
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = _resolve_group(group)
+    object_list.extend([obj] * group.nranks)
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    """src's value wins; replicated data is already identical, sharded data gets the
+    src rank's shard replicated to all."""
+    group = _resolve_group(group)
+    hit = _sharded_axis(tensor.data, group)
+    if hit is not None:
+        mesh, spec = hit
+        srk = group.get_group_rank(src) if src in group.ranks else src
+        kind = ("bcast", srk)
+        if kind not in _SPMD_BODIES:
+            _SPMD_BODIES[kind] = _make_bcast_body(srk)
+        # every rank's shard becomes src's shard (same layout, new values)
+        tensor._data = _run_spmd_cached(
+            mesh, (P(*spec),), P(*spec), kind, group.axis_name, tensor.data
+        )
+    return _Work(tensor)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """This process receives chunk[rank] of src's data (communication/scatter.py)."""
+    group = _resolve_group(group)
+    rank = max(group.get_group_rank(_env.get_rank()), 0)
+    if tensor_list:
+        src_parts = [p.data if isinstance(p, Tensor) else jnp.asarray(p) for p in tensor_list]
+        tensor._data = src_parts[rank]
+    else:
+        tensor._data = jnp.split(tensor.data, group.nranks, axis=0)[rank]
+    return _Work(tensor)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = _resolve_group(group)
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        stacked = Tensor(jnp.concatenate([t.data for t in tensor_or_tensor_list], axis=0))
+    else:
+        stacked = tensor_or_tensor_list
+    reduced = _collective_reduce(stacked, op, group)
+    rank = max(group.get_group_rank(_env.get_rank()), 0)
+    tensor._data = jnp.split(reduced, group.nranks, axis=0)[rank]
+    return _Work(tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    group = _resolve_group(group)
+    rank = max(group.get_group_rank(_env.get_rank()), 0)
+    n = group.nranks
+    ins = [t.data if isinstance(t, Tensor) else jnp.asarray(t) for t in in_tensor_list]
+    # rank r receives in_list[r] from every peer; replicated emulation → n copies of
+    # this process's slot.
+    out_tensor_list.extend(Tensor(ins[rank]) for _ in range(n))
+    return _Work(out_tensor_list)
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None, in_split_sizes=None,
+                      group=None, sync_op=True):
+    group = _resolve_group(group)
+    hit = _sharded_axis(in_tensor.data, group)
+    if hit is not None:
+        mesh, spec = hit
+        out_tensor._data = _run_spmd_cached(
+            mesh, (P(*spec),), P(*spec), "a2a", group.axis_name, in_tensor.data
+        )
+    else:
+        out_tensor._data = jnp.array(in_tensor.data)
+    return _Work(out_tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point in single-controller SPMD is a device_put; the matching recv
+    reads the mailbox.  Cross-host p2p rides `jax.lax.ppermute` inside jitted pipeline
+    code (meta_parallel/pipeline_parallel.py) — this eager path serves API parity."""
+    _p2p_mailbox.setdefault(_resolve_group(group).id, {})[dst] = jnp.array(tensor.data)
+    return _Work(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    box = _p2p_mailbox.get(_resolve_group(group).id, {})
+    rank = _env.get_rank()
+    if rank in box:
+        tensor._data = box.pop(rank)
+    return _Work(tensor)
+
+
+isend = send
+irecv = recv
+
+_p2p_mailbox: dict[int, dict[int, jax.Array]] = {}
+
+
+class _Work:
+    """Async-work handle parity (ProcessGroup::Task).  XLA dispatch is already async;
+    wait() blocks on the data."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self, timeout=None):
+        r = self._result
+        if isinstance(r, Tensor):
+            jax.block_until_ready(r.data)
+        elif isinstance(r, (list, tuple)):
+            for t in r:
+                if isinstance(t, Tensor):
+                    jax.block_until_ready(t.data)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
+
+
+def batch_isend_irecv(p2p_op_list):
+    works = []
+    for op in p2p_op_list:
+        works.append(op.op(op.tensor, op.peer, group=op.group))
+    return works
+
+
+def barrier(group=None):
+    _env.barrier(group if group is not None else None)

@@ -1,0 +1,8 @@
+"""Collective op surface (reference: python/paddle/distributed/communication/ — one
+module per op + stream/ variants).  Implementations live in distributed.collective."""
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, barrier, batch_isend_irecv, broadcast, irecv, isend, recv,
+    reduce, reduce_scatter, scatter, send,
+)
+from paddle_tpu.distributed.communication import stream  # noqa: F401

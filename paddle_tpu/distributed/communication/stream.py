@@ -1,0 +1,62 @@
+"""Stream collective variants (reference: python/paddle/distributed/communication/stream/).
+
+``use_calc_stream`` has no meaning under XLA (one compiled program, scheduler-managed
+overlap); the functions accept and ignore it, matching semantics not mechanics."""
+from __future__ import annotations
+
+from paddle_tpu.distributed import collective as _c
+
+__all__ = [
+    "all_reduce", "all_gather", "all_to_all", "all_to_all_single", "broadcast",
+    "reduce", "reduce_scatter", "scatter", "send", "recv",
+]
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_tensor_list, tensor, group=group, sync_op=sync_op)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_to_all(out_tensor_list, in_tensor_list, group=group, sync_op=sync_op)
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None, in_split_sizes=None,
+                      group=None, sync_op=True, use_calc_stream=False):
+    return _c.all_to_all_single(out_tensor, in_tensor, out_split_sizes, in_split_sizes,
+                                group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM, group=None,
+                   sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op, group=group,
+                             sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group, sync_op=sync_op)

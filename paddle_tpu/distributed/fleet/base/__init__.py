@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (  # noqa: F401
+    DistributedStrategy,
+)

@@ -1,0 +1,81 @@
+"""DistributedStrategy facade (reference: paddle/fluid/framework/distributed_strategy.proto
++ python/paddle/distributed/fleet/base/distributed_strategy.py, 2826 LoC).
+
+The reference round-trips a protobuf; the TPU build keeps the same attribute surface as
+plain Python config (nothing downstream needs wire format)."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULT_HYBRID = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    "mp_configs": {},
+    "pp_configs": {},
+}
+
+
+class _SubConfig(dict):
+    __getattr__ = dict.get
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = _SubConfig(
+            init_loss_scaling=32768.0, use_pure_fp16=False, use_bf16=False,
+            custom_white_list=[], custom_black_list=[],
+        )
+        self.recompute = False
+        self.recompute_configs = _SubConfig(checkpoints=[])
+        self.sharding = False
+        self.sharding_configs = _SubConfig(
+            stage=1, sharding_degree=1, segment_broadcast_MB=32.0,
+            comm_buffer_size_MB=-1, split_param=False,
+        )
+        self.pipeline = False
+        self.pipeline_configs = _SubConfig(
+            accumulate_steps=1, micro_batch_size=1, schedule_mode="1F1B",
+        )
+        self.hybrid_configs = _SubConfig({k: (dict(v) if isinstance(v, dict) else
+                                              (list(v) if isinstance(v, list) else v))
+                                          for k, v in _DEFAULT_HYBRID.items()})
+        self.gradient_merge = False
+        self.gradient_merge_configs = _SubConfig(k_steps=1, avg=True)
+        self.dgc = False
+        self.lamb = False
+        self.lars = False
+        self.localsgd = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = _SubConfig(scale_strategy="avg")
+        self.a_sync = False
+        self.a_sync_configs = _SubConfig(k_steps=-1)
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and isinstance(value, dict) and not isinstance(value, _SubConfig):
+            merged = _SubConfig({k: (dict(v) if isinstance(v, dict) else
+                                     (list(v) if isinstance(v, list) else v))
+                                 for k, v in _DEFAULT_HYBRID.items()})
+            merged.update(value)
+            value = merged
+        elif key.endswith("_configs") and isinstance(value, dict) and not isinstance(value, _SubConfig):
+            cur = self.__dict__.get(key)
+            merged = _SubConfig(cur or {})
+            merged.update(value)
+            value = merged
+        object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

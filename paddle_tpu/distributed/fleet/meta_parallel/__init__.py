@@ -1,0 +1,52 @@
+"""Meta-parallel wrappers (reference: python/paddle/distributed/fleet/meta_parallel/).
+
+``fleet.distributed_model`` wraps the user model in one of these by strategy.  Under
+single-controller SPMD the wrappers are thin: parallel math comes from parameter/batch
+*layouts* (mp_layers, DataParallel batch sharding), not per-process code paths."""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc,
+)
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (  # noqa: F401
+    PipelineParallel, pipeline_apply, stack_stage_params,
+)
+
+__all__ = [
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "pipeline_apply", "stack_stage_params", "TensorParallel", "ShardingParallel",
+    "SegmentParallel",
+]
+
+
+class _PassthroughParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None, **kw):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+
+class TensorParallel(_PassthroughParallel):
+    """meta_parallel/tensor_parallel.py — broadcast of non-distributed params across mp
+    is implicit here: they are one global (replicated) array already."""
+
+
+class ShardingParallel(_PassthroughParallel):
+    """meta_parallel/sharding_parallel.py."""
+
+
+class SegmentParallel(_PassthroughParallel):
+    """meta_parallel/segment_parallel.py:26 — inputs are sharded on the sequence dim
+    over the sep axis by the caller (see distributed.sep_utils)."""

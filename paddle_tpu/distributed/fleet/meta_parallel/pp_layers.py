@@ -1,0 +1,107 @@
+"""Pipeline layer segmentation (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc:56, SharedLayerDesc:76, PipelineLayer:257).
+
+`PipelineLayer` keeps the reference's description API (a flat list of LayerDesc
+segmented into stages).  Single-controller SPMD holds every stage in one process, so
+``forward`` is simply the sequential composition (numerically identical); the
+*scheduled* pipeline execution is the functional path in pipeline_parallel.py, which
+jits a microbatched ppermute program over the "pp" mesh axis."""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"The input layer should be derived from Layer, got {layer_cls}")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        if num_stages is None:
+            if topology is not None:
+                num_stages = topology.get_dim("pp")
+            else:
+                from paddle_tpu.distributed.fleet import get_hybrid_communicate_group
+
+                hcg = get_hybrid_communicate_group()
+                num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = max(int(num_stages), 1)
+        self._recompute_interval = recompute_interval
+
+        descs = list(layers)
+        self._shared_layers = {}
+        built = []
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append((self._shared_layers[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"Invalid pipeline layer entry {d!r}")
+        self.run_function = built
+        self._layers = LayerList([l for l, _ in built if isinstance(l, Layer)])
+        self._segment()
+
+    def _segment(self):
+        """Uniform segmentation (reference seg_method='uniform'|'layer:...')."""
+        n = len(self.run_function)
+        s = self._num_stages
+        base, extra = divmod(n, s)
+        bounds = [0]
+        for i in range(s):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return [fn for fn, _ in self.run_function[lo:hi]]
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def forward(self, x):
+        for fn, fwd in self.run_function:
+            if fwd is not None:
+                x = fwd(fn, x)
+            elif self._recompute_interval and isinstance(fn, Layer):
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x

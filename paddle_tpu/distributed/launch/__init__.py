@@ -1,0 +1,1 @@
+from paddle_tpu.distributed.launch import main  # noqa: F401

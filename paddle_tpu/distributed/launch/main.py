@@ -1,0 +1,55 @@
+"""Launcher (reference: python/paddle/distributed/launch/main.py:23).
+
+On TPU pods the runtime (GKE/queued-resources) starts one process per host and exports
+the coordinator env; this launcher therefore only normalizes env and execs the training
+script — the reference's process-manager/rendezvous duties live in
+``jax.distributed.initialize`` (parallel_env.init_parallel_env)."""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def launch():
+    argv = sys.argv[1:]
+    # strip `--key value` launcher options the TPU runtime makes irrelevant, keep env
+    # overrides of the reference's contract working.
+    script = None
+    script_args = []
+    i = 0
+    known_flags = {"--nnodes", "--nproc_per_node", "--master", "--rank", "--devices",
+                   "--job_id", "--log_dir", "--ips", "--gpus", "--xpus", "--run_mode"}
+    while i < len(argv):
+        a = argv[i]
+        if script is None and a.startswith("--"):
+            key = a.split("=")[0]
+            if key in known_flags:
+                if "=" not in a and i + 1 < len(argv):
+                    val = argv[i + 1]
+                    i += 1
+                else:
+                    val = a.split("=", 1)[1] if "=" in a else ""
+                if key == "--master":
+                    os.environ.setdefault("PADDLE_MASTER", val)
+                elif key == "--nnodes":
+                    os.environ.setdefault("PADDLE_NNODES", val)
+                elif key == "--rank":
+                    os.environ.setdefault("PADDLE_TRAINER_ID", val)
+            i += 1
+            continue
+        if script is None:
+            script = a
+        else:
+            script_args.append(a)
+        i += 1
+    if script is None:
+        print("usage: python -m paddle_tpu.distributed.launch [options] script.py ...")
+        return 1
+    sys.argv = [script] + script_args
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

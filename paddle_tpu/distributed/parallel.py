@@ -1,0 +1,80 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py ``class DataParallel``
++ the C++ bucketing reducer, collective/reducer.cc:794,1086).
+
+TPU-native re-design: under single-controller SPMD the global batch is ONE array laid
+out over the "dp" mesh axis.  The gradient of a replicated parameter w.r.t. a
+global-batch loss is already the fully-reduced gradient — XLA inserts the psum during
+backward and fuses/overlaps it (latency-hiding scheduler), which supersedes the
+reference's bucketed fused-allreduce machinery.  The wrapper's job is only to lay out
+incoming batches."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+from paddle_tpu.autograd import engine as _engine
+
+__all__ = ["DataParallel"]
+
+
+def _dp_mesh():
+    from paddle_tpu.distributed.fleet import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.jax_mesh, "dp"
+    from paddle_tpu.distributed.parallel_env import world_mesh
+
+    return world_mesh(), "world"
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        if group is not None:
+            self._mesh, self._axis = group.mesh, group.axis_name
+        else:
+            self._mesh, self._axis = _dp_mesh()
+
+    def _shard_batch(self, x):
+        if not isinstance(x, Tensor):
+            return x
+        if x.ndim == 0 or x.shape[0] % self._mesh.shape[self._axis]:
+            return x
+        spec = P(*(self._axis,) + (None,) * (x.ndim - 1))
+        sh = NamedSharding(self._mesh, spec)
+        return _engine.apply("dp_shard", lambda a: jax.device_put(a, sh), x)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(i) for i in inputs)
+        kwargs = {k: self._shard_batch(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # grads are globally reduced already
+
+    def apply_collective_grads(self):
+        pass  # reducer machinery not needed; see module docstring
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def named_parameters(self, *a, **kw):
+        return self._layers.named_parameters(*a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._layers, name)

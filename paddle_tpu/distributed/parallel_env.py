@@ -1,0 +1,143 @@
+"""World bring-up and environment.
+
+TPU-native re-design of the reference's distributed bring-up
+(python/paddle/distributed/parallel.py:978 ``init_parallel_env``: TCPStore handshake +
+ProcessGroupNCCL creation).  On TPU the rendezvous/store/comm-init stack collapses into
+``jax.distributed.initialize`` (DCN rendezvous) + a global ``jax.sharding.Mesh`` over all
+devices (ICI); collectives are XLA ops, not a ProcessGroup runtime.
+
+Rank semantics (single-controller SPMD): the framework follows JAX's model — ONE Python
+program drives every device.  ``get_rank()`` is the process index (multi-host) and
+``get_world_size()`` is the number of *devices* participating in sharding, which is what
+users divide their global batch by.  Under the 8-device CPU test platform this gives
+rank 0 / world_size 8, the same per-shard view the reference's fake CustomCPU plugin
+tests use (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+
+__all__ = [
+    "init_parallel_env",
+    "is_initialized",
+    "get_rank",
+    "get_world_size",
+    "ParallelEnv",
+    "world_mesh",
+    "barrier",
+]
+
+_WORLD = {"mesh": None, "initialized": False}
+_WORLD_AXIS = "world"
+
+
+def _build_world_mesh():
+    devs = np.asarray(jax.devices())
+    return jax.sharding.Mesh(devs, (_WORLD_AXIS,))
+
+
+def init_parallel_env():
+    """Reference: python/paddle/distributed/parallel.py:978.
+
+    Multi-host: honours the launcher env contract (``PADDLE_MASTER`` /
+    ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM``) by forwarding it to
+    ``jax.distributed.initialize`` — the TCPStore analog.  Single host: just builds the
+    world mesh.  Idempotent, like the reference.
+    """
+    if _WORLD["initialized"]:
+        return ParallelEnv()
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and nnodes > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT")
+        addr = master if ":" in master or not port else f"{master}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    _WORLD["mesh"] = _build_world_mesh()
+    _WORLD["initialized"] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _WORLD["initialized"]
+
+
+def world_mesh() -> jax.sharding.Mesh:
+    if _WORLD["mesh"] is None:
+        _WORLD["mesh"] = _build_world_mesh()
+    return _WORLD["mesh"]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def barrier(group=None):
+    """All participating devices sync; on TPU a tiny psum forces a cross-device fence
+    (the reference issues an all-reduce of one element too, collective.py barrier)."""
+    mesh = group.mesh if group is not None else world_mesh()
+    axes = group.axis_names if group is not None else (_WORLD_AXIS,)
+    arr = jax.device_put(
+        np.zeros((), np.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+
+    def _b(x):
+        return jax.lax.psum(x, axes)
+
+    out = jax.jit(
+        jax.shard_map(_b, mesh=mesh, in_specs=jax.sharding.PartitionSpec(),
+                      out_specs=jax.sharding.PartitionSpec())
+    )(arr)
+    jax.block_until_ready(out)
+
+
+class ParallelEnv:
+    """Reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -1,0 +1,23 @@
+"""paddle.static — static-graph facade (reference: python/paddle/static/).
+
+The full Program/Executor surface lands in static/program.py; mode toggling and the
+functionalized-train-step core live here."""
+from __future__ import annotations
+
+from paddle_tpu.static.functionalize import (  # noqa: F401
+    TrainStep, build_eval_fn, build_train_step,
+)
+
+_static_mode = [False]
+
+
+def _enable_static():
+    _static_mode[0] = True
+
+
+def _disable_static():
+    _static_mode[0] = False
+
+
+def _static_mode_enabled() -> bool:
+    return _static_mode[0]

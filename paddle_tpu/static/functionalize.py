@@ -1,0 +1,129 @@
+"""Functionalize a Layer + loss + Optimizer into one jit-compiled train step.
+
+This is the TPU-native analog of the reference's static-graph lowering
+(python/paddle/base/executor.py + jit/to_static): instead of capturing a ProgramDesc,
+the eager Layer is run once under ``jax.jit`` tracing with its parameters/buffers/
+optimizer accumulators passed as pytree arguments, producing ONE fused XLA program for
+forward+backward+update per step (the CinnJitInstruction analog, SURVEY.md §2.5).
+
+Sharded parameters (mp_layers, group_sharded, shard_tensor) keep their NamedShardings —
+pjit propagates them through the step, so the same TrainStep object serves single-chip
+and full tp/pp/dp/sharding meshes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import engine as _engine
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["TrainStep", "build_train_step", "build_eval_fn"]
+
+
+class TrainStep:
+    """Callable ``step(*inputs, label) -> loss``.  Holds the functional state
+    (params/buffers/accumulators) and keeps the Layer's Parameters pointed at the
+    latest arrays after every step (reference users read ``layer.state_dict()``
+    mid-training)."""
+
+    def __init__(self, network, loss_fn, optimizer, recompute=False, donate=True):
+        self._network = network
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._recompute = recompute
+        self._params, self._buffers = network.functional_state()
+        self._states = (
+            optimizer.functional_init_states(self._params)
+            if optimizer is not None
+            else {}
+        )
+        self._step_count = int(getattr(optimizer, "_global_step", 0) or 0)
+        donate_argnums = (0, 2) if donate else ()
+        self._jitted = jax.jit(self._step_fn, donate_argnums=donate_argnums)
+
+    # -- traced once per (shapes, dtypes, shardings) --------------------------------
+    def _step_fn(self, params, buffers, states, lr, step, *datas):
+        network, loss_fn, optimizer = self._network, self._loss_fn, self._optimizer
+
+        def loss_of(ps):
+            # the eager tape is bypassed (no_grad): ops execute their jnp bodies
+            # directly as traced ops; jax.value_and_grad supplies the gradients.
+            with _engine.no_grad():
+                inputs = [Tensor(d) for d in datas]
+                if loss_fn is not None:
+                    out = network.functional_call(ps, buffers, *inputs[:-1])
+                    l = loss_fn(out, inputs[-1])
+                else:
+                    out = network.functional_call(ps, buffers, *inputs)
+                    l = out
+            return l.data if isinstance(l, Tensor) else l
+
+        fwd = jax.checkpoint(loss_of) if self._recompute else loss_of
+        lval, grads = jax.value_and_grad(fwd)(params)
+
+        clip = getattr(optimizer, "_grad_clip", None)
+        if clip is not None and hasattr(clip, "clip_norm"):
+            gn = jnp.sqrt(
+                sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads.values())
+            )
+            scale = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+            grads = {k: (g * scale.astype(g.dtype)) for k, g in grads.items()}
+
+        prev = optimizer._global_step
+        optimizer._global_step = step  # bias-correction uses the traced step counter
+        try:
+            new_params, new_states = optimizer.functional_update(params, grads, states, lr)
+        finally:
+            optimizer._global_step = prev
+        return lval, new_params, new_states
+
+    def __call__(self, *datas):
+        arrs = [d.data if isinstance(d, Tensor) else jnp.asarray(d) for d in datas]
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        self._step_count += 1
+        step = jnp.asarray(self._step_count, jnp.int32)
+        lval, self._params, self._states = self._jitted(
+            self._params, self._buffers, self._states, lr, step, *arrs
+        )
+        for n, p in self._network.named_parameters():
+            if n in self._params:
+                p._data = self._params[n]  # pointer swap, no device copy
+        sched = getattr(self._optimizer, "_lr_scheduler", None)
+        if sched is not None:
+            sched.step()
+        return Tensor(lval)
+
+    def state_dict(self):
+        return {n: Tensor(a) for n, a in {**self._params, **self._buffers}.items()}
+
+
+def build_train_step(network, loss_fn, optimizer, recompute=False, donate=True):
+    return TrainStep(network, loss_fn, optimizer, recompute=recompute, donate=donate)
+
+
+def build_eval_fn(network, loss_fn=None):
+    """jit-compiled forward (plus loss) with parameters passed functionally."""
+    params, buffers = network.functional_state()
+
+    @jax.jit
+    def eval_fn(params, buffers, *datas):
+        with _engine.no_grad():
+            inputs = [Tensor(d) for d in datas]
+            if loss_fn is not None:
+                out = network.functional_call(params, buffers, *inputs[:-1])
+                out = loss_fn(out, inputs[-1])
+            else:
+                out = network.functional_call(params, buffers, *inputs)
+        return jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor),
+        )
+
+    def run(*datas):
+        arrs = [d.data if isinstance(d, Tensor) else jnp.asarray(d) for d in datas]
+        p, b = network.functional_state()
+        out = eval_fn(p, b, *arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    return run
