@@ -1,0 +1,75 @@
+"""Benchmark entry: one JSON line for the driver.
+
+Measures the flagship Llama-style causal-LM training step (fwd+bwd+AdamW fused
+into one XLA program via paddle_tpu.static.functionalize) in bf16 on the
+available chip, and reports tokens/sec.  The reference publishes no absolute
+numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first value
+this harness ever recorded on this hardware (bench_baseline.json, committed
+once measured) — i.e. it tracks our own progress round over round.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.static.functionalize import build_train_step
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=1024, dtype="bfloat16",
+    )
+    batch, seq = 8, 1024
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01)
+    step = build_train_step(model, None, opt)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64"
+    )
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64"
+    )
+
+    step(ids, labels).numpy()  # compile + warm up
+    step(ids, labels).numpy()
+
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss.numpy()  # sync
+    dt = (time.perf_counter() - t0) / iters
+    tokens_per_sec = batch * seq / dt
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                base = json.load(f)
+            if base.get("value"):
+                vs = tokens_per_sec / float(base["value"])
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": "llama_1b_slice_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

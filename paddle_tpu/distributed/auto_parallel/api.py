@@ -47,6 +47,23 @@ def _normalize_placements(placements, mesh):
     return out
 
 
+def _partial_layout(mesh: ProcessMesh, placements, ndim):
+    """(n_contributions, full PartitionSpec) for the hidden-leading-axis Partial
+    encoding.  Positions are preserved: Partial entries are replaced by
+    Replicate (NOT compacted away) so Shard entries keep their mesh-dim index."""
+    partial_dims = [i for i, pl in enumerate(placements) if isinstance(pl, Partial)]
+    n = 1
+    for d in partial_dims:
+        n *= mesh.shape[d]
+    non_partial = [
+        Replicate() if isinstance(pl, Partial) else pl for pl in placements
+    ]
+    spec = to_partition_spec(non_partial, mesh, ndim)
+    names = tuple(mesh.dim_names[d] for d in partial_dims)
+    full_spec = P(names if len(names) > 1 else names[0], *spec)
+    return n, full_spec
+
+
 def _axis_size(mesh: ProcessMesh, entry) -> int:
     names = entry if isinstance(entry, tuple) else (entry,)
     n = 1
@@ -77,14 +94,8 @@ def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
         # each rank along the partial mesh dims contributes the SAME local value (the
         # reference's shard_tensor-with-Partial bring-up); stack contributions on a
         # hidden leading axis so the pending sum is explicit.
-        n = 1
-        for d in partial_dims:
-            n *= mesh.shape[d]
+        n, full_spec = _partial_layout(mesh, placements, t.data.ndim)
         arr = jnp.broadcast_to(t.data[None], (n,) + tuple(t.data.shape))
-        rest = [pl for pl in placements if not isinstance(pl, Partial)]
-        spec = to_partition_spec(rest, mesh, t.data.ndim)
-        names = tuple(mesh.dim_names[d] for d in partial_dims)
-        full_spec = P(names if len(names) > 1 else names[0], *spec)
         arr = jax.device_put(arr, NamedSharding(mesh.jax_mesh, full_spec))
         out = _mk_like(t, arr, stop_gradient)
         out._dist_mesh, out._dist_placements = mesh, placements
@@ -136,17 +147,10 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
     if any(isinstance(pl, Partial) for pl in placements):
         # r/s -> p: value becomes one rank's contribution, zeros elsewhere (reference
         # r_to_p semantics: rank0 keeps the value).
-        partial_dims = [i for i, pl in enumerate(placements) if isinstance(pl, Partial)]
-        n = 1
-        for d in partial_dims:
-            n *= mesh.shape[d]
+        n, full_spec = _partial_layout(mesh, placements, arr.ndim)
         stacked = jnp.concatenate(
             [arr[None], jnp.zeros((n - 1,) + tuple(arr.shape), arr.dtype)], axis=0
         )
-        rest = [pl for pl in placements if not isinstance(pl, Partial)]
-        spec = to_partition_spec(rest, mesh, arr.ndim)
-        names = tuple(mesh.dim_names[d] for d in partial_dims)
-        full_spec = P(names if len(names) > 1 else names[0], *spec)
         out = _mk_like(t, jax.device_put(stacked, NamedSharding(mesh.jax_mesh, full_spec)))
         out._dist_mesh, out._dist_placements = mesh, placements
         out._partial_hidden = True
@@ -164,7 +168,11 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
 def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
     arr = dist_tensor.data
     if getattr(dist_tensor, "_partial_hidden", False):
-        arr = jnp.sum(arr, axis=0)
+        src = getattr(dist_tensor, "_dist_placements", None) or []
+        rts = [pl.reduce_type for pl in src if isinstance(pl, Partial)]
+        rt = rts[0] if rts else "sum"
+        red = {"sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min}[rt]
+        arr = red(arr, axis=0)
     mesh = getattr(dist_tensor, "_dist_mesh", None)
     if mesh is not None:
         arr = jax.device_put(arr, NamedSharding(mesh.jax_mesh, P(*[None] * arr.ndim)))
@@ -295,10 +303,11 @@ class DistModel:
             if self._train_fn is None:
                 self._build_train_fn()
             return self._train_fn(*args)
-        out = self.network(*args[:1] if self._mode == "predict" else args[:1])
         if self._mode == "eval" and self._loss is not None:
-            return self._loss(out, *args[1:])
-        return out
+            # last arg is the label, everything before feeds the network
+            out = self.network(*args[:-1])
+            return self._loss(out, args[-1])
+        return self.network(*args)
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):

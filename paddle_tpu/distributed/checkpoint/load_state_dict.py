@@ -1,0 +1,67 @@
+"""load_state_dict with reshard-on-load (reference:
+python/paddle/distributed/checkpoint/load_state_dict.py:467).
+
+Reads the metadata file written by save_state_dict, reassembles each tensor
+from its shard files (which may have been written under a different
+mesh/parallel strategy), and lays the result out with the CURRENT sharding of
+the destination tensor (jax.device_put with its existing sharding) — the
+reference's "reshard onto a different mesh" load path.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["load_state_dict"]
+
+
+def _assemble(entry, path):
+    import jax.numpy as jnp
+    import ml_dtypes  # bundled with jax
+
+    dtype_s = entry["dtype"]
+    try:
+        np_dtype = np.dtype(dtype_s)
+    except TypeError:
+        np_dtype = np.dtype(getattr(ml_dtypes, dtype_s))
+    out = np.empty(entry["global_shape"], dtype=np_dtype)
+    for sh in entry["shards"]:
+        block = np.load(os.path.join(path, sh["file"]))
+        if block.dtype != np_dtype:
+            block = block.view(np_dtype)
+        idx = tuple(slice(a, b) for a, b in sh["index"])
+        out[idx] = block
+    return out
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False):
+    """Fill ``state_dict``'s tensors in place from the checkpoint at ``path``."""
+    import jax
+
+    from paddle_tpu.tensor.tensor import Tensor
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    missing = [k for k in state_dict if k not in meta]
+    if missing:
+        raise ValueError(f"keys not found in checkpoint: {missing}")
+    for name, value in state_dict.items():
+        entry = meta[name]
+        assembled = _assemble(entry, path)
+        if isinstance(value, Tensor):
+            cur = value.data
+            if list(cur.shape) != list(assembled.shape):
+                raise ValueError(
+                    f"{name}: checkpoint shape {assembled.shape} != "
+                    f"current {tuple(cur.shape)}"
+                )
+            arr = jax.numpy.asarray(assembled)
+            if hasattr(cur, "sharding"):
+                arr = jax.device_put(arr, cur.sharding)  # reshard-on-load
+            value._data = arr
+        else:
+            state_dict[name] = assembled
+    return state_dict

@@ -1,0 +1,74 @@
+"""save_state_dict (reference: python/paddle/distributed/checkpoint/save_state_dict.py:145).
+
+Layout on disk:
+  path/
+    metadata.json      — {param: {"global_shape": [...], "dtype": str,
+                          "shards": [{"index": [[start, stop], ...], "file": f}]}}
+    shard_*.npy        — one file per DISTINCT global slice (replicated device
+                          shards are deduplicated, the reference's dedup_tensor
+                          behavior)
+
+Works for any jax.Array layout: fully-replicated, NamedSharding over any mesh,
+or single-device — the shard index recorded is the global slice each saved
+block covers, so load can reshard onto a different mesh/strategy.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["save_state_dict"]
+
+
+def _tensor_shards(arr):
+    """Yield (global_index, ndarray) for one copy of each distinct shard."""
+    import jax
+
+    if not isinstance(arr, jax.Array) or not hasattr(arr, "addressable_shards"):
+        a = np.asarray(arr)
+        yield tuple((0, s) for s in a.shape), a
+        return
+    seen = set()
+    for shard in arr.addressable_shards:
+        idx = shard.index  # tuple of slices into the global array
+        norm = tuple(
+            (0 if sl.start is None else int(sl.start),
+             int(arr.shape[d]) if sl.stop is None else int(sl.stop))
+            for d, sl in enumerate(idx)
+        )
+        if norm in seen:
+            continue
+        seen.add(norm)
+        yield norm, np.asarray(shard.data)
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    os.makedirs(path, exist_ok=True)
+    meta = {}
+    n_files = 0
+    for name, value in state_dict.items():
+        arr = value.data if isinstance(value, Tensor) else value
+        entry = {"global_shape": list(np.asarray(arr).shape)
+                 if not hasattr(arr, "shape") else list(arr.shape),
+                 "dtype": str(arr.dtype), "shards": []}
+        for norm_idx, block in _tensor_shards(arr):
+            fname = f"shard_{n_files}.npy"
+            n_files += 1
+            # bfloat16 & friends: store as raw uint16/uint8 view + dtype tag
+            if block.dtype.kind not in "biufc":
+                np.save(os.path.join(path, fname),
+                        block.view(np.uint8 if block.dtype.itemsize == 1
+                                   else np.uint16))
+            else:
+                np.save(os.path.join(path, fname), block)
+            entry["shards"].append(
+                {"index": [list(p) for p in norm_idx], "file": fname}
+            )
+        meta[name] = entry
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1)

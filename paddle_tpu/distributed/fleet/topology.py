@@ -77,10 +77,14 @@ class HybridCommunicateGroup:
 
         devs = np.asarray(jax.devices(), dtype=object)
         if self.nranks > len(devs):
-            reps = -(-self.nranks // len(devs))
-            devs = np.tile(devs, reps)[: self.nranks]
-        else:
-            devs = devs[: self.nranks]
+            # a Mesh with duplicated devices fails obscurely on first use —
+            # reject the misconfiguration up front
+            raise ValueError(
+                f"hybrid degrees {dict(zip(topology.get_hybrid_group_names(), [topology.get_dim(n) for n in topology.get_hybrid_group_names()]))} "
+                f"require {self.nranks} devices but only {len(devs)} are "
+                f"available"
+            )
+        devs = devs[: self.nranks]
         shape = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
         names = tuple(_JAX_AXES.get(n, n) for n in topology.get_hybrid_group_names())
         self.jax_mesh = Mesh(devs.reshape(shape), names)

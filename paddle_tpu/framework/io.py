@@ -1,0 +1,89 @@
+"""paddle.save / paddle.load (python/paddle/framework/io.py:773,1020 parity).
+
+Format: a pickle stream where Tensors are represented as (ndarray, dtype-str)
+leaves — same portability story as the reference (numpy-backed, loadable
+without device runtime).  ``.pdparams``/``.pdopt`` conventions are honored by
+callers; this layer is content-agnostic.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_SENTINEL = "__paddle_tpu_tensor__"
+_PARAM_SENTINEL = "__paddle_tpu_parameter__"
+
+
+def _pack(obj):
+    from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+    if isinstance(obj, Parameter):
+        return {
+            _PARAM_SENTINEL: np.asarray(obj.data),
+            "dtype": str(obj.data.dtype),
+            "name": obj.name,
+            "stop_gradient": obj.stop_gradient,
+        }
+    if isinstance(obj, Tensor):
+        return {
+            _SENTINEL: np.asarray(obj.data),
+            "dtype": str(obj.data.dtype),
+            "name": obj.name,
+            "stop_gradient": obj.stop_gradient,
+        }
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+    if isinstance(obj, dict):
+        if _SENTINEL in obj or _PARAM_SENTINEL in obj:
+            is_param = _PARAM_SENTINEL in obj
+            arr = obj[_PARAM_SENTINEL if is_param else _SENTINEL]
+            if str(arr.dtype) != obj["dtype"]:  # bfloat16 round-trips via view
+                import jax.numpy as jnp
+
+                arr = np.asarray(arr).view(jnp.bfloat16) if obj[
+                    "dtype"] == "bfloat16" else arr.astype(obj["dtype"])
+            if return_numpy:
+                return np.asarray(arr)
+            if is_param:
+                t = Parameter(arr, trainable=not obj.get("stop_gradient", False))
+            else:
+                t = Tensor(arr, stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", "")
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save: Layer/Optimizer state_dicts, Tensors, or nested containers."""
+    if hasattr(obj, "state_dict") and not isinstance(obj, dict):
+        obj = obj.state_dict()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    packed = _pack(obj)
+    with open(path, "wb") as f:
+        pickle.dump(packed, f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load."""
+    if not os.path.exists(path):
+        raise ValueError(f"The ``path`` ({path}) to load model not exists.")
+    with open(path, "rb") as f:
+        packed = pickle.load(f)
+    return _unpack(packed, return_numpy=return_numpy)

@@ -1,0 +1,12 @@
+"""paddle.io namespace (python/paddle/io parity, SURVEY.md §2.10 Data IO)."""
+from paddle_tpu.io.dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from paddle_tpu.io.reader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info,
+)
+from paddle_tpu.io.sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)

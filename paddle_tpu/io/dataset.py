@@ -1,0 +1,138 @@
+"""Datasets (python/paddle/io/dataloader/dataset.py parity)."""
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+]
+
+
+class Dataset:
+    """Map-style dataset: implement __getitem__ and __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            f"'{self.__class__.__name__}' not implement in function '__getitem__'"
+        )
+
+    def __len__(self):
+        raise NotImplementedError(
+            f"'{self.__class__.__name__}' not implement in function '__len__'"
+        )
+
+
+class IterableDataset(Dataset):
+    """Iterable-style dataset: implement __iter__."""
+
+    def __iter__(self):
+        raise NotImplementedError(
+            f"'{self.__class__.__name__}' not implement in function '__iter__'"
+        )
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset does not support __getitem__")
+
+    def __len__(self):
+        # TypeError (not RuntimeError): builtins like list() probe __len__ via
+        # length_hint, which only tolerates TypeError
+        raise TypeError("IterableDataset does not support __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        lens = {t.shape[0] for t in tensors}
+        assert len(lens) == 1, "tensors must have the same first-dim size"
+        self.tensors = tensors
+
+    def __getitem__(self, index):
+        return tuple(t[index] for t in self.tensors)
+
+    def __len__(self):
+        return int(self.tensors[0].shape[0])
+
+
+class ComposeDataset(Dataset):
+    """Zip several map-style datasets, concatenating their fields."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "datasets must not be empty"
+        n = len(self.datasets[0])
+        for d in self.datasets:
+            assert len(d) == n, "lengths of datasets must be the same"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        sample = []
+        for d in self.datasets:
+            item = d[idx]
+            if isinstance(item, (tuple, list)):
+                sample.extend(item)
+            else:
+                sample.append(item)
+        return tuple(sample)
+
+
+class ChainDataset(IterableDataset):
+    """Chain several iterable-style datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "datasets should not be an empty iterable"
+        self.cumulative_sizes = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx = len(self) + idx
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        start = 0 if di == 0 else self.cumulative_sizes[di - 1]
+        return self.datasets[di][idx - start]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    """paddle.io.random_split — lengths may be absolute or fractions summing to 1."""
+    n = len(dataset)
+    if all(0.0 < l < 1.0 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(np.floor(n * l)) for l in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    assert sum(lengths) == n, (
+        "Sum of input lengths does not equal the length of the input dataset!"
+    )
+    perm = np.random.permutation(n).tolist()
+    out, off = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[off:off + l]))
+        off += l
+    return out

@@ -1,0 +1,208 @@
+"""DataLoader (python/paddle/io/reader.py:262 parity).
+
+TPU-native worker model: the reference forks worker *processes*
+(io/dataloader/worker.py) because CPython+CUDA tolerates fork; the TPU/JAX
+runtime does not (forking after backend init deadlocks the PJRT client), so
+``num_workers > 0`` here means a prefetching *thread* pool feeding a bounded
+queue — same overlap (host decode vs device step), no fork hazard.  True
+multiprocessing belongs to a spawn-based Dataset service (future work, mirrors
+the reference's Dataset/data_feed path).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset, IterableDataset
+from paddle_tpu.io.sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference: collate.py default_collate_fn)."""
+    from paddle_tpu.tensor.tensor import Tensor
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([s.data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn(list(f)) for f in zip(*batch))
+    raise TypeError(f"batch data can not be a type of {type(sample)}")
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(int(num_workers), 0)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last,
+                )
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------------ iter
+    def _index_batches(self):
+        if self.batch_sampler is not None:
+            yield from self.batch_sampler
+        else:  # batch_size=None: sample-at-a-time
+            yield from ([i] for i in range(len(self.dataset)))
+
+    def _make_batch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        if self.batch_sampler is None and self.batch_size is None:
+            return samples[0]
+        return self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        if self.batch_size is None:
+            yield from it
+            return
+        while True:
+            chunk = list(itertools.islice(it, self.batch_size))
+            if not chunk:
+                return
+            if len(chunk) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(chunk)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            if self._iterable:
+                yield from self._iter_iterable()
+            else:
+                for idx in self._index_batches():
+                    yield self._make_batch(idx)
+            return
+        yield from self._iter_prefetch()
+
+    def _iter_prefetch(self):
+        """Bounded-queue prefetch with worker threads (order-preserving)."""
+        if self._iterable:
+            # single producer preserves stream order
+            q: queue.Queue = queue.Queue(self.num_workers * self.prefetch_factor)
+            stop = object()
+
+            def produce():
+                _worker_info.info = WorkerInfo(0, 1, self.dataset)
+                if self.worker_init_fn:
+                    self.worker_init_fn(0)
+                try:
+                    for b in self._iter_iterable():
+                        q.put(b)
+                finally:
+                    q.put(stop)
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            while True:
+                item = q.get()
+                if item is stop:
+                    return
+                yield item
+            return
+
+        batches = list(self._index_batches())
+        results: dict[int, object] = {}
+        lock = threading.Lock()
+        cond = threading.Condition(lock)
+        counter = itertools.count()
+        max_ahead = self.num_workers * self.prefetch_factor
+        next_emit = [0]
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while True:
+                i = next(counter)
+                if i >= len(batches):
+                    return
+                with cond:
+                    while i - next_emit[0] >= max_ahead:
+                        cond.wait(0.1)
+                out = self._make_batch(batches[i])
+                with cond:
+                    results[i] = out
+                    cond.notify_all()
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(self.num_workers)
+        ]
+        for t in threads:
+            t.start()
+        for i in range(len(batches)):
+            with cond:
+                while i not in results:
+                    cond.wait(0.1)
+                out = results.pop(i)
+                next_emit[0] = i + 1
+                cond.notify_all()
+            yield out
+
+    @staticmethod
+    def from_generator(*a, **k):  # pragma: no cover - legacy static-graph API
+        raise NotImplementedError(
+            "DataLoader.from_generator is a legacy fluid API; iterate a "
+            "Dataset instead"
+        )

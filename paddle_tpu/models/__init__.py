@@ -1,0 +1,15 @@
+"""paddle_tpu.models — flagship model families (PaddleNLP/PaddleClas parity).
+
+The reference ships its model zoo out-of-tree (PaddleNLP: ERNIE/Llama,
+PaddleClas: ResNet — see BASELINE.json configs); this package provides the
+TPU-native implementations the benchmarks and the graft entry run: a
+Llama-family causal LM (GQA + RoPE + SwiGLU + RMSNorm, flash/ring attention)
+and a BERT/ERNIE-style encoder.  Vision models live in paddle_tpu.vision.
+"""
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_shardings,
+    shard_llama,
+)

@@ -1,0 +1,322 @@
+"""Llama-family causal LM — the flagship LLM config (BASELINE.json: Llama-2 /
+ERNIE-Bot hybrid-parallel track; PaddleNLP's llama modeling is the reference
+surface, built here TPU-first).
+
+Design notes (TPU-first, not a translation):
+  * bf16 weights by default — MXU-native; RMSNorm/softmax accumulate in fp32.
+  * attention routes through F.scaled_dot_product_attention → Pallas flash
+    kernel on TPU (paddle_tpu/ops/flash_attention.py); with ``sep_axis`` set,
+    attention runs ring attention over that mesh axis (context parallelism the
+    reference lacks, SURVEY.md §5.7).
+  * ``llama_shardings``/``shard_llama`` lay parameters out Megatron-style over
+    a ('dp', 'mp') mesh via NamedSharding; GSPMD propagates everything else —
+    no hand-written collectives in the model body.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+import paddle_tpu.tensor.manipulation as M
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Embedding, Linear
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.norm import RMSNorm
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_shardings",
+    "shard_llama",
+]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    use_flash_attention: bool = True
+    sep_axis: str | None = None  # mesh axis for ring-attention context parallel
+    recompute: bool = False
+
+    # tiny preset used by tests / dryrun
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _rope_cos_sin(seq_len, head_dim, theta, dtype):
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = jnp.outer(pos, inv)  # [L, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [L, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _apply_rope(q, k, theta, position_offset=0):
+    """q/k: [B, L, H, D] jax arrays."""
+    seq_len, head_dim = q.shape[1], q.shape[-1]
+    cos, sin = _rope_cos_sin(position_offset + seq_len, head_dim, theta, q.dtype)
+    cos = cos[position_offset:][None, :, None, :]
+    sin = sin[position_offset:][None, :, None, :]
+
+    def rot_half(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    return q * cos + rot_half(q) * sin, k * cos + rot_half(k) * sin
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, nh, nkv = config.hidden_size, config.num_attention_heads, \
+            config.num_key_value_heads
+        self.head_dim = h // nh
+        self.q_proj = Linear(h, nh * self.head_dim, bias_attr=False)
+        self.k_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
+        self.v_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
+        self.o_proj = Linear(nh * self.head_dim, h, bias_attr=False)
+
+    def forward(self, hidden_states, attn_mask=None, cache=None,
+                position_offset=0):
+        cfg = self.config
+        b, l = hidden_states.shape[0], hidden_states.shape[1]
+        nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, \
+            self.head_dim
+        q = M.reshape(self.q_proj(hidden_states), [b, l, nh, hd])
+        k = M.reshape(self.k_proj(hidden_states), [b, l, nkv, hd])
+        v = M.reshape(self.v_proj(hidden_states), [b, l, nkv, hd])
+
+        def rope_fn(qa, ka):
+            return _apply_rope(qa, ka, cfg.rope_theta, position_offset)
+
+        q, k = apply("rope", rope_fn, q, k)
+
+        new_cache = None
+        if cache is not None:
+            pk, pv = cache
+            if pk is not None:
+                k = M.concat([pk, k], axis=1)
+                v = M.concat([pv, v], axis=1)
+            new_cache = (k, v)
+
+        if nkv != nh:  # GQA: expand kv heads to full head count
+            rep = nh // nkv
+            k = apply("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), k)
+            v = apply("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), v)
+
+        if cfg.sep_axis is not None:
+            from paddle_tpu.distributed.auto_parallel.process_mesh import get_mesh
+            from paddle_tpu.ops.ring_attention import ring_attention_sharded
+
+            mesh = get_mesh().jax_mesh
+            out = apply(
+                "ring_attention",
+                lambda qa, ka, va: ring_attention_sharded(
+                    qa, ka, va, mesh, cfg.sep_axis, causal=True
+                ), q, k, v,
+            )
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                is_causal=attn_mask is None and l > 1,
+            )
+        out = M.reshape(out, [b, l, nh * hd])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = Linear(h, i, bias_attr=False)
+        self.up_proj = Linear(h, i, bias_attr=False)
+        self.down_proj = Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(
+            config.hidden_size, config.rms_norm_eps
+        )
+
+    def forward(self, hidden_states, attn_mask=None, cache=None,
+                position_offset=0):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        if cache is not None:
+            h, new_cache = self.self_attn(h, attn_mask, cache, position_offset)
+        else:
+            h = self.self_attn(h, attn_mask, None, position_offset)
+            new_cache = None
+        h = residual + h
+        residual = h
+        h = self.post_attention_layernorm(h)
+        h = residual + self.mlp(h)
+        if cache is not None:
+            return h, new_cache
+        return h
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        h = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            layer_fn = layer
+            if self.config.recompute and caches is None:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                h = recompute(layer_fn, h, attn_mask)
+            elif caches is not None:
+                h, c = layer_fn(h, attn_mask, caches[i], position_offset)
+                new_caches.append(c)
+            else:
+                h = layer_fn(h, attn_mask)
+        h = self.norm(h)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(
+                config.hidden_size, config.vocab_size, bias_attr=False
+            )
+            if config.dtype != "float32":
+                self.lm_head.to(dtype=config.dtype)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.weight
+            logits = F.linear(h, M.transpose(w, [1, 0]))
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        # next-token LM loss; logits in fp32 for a stable softmax
+        logits = logits.astype("float32")
+        b, l, v = logits.shape
+        shift_logits = M.reshape(logits[:, :-1, :], [b * (l - 1), v])
+        shift_labels = M.reshape(labels[:, 1:], [b * (l - 1)])
+        return F.cross_entropy(shift_logits, shift_labels)
+
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None):
+        """Greedy decode with a per-layer KV cache (eager path)."""
+        import jax.numpy as _jnp
+
+        from paddle_tpu.autograd import engine as _engine
+
+        with _engine.no_grad():
+            caches = [(None, None)] * self.config.num_hidden_layers
+            ids = input_ids
+            h, caches = self.llama(ids, None, caches, 0)
+            out_tokens = []
+            cur_len = ids.shape[1]
+            for _ in range(max_new_tokens):
+                logits = self._head(h[:, -1:, :])
+                nxt = Tensor(_jnp.argmax(logits.data, axis=-1).astype(_jnp.int64))
+                out_tokens.append(nxt)
+                if eos_token_id is not None and bool(
+                    (nxt.data == eos_token_id).all()
+                ):
+                    break
+                h, caches = self.llama(nxt, None, caches, cur_len)
+                cur_len += 1
+            return M.concat(out_tokens, axis=1)
+
+    def _head(self, h):
+        if self.config.tie_word_embeddings:
+            return F.linear(
+                h, M.transpose(self.llama.embed_tokens.weight, [1, 0])
+            )
+        return self.lm_head(h)
+
+
+# ------------------------------------------------------------------ TP shardings
+def llama_shardings(model: LlamaForCausalLM, mesh, dp_axis="dp", mp_axis="mp"):
+    """name → placements map: Megatron layout over (dp, mp) — column-parallel
+    q/k/v/gate/up (shard out-features), row-parallel o/down (shard in-features),
+    vocab-parallel embedding + lm_head.  Replicated on every other axis."""
+    from paddle_tpu.distributed.auto_parallel.placement_type import (
+        Replicate, Shard,
+    )
+
+    has_mp = mp_axis in mesh.dim_names
+    mp_idx = mesh.dim_names.index(mp_axis) if has_mp else None
+
+    def place(shard_dim=None):
+        pls = [Replicate() for _ in mesh.dim_names]
+        if has_mp and shard_dim is not None:
+            pls[mp_idx] = Shard(shard_dim)
+        return pls
+
+    out = {}
+    for name, _ in model.named_parameters():
+        if name.endswith(("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                          "gate_proj.weight", "up_proj.weight")):
+            out[name] = place(1)  # weight [in, out]: shard out-features
+        elif name.endswith(("o_proj.weight", "down_proj.weight")):
+            out[name] = place(0)  # shard in-features
+        elif name.endswith(("embed_tokens.weight", "lm_head.weight")):
+            out[name] = place(0 if "embed" in name else 1)
+        else:
+            out[name] = place(None)  # norms: replicated
+    return out
+
+
+def shard_llama(model: LlamaForCausalLM, mesh, dp_axis="dp", mp_axis="mp"):
+    """Apply llama_shardings in place via dist.shard_tensor (NamedSharding)."""
+    from paddle_tpu.distributed.auto_parallel.api import shard_tensor
+
+    placements = llama_shardings(model, mesh, dp_axis, mp_axis)
+    for name, p in model.named_parameters():
+        sharded = shard_tensor(p, mesh, placements[name],
+                               stop_gradient=p.stop_gradient)
+        p._data = sharded.data
+    return model

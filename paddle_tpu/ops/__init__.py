@@ -1,0 +1,8 @@
+"""paddle_tpu.ops — Pallas TPU kernels for the fused hot ops.
+
+The TPU-native analog of the reference's fused-kernel library
+(paddle/phi/kernels/fusion + third_party/flashattn): hand-written kernels only
+where XLA fusion leaves performance on the table — attention (flash/ring),
+fused collectives helpers — everything else is left to the compiler.
+"""
+from paddle_tpu.ops import flash_attention  # noqa: F401

@@ -1,0 +1,223 @@
+"""Flash attention for TPU: Pallas forward kernel + blockwise-differentiable fallback.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py over
+third_party/flashattn (CUDA).  TPU-native design:
+
+* ``_flash_fwd_pallas`` — an online-softmax Pallas kernel tiled for the MXU
+  (q blocks in VMEM, k/v streamed block-by-block, fp32 accumulators).  Used as
+  the forward fast path on TPU.
+* ``blockwise_attention`` — the same math as a ``lax.scan`` over key/value
+  blocks in pure jnp.  It is differentiable, memory-efficient (never
+  materializes the [Lq, Lk] score matrix), works on any backend, and is the
+  building block ring attention rotates over the mesh (ops/ring_attention.py).
+* ``flash_attention_blhd`` — custom_vjp wrapper: Pallas forward, backward via
+  the vjp of ``blockwise_attention`` (recompute — the flashattn backward
+  strategy, traded for FLOPs exactly as jax.checkpoint would).
+
+Layout is Paddle's flash-attention layout [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------- pallas fwd
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                scale: float):
+    """One (batch*head, q-block) program: online softmax over k blocks.
+
+    q_ref [1, block_q, D]; k_ref/v_ref [1, Lk, D]; o_ref [1, block_q, D].
+    """
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    lk = k_ref.shape[1]
+    num_k_blocks = lk // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[0]  # [block_q, D]
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k] fp32
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, head_dim), jnp.float32),
+        jnp.full((block_q,), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    # static trip count: a dynamic (causal-skip) bound trips a Mosaic
+    # while-lowering recursion under x64; fully-masked blocks contribute
+    # exp(-inf)=0 so the result is identical
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks), body,
+                                  init, unroll=num_k_blocks <= 8)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    b = min(preferred, n)
+    while n % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+def _flash_fwd_pallas(q, k, v, causal=False, scale=None, interpret=False):
+    """[B, L, H, D] in/out.  Grid: (B*H_kv-expanded, q blocks)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    # -> [B*H, L, D]
+    qh = jnp.swapaxes(q, 1, 2).reshape(b * h, lq, d)
+    kh = jnp.swapaxes(k, 1, 2).reshape(b * h, lk, d)
+    vh = jnp.swapaxes(v, 1, 2).reshape(b * h, lk, d)
+    block_q = _pick_block(lq, 512)
+    block_k = _pick_block(lk, 512)
+    grid = (b * h, lq // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=grid,
+        # index maps use `i * 0` (not the literal 0) so the constant inherits the
+        # i32 index dtype — a literal traces as i64 under jax_enable_x64 and
+        # Mosaic rejects the mixed-width index tuple
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)
+
+
+# ------------------------------------------------------------------- blockwise (jnp)
+def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
+                        q_offset=0, k_offset=0, carry_in=None,
+                        return_carry=False):
+    """Memory-efficient attention as a scan over k/v blocks ([B, L, H, D]).
+
+    ``q_offset``/``k_offset`` shift query/key positions to their global indices
+    (ring attention passes each rotating shard's offset); ``carry_in``/
+    ``return_carry`` expose the online-softmax state (acc, m, l) so callers can
+    stitch multiple k/v shards together.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    block_k = _pick_block(lk, block_k)
+    nblocks = lk // block_k
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [B, H, Lq, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    kb = kt.reshape(b, h, nblocks, block_k, d)
+    vb = vt.reshape(b, h, nblocks, block_k, d)
+    q_idx = q_offset + jnp.arange(lq)
+
+    def step(carry, blk):
+        acc, m, l = carry
+        kblk, vblk, kb_idx = blk
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qt, kblk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_idx = k_offset + kb_idx * block_k + jnp.arange(block_k)
+            mask = q_idx[:, None] >= k_idx[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32)
+        )
+        return (acc_new, m_new, l_new), None
+
+    if carry_in is None:
+        carry = (
+            jnp.zeros((b, h, lq, d), jnp.float32),
+            jnp.full((b, h, lq), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, lq), jnp.float32),
+        )
+    else:
+        carry = carry_in
+    blocks = (
+        jnp.moveaxis(kb, 2, 0),  # [nblocks, B, H, block_k, D]
+        jnp.moveaxis(vb, 2, 0),
+        jnp.arange(nblocks),
+    )
+    carry, _ = jax.lax.scan(step, carry, blocks)
+    if return_carry:
+        return carry
+    acc, m, l = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- public entry
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def available(q_shape) -> bool:
+    """Whether the Pallas fast path handles this shape (else XLA composition)."""
+    if len(q_shape) != 4:
+        return False
+    _, l, _, d = q_shape
+    # lane dim wants 128-multiples; tiny shapes aren't worth a kernel launch
+    return _on_tpu() and d in (64, 128, 256) and l >= 128 and l % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_blhd(q, k, v, causal=False, scale=None):
+    """Flash attention, [batch, seq, heads, head_dim]."""
+    return _flash_fwd_pallas(q, k, v, causal=causal, scale=scale)
+
+
+def _fa_fwd(q, k, v, causal, scale):
+    return _flash_fwd_pallas(q, k, v, causal=causal, scale=scale), (q, k, v)
+
+
+def _fa_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               scale=scale), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention_blhd.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,98 @@
+"""Ring attention over an ICI ring (context parallelism for long sequences).
+
+The reference has NO ring/blockwise/Ulysses attention (SURVEY.md §5.7 — its
+long-context story stops at Megatron-SP + the 'sep' mesh axis + flashattn), so
+this component deliberately exceeds it: sequence-sharded attention where k/v
+shards rotate around the mesh axis with ``jax.lax.ppermute`` while each device
+accumulates online-softmax state — compute on the current shard overlaps the
+ICI transfer of the next (XLA's latency-hiding scheduler does the overlap).
+
+Use inside ``shard_map`` (paddle_tpu.distributed.sep_utils wires it to the
+fleet 'sep' axis), q/k/v sharded on the sequence dim: [B, L/n, H, D] per device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.flash_attention import _NEG_INF, blockwise_attention
+
+__all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention"]
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
+                   block_k: int = 512):
+    """Per-device body: full attention of the local q shard against the global
+    sequence, k/v rotating around ``axis_name``.  Differentiable (the backward
+    scan re-rotates in reverse via jax AD of the collective)."""
+    n = int(jax.lax.psum(1, axis_name))  # axis sizes are static under shard_map
+    my = jax.lax.axis_index(axis_name).astype(jnp.int32)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    def step(i, carry):
+        acc_m_l, kv = carry
+        kcur, vcur = kv
+        # source device whose shard we currently hold: my - i (mod n)
+        src = (my - i + n) % n
+        acc_m_l = blockwise_attention(
+            q, kcur, vcur, causal=causal, scale=scale, block_k=block_k,
+            q_offset=my * lq, k_offset=src * lk,
+            carry_in=acc_m_l, return_carry=True,
+        )
+        # rotate: pass our current shard to the next rank on the ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        knext = jax.lax.ppermute(kcur, axis_name, perm)
+        vnext = jax.lax.ppermute(vcur, axis_name, perm)
+        return acc_m_l, (knext, vnext)
+
+    carry0 = (
+        jnp.zeros((b, h, lq, d), jnp.float32),
+        jnp.full((b, h, lq), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, lq), jnp.float32),
+    )
+    carry = (carry0, (k, v))
+    # unrolled so XLA overlaps each shard's compute with the ppermute of the next
+    for i in range(n):
+        carry = step(i, carry)
+    (acc, m, l), _ = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis: str, causal: bool = False,
+                           scale=None, block_k: int = 512):
+    """Global-array entry: wraps ``ring_attention`` in a partial-manual
+    ``jax.shard_map`` over ``axis`` only — every other mesh axis (dp/mp/…)
+    stays automatic, so this composes with GSPMD sharding of the rest of the
+    model under one jit."""
+    P = jax.sharding.PartitionSpec
+    spec = P(None, axis)
+    f = jax.shard_map(
+        lambda q_, k_, v_: ring_attention(
+            q_, k_, v_, axis, causal=causal, scale=scale, block_k=block_k
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis}), check_vma=False,
+    )
+    return f(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+    """DeepSpeed-Ulysses style: all-to-all so each device gets the FULL sequence
+    for a subset of heads, attends locally, all-to-alls back.  [B, L/n, H, D] →
+    [B, L, H/n, D] → attn → [B, L/n, H, D].  Head count must divide the axis."""
+    n = jax.lax.psum(1, axis_name)
+
+    def a2a(x, split_axis, concat_axis):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            tiled=True,
+        )
+
+    # gather sequence, scatter heads
+    qh = a2a(q, 2, 1)
+    kh = a2a(k, 2, 1)
+    vh = a2a(v, 2, 1)
+    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    return a2a(out, 1, 2)

@@ -1,0 +1,229 @@
+"""Data IO + save/load + distributed checkpoint tests (SURVEY §2.10, §5.4)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, DataLoader,
+    Dataset, DistributedBatchSampler, IterableDataset, RandomSampler,
+    SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
+    random_split,
+)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * 2], dtype=np.float32), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class CountStream(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.asarray([i], dtype=np.float32)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        y = paddle.to_tensor(np.arange(6, dtype=np.int64))
+        ds = TensorDataset([x, y])
+        assert len(ds) == 6
+        a, b = ds[2]
+        assert list(a.numpy()) == [4.0, 5.0] and int(b.numpy()) == 2
+
+    def test_concat_subset_split(self):
+        ds = ConcatDataset([RangeDataset(4), RangeDataset(6)])
+        assert len(ds) == 10
+        assert float(ds[5][0][0]) == 1.0  # second dataset idx 1
+        sub = Subset(ds, [0, 5, 9])
+        assert len(sub) == 3
+        parts = random_split(RangeDataset(10), [7, 3])
+        assert [len(p) for p in parts] == [7, 3]
+
+    def test_compose_chain(self):
+        comp = ComposeDataset([RangeDataset(4), RangeDataset(4)])
+        assert len(comp[1]) == 4
+        chained = list(ChainDataset([CountStream(2), CountStream(3)]))
+        assert len(chained) == 5
+
+
+class TestSamplers:
+    def test_sequence_random(self):
+        ds = RangeDataset(10)
+        assert list(SequenceSampler(ds)) == list(range(10))
+        r = list(RandomSampler(ds))
+        assert sorted(r) == list(range(10))
+
+    def test_weighted(self):
+        w = [0.0, 0.0, 1.0]
+        idx = list(WeightedRandomSampler(w, 20, replacement=True))
+        assert all(i == 2 for i in idx)
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=False)
+        batches = list(bs)
+        assert len(bs) == 4 and [len(b) for b in batches] == [3, 3, 3, 1]
+        bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3
+
+    def test_distributed_batch_sampler(self):
+        seen = []
+        for rank in range(2):
+            s = DistributedBatchSampler(
+                RangeDataset(10), batch_size=2, num_replicas=2, rank=rank
+            )
+            for b in s:
+                seen.extend(b)
+        assert sorted(set(seen)) == list(range(10))
+
+
+class TestDataLoader:
+    def test_basic(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 2] and y.shape == [4]
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(RangeDataset(12), batch_size=3, shuffle=True)
+        ys = np.concatenate([np.asarray(x.numpy()[:, 0]) for x, _ in dl])
+        assert sorted(ys.tolist()) == [float(i) for i in range(12)]
+
+    def test_workers_preserve_order(self):
+        dl0 = DataLoader(RangeDataset(20), batch_size=4, num_workers=0)
+        dl2 = DataLoader(RangeDataset(20), batch_size=4, num_workers=2)
+        for (x0, _), (x2, _) in zip(dl0, dl2):
+            np.testing.assert_array_equal(x0.numpy(), x2.numpy())
+
+    def test_iterable_dataset(self):
+        dl = DataLoader(CountStream(7), batch_size=2, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 3 and batches[0].shape == [2, 1]
+        dl = DataLoader(CountStream(5), batch_size=2, num_workers=1)
+        assert len(list(dl)) == 3
+
+    def test_dict_collate(self):
+        class D(Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.asarray([i, i])}
+
+            def __len__(self):
+                return 4
+
+        batch = next(iter(DataLoader(D(), batch_size=4)))
+        assert batch["a"].shape == [4] and batch["b"].shape == [4, 2]
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        from paddle_tpu import nn
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        p = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), p)
+        sd = paddle.load(p)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        for (n1, p1), (n2, p2) in zip(m.named_parameters(), m2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+    def test_nested_and_numpy(self, tmp_path):
+        obj = {"step": 7, "w": paddle.to_tensor([1.0, 2.0]),
+               "nested": [paddle.to_tensor([3])]}
+        p = str(tmp_path / "ckpt.pdopt")
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        assert back["step"] == 7
+        np.testing.assert_array_equal(back["w"].numpy(), [1.0, 2.0])
+        asnp = paddle.load(p, return_numpy=True)
+        assert isinstance(asnp["w"], np.ndarray)
+
+    def test_bf16_roundtrip(self, tmp_path):
+        t = paddle.to_tensor(np.arange(8, dtype=np.float32)).astype("bfloat16")
+        p = str(tmp_path / "t.pd")
+        paddle.save({"t": t}, p)
+        back = paddle.load(p)
+        assert str(back["t"].data.dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(back["t"].data, dtype=np.float32),
+            np.asarray(t.data, dtype=np.float32),
+        )
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.optimizer import AdamW
+
+        m = nn.Linear(4, 4)
+        opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        loss = m(x).sum()
+        loss.backward()
+        opt.step()
+        p = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), p)
+        sd = paddle.load(p)
+        opt2 = AdamW(learning_rate=1e-3, parameters=m.parameters())
+        opt2.set_state_dict(sd)
+
+
+class TestDistributedCheckpoint:
+    def test_roundtrip_and_reshard(self, tmp_path):
+        import jax
+        import numpy as np
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel.api import shard_tensor
+        from paddle_tpu.distributed.auto_parallel.placement_type import (
+            Replicate, Shard,
+        )
+        from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict, save_state_dict,
+        )
+
+        mesh1 = ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["dp", "mp"])
+        w = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+        w1 = shard_tensor(w, mesh1, [Shard(0), Replicate()])
+        path = str(tmp_path / "dist_ckpt")
+        save_state_dict({"w": w1}, path)
+        assert os.path.exists(os.path.join(path, "metadata.json"))
+
+        # reshard onto a different mesh/layout
+        mesh2 = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        dst = shard_tensor(
+            paddle.to_tensor(np.zeros((8, 8), np.float32)), mesh2,
+            [Replicate(), Shard(1)],
+        )
+        load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(np.asarray(dst.data), w.numpy())
+        # layout preserved
+        assert dst.data.sharding.spec == jax.sharding.PartitionSpec(None, "mp")
+
+    def test_dedup_replicated_shards(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu.distributed.auto_parallel.api import shard_tensor
+        from paddle_tpu.distributed.auto_parallel.placement_type import Replicate
+        from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["dp"])
+        w = shard_tensor(
+            paddle.to_tensor(np.ones((4, 4), np.float32)), mesh, [Replicate()]
+        )
+        path = str(tmp_path / "ckpt")
+        save_state_dict({"w": w}, path)
+        files = [f for f in os.listdir(path) if f.endswith(".npy")]
+        assert len(files) == 1  # 8 replicated device shards -> 1 file
