@@ -69,6 +69,18 @@ def __getattr__(name):
         mod = _importlib.import_module(f"paddle_tpu.{name}")
         globals()[name] = mod
         return mod
+    if name == "Model":
+        from paddle_tpu.hapi.model import Model as _M
+
+        return _M
+    if name == "metric":
+        mod = _importlib.import_module("paddle_tpu.metric")
+        globals()[name] = mod
+        return mod
+    if name == "models":
+        mod = _importlib.import_module("paddle_tpu.models")
+        globals()[name] = mod
+        return mod
     if name == "save":
         from paddle_tpu.framework.io import save as _s
 

@@ -34,6 +34,7 @@ __all__ = [
 
 _tls = threading.local()
 _amp_cast = None  # lazily bound to amp.auto_cast.cast_op_inputs
+_symbolic_variable = None  # lazily bound to static.program.Variable
 
 
 def is_grad_enabled() -> bool:
@@ -186,6 +187,16 @@ def apply(name: str, fn: Callable, *args, **kwargs):
 
     is_tensor = lambda x: isinstance(x, Tensor)
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=is_tensor)
+
+    # static-graph capture: ops over symbolic Variables record onto the Program
+    # tape instead of executing (SURVEY §3.2; static/program.py)
+    global _symbolic_variable
+    if _symbolic_variable is None:
+        from paddle_tpu.static.program import Variable as _symbolic_variable  # noqa
+    if any(isinstance(l, _symbolic_variable) for l in leaves):
+        from paddle_tpu.static.program import record_symbolic
+
+        return record_symbolic(name, fn, leaves, treedef)
 
     global _amp_cast
     if _amp_cast is None:

@@ -1,0 +1,4 @@
+"""paddle.callbacks namespace (python/paddle/callbacks.py parity)."""
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)

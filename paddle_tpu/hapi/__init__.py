@@ -1,0 +1,3 @@
+"""paddle.hapi — high-level training API (python/paddle/hapi parity)."""
+from paddle_tpu.hapi import callbacks  # noqa: F401
+from paddle_tpu.hapi.model import Model  # noqa: F401

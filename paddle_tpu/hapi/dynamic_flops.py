@@ -1,0 +1,39 @@
+"""paddle.flops (python/paddle/hapi/dynamic_flops.py parity — conv/linear FLOPs)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flops"]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough analytic FLOPs: counts matmul/conv multiply-adds from layer shapes."""
+    from paddle_tpu import nn
+
+    total = [0]
+    hooks = []
+
+    def linear_hook(layer, inp, out):
+        total[0] += int(np.prod(inp[0].shape)) * layer.weight.shape[-1]
+
+    def conv_hook(layer, inp, out):
+        k = int(np.prod(layer.weight.shape[2:]))
+        cin = layer.weight.shape[1]
+        total[0] += int(np.prod(out.shape)) * cin * k
+
+    for sub in net.sublayers():
+        if isinstance(sub, nn.Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+        elif isinstance(sub, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+
+    import paddle_tpu as paddle
+
+    x = paddle.zeros(list(input_size))
+    from paddle_tpu.autograd import engine as _e
+
+    with _e.no_grad():
+        net(x)
+    for h in hooks:
+        h.remove()
+    return total[0]

@@ -1,0 +1,239 @@
+"""High-level Model trainer (python/paddle/hapi/model.py:1472 parity).
+
+fit/evaluate/predict over DataLoaders with callbacks and metrics; train_batch
+runs the jit-compiled functional train step (paddle_tpu.static.functionalize),
+so Model.fit is a fused XLA program per step — the hapi analog of the
+reference's prepare→fit path (which builds a static Program under the hood).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.hapi.callbacks import config_callbacks
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._train_step = None
+        self._eval_fn = None
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from paddle_tpu.static.functionalize import build_train_step
+
+            self._train_step = build_train_step(
+                self.network, self._loss, self._optimizer
+            )
+        return self._train_step
+
+    def _ensure_eval_fn(self):
+        if self._eval_fn is None:
+            from paddle_tpu.static.functionalize import build_eval_fn
+
+            self._eval_fn = build_eval_fn(self.network)
+        return self._eval_fn
+
+    # ------------------------------------------------------------------ steps
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_train_step()
+        args = _to_list(inputs) + _to_list(labels)
+        loss = step(*args)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        out = self._ensure_eval_fn()(*_to_list(inputs))
+        if self._loss is not None and labels is not None:
+            l = self._loss(out, *_to_list(labels))
+            return [float(l.numpy())], out
+        return [], out
+
+    def predict_batch(self, inputs):
+        return self._ensure_eval_fn()(*_to_list(inputs))
+
+    # ------------------------------------------------------------------ loops
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        steps = self._safe_len(train_loader)
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics],
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_i, batch in enumerate(train_loader):
+                inputs, labels = self._split_batch(batch)
+                cbks.on_train_batch_begin(step_i)
+                losses = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0]}
+                logs.update(self._update_metrics(inputs, labels))
+                cbks.on_train_batch_end(step_i, logs)
+                it += 1
+                if (num_iters and it >= num_iters) or self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_data, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers,
+                )
+                cbks.on_eval_end(eval_logs)
+            if (num_iters and it >= num_iters) or self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            l, out = self.eval_batch(inputs, labels)
+            losses.extend(l)
+            self._update_metrics_with_out(out, labels)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            import jax.numpy as jnp
+
+            first = outputs[0]
+            if isinstance(first, Tensor):
+                return [Tensor(jnp.concatenate([o.data for o in outputs]))]
+        return [outputs]
+
+    # ------------------------------------------------------------------ helpers
+    def _update_metrics(self, inputs, labels):
+        if not self._metrics or labels is None:
+            return {}
+        out = None
+        logs = {}
+        for m in self._metrics:
+            if out is None:
+                from paddle_tpu.autograd import engine as _e
+
+                with _e.no_grad():
+                    out = self.network(*_to_list(inputs))
+            c = m.compute(out, *_to_list(labels))
+            m.update(c if not isinstance(c, tuple) else c[0])
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def _update_metrics_with_out(self, out, labels):
+        if labels is None:
+            return
+        for m in self._metrics:
+            c = m.compute(out, *_to_list(labels))
+            m.update(c if not isinstance(c, tuple) else c[0])
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if has_labels and len(batch) >= 2:
+                return batch[:-1], batch[-1:]
+            return batch, None
+        return [batch], None
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        if data is None:
+            return []
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data
+
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    # ------------------------------------------------------------------ io
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+
+        self.network.set_state_dict(paddle.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(
+            int(np.prod(p.shape)) for p in self.network.parameters()
+        )
+        return {"total_params": n_params, "trainable_params": n_params}

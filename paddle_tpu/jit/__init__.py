@@ -1,0 +1,16 @@
+"""paddle.jit — dynamic-to-static (python/paddle/jit parity, SURVEY.md §2.8).
+
+TPU-native design: the reference needs SOT bytecode interception + AST
+transforms because Python must be lowered to ProgramDesc/PIR; here jax.jit
+already traces Python directly, so ``to_static`` wraps forward in a jit-compiled
+functional call (parameters passed as pytree) with an input_spec-keyed cache.
+``jit.save``/``jit.load`` persist (StableHLO text + weights) — the saved-model
+story whose runtime analog is the reference's AnalysisPredictor load-and-run.
+"""
+from paddle_tpu.jit.api import (  # noqa: F401
+    InputSpec, TranslatedLayer, ignore_module, load, not_to_static, save,
+    to_static,
+)
+
+__all__ = ["to_static", "save", "load", "not_to_static", "ignore_module",
+           "InputSpec", "TranslatedLayer"]

@@ -1,0 +1,224 @@
+"""paddle.jit implementation (reference: python/paddle/jit/api.py:195 to_static,
+jit/save/load via translated_layer.py; SOT replaced by jax.jit tracing)."""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_static", "save", "load", "not_to_static", "ignore_module",
+           "InputSpec", "TranslatedLayer"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec: shape may contain None (dynamic batch)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @staticmethod
+    def from_tensor(tensor, name=None):
+        return InputSpec(tensor.shape, str(tensor.dtype), name)
+
+
+_NOT_TO_STATIC = set()
+
+
+def not_to_static(func):
+    """Mark a function to run eagerly inside a to_static region (graph-break
+    parity; with jax.jit everything traces, so this is a no-op marker)."""
+    _NOT_TO_STATIC.add(func)
+    return func
+
+
+def ignore_module(modules):
+    return None
+
+
+class StaticFunction:
+    """Callable wrapping a Layer (or function) with a jit-compiled path.
+
+    The compiled function takes (params, buffers, *array_inputs) — recompiled
+    per (shapes, dtypes) signature exactly like the reference's program cache
+    keyed on input spec (program_translator.py CacheKey).
+    """
+
+    def __init__(self, function, input_spec=None, layer=None, full_graph=True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    @property
+    def _is_layer(self):
+        return self._layer is not None
+
+    def _compiled(self):
+        from paddle_tpu.autograd import engine as _engine
+        from paddle_tpu.tensor.tensor import Tensor
+
+        layer, fn = self._layer, self._function
+
+        @jax.jit
+        def run(params, buffers, *arrs):
+            with _engine.no_grad():
+                inputs = [Tensor(a) for a in arrs]
+                if layer is not None:
+                    out = layer.functional_call(params, buffers, *inputs)
+                else:
+                    out = fn(*inputs)
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor),
+            )
+
+        return run
+
+    def __call__(self, *args, **kwargs):
+        from paddle_tpu.tensor.tensor import Tensor
+
+        arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+        if key not in self._cache:
+            self._cache[key] = self._compiled()
+        if self._layer is not None:
+            params, buffers = self._layer.functional_state()
+        else:
+            params, buffers = {}, {}
+        out = self._cache[key](params, buffers, *arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    # parity surface
+    def concrete_program(self):  # pragma: no cover - reference debugging API
+        return None
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Reference api.py:195-301.  Decorator or wrapper; on a Layer instance
+    wraps its forward."""
+
+    def decorate(obj):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        if isinstance(obj, Layer):
+            obj.forward = StaticFunction(
+                obj.forward, input_spec=input_spec, layer=obj
+            )
+            return obj
+        if hasattr(obj, "__self__") and isinstance(obj.__self__, Layer):
+            return StaticFunction(obj, input_spec=input_spec, layer=obj.__self__)
+        return StaticFunction(obj, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+# ----------------------------------------------------------------------- save/load
+def _resolve_specs(layer, input_spec):
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (no recorded trace)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = [1 if d is None else int(d) for d in s.shape]
+            specs.append((shape, s.dtype))
+        else:
+            specs.append((list(s.shape), str(s.dtype)))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: persists weights + StableHLO export of the forward.
+
+    Files: path.pdparams (weights), path.pdmodel.json (specs + layer class),
+    path.stablehlo (portable compiled graph text, the deployment artifact).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import engine as _engine
+    from paddle_tpu.tensor.tensor import Tensor
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    specs = _resolve_specs(layer, input_spec)
+    params, buffers = layer.functional_state()
+
+    def fwd(params, buffers, *arrs):
+        with _engine.no_grad():
+            out = layer.functional_call(
+                params, buffers, *[Tensor(a) for a in arrs]
+            )
+        return jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor),
+        )
+
+    example = [jnp.zeros(shape, dtype) for shape, dtype in specs]
+    lowered = jax.jit(fwd).lower(params, buffers, *example)
+    stablehlo = lowered.as_text()
+    with open(path + ".stablehlo", "w") as f:
+        f.write(stablehlo)
+    paddle.save({"params": params, "buffers": buffers}, path + ".pdparams")
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump({"input_specs": specs}, f)
+
+
+class TranslatedLayer:
+    """Loaded saved-model (reference: translated_layer.py).  Executes the saved
+    weights through a jit-compiled forward rebuilt from the stored params —
+    program semantics (weights frozen, inference only)."""
+
+    def __init__(self, params, buffers, specs, stablehlo_path=None):
+        self._params = params
+        self._buffers = buffers
+        self._specs = specs
+        self._stablehlo_path = stablehlo_path
+        self._fn = None
+
+    def __call__(self, *args):
+        raise NotImplementedError(
+            "TranslatedLayer is data-only unless a forward is bound; use "
+            "paddle.jit.load(path, layer=YourLayerClass(...)) to re-bind"
+        )
+
+    def state_dict(self):
+        from paddle_tpu.tensor.tensor import Tensor
+
+        return {k: Tensor(v) for k, v in {**self._params, **self._buffers}.items()}
+
+
+def load(path, layer=None, **configs):
+    """paddle.jit.load.  With ``layer`` (a constructed Layer of the same
+    architecture), rebinds weights and returns the layer with a jitted forward;
+    without, returns a TranslatedLayer exposing state_dict()."""
+    import paddle_tpu as paddle
+
+    blob = paddle.load(path + ".pdparams", return_numpy=True)
+    with open(path + ".pdmodel.json") as f:
+        meta = json.load(f)
+    params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    buffers = {k: jnp.asarray(v) for k, v in blob["buffers"].items()}
+    if layer is None:
+        return TranslatedLayer(params, buffers, meta["input_specs"],
+                               path + ".stablehlo")
+    layer.load_functional_state(params, buffers)
+    return to_static(layer)

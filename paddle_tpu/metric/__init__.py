@@ -1,0 +1,22 @@
+"""paddle.metric (python/paddle/metric/metrics.py parity)."""
+from paddle_tpu.metric.metrics import Accuracy, Auc, Metric, Precision, Recall  # noqa: F401
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (python/paddle/metric/metrics.py accuracy)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd.engine import apply
+    from paddle_tpu.tensor.tensor import Tensor
+
+    def f(pred, lab):
+        topk = jnp.argsort(pred, axis=-1)[..., ::-1][..., :k]
+        lab_ = lab.reshape(lab.shape[0], -1)[:, :1]
+        correct = (topk == lab_).any(axis=-1)
+        return correct.astype(jnp.float32).mean(keepdims=True)
+
+    input = input if isinstance(input, Tensor) else Tensor(input)
+    label = label if isinstance(label, Tensor) else Tensor(label)
+    return apply("accuracy", f, input, label)

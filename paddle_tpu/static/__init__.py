@@ -7,6 +7,11 @@ from __future__ import annotations
 from paddle_tpu.static.functionalize import (  # noqa: F401
     TrainStep, build_eval_fn, build_train_step,
 )
+from paddle_tpu.static.program import (  # noqa: F401
+    Executor, InputSpec, Program, Variable, data, default_main_program,
+    default_startup_program, global_scope, name_scope, program_guard,
+    scope_guard,
+)
 
 _static_mode = [False]
 

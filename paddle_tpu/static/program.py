@@ -1,0 +1,299 @@
+"""Program / Executor facade (reference: python/paddle/base/executor.py:1234,
+paddle/pir program; SURVEY.md §3.2 run contract).
+
+TPU-native design: the reference captures a ProgramDesc/PIR graph and runs it
+through PirInterpreter; here the Program is a recorded op tape.  Every eager op
+funnels through ``autograd.engine.apply`` — in static mode, ops whose inputs
+contain symbolic ``Variable``s append a node to the current Program instead of
+executing.  ``Executor.run`` compiles the tape once per (feed shapes/dtypes)
+with jax.jit — the jitted XLA executable is the StandaloneExecutor+
+CinnJitInstruction analog — and caches it (the _ExecutorCache behavior,
+executor.py:871).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Variable", "Program", "Executor", "data", "program_guard",
+    "default_main_program", "default_startup_program", "scope_guard",
+    "global_scope", "name_scope", "InputSpec",
+]
+
+
+class Variable:
+    """Symbolic tensor inside a Program (pd_op result analog)."""
+
+    __slots__ = ("program", "name", "shape", "dtype", "node_id", "out_index",
+                 "stop_gradient", "persistable")
+
+    def __init__(self, program, name, shape, dtype, node_id=None, out_index=0):
+        self.program = program
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.node_id = node_id  # producing node; None for feeds/params
+        self.out_index = out_index
+        self.stop_gradient = True
+        self.persistable = False
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+
+class _Node:
+    __slots__ = ("op_name", "fn", "arg_refs", "treedef", "n_out", "out_treedef")
+
+    def __init__(self, op_name, fn, arg_refs, treedef):
+        self.op_name = op_name
+        self.fn = fn
+        self.arg_refs = arg_refs  # list of Variable | jax.Array | python leaf
+        self.treedef = treedef
+        self.n_out = None
+        self.out_treedef = None
+
+
+class Program:
+    """Reference Program: holds ops + feed vars.  ``clone()``/random_seed kept
+    for surface parity."""
+
+    def __init__(self):
+        self.nodes: list[_Node] = []
+        self.feeds: dict[str, Variable] = {}
+        self.random_seed = 0
+        self._name_n = 0
+
+    def _fresh_name(self, prefix="tmp"):
+        self._name_n += 1
+        return f"{prefix}_{self._name_n}"
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    # block surface parity
+    @property
+    def ops(self):
+        return self.nodes
+
+    def all_parameters(self):
+        return []
+
+    def __repr__(self):
+        return f"Program(nodes={len(self.nodes)}, feeds={list(self.feeds)})"
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program() -> Program:
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[0]
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        self._prev_main = _default_main[0]
+        self._prev_startup = _default_startup[0]
+        _default_main[0] = self._main
+        if self._startup is not None:
+            _default_startup[0] = self._startup
+        return self
+
+    def __exit__(self, *a):
+        _default_main[0] = self._prev_main
+        _default_startup[0] = self._prev_startup
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — declare a feed Variable in the default main program."""
+    from paddle_tpu.core.dtype import convert_dtype
+
+    prog = default_main_program()
+    var = Variable(prog, name, [(-1 if s is None else s) for s in shape],
+                   np.dtype(convert_dtype(dtype)))
+    prog.feeds[name] = var
+    return var
+
+
+class InputSpec:  # re-exported by paddle.static
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+# --------------------------------------------------------------------- recording
+def record_symbolic(op_name, fn, leaves, treedef):
+    """Called from autograd.engine.apply when a leaf is a Variable: append a
+    node, infer output shapes with jax.eval_shape, return Variables."""
+    prog = None
+    for l in leaves:
+        if isinstance(l, Variable):
+            prog = l.program
+            break
+    node = _Node(op_name, fn, list(leaves), treedef)
+    node_id = len(prog.nodes)
+    prog.nodes.append(node)
+
+    def _aval(l):
+        if isinstance(l, Variable):
+            shape = [1 if s in (-1, None) else s for s in l.shape]
+            return jax.ShapeDtypeStruct(tuple(shape), l.dtype)
+        return l
+
+    from paddle_tpu.tensor.tensor import Tensor
+
+    avals = [
+        _aval(l) if isinstance(l, Variable)
+        else (l.data if isinstance(l, Tensor) else l) for l in leaves
+    ]
+
+    def run(*xs):
+        a, kw = jax.tree_util.tree_unflatten(treedef, list(xs))
+        return fn(*a, **kw)
+
+    out_shape = jax.eval_shape(run, *avals)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out_shape)
+    node.n_out = len(out_leaves)
+    node.out_treedef = out_treedef
+    outs = [
+        Variable(prog, prog._fresh_name(op_name), list(o.shape), o.dtype,
+                 node_id=node_id, out_index=i)
+        for i, o in enumerate(out_leaves)
+    ]
+    return jax.tree_util.tree_unflatten(out_treedef, outs)
+
+
+def _contains_variable(leaves):
+    return any(isinstance(l, Variable) for l in leaves)
+
+
+# --------------------------------------------------------------------- executor
+class Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._prev = _global_scope
+        _global_scope = self.scope
+        return self
+
+    def __exit__(self, *a):
+        global _global_scope
+        _global_scope = self._prev
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class Executor:
+    """Reference Executor (base/executor.py:1234): run(program, feed, fetch_list).
+
+    Compiles the program tape to one XLA executable per feed signature and
+    caches it."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, **kwargs):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        if not program.nodes and not fetch_list:
+            return []  # startup program: parameters are already initialized
+
+        feed_names = sorted(program.feeds.keys() & feed.keys())
+        arrs = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        key = (
+            id(program), tuple(feed_names),
+            tuple((tuple(a.shape), str(a.dtype)) for a in arrs),
+            tuple(id(v) for v in fetch_list),
+        )
+        if key not in self._cache:
+            self._cache[key] = self._compile(program, feed_names, fetch_list)
+        out = self._cache[key](*arrs)
+        if return_numpy:
+            return [np.asarray(o) for o in out]
+        from paddle_tpu.tensor.tensor import Tensor
+
+        return [Tensor(o) for o in out]
+
+    def _compile(self, program, feed_names, fetch_list):
+        from paddle_tpu.tensor.tensor import Tensor
+
+        def run_tape(*feed_arrs):
+            env = {}  # (node_id, out_index) -> value
+            feeds = dict(zip(feed_names, feed_arrs))
+
+            def resolve(ref):
+                if isinstance(ref, Variable):
+                    if ref.node_id is None:
+                        return feeds[ref.name]
+                    return env[(ref.node_id, ref.out_index)]
+                if isinstance(ref, Tensor):
+                    return ref.data
+                return ref
+
+            for node_id, node in enumerate(program.nodes):
+                vals = [resolve(r) for r in node.arg_refs]
+                a, kw = jax.tree_util.tree_unflatten(node.treedef, vals)
+                out = node.fn(*a, **kw)
+                out_leaves, _ = jax.tree_util.tree_flatten(out)
+                for i, o in enumerate(out_leaves):
+                    env[(node_id, i)] = o
+            return tuple(resolve(v) for v in fetch_list)
+
+        return jax.jit(run_tape)
+
+    def close(self):
+        self._cache.clear()

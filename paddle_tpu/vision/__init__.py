@@ -1,0 +1,28 @@
+"""paddle.vision namespace (python/paddle/vision parity, SURVEY.md §2.10)."""
+from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision.models import (  # noqa: F401
+    LeNet, MobileNetV1, ResNet, VGG, mobilenet_v1, resnet18, resnet34,
+    resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
+)
+
+
+def set_image_backend(backend):
+    if backend not in ("cv2", "pil", "tensor"):
+        raise ValueError(f"unsupported backend {backend}")
+    global _image_backend
+    _image_backend = backend
+
+
+_image_backend = "cv2"
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    import numpy as np
+
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    raise NotImplementedError("image decoding requires cv2/PIL (not bundled)")

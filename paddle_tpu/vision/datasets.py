@@ -1,0 +1,194 @@
+"""paddle.vision.datasets (python/paddle/vision/datasets parity).
+
+Zero-egress environment: the reference's downloaders can't run, so each dataset
+loads from a local file if given, and otherwise raises with instructions.
+``FakeData`` (the reference has an equivalent test-double pattern in
+test/legacy_test) generates deterministic synthetic images for pipelines and
+benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randint(0, 256, self.image_shape).astype(np.float32) / 255.0
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _need_file(path, what):
+    if path is None or not os.path.exists(path):
+        raise ValueError(
+            f"{what} requires a local data file (downloads are disabled in "
+            f"this environment); pass the path explicitly, got {path!r}"
+        )
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        _need_file(image_path, type(self).__name__)
+        _need_file(label_path, type(self).__name__)
+        self.mode = mode
+        self.transform = transform
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols
+            )
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[..., None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    _batches_train = [f"data_batch_{i}" for i in range(1, 6)]
+    _batches_test = ["test_batch"]
+    _key_prefix = "cifar-10-batches-py"
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        _need_file(data_file, type(self).__name__)
+        self.transform = transform
+        names = self._batches_train if mode == "train" else self._batches_test
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(b) for b in names):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(d[b"data"])
+                    labels.extend(d[self._label_key])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    _batches_train = ["train"]
+    _batches_test = ["test"]
+    _key_prefix = "cifar-100-python"
+    _label_key = b"fine_labels"
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class layout; .npy images supported natively (PIL-free)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = extensions or _IMG_EXTS
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                ok = (is_valid_file(fn) if is_valid_file
+                      else fn.lower().endswith(tuple(exts)))
+                if ok:
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        raise NotImplementedError(
+            "non-.npy image decoding requires cv2/PIL; provide a custom loader"
+        )
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = extensions or _IMG_EXTS
+        self.samples = [
+            os.path.join(root, fn) for fn in sorted(os.listdir(root))
+            if (is_valid_file(fn) if is_valid_file
+                else fn.lower().endswith(tuple(exts)))
+        ]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

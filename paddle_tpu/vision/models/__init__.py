@@ -1,0 +1,10 @@
+"""paddle.vision.models (python/paddle/vision/models parity)."""
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1, mobilenet_v1,
+)
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext101_32x4d, wide_resnet50_2, wide_resnet101_2,
+)
+from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle.vision.transforms (python/paddle/vision/transforms parity)."""
+from paddle_tpu.vision.transforms import functional  # noqa: F401
+from paddle_tpu.vision.transforms.transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, Compose, ContrastTransform,
+    Grayscale, Normalize, Pad, RandomCrop, RandomHorizontalFlip,
+    RandomResizedCrop, RandomVerticalFlip, Resize, ToTensor, Transpose,
+)

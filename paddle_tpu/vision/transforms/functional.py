@@ -1,0 +1,151 @@
+"""Transform functionals on numpy HWC images
+(python/paddle/vision/transforms/functional*.py parity; numpy backend — PIL is
+not a dependency of the TPU build, host-side image work is numpy/CPU)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "resize", "pad", "crop", "center_crop", "hflip", "vflip",
+    "normalize", "transpose", "adjust_brightness", "adjust_contrast",
+    "rotate", "to_grayscale",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(pic, data_format="CHW"):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    img = _as_hwc(pic).astype(np.float32)
+    if img.dtype == np.float32 and np.asarray(pic).dtype == np.uint8:
+        img = img / 255.0
+    elif np.asarray(pic).dtype == np.uint8:
+        img = img / 255.0
+    if data_format == "CHW":
+        img = img.transpose(2, 0, 1)
+    return Tensor(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    if interpolation == "nearest":
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        return img[yi][:, xi]
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    f = img.astype(np.float32)
+    out = (f[y0][:, x0] * (1 - wy) * (1 - wx) + f[y1][:, x0] * wy * (1 - wx)
+           + f[y0][:, x1] * (1 - wy) * wx + f[y1][:, x1] * wy * wx)
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return crop(img, i, j, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    is_tensor = isinstance(img, Tensor)
+    arr = np.asarray(img.numpy() if is_tensor else img, dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if is_tensor else arr
+
+
+def transpose(img, order=(2, 0, 1)):
+    return _as_hwc(img).transpose(order)
+
+
+def adjust_brightness(img, factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * factor
+    return np.clip(out, 0, 255).astype(img.dtype)
+
+
+def adjust_contrast(img, factor):
+    img = _as_hwc(img)
+    mean = img.astype(np.float32).mean()
+    out = (img.astype(np.float32) - mean) * factor + mean
+    return np.clip(out, 0, 255).astype(img.dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    img = _as_hwc(img)
+    k = int(round(angle / 90.0)) % 4
+    if abs(angle - 90 * round(angle / 90.0)) > 1e-6:
+        raise NotImplementedError(
+            "only multiples of 90 degrees supported by the numpy backend"
+        )
+    return np.rot90(img, k)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img).astype(np.float32)
+    g = img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+    g = g[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return g
