@@ -1,0 +1,59 @@
+"""paddle.base compatibility layer (reference: python/paddle/base/ — the legacy
+"fluid" namespace many downstream repos still import).  Thin aliases onto the real
+implementations; no separate machinery.
+"""
+from __future__ import annotations
+
+from paddle_tpu.base import core  # noqa: F401
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, TPUPlace, XPUPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu,
+)
+from paddle_tpu.static.program import (  # noqa: F401
+    Executor, Program, Scope, Variable, default_main_program,
+    default_startup_program, global_scope, name_scope, program_guard, scope_guard,
+)
+
+__all__ = [
+    "core", "Executor", "Program", "Scope", "Variable",
+    "default_main_program", "default_startup_program", "global_scope",
+    "program_guard", "scope_guard", "name_scope",
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace", "TPUPlace",
+    "CustomPlace", "dygraph", "framework", "in_dygraph_mode",
+]
+
+
+def in_dygraph_mode() -> bool:
+    import paddle_tpu
+
+    return paddle_tpu.in_dynamic_mode()
+
+
+class _DygraphShim:
+    """paddle.base.dygraph — guard/no_grad aliases."""
+
+    @staticmethod
+    def guard(place=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _noop():
+            yield
+
+        return _noop()
+
+    from paddle_tpu.autograd.engine import no_grad  # noqa: F401
+
+
+dygraph = _DygraphShim
+
+
+class _FrameworkShim:
+    from paddle_tpu.core.dtype import convert_dtype  # noqa: F401
+
+    @staticmethod
+    def in_dygraph_mode():
+        return in_dygraph_mode()
+
+
+framework = _FrameworkShim

@@ -1,0 +1,62 @@
+"""paddle.base.core compatibility shim.
+
+The reference's ``core`` is the pybind11 extension module ``libpaddle``
+(paddle/fluid/pybind/pybind.cc).  Here the native core is jax/XLA plus the
+paddle_tpu.native C ABI host; this shim exposes the handful of ``core.*`` symbols
+downstream code touches directly.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace, XPUPlace,
+    get_all_custom_device_type, get_all_device_type,
+)
+from paddle_tpu.core import dtype as _dtype
+
+
+class VarDesc:
+    """Legacy VarDesc.VarType dtype enum facade (reference: framework.proto)."""
+
+    class VarType:
+        FP16 = _dtype.float16
+        BF16 = _dtype.bfloat16
+        FP32 = _dtype.float32
+        FP64 = _dtype.float64
+        INT8 = _dtype.int8
+        INT16 = _dtype.int16
+        INT32 = _dtype.int32
+        INT64 = _dtype.int64
+        UINT8 = _dtype.uint8
+        BOOL = _dtype.bool_
+        COMPLEX64 = _dtype.complex64
+        COMPLEX128 = _dtype.complex128
+
+
+def is_compiled_with_cuda() -> bool:
+    return any(d.platform == "gpu" for d in jax.devices())
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(name: str) -> bool:
+    from paddle_tpu.core.device import is_compiled_with_custom_device as _f
+
+    return _f(name)
+
+
+def get_custom_device_count(name: str) -> int:
+    return sum(1 for d in jax.devices() if d.platform == name)
+
+
+class eager:
+    """core.eager namespace: Tensor is the eager tensor type."""
+
+    from paddle_tpu.tensor.tensor import Tensor  # noqa: F401
+
+
+def _get_all_register_op_kernels(*a, **k):  # pragma: no cover - parity shim
+    return {}

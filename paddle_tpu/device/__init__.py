@@ -1,0 +1,185 @@
+"""Device management facade (analog of python/paddle/device/__init__.py in the
+reference, which resolves custom device types via core.get_all_custom_device_type —
+python/paddle/device/__init__.py:201-313).
+
+The heavy lifting lives in paddle_tpu.core.device; this package adds the ``cuda`` /
+``xpu`` compatibility namespaces (memory stats map onto jax device memory stats) and
+stream/event objects whose synchronization semantics collapse onto XLA's ordered
+execution per device.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    current_place,
+    device_count,
+    device_guard,
+    get_all_custom_device_type,
+    get_all_device_type,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_custom_device,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+    synchronize,
+)
+
+from paddle_tpu.device import cuda, xpu  # noqa: F401,E402
+
+__all__ = [
+    "get_device", "set_device", "device_count", "synchronize",
+    "get_available_device", "get_available_custom_device",
+    "get_all_device_type", "get_all_custom_device_type",
+    "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_tpu",
+    "is_compiled_with_custom_device", "is_compiled_with_rocm",
+    "is_compiled_with_cinn", "is_compiled_with_distribute",
+    "is_compiled_with_ipu", "is_compiled_with_mlu", "is_compiled_with_npu",
+    "Stream", "Event", "stream_guard", "current_stream",
+    "cuda", "xpu", "IPUPlace", "MLUPlace", "NPUPlace",
+]
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # the XLA compiler is always present — it is this framework's CINN
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def IPUPlace(*a):  # pragma: no cover - parity shim
+    raise RuntimeError("IPU is not supported by paddle_tpu")
+
+
+def MLUPlace(*a):  # pragma: no cover - parity shim
+    raise RuntimeError("MLU is not supported by paddle_tpu")
+
+
+def NPUPlace(*a):  # pragma: no cover - parity shim
+    raise RuntimeError("NPU is not supported by paddle_tpu")
+
+
+def get_available_device():
+    """List of device strings usable with ``set_device`` (e.g. ['tpu:0', ...])."""
+    out = []
+    counts = {}
+    for d in jax.devices():
+        kind = {"gpu": "gpu", "tpu": "tpu", "cpu": "cpu"}.get(d.platform, d.platform)
+        i = counts.get(kind, 0)
+        counts[kind] = i + 1
+        out.append(f"{kind}:{i}" if kind != "cpu" else "cpu")
+    return out
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+class Event:
+    """Device event.  XLA executes each device's work in program order, so an event
+    is simply a marker tensor; ``synchronize`` blocks until prior work finished
+    (analog of phi::event::Event, paddle/phi/backends/event.cc)."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._device = device
+        self._marker = None
+
+    def record(self, stream=None):
+        # block_until_ready on a trivial computation after queued work acts as a
+        # completion marker for everything enqueued so far on the device.
+        import jax.numpy as jnp
+
+        self._marker = jnp.zeros((), jnp.int32) + 0
+
+    def query(self) -> bool:
+        if self._marker is None:
+            return True
+        return self._marker.is_ready()
+
+    def synchronize(self):
+        if self._marker is not None:
+            self._marker.block_until_ready()
+
+    def elapsed_time(self, end_event) -> float:  # pragma: no cover - timing shim
+        return 0.0
+
+
+class Stream:
+    """Device stream.  XLA owns stream assignment (its latency-hiding scheduler is
+    the analog of Paddle's multi-stream executor, SURVEY.md §5.8); this object keeps
+    the API surface (wait_event/wait_stream/record_event/synchronize)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def wait_event(self, event: Event):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+    def record_event(self, event: Event = None) -> Event:
+        event = event or Event(self.device)
+        event.record(self)
+        return event
+
+    def synchronize(self):
+        synchronize()
+
+    @property
+    def stream_base(self):
+        return self
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+def set_stream(stream: Stream) -> Stream:
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+@contextlib.contextmanager
+def stream_guard(stream: Stream):
+    prev = set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(prev)

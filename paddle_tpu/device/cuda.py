@@ -1,0 +1,105 @@
+"""paddle.device.cuda compatibility namespace.
+
+On TPU there is no CUDA, but user code ported from the reference calls
+``paddle.device.cuda.max_memory_allocated()`` etc. (python/paddle/device/cuda/,
+phi/core/memory/stats.cc).  These map onto jax's per-device memory stats so the
+calls keep working and report real accelerator numbers.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _stats(device=None) -> dict:
+    devs = jax.devices()
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif device is not None and hasattr(device, "get_device_id"):
+        idx = device.get_device_id()
+    try:
+        return devs[idx].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def device_count() -> int:
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+
+def is_available() -> bool:
+    return device_count() > 0
+
+
+def current_device():
+    return 0
+
+
+def get_device_name(device=None) -> str:
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    idx = device if isinstance(device, int) else 0
+    return devs[min(idx, len(devs) - 1)].device_kind
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+def get_device_properties(device=None):
+    class _Props:
+        pass
+
+    p = _Props()
+    p.name = get_device_name(device)
+    stats = _stats(device)
+    p.total_memory = stats.get("bytes_limit", 0)
+    p.major, p.minor = 0, 0
+    p.multi_processor_count = 0
+    return p
+
+
+def max_memory_allocated(device=None) -> int:
+    return _stats(device).get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None) -> int:
+    return _stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None) -> int:
+    return _stats(device).get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None) -> int:
+    return _stats(device).get("bytes_reserved", _stats(device).get("bytes_in_use", 0))
+
+
+def reset_max_memory_allocated(device=None):
+    pass
+
+
+def reset_max_memory_reserved(device=None):
+    pass
+
+
+def empty_cache():
+    # XLA's allocator manages HBM; donation/deallocation is automatic.
+    pass
+
+
+def synchronize(device=None):
+    from paddle_tpu.core.device import synchronize as _sync
+
+    _sync(device)
+
+
+def stream_guard(stream):
+    from paddle_tpu.device import stream_guard as _sg
+
+    return _sg(stream)
+
+
+def current_stream(device=None):
+    from paddle_tpu.device import current_stream as _cs
+
+    return _cs(device)

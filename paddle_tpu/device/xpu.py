@@ -1,0 +1,18 @@
+"""paddle.device.xpu compatibility namespace (reference: python/paddle/device/xpu/)."""
+from __future__ import annotations
+
+
+def device_count() -> int:
+    return 0
+
+
+def is_available() -> bool:
+    return False
+
+
+def synchronize(device=None):
+    pass
+
+
+def empty_cache():
+    pass

@@ -1,0 +1,151 @@
+"""paddle.fft parity (reference: python/paddle/fft.py over phi fft kernels backed by
+pocketfft/cuFFT — paddle/phi/kernels/funcs/fft.h).  On TPU the FFTs lower through
+XLA's FFT HLO; every transform goes through the autograd tape so gradients work in
+eager mode.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _check_norm(norm):
+    norm = norm or "backward"
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _make1d(op_name, jnp_fn, real_input=False):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        norm = _check_norm(norm)
+        x = _t(x)
+
+        def impl(a):
+            if real_input and jnp.iscomplexobj(a):
+                a = a.real
+            return jnp_fn(a, n=n, axis=axis, norm=norm)
+
+        return apply(op_name, impl, x)
+
+    op.__name__ = op_name
+    return op
+
+
+def _make_nd(op_name, jnp_fn, default_axes=None, real_input=False):
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
+        norm = _check_norm(norm)
+        x = _t(x)
+
+        def impl(a):
+            if real_input and jnp.iscomplexobj(a):
+                a = a.real
+            return jnp_fn(a, s=s, axes=axes, norm=norm)
+
+        return apply(op_name, impl, x)
+
+    op.__name__ = op_name
+    return op
+
+
+fft = _make1d("fft", jnp.fft.fft)
+ifft = _make1d("ifft", jnp.fft.ifft)
+rfft = _make1d("rfft", jnp.fft.rfft, real_input=True)
+irfft = _make1d("irfft", jnp.fft.irfft)
+hfft = _make1d("hfft", jnp.fft.hfft)
+ihfft = _make1d("ihfft", jnp.fft.ihfft, real_input=True)
+
+fft2 = _make_nd("fft2", jnp.fft.fft2, default_axes=(-2, -1))
+ifft2 = _make_nd("ifft2", jnp.fft.ifft2, default_axes=(-2, -1))
+rfft2 = _make_nd("rfft2", jnp.fft.rfft2, default_axes=(-2, -1), real_input=True)
+irfft2 = _make_nd("irfft2", jnp.fft.irfft2, default_axes=(-2, -1))
+fftn = _make_nd("fftn", jnp.fft.fftn)
+ifftn = _make_nd("ifftn", jnp.fft.ifftn)
+rfftn = _make_nd("rfftn", jnp.fft.rfftn, real_input=True)
+irfftn = _make_nd("irfftn", jnp.fft.irfftn)
+
+
+def _hfft_nd(op_name, fwd_nd, conj_ifft):
+    """hfft2/hfftn and ihfft2/ihfftn are not in jnp.fft; build them from the
+    identities hfftn(x) = irfftn-like real output of conj-symmetric input:
+    hfft(x) = fft of hermitian signal → real; equivalently irfft(conj(x)) scaled.
+    """
+
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        norm = _check_norm(norm)
+        x = _t(x)
+
+        def impl(a):
+            if conj_ifft:
+                # ihfftn: inverse of hfftn — rfftn of real input, conjugated
+                if jnp.iscomplexobj(a):
+                    a = a.real
+                inv_norm = {"backward": "forward", "forward": "backward",
+                            "ortho": "ortho"}[norm]
+                return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes, norm=inv_norm))
+            # hfftn: treat input as hermitian along the last axis
+            inv_norm = {"backward": "forward", "forward": "backward",
+                        "ortho": "ortho"}[norm]
+            return jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes, norm=inv_norm)
+
+        return apply(op_name, impl, x)
+
+    op.__name__ = op_name
+    return op
+
+
+hfft2 = _hfft_nd("hfft2", jnp.fft.fft2, conj_ifft=False)
+ihfft2 = _hfft_nd("ihfft2", jnp.fft.ifft2, conj_ifft=True)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfft_nd("hfftn", jnp.fft.fftn, conj_ifft=False)(
+        x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfft_nd("ihfftn", jnp.fft.ifftn, conj_ifft=True)(
+        x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from paddle_tpu.core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from paddle_tpu.core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    x = _t(x)
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    x = _t(x)
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

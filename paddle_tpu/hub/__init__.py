@@ -1,0 +1,60 @@
+"""paddle.hub parity (reference: python/paddle/hapi/hub.py — list/help/load from a
+github/gitee/local repo's hubconf.py).  Zero-egress: only ``source='local'`` works;
+remote sources raise with instructions.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.pop(0)
+    return module
+
+
+def _check_source(source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source '{source}' requires network access, which is disabled; "
+            f"clone the repo and use source='local'."
+        )
+
+
+def list(repo_dir, source="github", force_reload=False):
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    return [
+        name for name in dir(module)
+        if callable(getattr(module, name)) and not name.startswith("_")
+    ]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    if not hasattr(module, model):
+        raise ValueError(f"model {model} not found in {repo_dir}")
+    return getattr(module, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    if not hasattr(module, model):
+        raise ValueError(f"model {model} not found in {repo_dir}")
+    return getattr(module, model)(**kwargs)

@@ -1,0 +1,21 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py, which defers to the
+paddle2onnx package).  The TPU-native interchange format is StableHLO
+(paddle_tpu.jit.save / paddle_tpu.inference); ONNX export additionally requires the
+optional ``onnx`` package, which is not in this image, so the API is gated.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle_tpu.onnx.export requires the optional 'onnx' package, which is "
+            "not installed. For deployment use paddle_tpu.jit.save (StableHLO), the "
+            "TPU-native exchange format, instead."
+        )
+    raise NotImplementedError(
+        "ONNX export is not yet implemented; use paddle_tpu.jit.save (StableHLO).")

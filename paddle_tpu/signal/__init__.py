@@ -1,0 +1,160 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — frame, overlap_add,
+stft, istft over phi frame/overlap_add kernels + fft).
+
+Implemented as gather/scatter-free jnp ops so XLA can fuse: ``frame`` is a strided
+gather expressed with take, ``overlap_add`` a segment-sum via zero-padded reshape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _frame_impl(a, frame_length, hop_length, axis):
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    if axis == 0:
+        a = jnp.moveaxis(a, 0, -1)
+    n = a.shape[-1]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) > signal length ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = a[..., idx]  # (..., num_frames, frame_length)
+    out = jnp.swapaxes(out, -1, -2)  # (..., frame_length, num_frames)
+    if axis == 0:
+        out = jnp.moveaxis(out, (-2, -1), (1, 0))
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice a signal into overlapping frames (reference signal.py:frame)."""
+    x = _t(x)
+    return apply(
+        "frame",
+        lambda a: _frame_impl(a, int(frame_length), int(hop_length), int(axis)),
+        x,
+    )
+
+
+def _overlap_add_impl(a, hop_length, axis):
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+    if axis == 0:
+        # (frame_length, num_frames, ...) -> (..., frame_length, num_frames)
+        a = jnp.moveaxis(a, (0, 1), (-2, -1))
+    frame_length = a.shape[-2]
+    num_frames = a.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    # scatter-add each frame at offset i*hop: use a one-hot matmul so it maps to MXU
+    # instead of serialized scatters.
+    offsets = jnp.arange(num_frames) * hop_length  # (F,)
+    pos = offsets[:, None] + jnp.arange(frame_length)[None, :]  # (F, L)
+    onehot = (pos[..., None] == jnp.arange(out_len)).astype(a.dtype)  # (F, L, out)
+    # a: (..., L, F) ; einsum over (F, L)
+    out = jnp.einsum("...lf,flo->...o", a, onehot)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from overlapping frames (reference signal.py:overlap_add)."""
+    x = _t(x)
+    return apply(
+        "overlap_add",
+        lambda a: _overlap_add_impl(a, int(hop_length), int(axis)),
+        x,
+    )
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py:stft).
+
+    x: (batch?, signal_length) real or complex; returns (batch?, n_fft or
+    n_fft//2+1, num_frames) complex.
+    """
+    x = _t(x)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    if window is not None:
+        window = _t(window)
+
+    def impl(a, w=None):
+        complex_input = jnp.iscomplexobj(a)
+        if w is None:
+            w = jnp.ones((win_length,), a.real.dtype if complex_input else a.dtype)
+        # center-pad window to n_fft
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pad = n_fft // 2
+            widths = [(0, 0)] * (a.ndim - 1) + [(pad, pad)]
+            a = jnp.pad(a, widths, mode=pad_mode)
+        frames = _frame_impl(a, n_fft, hop_length, -1)  # (..., n_fft, F)
+        frames = frames * w[:, None]
+        if onesided and not complex_input:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    if window is not None:
+        return apply("stft", impl, x, window)
+    return apply("stft", impl, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT (reference signal.py:istft) with window-envelope normalization."""
+    x = _t(x)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    if window is not None:
+        window = _t(window)
+
+    def impl(spec, w=None):
+        if w is None:
+            w = jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[:, None]
+        sig = _overlap_add_impl(frames, hop_length, -1)
+        # normalize by summed squared window envelope
+        wsq = jnp.broadcast_to((w * w)[:, None], frames.shape[-2:])
+        env = _overlap_add_impl(wsq, hop_length, -1)
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:sig.shape[-1] - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    if window is not None:
+        return apply("istft", impl, x, window)
+    return apply("istft", impl, x)

@@ -1,0 +1,18 @@
+"""Build-environment queries (analog of python/paddle/sysconfig.py in the reference)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory containing the framework's C headers (native plugin ABI)."""
+    root = os.path.abspath(os.path.dirname(os.path.dirname(__file__)))
+    return os.path.join(root, "native", "include")
+
+
+def get_lib() -> str:
+    """Directory containing the framework's native shared libraries."""
+    root = os.path.abspath(os.path.dirname(os.path.dirname(__file__)))
+    return os.path.join(root, "native", "lib")

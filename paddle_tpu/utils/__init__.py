@@ -1,0 +1,117 @@
+"""paddle.utils parity (reference: python/paddle/utils/).
+
+Submodules: unique_name, download (gated — zero-egress), dlpack, cpp_extension
+(native build helpers for the plugin ABI, §2.2 of SURVEY.md).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from paddle_tpu.utils import dlpack, download, unique_name  # noqa: F401
+
+__all__ = [
+    "deprecated", "try_import", "require_version", "run_check",
+    "unique_name", "download", "dlpack", "flatten", "pack_sequence_as", "map_structure",
+]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Decorator marking an API deprecated (reference:
+    python/paddle/utils/deprecated.py)."""
+
+    def decorator(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level > 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """Import an optional dependency with a clear error (reference:
+    python/paddle/utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (
+                f"Optional dependency '{module_name}' is required for this API "
+                f"but is not installed (installs are disabled in this environment)."
+            )
+        raise ImportError(err_msg)
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is within range."""
+    from paddle_tpu.version import full_version
+
+    def _tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = _tuple(full_version)
+    if _tuple(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu>={min_version} required, found {full_version}")
+    if max_version is not None and _tuple(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu<={max_version} required, found {full_version}")
+    return True
+
+
+def run_check():
+    """Sanity-check the install: run a small matmul on the default device and, if
+    multiple devices exist, a psum across all of them (the analog of the
+    reference's paddle.utils.install_check which runs a tiny train step and a
+    2-GPU allreduce)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    x = paddle.randn([4, 4])
+    y = paddle.matmul(x, x)
+    y.numpy()
+    n = jax.device_count()
+    if n > 1:
+        arr = jnp.arange(float(n))
+        out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(arr)
+        assert float(out[0]) == float(arr.sum())
+    print(
+        f"paddle_tpu is installed successfully! "
+        f"backend={jax.default_backend()}, devices={n}"
+    )
+
+
+# --- pytree helpers (reference: python/paddle/utils/layers_utils.py flatten etc.) ---
+
+def flatten(nest):
+    import jax
+
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_sequence_as(structure, flat_sequence):
+    import jax
+
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structures):
+    import jax
+
+    return jax.tree_util.tree_map(func, *structures)

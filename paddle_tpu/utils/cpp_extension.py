@@ -1,0 +1,63 @@
+"""Custom native-extension build helpers (reference:
+python/paddle/utils/cpp_extension/ — CppExtension/CUDAExtension/setup/load used by
+test/custom_op and test/cpp_extension).
+
+TPU-native story: custom *device compute* belongs in Pallas (Python), so this module
+covers the remaining native use case — building C++ host-side extensions (custom IO,
+plugin-ABI devices, schedulers) with the in-image toolchain (g++).  pybind11 is not
+available; extensions use the raw CPython C API or export a C ABI consumed via
+ctypes (see paddle_tpu/native/).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig as _pysysconfig
+import tempfile
+
+__all__ = ["CppExtension", "load", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    def __init__(self, sources, include_dirs=None, extra_compile_args=None,
+                 extra_link_args=None, name=None):
+        self.sources = list(sources)
+        self.include_dirs = list(include_dirs or [])
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.name = name
+
+
+def load(name, sources, extra_include_paths=None, extra_cxx_cflags=None,
+         extra_ldflags=None, build_directory=None, verbose=False):
+    """Compile C++ sources into a shared library and return its path.
+
+    Unlike the reference (which imports the resulting pybind11 module), the
+    library is meant to be opened with ctypes/cffi; returns the .so path.
+    """
+    build_dir = build_directory or get_build_directory()
+    out = os.path.join(build_dir, f"lib{name}.so")
+    py_inc = _pysysconfig.get_paths()["include"]
+    from paddle_tpu.sysconfig import get_include
+
+    cmd = (
+        ["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+        + [f"-I{p}" for p in [py_inc, get_include()] + list(extra_include_paths or [])]
+        + list(extra_cxx_cflags or [])
+        + list(sources)
+        + ["-o", out]
+        + list(extra_ldflags or [])
+    )
+    if verbose:
+        print(" ".join(cmd))
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"extension build failed:\n{res.stderr}")
+    return out

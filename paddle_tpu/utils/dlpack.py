@@ -1,0 +1,31 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py over
+paddle/fluid/pybind/tensor.cc to_dlpack/from_dlpack; third_party/dlpack).
+
+jax arrays implement the DLPack protocol natively, so this is a thin adapter that
+keeps Paddle's API names.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    arr = x.data if isinstance(x, Tensor) else x
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    if isinstance(capsule, Tensor):
+        capsule = capsule.data
+    if hasattr(capsule, "__dlpack__"):
+        arr = jnp.from_dlpack(capsule)
+    else:  # legacy PyCapsule
+        arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

@@ -1,0 +1,41 @@
+"""Model/dataset download helpers (reference: python/paddle/utils/download.py).
+
+This environment is zero-egress, so network fetches are gated: if the target file
+already exists in the cache (pre-seeded) it is used; otherwise a clear error tells
+the user to place the file manually.  md5 checking still works for local files.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/hapi/weights")
+DATA_HOME = osp.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def _md5check(fullname, md5sum=None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if osp.exists(fullname) and (not check_exist or _md5check(fullname, md5sum)):
+        return fullname
+    raise RuntimeError(
+        f"Cannot download '{url}': network access is disabled in this "
+        f"environment. Place the file manually at '{fullname}'."
+    )
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

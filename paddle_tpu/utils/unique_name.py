@@ -1,0 +1,53 @@
+"""Unique name generation (reference: python/paddle/utils/unique_name.py over
+python/paddle/base/unique_name.py — prefix counters with guard/switch)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self):
+        self._ids = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key: str) -> str:
+        with self._lock:
+            i = self._ids.get(key, 0)
+            self._ids[key] = i + 1
+        return f"{key}_{i}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        # paddle allows a string prefix guard
+        gen = _Generator()
+        prefix = new_generator
+
+        class _Prefixed(_Generator):
+            def __call__(self, key):
+                return gen(prefix + key)
+
+        new_generator = _Prefixed()
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

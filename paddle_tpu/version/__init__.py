@@ -1,0 +1,58 @@
+"""Version metadata (analog of the generated python/paddle/version/__init__.py in the
+reference wheel build, python/setup.py.in)."""
+from __future__ import annotations
+
+import jax
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+xpu_xccl_version = "False"
+nccl_version = "0"
+tensorrt_version = "None"
+cinn_version = "False"
+
+
+def show():
+    """Print the framework version and backing stack (jax/XLA instead of CUDA)."""
+    print(f"paddle_tpu {full_version}")
+    print(f"commit: {commit}")
+    print(f"jax: {jax.__version__}")
+    print(f"backend: {jax.default_backend()}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version
+
+
+def xpu_xccl():
+    return xpu_xccl_version
+
+
+def nccl():
+    return nccl_version
+
+
+def tensorrt():
+    return tensorrt_version
+
+
+def cinn():
+    return cinn_version

@@ -1,0 +1,95 @@
+"""Import-integrity for the full lazy subpackage surface + small-module behavior."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+LAZY = [
+    "nn", "optimizer", "io", "amp", "distributed", "vision", "metric", "jit",
+    "static", "device", "framework", "hapi",
+    "fft", "signal",
+    "utils", "callbacks", "hub", "onnx", "version", "sysconfig",
+    "base", "models",
+]
+
+
+@pytest.mark.parametrize("name", LAZY)
+def test_lazy_subpackage_imports(name):
+    mod = getattr(paddle, name)
+    assert mod is not None
+
+
+def test_version():
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.cuda() == "False"
+
+
+def test_device_namespace():
+    assert paddle.device.get_device()
+    assert isinstance(paddle.device.cuda.memory_allocated(), int)
+    ev = paddle.device.Event()
+    ev.record()
+    ev.synchronize()
+    assert ev.query()
+    s = paddle.device.current_stream()
+    s.synchronize()
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+    assert c.startswith("fc_")
+
+
+def test_utils_structure_helpers():
+    from paddle_tpu.utils import flatten, map_structure, pack_sequence_as
+
+    nest = {"a": [1, 2], "b": (3,)}
+    flat = flatten(nest)
+    assert sorted(flat) == [1, 2, 3]
+    rebuilt = pack_sequence_as(nest, flat)
+    assert rebuilt["a"] == [1, 2]
+    doubled = map_structure(lambda v: v * 2, nest)
+    assert doubled["b"] == (6,)
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils import dlpack
+
+    x = paddle.to_tensor(np.arange(6.0).reshape(2, 3))
+    cap = dlpack.to_dlpack(x)
+    y = dlpack.from_dlpack(x)  # jax arrays implement __dlpack__
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+    assert cap is not None
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    'a tiny model'\n"
+        "    return {'scale': scale}\n"
+    )
+    assert "tiny_model" in paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model", source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                           scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("repo", source="github")
+
+
+def test_base_namespace():
+    from paddle_tpu import base
+
+    assert base.in_dygraph_mode()
+    assert base.core.eager.Tensor is paddle.Tensor
+    assert base.CPUPlace is paddle.CPUPlace
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    assert "successfully" in capsys.readouterr().out

@@ -1,0 +1,96 @@
+"""paddle.fft / paddle.signal vs numpy references (reference test model:
+test/legacy_test/test_fft.py, test_signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft_ifft_roundtrip(rng, norm):
+    x = rng.standard_normal((3, 16)) + 1j * rng.standard_normal((3, 16))
+    xt = paddle.to_tensor(x)
+    out = fft.fft(xt, norm=norm)
+    np.testing.assert_allclose(out.numpy(), np.fft.fft(x, norm=norm), rtol=1e-6,
+                               atol=1e-8)
+    back = fft.ifft(out, norm=norm)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.parametrize("fn,npfn", [
+    ("rfft", np.fft.rfft), ("ihfft", lambda a: np.fft.ihfft(a)),
+])
+def test_real_input_transforms(rng, fn, npfn):
+    x = rng.standard_normal((4, 32))
+    out = getattr(fft, fn)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), npfn(x), rtol=1e-6, atol=1e-8)
+
+
+def test_fft2_fftn(rng):
+    x = rng.standard_normal((2, 8, 8))
+    np.testing.assert_allclose(
+        fft.fft2(paddle.to_tensor(x)).numpy(), np.fft.fft2(x), rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(
+        fft.fftn(paddle.to_tensor(x)).numpy(), np.fft.fftn(x), rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(
+        fft.rfft2(paddle.to_tensor(x)).numpy(), np.fft.rfft2(x), rtol=1e-6,
+        atol=1e-8)
+
+
+def test_irfft_hfft(rng):
+    spec = np.fft.rfft(rng.standard_normal((3, 16)))
+    out = fft.irfft(paddle.to_tensor(spec))
+    np.testing.assert_allclose(out.numpy(), np.fft.irfft(spec), rtol=1e-6,
+                               atol=1e-8)
+    out = fft.hfft(paddle.to_tensor(spec))
+    np.testing.assert_allclose(out.numpy(), np.fft.hfft(spec), rtol=1e-6, atol=1e-7)
+
+
+def test_fftfreq_shift(rng):
+    np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5))
+    np.testing.assert_allclose(fft.rfftfreq(8, d=0.5).numpy(),
+                               np.fft.rfftfreq(8, d=0.5))
+    x = rng.standard_normal((4, 6))
+    np.testing.assert_allclose(
+        fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+    np.testing.assert_allclose(
+        fft.ifftshift(paddle.to_tensor(x)).numpy(), np.fft.ifftshift(x))
+
+
+def test_fft_grad(rng):
+    x = paddle.to_tensor(rng.standard_normal((8,)), stop_gradient=False)
+    y = fft.fft(x)
+    loss = paddle.sum(paddle.abs(y) ** 2)
+    loss.backward()
+    # Parseval: d/dx sum|fft(x)|^2 = 2*N*x
+    np.testing.assert_allclose(x.grad.numpy(), 2 * 8 * x.numpy(), rtol=1e-5)
+
+
+def test_frame_overlap_add(rng):
+    x = rng.standard_normal((2, 20))
+    f = signal.frame(paddle.to_tensor(x), frame_length=6, hop_length=3)
+    assert f.shape == [2, 6, 5]
+    for i in range(5):
+        np.testing.assert_allclose(f.numpy()[:, :, i], x[:, i * 3:i * 3 + 6])
+    # overlap_add of disjoint frames (hop == frame_length) reconstructs exactly
+    f2 = signal.frame(paddle.to_tensor(x), frame_length=5, hop_length=5)
+    rec = signal.overlap_add(f2, hop_length=5)
+    np.testing.assert_allclose(rec.numpy(), x, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip(rng):
+    x = rng.standard_normal((2, 256)).astype(np.float64)
+    window = np.hanning(64).astype(np.float64)
+    spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                       window=paddle.to_tensor(window))
+    assert spec.shape == [2, 33, 256 // 16 + 1]
+    rec = signal.istft(spec, n_fft=64, hop_length=16,
+                       window=paddle.to_tensor(window), length=256)
+    np.testing.assert_allclose(rec.numpy(), x, rtol=1e-5, atol=1e-6)
