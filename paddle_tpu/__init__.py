@@ -37,9 +37,10 @@ from paddle_tpu.tensor.math import *  # noqa: F401,F403,E402
 from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403,E402
 from paddle_tpu.tensor.logic import *  # noqa: F401,F403,E402
 from paddle_tpu.tensor.linalg import (  # noqa: F401,E402
-    norm, dist, einsum, tensordot,
+    norm, dist, einsum, tensordot, cdist, cholesky, cholesky_solve,
+    cholesky_inverse, eigvalsh, histogram_bin_edges, histogramdd,
 )
-from paddle_tpu.tensor import linalg  # noqa: F401,E402
+from paddle_tpu import linalg  # noqa: F401,E402
 from paddle_tpu.tensor.random import (  # noqa: F401,E402
     bernoulli, binomial, gaussian, get_rng_state, multinomial, normal, poisson,
     rand, randint, randint_like, randn, randperm, seed, set_rng_state,

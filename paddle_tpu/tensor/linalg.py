@@ -264,3 +264,114 @@ def tensordot(x, y, axes=2, name=None):
 
 def corrcoef(x, rowvar=True, name=None):
     return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Pairwise p-norm distance (reference python/paddle/tensor/linalg.py:4690)."""
+
+    def f(a, b):
+        use_mm = compute_mode == "use_mm_for_euclid_dist" or (
+            compute_mode == "use_mm_for_euclid_dist_if_necessary"
+            and a.shape[-2] > 25 and b.shape[-2] > 25
+        )
+        if p == 2.0 and use_mm:
+            # MXU-friendly: |a-b|^2 = |a|^2 + |b|^2 - 2ab via one matmul
+            a2 = jnp.sum(a * a, axis=-1)[..., :, None]
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2), precision=jax.lax.Precision.HIGHEST)
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+    return apply("cdist", f, _t(x), _t(y))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Householder-reflector product Q from (x, tau)
+    (reference python/paddle/tensor/linalg.py:5561)."""
+
+    def f(a, t, c):
+        # Apply reflectors H_i = I - tau_i v_i v_i^H to c directly as rank-1
+        # updates: O(k·m·n) instead of materializing the m×m Q.
+        k = t.shape[-1]
+        m = a.shape[-2]
+
+        def reflect(c, i, from_left):
+            v = jnp.where(jnp.arange(m) < i, jnp.zeros_like(a[..., :, i]), a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            ti = t[..., i][..., None, None]
+            if from_left:  # c ← c - tau v (v^H c)
+                return c - ti * v[..., :, None] * (v[..., None, :].conj() @ c)
+            return c - ti * (c @ v[..., :, None]) * v[..., None, :].conj()
+
+        # Q = H_0 H_1 … H_{k-1}.  Left-multiplying by Q applies reflectors in
+        # reverse order; by Q^H (transpose) in forward order.  Right-multiply dual.
+        order = range(k) if (left == transpose) else range(k - 1, -1, -1)
+        for i in order:
+            c = reflect(c, i, left)
+        return c
+
+    return apply("ormqr", f, _t(x), _t(tau), _t(y))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply(
+        "vecdot", lambda a, b: jnp.sum(a * b, axis=axis), _t(x), _t(y)
+    )
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """reference python/paddle/tensor/linalg.py:2531 — edges only, no weights."""
+    a = input.numpy() if hasattr(input, "numpy") else np.asarray(input)
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(a.min()), float(a.max())
+    if lo == hi:
+        lo, hi = lo - 0.5, hi + 0.5
+    edge_dt = a.dtype if np.issubdtype(a.dtype, np.floating) else np.float32
+    return Tensor(np.linspace(lo, hi, bins + 1, dtype=edge_dt))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    """reference python/paddle/tensor/linalg.py:5328."""
+    a = x.numpy()
+    w = weights.numpy() if weights is not None else None
+    # paddle passes ranges as a flat list of 2*D floats; numpy wants D (min,max) pairs
+    rng = None
+    if ranges is not None:
+        rng = [tuple(pair) for pair in np.asarray(ranges, dtype=np.float64).reshape(-1, 2)]
+    hist, edges = np.histogramdd(a, bins=bins, range=rng, density=density, weights=w)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def fp8_fp8_half_gemm_fused(
+    x, y, bias=None, transpose_x=False, transpose_y=False,
+    scale=1.0, output_dtype="float16", activation_type="identity", name=None,
+):
+    """fp8 × fp8 → half gemm (reference exposes via paddle.linalg); on TPU we
+    cast to float8_e4m3fn and let XLA emit the native fp8 matmul."""
+
+    def f(a, b):
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        out_dt = jnp.float16 if output_dtype == "float16" else jnp.bfloat16
+        out = jnp.matmul(a8, b8, preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            out = out + _t(bias).data
+        if activation_type == "gelu":
+            out = jax.nn.gelu(out)
+        elif activation_type == "relu":
+            out = jax.nn.relu(out)
+        return out.astype(out_dt)
+
+    return apply("fp8_gemm", f, _t(x), _t(y))

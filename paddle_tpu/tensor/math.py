@@ -489,3 +489,48 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 
 def equal_all(x, y, name=None):
     return apply("equal_all", lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clip sub-tensor p-norms along `axis` to max_norm
+    (reference python/paddle/tensor/math.py:2524)."""
+
+    def f(a):
+        dims = [d for d in range(a.ndim) if d != (axis % a.ndim)]
+        norms = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=tuple(dims), keepdims=True), 1.0 / p
+        )
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return apply("renorm", f, _t(x))
+
+
+def renorm_(x, p, axis, max_norm, name=None):
+    return x._in_place(renorm(x, p, axis, max_norm))
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (reference python/paddle/tensor/math.py:7405)."""
+    if n == 0:
+        return apply("digamma", jax.scipy.special.digamma, _t(x))
+    from jax.scipy.special import polygamma as _pg
+
+    return apply("polygamma", lambda a: _pg(n, a), _t(x))
+
+
+def polygamma_(x, n, name=None):
+    return x._in_place(polygamma(x, n))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference python/paddle/tensor/math.py:7114)."""
+
+    def f(a):
+        cols = a.shape[0] if n is None else n
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return jnp.power(a[:, None], powers[None, :].astype(a.dtype))
+
+    return apply("vander", f, _t(x))

@@ -1,0 +1,190 @@
+"""paddle.linalg namespace parity tests (reference python/paddle/linalg.py,
+python/paddle/tensor/linalg.py; test model: test/legacy_test/test_linalg_*)."""
+import numpy as np
+import numpy.linalg as npl
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import linalg as L
+
+
+def _spd(n=4, dtype="float32"):
+    a = np.random.rand(n, n).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+class TestDecompositions:
+    def test_cholesky_roundtrip(self):
+        s = _spd()
+        c = L.cholesky(paddle.to_tensor(s)).numpy()
+        np.testing.assert_allclose(c @ c.T, s, rtol=1e-4, atol=1e-4)
+        cu = L.cholesky(paddle.to_tensor(s), upper=True).numpy()
+        np.testing.assert_allclose(cu.T @ cu, s, rtol=1e-4, atol=1e-4)
+
+    def test_qr_svd(self):
+        s = _spd()
+        q, r = L.qr(paddle.to_tensor(s))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), s, rtol=1e-4, atol=1e-4)
+        u, sv, vt = L.svd(paddle.to_tensor(s))
+        np.testing.assert_allclose((u.numpy() * sv.numpy()) @ vt.numpy(), s, rtol=1e-4, atol=1e-4)
+
+    def test_eigh_eig(self):
+        s = _spd()
+        w, v = L.eigh(paddle.to_tensor(s))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, s, rtol=1e-4, atol=1e-4
+        )
+        w2, _ = L.eig(paddle.to_tensor(s))
+        np.testing.assert_allclose(
+            np.sort(np.real(w2.numpy())), np.sort(w.numpy()), rtol=1e-4, atol=1e-4
+        )
+
+    def test_lu_and_unpack(self):
+        s = _spd()
+        lu_mat, piv = L.lu(paddle.to_tensor(s))
+        P, Lo, U = L.lu_unpack(lu_mat, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ Lo.numpy() @ U.numpy(), s, rtol=1e-4, atol=1e-4
+        )
+
+
+class TestSolvers:
+    def test_solve(self):
+        s, b = _spd(), np.random.rand(4, 2).astype("float32")
+        x = L.solve(paddle.to_tensor(s), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(s @ x, b, rtol=1e-3, atol=1e-4)
+
+    def test_triangular_cholesky_solve(self):
+        s = _spd()
+        b = np.random.rand(4, 2).astype("float32")
+        c = npl.cholesky(s).astype("float32")
+        x = L.cholesky_solve(paddle.to_tensor(b), paddle.to_tensor(c)).numpy()
+        np.testing.assert_allclose(s @ x, b, rtol=1e-3, atol=1e-3)
+        t = L.triangular_solve(
+            paddle.to_tensor(np.triu(s)), paddle.to_tensor(b), upper=True
+        ).numpy()
+        np.testing.assert_allclose(np.triu(s) @ t, b, rtol=1e-3, atol=1e-3)
+
+    def test_lstsq(self):
+        a = np.random.rand(6, 3).astype("float32")
+        b = np.random.rand(6, 2).astype("float32")
+        sol, _, rank, sv = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+        ref = npl.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(sol.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_pinv_inv(self):
+        s = _spd()
+        np.testing.assert_allclose(
+            L.inv(paddle.to_tensor(s)).numpy(), npl.inv(s), rtol=1e-3, atol=1e-4
+        )
+        a = np.random.rand(5, 3).astype("float32")
+        pv = L.pinv(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(a @ pv @ a, a, rtol=1e-3, atol=1e-3)
+
+
+class TestReductions:
+    def test_det_slogdet_rank_cond(self):
+        s = _spd()
+        np.testing.assert_allclose(L.det(paddle.to_tensor(s)).numpy(), npl.det(s), rtol=1e-4)
+        out = L.slogdet(paddle.to_tensor(s)).numpy()
+        sign, logd = npl.slogdet(s)
+        np.testing.assert_allclose(out, [sign, logd], rtol=1e-4)
+        assert int(L.matrix_rank(paddle.to_tensor(s)).numpy()) == 4
+        np.testing.assert_allclose(
+            L.cond(paddle.to_tensor(s)).numpy(), npl.cond(s), rtol=1e-3
+        )
+
+    def test_matrix_power_exp_multidot(self):
+        s = _spd().astype("float32")
+        np.testing.assert_allclose(
+            L.matrix_power(paddle.to_tensor(s), 3).numpy(),
+            npl.matrix_power(s, 3), rtol=1e-3,
+        )
+        a, b, c = (np.random.rand(3, 4), np.random.rand(4, 5), np.random.rand(5, 2))
+        np.testing.assert_allclose(
+            L.multi_dot([paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(c)]).numpy(),
+            a @ b @ c, rtol=1e-6,
+        )
+
+
+class TestDistanceAndMisc:
+    def test_cdist(self):
+        x = np.random.rand(5, 3).astype("float32")
+        y = np.random.rand(7, 3).astype("float32")
+        ref = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(
+            L.cdist(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), ref, rtol=1e-3, atol=1e-4
+        )
+        ref1 = np.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(
+            L.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0).numpy(), ref1,
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_householder_ormqr(self):
+        import scipy.linalg as sla
+
+        a = np.random.rand(5, 3)
+        (h, tau), _r = sla.qr(a, mode='raw')
+        q_ref = sla.qr(a, mode='economic')[0]
+        q = L.householder_product(paddle.to_tensor(np.asarray(h)), paddle.to_tensor(tau)).numpy()
+        np.testing.assert_allclose(np.abs(q), np.abs(q_ref), rtol=1e-5, atol=1e-6)
+        c = np.random.rand(5, 4)
+        out = L.ormqr(paddle.to_tensor(np.asarray(h)), paddle.to_tensor(tau), paddle.to_tensor(c))
+        full_q = sla.qr(a)[0]
+        np.testing.assert_allclose(out.numpy(), full_q @ c, rtol=1e-5, atol=1e-6)
+
+    def test_vecdot_vander_renorm_polygamma(self):
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(4, 3).astype("float32")
+        np.testing.assert_allclose(
+            L.vecdot(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), (x * y).sum(-1), rtol=1e-5
+        )
+        v = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(paddle.vander(paddle.to_tensor(v)).numpy(), np.vander(v), rtol=1e-6)
+        t = paddle.renorm(paddle.to_tensor(np.random.rand(3, 4).astype("float32")), 2.0, 0, 0.5)
+        norms = npl.norm(t.numpy(), axis=1)
+        assert (norms <= 0.5 + 1e-4).all()
+        from scipy.special import polygamma as sp_pg
+
+        z = np.array([2.0, 3.5], "float32")
+        np.testing.assert_allclose(
+            paddle.polygamma(paddle.to_tensor(z), 1).numpy(), sp_pg(1, z), rtol=1e-4
+        )
+
+    def test_histogram_family(self):
+        data = np.random.rand(50).astype("float32")
+        edges = paddle.histogram_bin_edges(paddle.to_tensor(data), bins=8).numpy()
+        np.testing.assert_allclose(edges, np.histogram_bin_edges(data, bins=8), rtol=1e-5)
+        pts = np.random.rand(30, 2)
+        hist, eds = paddle.histogramdd(paddle.to_tensor(pts), bins=5)
+        ref_h, ref_e = np.histogramdd(pts, bins=5)
+        np.testing.assert_allclose(hist.numpy(), ref_h)
+
+    def test_fp8_gemm(self):
+        a = np.random.rand(8, 16).astype("float32")
+        b = np.random.rand(16, 8).astype("float32")
+        out = L.fp8_fp8_half_gemm_fused(paddle.to_tensor(a), paddle.to_tensor(b))
+        assert str(out.dtype) in ("float16", "paddle.float16", "dtype('float16')") or "float16" in str(out.dtype)
+        # fp8 quantization error is large; just check the result correlates
+        ref = a @ b
+        assert np.corrcoef(out.numpy().astype("float32").ravel(), ref.ravel())[0, 1] > 0.98
+
+
+class TestGradients:
+    def test_svd_grad(self):
+        s = _spd()
+        x = paddle.to_tensor(s)
+        x.stop_gradient = False
+        _, sv, _ = L.svd(x)
+        sv.sum().backward()
+        assert x.grad is not None and x.grad.shape == list(s.shape)
+
+    def test_cholesky_solve_grad(self):
+        s = _spd()
+        x = paddle.to_tensor(s)
+        x.stop_gradient = False
+        L.det(x).backward()
+        # d det / dA = det(A) * inv(A).T
+        ref = npl.det(s) * npl.inv(s).T
+        np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-2, atol=1e-2)
