@@ -1,0 +1,39 @@
+"""paddle.distribution (reference python/paddle/distribution/__init__.py)."""
+from paddle_tpu.distribution import transform
+from paddle_tpu.distribution.bernoulli import Bernoulli
+from paddle_tpu.distribution.beta import Beta
+from paddle_tpu.distribution.binomial import Binomial
+from paddle_tpu.distribution.categorical import Categorical
+from paddle_tpu.distribution.cauchy import Cauchy
+from paddle_tpu.distribution.chi2 import Chi2
+from paddle_tpu.distribution.continuous_bernoulli import ContinuousBernoulli
+from paddle_tpu.distribution.dirichlet import Dirichlet
+from paddle_tpu.distribution.distribution import Distribution
+from paddle_tpu.distribution.exponential import Exponential
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.gamma import Gamma
+from paddle_tpu.distribution.geometric import Geometric
+from paddle_tpu.distribution.gumbel import Gumbel
+from paddle_tpu.distribution.independent import Independent
+from paddle_tpu.distribution.kl import kl_divergence, register_kl
+from paddle_tpu.distribution.laplace import Laplace
+from paddle_tpu.distribution.lkj_cholesky import LKJCholesky
+from paddle_tpu.distribution.lognormal import LogNormal
+from paddle_tpu.distribution.multinomial import Multinomial
+from paddle_tpu.distribution.multivariate_normal import MultivariateNormal
+from paddle_tpu.distribution.normal import Normal
+from paddle_tpu.distribution.poisson import Poisson
+from paddle_tpu.distribution.student_t import StudentT
+from paddle_tpu.distribution.transform import *  # noqa: F401,F403
+from paddle_tpu.distribution.transformed_distribution import TransformedDistribution
+from paddle_tpu.distribution.uniform import Uniform
+
+__all__ = [
+    'Bernoulli', 'Beta', 'Categorical', 'Cauchy', 'Chi2', 'ContinuousBernoulli',
+    'Dirichlet', 'Distribution', 'Exponential', 'ExponentialFamily',
+    'Multinomial', 'MultivariateNormal', 'Normal', 'Uniform', 'kl_divergence',
+    'register_kl', 'Independent', 'TransformedDistribution', 'Laplace',
+    'LogNormal', 'LKJCholesky', 'Gamma', 'Gumbel', 'Geometric', 'Binomial',
+    'Poisson', 'StudentT',
+]
+__all__.extend(transform.__all__)

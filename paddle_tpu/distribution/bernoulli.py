@@ -1,0 +1,89 @@
+"""Bernoulli distribution (reference python/paddle/distribution/bernoulli.py:58)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.distribution import _t
+
+_EPS = 1e-6
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def logits(self):
+        return apply("logits", lambda p: jnp.log(p / (1 - p)), self.probs)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return apply("var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        from paddle_tpu.tensor.tensor import Tensor
+
+        return Tensor(
+            jax.random.bernoulli(key, self.probs.data, out_shape).astype(
+                self.probs.data.dtype
+            ),
+            stop_gradient=True,
+        )
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxation (reference bernoulli.py:196)."""
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, dtype=jnp.result_type(p), minval=_EPS, maxval=1 - _EPS)
+            logistic = jnp.log(u) - jnp.log1p(-u)
+            logits = jnp.log(p / (1 - p))
+            return jax.nn.sigmoid((logits + logistic) / temperature)
+
+        return apply("bernoulli_rsample", f, self.probs)
+
+    def log_prob(self, value):
+        def f(p, v):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply("bernoulli_log_prob", f, self.probs, _t(value))
+
+    def cdf(self, value):
+        def f(p, v):
+            return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+
+        return apply("bernoulli_cdf", f, self.probs, _t(value))
+
+    def entropy(self):
+        def f(p):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply("bernoulli_entropy", f, self.probs)
+
+    def kl_divergence(self, other):
+        def f(p, q):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            q = jnp.clip(q, _EPS, 1 - _EPS)
+            return p * (jnp.log(p) - jnp.log(q)) + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q))
+
+        return apply("bernoulli_kl", f, self.probs, other.probs)
+
+    @property
+    def _natural_parameters(self):
+        return (self.logits,)
+
+    def _log_normalizer(self, x):
+        return jnp.log1p(jnp.exp(x))

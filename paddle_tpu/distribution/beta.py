@@ -1,0 +1,74 @@
+"""Beta distribution (reference python/paddle/distribution/beta.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.distribution import _broadcast_params, _t
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        (self.alpha, self.beta), batch = _broadcast_params(alpha, beta)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def f(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return apply("var", f, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            k1, k2 = jax.random.split(key)
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape), dtype=jnp.result_type(a))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape), dtype=jnp.result_type(b))
+            return ga / (ga + gb)
+
+        return apply("beta_rsample", f, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            return (
+                (a - 1) * jnp.log(v)
+                + (b - 1) * jnp.log1p(-v)
+                - (jax.scipy.special.betaln(a, b))
+            )
+
+        return apply("beta_log_prob", f, self.alpha, self.beta, _t(value))
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            return (
+                jax.scipy.special.betaln(a, b)
+                - (a - 1) * dg(a)
+                - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b)
+            )
+
+        return apply("beta_entropy", f, self.alpha, self.beta)
+
+    def kl_divergence(self, other):
+        def f(a1, b1, a2, b2):
+            dg = jax.scipy.special.digamma
+            return (
+                jax.scipy.special.betaln(a2, b2)
+                - jax.scipy.special.betaln(a1, b1)
+                + (a1 - a2) * dg(a1)
+                + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1)
+            )
+
+        return apply("beta_kl", f, self.alpha, self.beta, other.alpha, other.beta)

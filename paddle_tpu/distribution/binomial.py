@@ -1,0 +1,65 @@
+"""Binomial distribution (reference python/paddle/distribution/binomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        (self.total_count, self.probs), batch = _broadcast_params(total_count, probs)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda n, p: n * p, self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return apply("var", lambda n, p: n * p * (1 - p), self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        n = jnp.broadcast_to(jnp.asarray(self.total_count.data, jnp.float32), out_shape)
+        p = jnp.broadcast_to(jnp.asarray(self.probs.data, jnp.float32), out_shape)
+        out = jax.random.binomial(key, n, p, shape=out_shape)
+        return Tensor(out.astype(self.probs.data.dtype), stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(n, p, v):
+            logc = (
+                jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1)
+            )
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return apply("binomial_log_prob", f, self.total_count, self.probs, _t(value))
+
+    def entropy(self):
+        def f(n, p):
+            n_int = int(jnp.max(n))
+            ks = jnp.arange(n_int + 1, dtype=p.dtype)
+            logc = (
+                jax.scipy.special.gammaln(n[..., None] + 1)
+                - jax.scipy.special.gammaln(ks + 1)
+                - jax.scipy.special.gammaln(n[..., None] - ks + 1)
+            )
+            logp = logc + ks * jnp.log(p[..., None]) + (n[..., None] - ks) * jnp.log1p(-p[..., None])
+            logp = jnp.where(ks <= n[..., None], logp, -jnp.inf)
+            pk = jnp.exp(logp)
+            return -jnp.sum(pk * jnp.where(jnp.isfinite(logp), logp, 0.0), -1)
+
+        return apply("binomial_entropy", f, self.total_count, self.probs)
+
+    def kl_divergence(self, other):
+        return apply(
+            "binomial_kl",
+            lambda n, p, q: n * (p * (jnp.log(p) - jnp.log(q)) + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q))),
+            self.total_count, self.probs, other.probs,
+        )

@@ -1,0 +1,55 @@
+"""Categorical distribution (reference python/paddle/distribution/categorical.py).
+
+Paddle's Categorical takes UNNORMALIZED logits (non-negative weights) and
+normalizes them; sample returns indices.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def _probs_arr(self, l):
+        return l / jnp.sum(l, -1, keepdims=True)
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + tuple(self.logits.shape[:-1])
+        logp = jnp.log(self._probs_arr(self.logits.data))
+        idx = jax.random.categorical(key, logp, shape=out_shape)
+        return Tensor(idx.astype(jnp.int64), stop_gradient=True)
+
+    def probs(self, value):
+        def f(l, v):
+            p = self._probs_arr(l)
+            return jnp.take_along_axis(p, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply("categorical_probs", f, self.logits, _t(value))
+
+    def log_prob(self, value):
+        return apply("log", jnp.log, self.probs(value))
+
+    def entropy(self):
+        def f(l):
+            p = self._probs_arr(l)
+            logp = jnp.where(p > 0, jnp.log(p), 0.0)
+            return -jnp.sum(p * logp, -1)
+
+        return apply("categorical_entropy", f, self.logits)
+
+    def kl_divergence(self, other):
+        def f(l1, l2):
+            p = self._probs_arr(l1)
+            q = self._probs_arr(l2)
+            return jnp.sum(p * (jnp.log(p) - jnp.log(q)), -1)
+
+        return apply("categorical_kl", f, self.logits, other.logits)

@@ -1,0 +1,62 @@
+"""Cauchy distribution (reference python/paddle/distribution/cauchy.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), batch = _broadcast_params(loc, scale)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev.")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            c = jax.random.cauchy(key, out_shape, dtype=jnp.result_type(l))
+            return l + s * c
+
+        return apply("cauchy_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(jnp.pi * s * (1 + z * z))
+
+        return apply("cauchy_log_prob", f, self.loc, self.scale, _t(value))
+
+    def cdf(self, value):
+        return apply(
+            "cauchy_cdf",
+            lambda l, s, v: jnp.arctan((v - l) / s) / jnp.pi + 0.5,
+            self.loc, self.scale, _t(value),
+        )
+
+    def entropy(self):
+        return apply("cauchy_entropy", lambda l, s: jnp.log(4 * jnp.pi * s) + 0.0 * l, self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        """KL(Cauchy(l1,s1) || Cauchy(l2,s2)) — closed form (Chyzak & Nielsen 2019)."""
+
+        def f(l1, s1, l2, s2):
+            num = (s1 + s2) ** 2 + (l1 - l2) ** 2
+            return jnp.log(num / (4 * s1 * s2))
+
+        return apply("cauchy_kl", f, self.loc, self.scale, other.loc, other.scale)

@@ -1,0 +1,14 @@
+"""Chi2 distribution (reference python/paddle/distribution/chi2.py)."""
+from __future__ import annotations
+
+from paddle_tpu.distribution.gamma import Gamma
+from paddle_tpu.distribution.distribution import _t
+from paddle_tpu.autograd.engine import apply
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        self.df = _t(df)
+        half = apply("half", lambda d: d / 2, self.df)
+        rate = apply("const_half", lambda d: d * 0 + 0.5, self.df)
+        super().__init__(half, rate)

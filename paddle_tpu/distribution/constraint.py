@@ -1,0 +1,49 @@
+"""Value constraints (reference python/paddle/distribution/constraint.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import _t
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return apply("real_check", lambda v: v == v, _t(value))
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        return apply(
+            "range_check",
+            lambda v: (self._lower <= v) & (v <= self._upper),
+            _t(value),
+        )
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return apply("positive_check", lambda v: v >= 0, _t(value))
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return apply(
+            "simplex_check",
+            lambda v: jnp.all(v >= 0, -1) & (jnp.abs(jnp.sum(v, -1) - 1) < 1e-6),
+            _t(value),
+        )
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

@@ -1,0 +1,131 @@
+"""ContinuousBernoulli (reference python/paddle/distribution/continuous_bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self.lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _outside(self, p):
+        return (p < self.lims[0]) | (p > self.lims[1])
+
+    def _cut(self, p):
+        # keep p away from 0.5 where the normalizer is singular (use taylor there)
+        return jnp.where(self._outside(p), p, self.lims[0])
+
+    def _log_norm(self, p):
+        """log C(p), C = 2 atanh(1-2p) / (1-2p) for p≠0.5, 2 at p=0.5."""
+        ps = self._cut(p)
+        lognorm = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * ps))) - jnp.log(jnp.abs(1 - 2 * ps))
+        taylor = jnp.log(2.0) + 4 / 3 * (p - 0.5) ** 2 + 104 / 45 * (p - 0.5) ** 4
+        return jnp.where(self._outside(p), lognorm, taylor)
+
+    @property
+    def mean(self):
+        def f(p):
+            ps = self._cut(p)
+            m = ps / (2 * ps - 1) + 1 / (2 * jnp.arctanh(1 - 2 * ps))
+            taylor = 0.5 + (p - 0.5) / 3 + 16 / 45 * (p - 0.5) ** 3
+            return jnp.where(self._outside(p), m, taylor)
+
+        return apply("cb_mean", f, self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            ps = self._cut(p)
+            v = ps * (ps - 1) / (2 * ps - 1) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * ps)) ** 2
+            taylor = 1 / 12 - (p - 0.5) ** 2 / 15 - 128 / 945 * (p - 0.5) ** 4
+            return jnp.where(self._outside(p), v, taylor)
+
+        return apply("cb_var", f, self.probs)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, dtype=jnp.result_type(p), minval=1e-6, maxval=1 - 1e-6)
+            return self._icdf_arr(p, u)
+
+        return apply("cb_rsample", f, self.probs)
+
+    def sample(self, shape=()):
+        from paddle_tpu.autograd.engine import no_grad
+
+        with no_grad():
+            s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def _icdf_arr(self, p, u):
+        ps = self._cut(p)
+        icdf = (
+            jnp.log1p(u * (2 * ps - 1) / (1 - ps)) / (jnp.log(ps) - jnp.log1p(-ps))
+        )
+        return jnp.where(self._outside(p), icdf, u)
+
+    def log_prob(self, value):
+        def f(p, v):
+            eps = 1e-6
+            pc = jnp.clip(p, eps, 1 - eps)
+            return (
+                v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc) + self._log_norm(pc)
+            )
+
+        return apply("cb_log_prob", f, self.probs, _t(value))
+
+    def cdf(self, value):
+        def f(p, v):
+            ps = self._cut(p)
+            c = (jnp.power(ps, v) * jnp.power(1 - ps, 1 - v) + ps - 1) / (2 * ps - 1)
+            c = jnp.where(self._outside(p), c, v)
+            return jnp.clip(c, 0.0, 1.0)
+
+        return apply("cb_cdf", f, self.probs, _t(value))
+
+    def icdf(self, value):
+        return apply("cb_icdf", self._icdf_arr, self.probs, _t(value))
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-6
+            pc = jnp.clip(p, eps, 1 - eps)
+            ps = self._cut(pc)
+            mean = jnp.where(
+                self._outside(pc),
+                ps / (2 * ps - 1) + 1 / (2 * jnp.arctanh(1 - 2 * ps)),
+                0.5 + (pc - 0.5) / 3,
+            )
+            return -(
+                mean * jnp.log(pc) + (1 - mean) * jnp.log1p(-pc) + self._log_norm(pc)
+            )
+
+        return apply("cb_entropy", f, self.probs)
+
+    def kl_divergence(self, other):
+        def f(p, q):
+            eps = 1e-6
+            pc, qc = jnp.clip(p, eps, 1 - eps), jnp.clip(q, eps, 1 - eps)
+            ps = self._cut(pc)
+            mean = jnp.where(
+                self._outside(pc),
+                ps / (2 * ps - 1) + 1 / (2 * jnp.arctanh(1 - 2 * ps)),
+                0.5 + (pc - 0.5) / 3,
+            )
+            return (
+                mean * (jnp.log(pc) - jnp.log(qc))
+                + (1 - mean) * (jnp.log1p(-pc) - jnp.log1p(-qc))
+                + self._log_norm(pc)
+                - self._log_norm(qc)
+            )
+
+        return apply("cb_kl", f, self.probs, other.probs)

@@ -1,0 +1,75 @@
+"""Dirichlet distribution (reference python/paddle/distribution/dirichlet.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.distribution import _t
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]), tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return apply("mean", lambda c: c / jnp.sum(c, -1, keepdims=True), self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            return c * (a0 - c) / (a0 * a0 * (a0 + 1))
+
+        return apply("var", f, self.concentration)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + tuple(self.concentration.shape)
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape), dtype=jnp.result_type(c))
+            return g / jnp.sum(g, -1, keepdims=True)
+
+        return apply("dirichlet_rsample", f, self.concentration)
+
+    def log_prob(self, value):
+        def f(c, v):
+            return (
+                jnp.sum((c - 1) * jnp.log(v), -1)
+                + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                - jnp.sum(jax.scipy.special.gammaln(c), -1)
+            )
+
+        return apply("dirichlet_log_prob", f, self.concentration, _t(value))
+
+    def entropy(self):
+        def f(c):
+            k = c.shape[-1]
+            a0 = jnp.sum(c, -1)
+            dg = jax.scipy.special.digamma
+            return (
+                jnp.sum(jax.scipy.special.gammaln(c), -1)
+                - jax.scipy.special.gammaln(a0)
+                + (a0 - k) * dg(a0)
+                - jnp.sum((c - 1) * dg(c), -1)
+            )
+
+        return apply("dirichlet_entropy", f, self.concentration)
+
+    def kl_divergence(self, other):
+        def f(c1, c2):
+            dg = jax.scipy.special.digamma
+            a0 = jnp.sum(c1, -1, keepdims=True)
+            return (
+                jax.scipy.special.gammaln(jnp.sum(c1, -1))
+                - jax.scipy.special.gammaln(jnp.sum(c2, -1))
+                - jnp.sum(jax.scipy.special.gammaln(c1), -1)
+                + jnp.sum(jax.scipy.special.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (dg(c1) - dg(a0)), -1)
+            )
+
+        return apply("dirichlet_kl", f, self.concentration, other.concentration)

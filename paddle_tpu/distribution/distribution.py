@@ -1,0 +1,92 @@
+"""Distribution base class (reference python/paddle/distribution/distribution.py:40).
+
+TPU-native design: parameters live as jax arrays inside Tensors; every density is a
+pure jnp function routed through the autograd engine's ``apply`` so log_prob/entropy
+are differentiable w.r.t. parameters and XLA-fusable; sampling draws keys from the
+process-global generator (paddle.seed semantics) and uses jax.random.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.random import default_generator
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    arr = np.asarray(x, dtype=dtype or ("float32" if not hasattr(x, "dtype") else None))
+    if arr.dtype == np.float64 and dtype is None:
+        arr = arr.astype("float32")
+    return Tensor(arr)
+
+
+def _broadcast_params(*xs):
+    ts = [_t(x) for x in xs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return ts, tuple(shape)
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:40): batch_shape/event_shape,
+    sample/rsample, prob/log_prob, entropy, kl_divergence."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        from paddle_tpu.autograd.engine import no_grad
+
+        with no_grad():
+            s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from paddle_tpu.distribution.kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def prob(self, value):
+        return apply("exp", jnp.exp, self.log_prob(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    def _key(self):
+        return default_generator.next_key()
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self.batch_shape}, event_shape={self.event_shape})"

@@ -1,0 +1,54 @@
+"""Exponential distribution (reference python/paddle/distribution/exponential.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.distribution import _t
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return apply("mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply("var", lambda r: 1.0 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(r):
+            e = jax.random.exponential(key, out_shape, dtype=jnp.result_type(r))
+            return e / r
+
+        return apply("exponential_rsample", f, self.rate)
+
+    def log_prob(self, value):
+        return apply(
+            "exponential_log_prob", lambda r, v: jnp.log(r) - r * v, self.rate, _t(value)
+        )
+
+    def cdf(self, value):
+        return apply("exponential_cdf", lambda r, v: 1 - jnp.exp(-r * v), self.rate, _t(value))
+
+    def icdf(self, value):
+        return apply("exponential_icdf", lambda r, v: -jnp.log1p(-v) / r, self.rate, _t(value))
+
+    def entropy(self):
+        return apply("exponential_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def kl_divergence(self, other):
+        def f(r1, r2):
+            ratio = r2 / r1
+            return jnp.log(r1) - jnp.log(r2) + ratio - 1
+
+        return apply("exponential_kl", f, self.rate, other.rate)

@@ -1,0 +1,43 @@
+"""ExponentialFamily base (reference python/paddle/distribution/exponential_family.py).
+
+Provides the generic Bregman-divergence entropy used by paddle: entropy =
+F(natural_params) - <natural_params, dF> where F is the log-normalizer; gradients
+come from jax.grad instead of the reference's C++ autograd.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distribution.distribution import Distribution
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        """Bregman-divergence entropy (reference exponential_family.py:49)."""
+        nparams = [p if isinstance(p, Tensor) else Tensor(jnp.asarray(p))
+                   for p in self._natural_parameters]
+
+        def f(*nats):
+            lg = self._log_normalizer(*nats)
+            grads = jax.grad(lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+                             argnums=tuple(range(len(nats))))(*nats)
+            ent = lg - self._mean_carrier_measure
+            for np_, g in zip(nats, grads):
+                ent = ent - np_ * g
+            return ent
+
+        return apply("expfam_entropy", f, *nparams)

@@ -1,0 +1,67 @@
+"""Gamma distribution (reference python/paddle/distribution/gamma.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+from paddle_tpu.distribution.distribution import _broadcast_params, _t
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        (self.concentration, self.rate), batch = _broadcast_params(concentration, rate)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda c, r: c / r, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply("var", lambda c, r: c / (r * r), self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(c, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape), dtype=jnp.result_type(c))
+            return g / r
+
+        return apply("gamma_rsample", f, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def f(c, r, v):
+            return (
+                c * jnp.log(r)
+                + (c - 1) * jnp.log(v)
+                - r * v
+                - jax.scipy.special.gammaln(c)
+            )
+
+        return apply("gamma_log_prob", f, self.concentration, self.rate, _t(value))
+
+    def entropy(self):
+        def f(c, r):
+            return (
+                c
+                - jnp.log(r)
+                + jax.scipy.special.gammaln(c)
+                + (1 - c) * jax.scipy.special.digamma(c)
+            )
+
+        return apply("gamma_entropy", f, self.concentration, self.rate)
+
+    def kl_divergence(self, other):
+        def f(c1, r1, c2, r2):
+            return (
+                (c1 - c2) * jax.scipy.special.digamma(c1)
+                - jax.scipy.special.gammaln(c1)
+                + jax.scipy.special.gammaln(c2)
+                + c2 * (jnp.log(r1) - jnp.log(r2))
+                + c1 * (r2 - r1) / r1
+            )
+
+        return apply("gamma_kl", f, self.concentration, self.rate, other.concentration, other.rate)

@@ -1,0 +1,68 @@
+"""Geometric distribution, support k=0,1,2,... with pmf (1-p)^k p
+(reference python/paddle/distribution/geometric.py:131)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return apply("mean", lambda p: 1.0 / p - 1.0, self.probs)
+
+    @property
+    def variance(self):
+        return apply("var", lambda p: (1.0 / p - 1.0) / p, self.probs)
+
+    @property
+    def stddev(self):
+        return apply("std", lambda p: jnp.sqrt((1.0 / p - 1.0) / p), self.probs)
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        u = jax.random.uniform(key, out_shape, minval=1e-7, maxval=1.0)
+        # inverse-cdf: k = floor(log(1-u)/log(1-p))
+        k = jnp.floor(jnp.log(u) / jnp.log1p(-jnp.broadcast_to(self.probs.data, out_shape)))
+        return Tensor(k.astype(self.probs.data.dtype), stop_gradient=True)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def pmf(self, k):
+        return apply("geometric_pmf", lambda p, kk: jnp.power(1 - p, kk) * p, self.probs, _t(k))
+
+    def log_pmf(self, k):
+        return apply(
+            "geometric_log_pmf",
+            lambda p, kk: kk * jnp.log1p(-p) + jnp.log(p),
+            self.probs, _t(k),
+        )
+
+    def log_prob(self, value):
+        return self.log_pmf(value)
+
+    def cdf(self, k):
+        return apply("geometric_cdf", lambda p, kk: 1 - jnp.power(1 - p, kk + 1), self.probs, _t(k))
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+        return apply("geometric_entropy", f, self.probs)
+
+    def kl_divergence(self, other):
+        def kl(p, q):
+            return (jnp.log(p) - jnp.log(q) + (1 - p) / p * (jnp.log1p(-p) - jnp.log1p(-q)))
+
+        return apply("geometric_kl", kl, self.probs, other.probs)

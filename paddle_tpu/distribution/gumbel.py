@@ -1,0 +1,58 @@
+"""Gumbel distribution (reference python/paddle/distribution/gumbel.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+_EULER = float(np.euler_gamma)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        (self.loc, self.scale), batch = _broadcast_params(loc, scale)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda l, s: l + s * _EULER, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("var", lambda l, s: (jnp.pi ** 2 / 6) * s * s + 0.0 * l, self.loc, self.scale)
+
+    @property
+    def stddev(self):
+        return apply("std", lambda l, s: jnp.pi / jnp.sqrt(6.0) * s + 0.0 * l, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            g = jax.random.gumbel(key, out_shape, dtype=jnp.result_type(l))
+            return l + s * g
+
+        return apply("gumbel_rsample", f, self.loc, self.scale)
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply("gumbel_log_prob", f, self.loc, self.scale, _t(value))
+
+    def cdf(self, value):
+        return apply(
+            "gumbel_cdf",
+            lambda l, s, v: jnp.exp(-jnp.exp(-(v - l) / s)),
+            self.loc, self.scale, _t(value),
+        )
+
+    def entropy(self):
+        return apply("gumbel_entropy", lambda l, s: jnp.log(s) + 1 + _EULER + 0.0 * l, self.loc, self.scale)

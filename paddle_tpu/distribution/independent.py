@@ -1,0 +1,40 @@
+"""Independent distribution wrapper (reference python/paddle/distribution/independent.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape)
+        cut = len(shape) - self.reinterpreted_batch_rank
+        super().__init__(shape[:cut], shape[cut:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        r = self.reinterpreted_batch_rank
+        return apply("indep_reduce", lambda l: jnp.sum(l, axis=tuple(range(-r, 0))), lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        r = self.reinterpreted_batch_rank
+        return apply("indep_reduce", lambda l: jnp.sum(l, axis=tuple(range(-r, 0))), ent)

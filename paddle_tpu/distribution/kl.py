@@ -1,0 +1,77 @@
+"""KL divergence registry (reference python/paddle/distribution/kl.py:
+kl_divergence dispatch + register_kl decorator)."""
+from __future__ import annotations
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = []
+    for (p, q), fn in _REGISTRY.items():
+        if issubclass(type_p, p) and issubclass(type_q, q):
+            matches.append(((p, q), fn))
+    if not matches:
+        return None
+    # most-derived match wins
+    def score(item):
+        (p, q), _ = item
+        return (len(type_p.__mro__) - type_p.__mro__.index(p)) + (
+            len(type_q.__mro__) - type_q.__mro__.index(q)
+        )
+
+    return max(matches, key=score)[1]
+
+
+def kl_divergence(p, q):
+    from paddle_tpu.distribution.distribution import Distribution
+
+    fn = _dispatch(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    # same-family closed forms implemented on the distribution itself — only if
+    # the class actually overrides the base method (which dispatches back here)
+    overrides = type(p).kl_divergence is not Distribution.kl_divergence
+    if overrides and (isinstance(q, type(p)) or isinstance(p, type(q))):
+        try:
+            return p.kl_divergence(q)
+        except (NotImplementedError, AttributeError):
+            pass
+    raise NotImplementedError(
+        f"no KL(p || q) registered for {type(p).__name__}, {type(q).__name__}"
+    )
+
+
+def _register_defaults():
+    from paddle_tpu.distribution.beta import Beta
+    from paddle_tpu.distribution.dirichlet import Dirichlet
+    from paddle_tpu.distribution.categorical import Categorical
+    from paddle_tpu.distribution.normal import Normal
+    from paddle_tpu.distribution.uniform import Uniform
+    from paddle_tpu.distribution.bernoulli import Bernoulli
+    from paddle_tpu.distribution.exponential import Exponential
+    from paddle_tpu.distribution.gamma import Gamma
+    from paddle_tpu.distribution.geometric import Geometric
+    from paddle_tpu.distribution.laplace import Laplace
+    from paddle_tpu.distribution.lognormal import LogNormal
+    from paddle_tpu.distribution.cauchy import Cauchy
+    from paddle_tpu.distribution.poisson import Poisson
+    from paddle_tpu.distribution.binomial import Binomial
+    from paddle_tpu.distribution.multivariate_normal import MultivariateNormal
+
+    for cls in (
+        Beta, Dirichlet, Categorical, Normal, Uniform, Bernoulli, Exponential,
+        Gamma, Geometric, Laplace, Cauchy, Poisson, Binomial, MultivariateNormal,
+    ):
+        register_kl(cls, cls)(lambda p, q: p.kl_divergence(q))
+    register_kl(LogNormal, LogNormal)(lambda p, q: p.kl_divergence(q))
+
+
+_register_defaults()

@@ -1,0 +1,67 @@
+"""Laplace distribution (reference python/paddle/distribution/laplace.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        (self.loc, self.scale), batch = _broadcast_params(loc, scale)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda l, s: jnp.broadcast_to(l, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("var", lambda l, s: jnp.broadcast_to(2 * s * s, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale)
+
+    @property
+    def stddev(self):
+        return apply("std", lambda l, s: jnp.sqrt(2.0) * s, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, out_shape, dtype=jnp.result_type(l), minval=-0.5 + 1e-7, maxval=0.5)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return apply("laplace_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply(
+            "laplace_log_prob",
+            lambda l, s, v: -jnp.log(2 * s) - jnp.abs(v - l) / s,
+            self.loc, self.scale, _t(value),
+        )
+
+    def cdf(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return apply("laplace_cdf", f, self.loc, self.scale, _t(value))
+
+    def icdf(self, value):
+        def f(l, s, v):
+            term = v - 0.5
+            return l - s * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term))
+
+        return apply("laplace_icdf", f, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        return apply("laplace_entropy", lambda l, s: 1 + jnp.log(2 * s) + 0.0 * l, self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        def f(l1, s1, l2, s2):
+            d = jnp.abs(l1 - l2)
+            return jnp.log(s2 / s1) + s1 / s2 * jnp.exp(-d / s1) + d / s2 - 1
+
+        return apply("laplace_kl", f, self.loc, self.scale, other.loc, other.scale)

@@ -1,0 +1,63 @@
+"""LKJCholesky (reference python/paddle/distribution/lkj_cholesky.py): distribution
+over Cholesky factors of correlation matrices, onion-method sampling."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class LKJCholesky(Distribution):
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion"):
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        batch = tuple(self.concentration.shape)
+        super().__init__(batch, (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        key = self._key()
+        d = self.dim
+        conc = self.concentration.data
+        out_batch = tuple(shape) + tuple(self.concentration.shape)
+
+        # Onion method: build L row by row; row i direction uniform on sphere,
+        # radius^2 ~ Beta(i/2, conc + (d-1-i)/2)
+        k1, k2 = jax.random.split(key)
+        normals = jax.random.normal(k1, out_batch + (d, d), dtype=jnp.result_type(conc))
+        dt = jnp.result_type(conc)
+        L = jnp.zeros(out_batch + (d, d), dtype=dt)
+        L = L.at[..., 0, 0].set(jnp.asarray(1.0, dt))
+        for i in range(1, d):
+            alpha = conc + (d - 1 - i) / 2.0
+            kk = jax.random.fold_in(k2, i)
+            b1, b2 = jax.random.split(kk)
+            ga = jax.random.gamma(b1, jnp.broadcast_to(jnp.asarray(i / 2.0, dt), out_batch), dtype=dt)
+            gb = jax.random.gamma(b2, jnp.broadcast_to(jnp.asarray(alpha, dt), out_batch), dtype=dt)
+            r2 = ga / (ga + gb)
+            u = normals[..., i, :i]
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            L = L.at[..., i, :i].set(u * jnp.sqrt(r2)[..., None])
+            L = L.at[..., i, i].set(jnp.sqrt(1 - r2))
+        return Tensor(L, stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(conc, L):
+            d = self.dim
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=conc.dtype)
+            unnorm = jnp.sum((d - orders + 2 * conc[..., None] - 2) * jnp.log(diag), -1)
+            # normalizer (reference lkj_cholesky.py log_normalizer)
+            alpha = conc[..., None] + (d - orders) / 2.0
+            lognorm = jnp.sum(
+                0.5 * (orders - 1) * jnp.log(jnp.pi)
+                + jax.scipy.special.gammaln(alpha - 0.5 * (orders - 1))
+                - jax.scipy.special.gammaln(alpha),
+                -1,
+            )
+            return unnorm - lognorm
+
+        return apply("lkj_log_prob", f, self.concentration, _t(value))

@@ -1,0 +1,38 @@
+"""LogNormal distribution (reference python/paddle/distribution/lognormal.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.normal import Normal
+from paddle_tpu.distribution.transformed_distribution import TransformedDistribution
+from paddle_tpu.distribution.transform import ExpTransform
+from paddle_tpu.distribution.distribution import _broadcast_params
+
+
+class LogNormal(TransformedDistribution):
+    def __init__(self, loc, scale):
+        (self.loc, self.scale), _ = _broadcast_params(loc, scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(self._base, [ExpTransform()])
+
+    @property
+    def mean(self):
+        return apply("mean", lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply(
+            "var", lambda l, s: jnp.expm1(s * s) * jnp.exp(2 * l + s * s), self.loc, self.scale
+        )
+
+    def entropy(self):
+        def f(l, s):
+            import math
+
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l
+
+        return apply("lognormal_entropy", f, self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        return self._base.kl_divergence(other._base)

@@ -1,0 +1,63 @@
+"""Multinomial distribution (reference python/paddle/distribution/multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]), tuple(self.probs.shape[-1:]))
+
+    @property
+    def mean(self):
+        return apply("mean", lambda p: self.total_count * p / jnp.sum(p, -1, keepdims=True), self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return self.total_count * pn * (1 - pn)
+
+        return apply("var", f, self.probs)
+
+    def sample(self, shape=()):
+        key = self._key()
+        p = self.probs.data / jnp.sum(self.probs.data, -1, keepdims=True)
+        out_shape = tuple(shape) + tuple(p.shape[:-1])
+        k = p.shape[-1]
+        idx = jax.random.categorical(
+            key, jnp.log(p), shape=(self.total_count,) + out_shape
+        )
+        # O(n + k) memory: bincount per batch cell instead of a (n, ..., k) one-hot
+        flat = jnp.moveaxis(idx, 0, -1).reshape(-1, self.total_count)
+        counts = jax.vmap(lambda row: jnp.bincount(row, length=k))(flat)
+        counts = counts.reshape(out_shape + (k,)).astype(p.dtype)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(p, v):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            logc = (
+                jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+            )
+            return logc + jnp.sum(v * jnp.log(pn), -1)
+
+        return apply("multinomial_log_prob", f, self.probs, _t(value))
+
+    def entropy(self):
+        """Monte-Carlo-free upper bound used by paddle: sum of categorical entropies."""
+
+        def f(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            cat_ent = -jnp.sum(pn * jnp.log(pn), -1)
+            return self.total_count * cat_ent
+
+        return apply("multinomial_entropy", f, self.probs)

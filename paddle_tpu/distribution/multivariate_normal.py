@@ -1,0 +1,83 @@
+"""MultivariateNormal (reference python/paddle/distribution/multivariate_normal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None, scale_tril=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+            self.covariance_matrix = apply(
+                "cov", lambda L: L @ jnp.swapaxes(L, -1, -2), self.scale_tril
+            )
+        elif precision_matrix is not None:
+            self.precision_matrix = _t(precision_matrix)
+            self.covariance_matrix = apply("inv", jnp.linalg.inv, self.precision_matrix)
+            self.scale_tril = apply("chol", jnp.linalg.cholesky, self.covariance_matrix)
+        elif covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self.scale_tril = apply("chol", jnp.linalg.cholesky, self.covariance_matrix)
+        else:
+            raise ValueError("one of covariance_matrix/precision_matrix/scale_tril required")
+        batch = tuple(jnp.broadcast_shapes(tuple(self.loc.shape[:-1]), tuple(self.covariance_matrix.shape[:-2])))
+        super().__init__(batch, tuple(self.loc.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply("var", lambda c: jnp.diagonal(c, axis1=-2, axis2=-1), self.covariance_matrix)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, L):
+            eps = jax.random.normal(key, out_shape, dtype=jnp.result_type(l))
+            return l + jnp.einsum("...ij,...j->...i", jnp.broadcast_to(L, out_shape[:-1] + (L.shape[-2], L.shape[-1])), eps)
+
+        return apply("mvn_rsample", f, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def f(l, L, v):
+            d = v - l
+            z = jax.scipy.linalg.solve_triangular(L, d[..., None], lower=True)[..., 0]
+            half_logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            k = l.shape[-1]
+            return -0.5 * jnp.sum(z * z, -1) - half_logdet - 0.5 * k * math.log(2 * math.pi)
+
+        return apply("mvn_log_prob", f, self.loc, self.scale_tril, _t(value))
+
+    def entropy(self):
+        def f(L):
+            k = L.shape[-1]
+            half_logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet
+
+        return apply("mvn_entropy", f, self.scale_tril)
+
+    def kl_divergence(self, other):
+        def f(l1, L1, l2, L2):
+            k = l1.shape[-1]
+            M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+            tr = jnp.sum(M * M, axis=(-2, -1))
+            d = l2 - l1
+            z = jax.scipy.linalg.solve_triangular(L2, d[..., None], lower=True)[..., 0]
+            maha = jnp.sum(z * z, -1)
+            logdet = 2 * (
+                jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+                - jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), -1)
+            )
+            return 0.5 * (tr + maha - k + logdet)
+
+        return apply("mvn_kl", f, self.loc, self.scale_tril, other.loc, other.scale_tril)

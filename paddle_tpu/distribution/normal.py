@@ -1,0 +1,77 @@
+"""Normal distribution (reference python/paddle/distribution/normal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), batch = _broadcast_params(loc, scale)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("broadcast", lambda l, s: jnp.broadcast_to(l, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("var", lambda l, s: jnp.broadcast_to(s * s, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale)
+
+    @property
+    def stddev(self):
+        return apply("std", lambda l, s: jnp.broadcast_to(s, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            eps = jax.random.normal(key, out_shape, dtype=jnp.result_type(l))
+            return l + s * eps
+
+        return apply("normal_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            var = s * s
+            return -((v - l) ** 2) / (2 * var) - jnp.log(s) - 0.5 * math.log(2 * math.pi)
+
+        return apply("normal_log_prob", f, self.loc, self.scale, _t(value))
+
+    def cdf(self, value):
+        return apply(
+            "normal_cdf",
+            lambda l, s, v: 0.5 * (1 + jax.scipy.special.erf((v - l) / (s * jnp.sqrt(2.0)))),
+            self.loc, self.scale, _t(value),
+        )
+
+    def icdf(self, value):
+        return apply(
+            "normal_icdf",
+            lambda l, s, v: l + s * jnp.sqrt(2.0) * jax.scipy.special.erfinv(2 * v - 1),
+            self.loc, self.scale, _t(value),
+        )
+
+    def entropy(self):
+        return apply(
+            "normal_entropy",
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.broadcast_shapes(l.shape, s.shape),
+            ),
+            self.loc, self.scale,
+        )
+
+    def kl_divergence(self, other):
+        def f(l1, s1, l2, s2):
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+        return apply("normal_kl", f, self.loc, self.scale, other.loc, other.scale)

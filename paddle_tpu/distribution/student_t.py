@@ -1,0 +1,65 @@
+"""StudentT distribution (reference python/paddle/distribution/student_t.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        (self.df, self.loc, self.scale), batch = _broadcast_params(df, loc, scale)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply(
+            "mean",
+            lambda df, l: jnp.where(df > 1, l, jnp.nan),
+            self.df, self.loc,
+        )
+
+    @property
+    def variance(self):
+        def f(df, s):
+            v = jnp.where(df > 2, s * s * df / (df - 2), jnp.inf)
+            return jnp.where(df > 1, v, jnp.nan)
+
+        return apply("var", f, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(df, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(df, out_shape), dtype=jnp.result_type(l))
+            return l + s * t
+
+        return apply("student_t_rsample", f, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(df, l, s, v):
+            z = (v - l) / s
+            return (
+                jax.scipy.special.gammaln((df + 1) / 2)
+                - jax.scipy.special.gammaln(df / 2)
+                - 0.5 * jnp.log(df * jnp.pi)
+                - jnp.log(s)
+                - (df + 1) / 2 * jnp.log1p(z * z / df)
+            )
+
+        return apply("student_t_log_prob", f, self.df, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def f(df, s):
+            dg = jax.scipy.special.digamma
+            return (
+                (df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                + 0.5 * jnp.log(df)
+                + jax.scipy.special.betaln(df / 2, 0.5)
+                + jnp.log(s)
+            )
+
+        return apply("student_t_entropy", f, self.df, self.scale)

@@ -1,0 +1,306 @@
+"""Probability transforms (reference python/paddle/distribution/transform.py).
+
+Each Transform is a bijection-ish map with forward/inverse and
+forward_log_det_jacobian, implemented as pure jnp through the autograd engine.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import _t
+
+__all__ = [
+    'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = 'bijection'
+    INJECTION = 'injection'
+    SURJECTION = 'surjection'
+    OTHER = 'other'
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    def forward(self, x):
+        return apply(type(self).__name__ + "_fwd", self._forward, _t(x))
+
+    def inverse(self, y):
+        return apply(type(self).__name__ + "_inv", self._inverse, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(type(self).__name__ + "_fldj", self._forward_log_det_jacobian, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return apply(
+            type(self).__name__ + "_ildj",
+            lambda yy: -self._forward_log_det_jacobian(self._inverse(yy)),
+            _t(y),
+        )
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # event dims consumed by the transform (0 = elementwise)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc.data + self.scale.data * x
+
+    def _inverse(self, y):
+        return (y - self.loc.data) / self.scale.data
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale.data)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power.data)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power.data)
+
+    def _forward_log_det_jacobian(self, x):
+        p = self.power.data
+        return jnp.broadcast_to(jnp.log(jnp.abs(p)) + (p - 1) * jnp.log(x), x.shape)
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not injective")
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.concatenate([jnp.zeros_like(z[..., :1]), z], -1)
+        cum = jnp.cumprod(1 - zc, -1)
+        pad_z = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        return pad_z * cum
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.cumsum(jnp.ones_like(y_crop), -1) + 1
+        denom = 1 - jnp.cumsum(y_crop, -1) + y_crop
+        z = y_crop / denom
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        x_ = x - jnp.log(offset)
+        z = jax.nn.sigmoid(x_)
+        # log|det J| = Σ_i [log σ'(x_i) + log Π_{j<i}(1-z_j)]
+        rem = jnp.cumprod(1 - z, -1) / (1 - z)
+        return jnp.sum(-jax.nn.softplus(-x_) - jax.nn.softplus(x_) + jnp.log(rem + 1e-38), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape if n else tuple(shape) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape if n else tuple(shape) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+        self._domain_event_rank = base._domain_event_rank + self.reinterpreted_batch_rank
+        self._codomain_event_rank = base._codomain_event_rank + self.reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.reinterpreted_batch_rank, 0)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._domain_event_rank = max([t._domain_event_rank for t in self.transforms], default=0)
+        self._codomain_event_rank = max([t._codomain_event_rank for t in self.transforms], default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = 0.0
+        for t in self.transforms:
+            ldj = ldj + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return ldj
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        parts = [t._forward(p) for t, p in zip(self.transforms, self._split(x))]
+        return jnp.stack(parts, self.axis)
+
+    def _inverse(self, y):
+        parts = [t._inverse(p) for t, p in zip(self.transforms, self._split(y))]
+        return jnp.stack(parts, self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        parts = [t._forward_log_det_jacobian(p) for t, p in zip(self.transforms, self._split(x))]
+        return jnp.stack(parts, self.axis)

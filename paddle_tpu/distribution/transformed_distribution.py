@@ -1,0 +1,52 @@
+"""TransformedDistribution (reference python/paddle/distribution/transformed_distribution.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _t
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        event_rank = max(
+            [t._codomain_event_rank for t in self.transforms] + [len(base.event_shape)]
+        )
+        cut = len(shape) - event_rank
+        super().__init__(shape[:cut], shape[cut:])
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    @staticmethod
+    def _sum_last(t, n):
+        if n <= 0:
+            return t
+        return apply("sum_last", lambda l: jnp.sum(l, axis=tuple(range(-n, 0))), t)
+
+    def log_prob(self, value):
+        y = _t(value)
+        event_rank = len(self.event_shape)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ildj = t.forward_log_det_jacobian(x)
+            lp = lp - self._sum_last(ildj, event_rank - t._codomain_event_rank)
+            event_rank = event_rank - t._codomain_event_rank + t._domain_event_rank
+            y = x
+        base_lp = self.base.log_prob(y)
+        return lp + self._sum_last(base_lp, event_rank - len(self.base.event_shape))

@@ -1,0 +1,60 @@
+"""Uniform distribution (reference python/paddle/distribution/uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.distribution.distribution import Distribution, _broadcast_params, _t
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        (self.low, self.high), batch = _broadcast_params(low, high)
+        super().__init__(batch)
+
+    @property
+    def mean(self):
+        return apply("mean", lambda a, b: (a + b) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply("var", lambda a, b: (b - a) ** 2 / 12, self.low, self.high)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            u = jax.random.uniform(key, out_shape, dtype=jnp.result_type(a))
+            return a + (b - a) * u
+
+        return apply("uniform_rsample", f, self.low, self.high)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            inside = (v >= a) & (v < b)
+            lp = -jnp.log(b - a)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return apply("uniform_log_prob", f, self.low, self.high, _t(value))
+
+    def cdf(self, value):
+        return apply(
+            "uniform_cdf",
+            lambda a, b, v: jnp.clip((v - a) / (b - a), 0.0, 1.0),
+            self.low, self.high, _t(value),
+        )
+
+    def icdf(self, value):
+        return apply("uniform_icdf", lambda a, b, v: a + (b - a) * v, self.low, self.high, _t(value))
+
+    def entropy(self):
+        return apply("uniform_entropy", lambda a, b: jnp.log(b - a), self.low, self.high)
+
+    def kl_divergence(self, other):
+        def f(a1, b1, a2, b2):
+            res = jnp.log((b2 - a2) / (b1 - a1))
+            return jnp.where((a2 <= a1) & (b1 <= b2), res, jnp.inf)
+
+        return apply("uniform_kl", f, self.low, self.high, other.low, other.high)

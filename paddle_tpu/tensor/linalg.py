@@ -305,7 +305,9 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
         def reflect(c, i, from_left):
             v = jnp.where(jnp.arange(m) < i, jnp.zeros_like(a[..., :, i]), a[..., :, i])
             v = v.at[..., i].set(1.0)
-            ti = t[..., i][..., None, None]
+            # LAPACK unmqr semantics: 'transpose' applies Q^H, whose factors use conj(tau)
+            tau_i = jnp.conj(t[..., i]) if (transpose and jnp.iscomplexobj(t)) else t[..., i]
+            ti = tau_i[..., None, None]
             if from_left:  # c ← c - tau v (v^H c)
                 return c - ti * v[..., :, None] * (v[..., None, :].conj() @ c)
             return c - ti * (c @ v[..., :, None]) * v[..., None, :].conj()

@@ -1,0 +1,241 @@
+"""paddle.distribution parity tests (reference test/distribution/*): moments,
+log_prob vs scipy, sampling statistics, transforms, KL registry."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype="float64")
+
+
+class TestMomentsAndLogProb:
+    def test_normal(self):
+        d = D.Normal(1.0, 2.0)
+        v = np.array([0.5, 1.5], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(v))), st.norm(1, 2).logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.cdf(paddle.to_tensor(v))), st.norm(1, 2).cdf(v), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.norm(1, 2).entropy(), rtol=1e-5)
+
+    def test_uniform(self):
+        d = D.Uniform(0.0, 4.0)
+        v = np.array([1.0, 3.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(v))), st.uniform(0, 4).logpdf(v), rtol=1e-5)
+        assert abs(float(_np(d.mean)) - 2.0) < 1e-6
+
+    @pytest.mark.parametrize(
+        "dist,ref,vals",
+        [
+            (lambda: D.Beta(2.0, 3.0), st.beta(2, 3), [0.2, 0.7]),
+            (lambda: D.Gamma(2.0, 3.0), st.gamma(2, scale=1 / 3), [0.5, 1.5]),
+            (lambda: D.Exponential(1.5), st.expon(scale=1 / 1.5), [0.5, 2.0]),
+            (lambda: D.Laplace(0.0, 1.0), st.laplace(0, 1), [-1.0, 0.5]),
+            (lambda: D.Gumbel(0.5, 2.0), st.gumbel_r(0.5, 2.0), [0.0, 1.0]),
+            (lambda: D.Cauchy(0.0, 1.0), st.cauchy(0, 1), [-1.0, 2.0]),
+            (lambda: D.StudentT(5.0, 0.0, 1.0), st.t(5), [-1.0, 1.5]),
+            (lambda: D.LogNormal(0.0, 1.0), st.lognorm(1.0), [0.5, 2.0]),
+        ],
+    )
+    def test_continuous_logpdf(self, dist, ref, vals):
+        d = dist()
+        v = np.array(vals, "float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))), ref.logpdf(v), rtol=1e-4, atol=1e-5
+        )
+        ent = d.entropy()
+        np.testing.assert_allclose(float(np.ravel(_np(ent))[0]), ref.entropy(), rtol=1e-4, atol=1e-5)
+
+    def test_discrete_logpmf(self):
+        v = np.array([0.0, 1.0], "float32")
+        np.testing.assert_allclose(
+            _np(D.Bernoulli(0.3).log_prob(paddle.to_tensor(v))), st.bernoulli(0.3).logpmf(v), rtol=1e-4
+        )
+        k = np.array([0.0, 3.0], "float32")
+        np.testing.assert_allclose(
+            _np(D.Geometric(0.4).log_pmf(paddle.to_tensor(k))),
+            st.geom(0.4, loc=-1).logpmf(k), rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            _np(D.Poisson(2.5).log_prob(paddle.to_tensor(k))), st.poisson(2.5).logpmf(k), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            _np(D.Binomial(paddle.to_tensor(10.0), paddle.to_tensor(0.3)).log_prob(paddle.to_tensor(k))),
+            st.binom(10, 0.3).logpmf(k), rtol=1e-4,
+        )
+
+    def test_dirichlet_multinomial_mvn(self):
+        conc = np.array([1.0, 2.0, 3.0], "float32")
+        d = D.Dirichlet(paddle.to_tensor(conc))
+        v = np.array([0.2, 0.3, 0.5], "float32")
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(v)))), st.dirichlet(conc).logpdf(v), rtol=1e-4
+        )
+        m = D.Multinomial(5, paddle.to_tensor(np.array([0.2, 0.3, 0.5], "float32")))
+        cnt = np.array([1.0, 2.0, 2.0], "float32")
+        np.testing.assert_allclose(
+            float(_np(m.log_prob(paddle.to_tensor(cnt)))),
+            st.multinomial(5, [0.2, 0.3, 0.5]).logpmf(cnt), rtol=1e-4,
+        )
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mvn = D.MultivariateNormal(paddle.to_tensor(np.zeros(2, "float32")), covariance_matrix=paddle.to_tensor(cov))
+        x = np.array([0.3, -0.2], "float64")
+        np.testing.assert_allclose(
+            float(_np(mvn.log_prob(paddle.to_tensor(x.astype("float32"))))),
+            st.multivariate_normal([0, 0], cov).logpdf(x), rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(_np(mvn.entropy())), st.multivariate_normal([0, 0], cov).entropy(), rtol=1e-4
+        )
+
+
+class TestSampling:
+    def test_reparameterized_sample_stats(self):
+        n = 20000
+        for d, mean, std in [
+            (D.Normal(2.0, 3.0), 2.0, 3.0),
+            (D.Laplace(0.0, 1.0), 0.0, np.sqrt(2)),
+            (D.Exponential(2.0), 0.5, 0.5),
+        ]:
+            s = _np(d.sample((n,)))
+            assert abs(s.mean() - mean) < 0.1 * max(1, abs(mean)), type(d)
+            assert abs(s.std() - std) < 0.1 * std + 0.05, type(d)
+
+    def test_rsample_grad(self):
+        loc = paddle.to_tensor(np.array(1.0, "float32"))
+        loc.stop_gradient = False
+        d = D.Normal(loc, 2.0)
+        s = d.rsample((64,))
+        s.sum().backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 64.0, rtol=1e-4)
+
+    def test_categorical_multinomial_counts(self):
+        logits = paddle.to_tensor(np.array([1.0, 1.0, 2.0], "float32"))
+        c = D.Categorical(logits)
+        s = _np(c.sample((4000,)))
+        freq = np.bincount(s.astype(int), minlength=3) / 4000
+        np.testing.assert_allclose(freq, [0.25, 0.25, 0.5], atol=0.04)
+        m = D.Multinomial(10, paddle.to_tensor(np.array([0.5, 0.5], "float32")))
+        s = _np(m.sample((100,)))
+        assert s.shape == (100, 2) and np.all(s.sum(-1) == 10)
+
+    def test_lkj_cholesky_valid(self):
+        d = D.LKJCholesky(3, 1.5)
+        L = _np(d.sample())
+        corr = L @ L.T
+        np.testing.assert_allclose(np.diag(corr), np.ones(3), atol=1e-5)
+        assert np.all(np.linalg.eigvalsh(corr) > -1e-6)
+        lp = d.log_prob(paddle.to_tensor(L.astype("float32")))
+        assert np.isfinite(float(_np(lp)))
+
+
+class TestTransforms:
+    @pytest.mark.parametrize(
+        "t,x",
+        [
+            (D.ExpTransform(), [0.5, -0.3]),
+            (D.SigmoidTransform(), [0.5, -0.3]),
+            (D.TanhTransform(), [0.5, -0.3]),
+            (D.AffineTransform(paddle.to_tensor(1.0), paddle.to_tensor(2.0)), [0.5, -0.3]),
+            (D.PowerTransform(paddle.to_tensor(2.0)), [0.5, 1.3]),
+        ],
+    )
+    def test_roundtrip_and_ldj(self, t, x):
+        xt = paddle.to_tensor(np.array(x, "float32"))
+        y = t.forward(xt)
+        back = t.inverse(y)
+        np.testing.assert_allclose(_np(back), np.array(x), rtol=1e-4, atol=1e-5)
+        # numeric log-det-jacobian (elementwise)
+        eps = 1e-4
+        num = (t.forward(paddle.to_tensor(np.array(x, "float32") + eps)).numpy() - y.numpy()) / eps
+        np.testing.assert_allclose(
+            _np(t.forward_log_det_jacobian(xt)), np.log(np.abs(num)), atol=1e-2
+        )
+
+    def test_stickbreaking_chain_reshape(self):
+        sb = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.5, 0.1], "float32"))
+        y = sb.forward(x)
+        assert abs(_np(y).sum() - 1.0) < 1e-5 and y.shape[-1] == 4
+        np.testing.assert_allclose(_np(sb.inverse(y)), _np(x), rtol=1e-3, atol=1e-4)
+        chain = D.ChainTransform([D.AffineTransform(paddle.to_tensor(0.0), paddle.to_tensor(2.0)), D.ExpTransform()])
+        z = chain.forward(x)
+        np.testing.assert_allclose(_np(chain.inverse(z)), _np(x), rtol=1e-4)
+        rt = D.ReshapeTransform((6,), (2, 3))
+        r = rt.forward(paddle.to_tensor(np.arange(6, dtype="float32")))
+        assert list(r.shape) == [2, 3]
+
+    def test_transformed_distribution(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        v = np.array([0.5, 2.0], "float32")
+        np.testing.assert_allclose(
+            _np(td.log_prob(paddle.to_tensor(v))), st.lognorm(1.0).logpdf(v), rtol=1e-4
+        )
+        s = td.sample((1000,))
+        assert np.all(_np(s) > 0)
+
+    def test_independent(self):
+        base = D.Normal(paddle.to_tensor(np.zeros(3, "float32")), paddle.to_tensor(np.ones(3, "float32")))
+        ind = D.Independent(base, 1)
+        assert ind.event_shape == (3,)
+        v = np.array([0.1, 0.2, 0.3], "float32")
+        np.testing.assert_allclose(
+            float(_np(ind.log_prob(paddle.to_tensor(v)))),
+            st.norm(0, 1).logpdf(v).sum(), rtol=1e-5,
+        )
+
+
+class TestKL:
+    def test_closed_forms_vs_numeric(self):
+        pairs = [
+            (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0), st.norm(0, 1), st.norm(1, 2), np.linspace(-8, 8, 4001)),
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0), st.gamma(2), st.gamma(3, scale=0.5), np.linspace(1e-3, 30, 4001)),
+            (D.Beta(2.0, 2.0), D.Beta(3.0, 1.5), st.beta(2, 2), st.beta(3, 1.5), np.linspace(1e-4, 1 - 1e-4, 4001)),
+            (D.Exponential(2.0), D.Exponential(1.0), st.expon(scale=0.5), st.expon(scale=1.0), np.linspace(1e-3, 20, 4001)),
+        ]
+        for p, q, sp, sq, grid in pairs:
+            kl = float(np.ravel(_np(D.kl_divergence(p, q)))[0])
+            pdf = sp.pdf(grid)
+            numeric = np.trapezoid(pdf * (sp.logpdf(grid) - sq.logpdf(grid)), grid)
+            np.testing.assert_allclose(kl, numeric, rtol=2e-2, atol=1e-3), (type(p), kl, numeric)
+
+    def test_registry_and_categorical(self):
+        p = D.Categorical(paddle.to_tensor(np.array([1.0, 1.0], "float32")))
+        q = D.Categorical(paddle.to_tensor(np.array([1.0, 3.0], "float32")))
+        kl = float(_np(D.kl_divergence(p, q)))
+        ref = 0.5 * np.log(0.5 / 0.25) + 0.5 * np.log(0.5 / 0.75)
+        np.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_mydist(a, b):
+            return paddle.to_tensor(np.array(42.0, "float32"))
+
+        assert float(_np(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)))) == 42.0
+
+    def test_bernoulli_mvn_kl(self):
+        kl = float(_np(D.kl_divergence(D.Bernoulli(0.3), D.Bernoulli(0.6))))
+        ref = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+        np.testing.assert_allclose(kl, ref, rtol=1e-4)
+        c1 = np.array([[1.0, 0.0], [0.0, 1.0]], "float32")
+        c2 = np.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+        p = D.MultivariateNormal(paddle.to_tensor(np.zeros(2, "float32")), covariance_matrix=paddle.to_tensor(c1))
+        q = D.MultivariateNormal(paddle.to_tensor(np.ones(2, "float32")), covariance_matrix=paddle.to_tensor(c2))
+        kl = float(_np(D.kl_divergence(p, q)))
+        # closed form check via numpy
+        ic2 = np.linalg.inv(c2)
+        ref = 0.5 * (np.trace(ic2 @ c1) + np.ones(2) @ ic2 @ np.ones(2) - 2 + np.log(np.linalg.det(c2) / np.linalg.det(c1)))
+        np.testing.assert_allclose(kl, ref, rtol=1e-4)
+
+
+class TestExponentialFamilyEntropy:
+    def test_bregman_entropy_matches_closed_form(self):
+        d = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            float(_np(D.ExponentialFamily.entropy(d))), float(_np(d.entropy())), rtol=1e-4
+        )
