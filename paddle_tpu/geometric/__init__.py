@@ -1,0 +1,12 @@
+"""paddle.geometric (reference python/paddle/geometric/__init__.py) — graph
+message passing on XLA segment ops."""
+from paddle_tpu.geometric.math import segment_max, segment_mean, segment_min, segment_sum
+from paddle_tpu.geometric.message_passing import send_u_recv, send_ue_recv, send_uv
+from paddle_tpu.geometric.reindex import reindex_graph, reindex_heter_graph
+from paddle_tpu.geometric.sampling import sample_neighbors, weighted_sample_neighbors
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv', 'segment_sum', 'segment_mean',
+    'segment_min', 'segment_max', 'reindex_graph', 'reindex_heter_graph',
+    'sample_neighbors', 'weighted_sample_neighbors',
+]

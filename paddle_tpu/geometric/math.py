@@ -1,0 +1,45 @@
+"""Segment reductions (reference python/paddle/geometric/math.py) — XLA
+segment ops map these directly to efficient TPU scatter-reduce."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _seg(op_name, jfn, fill=0.0):
+    def op(data, segment_ids, name=None):
+        def f(d, ids):
+            n = int(jnp.max(ids)) + 1 if ids.size else 0
+            out = jfn(d, ids.astype(jnp.int32), num_segments=n)
+            if op_name in ("segment_min", "segment_max"):
+                # empty segments: paddle fills with 0 (dtype-preserving)
+                counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids.astype(jnp.int32), num_segments=n)
+                out = jnp.where((counts > 0).reshape((-1,) + (1,) * (d.ndim - 1)), out, jnp.zeros_like(out))
+            return out
+
+        return apply(op_name, f, _t(data), _t(segment_ids))
+
+    return op
+
+
+segment_sum = _seg("segment_sum", jax.ops.segment_sum)
+segment_min = _seg("segment_min", jax.ops.segment_min)
+segment_max = _seg("segment_max", jax.ops.segment_max)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def f(d, ids):
+        n = int(jnp.max(ids)) + 1 if ids.size else 0
+        ids32 = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(d, ids32, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids32, num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (d.ndim - 1))
+
+    return apply("segment_mean", f, _t(data), _t(segment_ids))

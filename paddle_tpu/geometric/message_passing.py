@@ -1,0 +1,73 @@
+"""Graph message passing (reference python/paddle/geometric/message_passing/):
+send_u_recv / send_ue_recv / send_uv as gather + segment-reduce, the TPU-native
+formulation of the reference's graph_send_recv CUDA kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+_COMPUTERS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _reduce(msg, dst, pool_type, n):
+    dst32 = dst.astype(jnp.int32)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(msg, dst32, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst32, num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (msg.ndim - 1))
+    out = _REDUCERS[pool_type](msg, dst32, num_segments=n)
+    if pool_type in ("min", "max"):
+        counts = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.float32), dst32, num_segments=n)
+        out = jnp.where((counts > 0).reshape((-1,) + (1,) * (msg.ndim - 1)), out, jnp.zeros_like(out))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], reduce onto dst (reference message_passing/send_recv.py)."""
+
+    def f(xd, src, dst):
+        n = int(out_size) if out_size is not None else xd.shape[0]
+        msg = xd[src.astype(jnp.int32)]
+        return _reduce(msg, dst, reduce_op, n)
+
+    return apply("send_u_recv", f, _t(x), _t(src_index), _t(dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], combine with edge feature y, reduce onto dst."""
+
+    def f(xd, yd, src, dst):
+        n = int(out_size) if out_size is not None else xd.shape[0]
+        msg = _COMPUTERS[message_op](xd[src.astype(jnp.int32)], yd)
+        return _reduce(msg, dst, reduce_op, n)
+
+    return apply("send_ue_recv", f, _t(x), _t(y), _t(src_index), _t(dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] op y[dst] (reference send_uv.py)."""
+
+    def f(xd, yd, src, dst):
+        return _COMPUTERS[message_op](xd[src.astype(jnp.int32)], yd[dst.astype(jnp.int32)])
+
+    return apply("send_uv", f, _t(x), _t(y), _t(src_index), _t(dst_index))

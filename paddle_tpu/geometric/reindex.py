@@ -1,0 +1,43 @@
+"""Graph reindex (reference python/paddle/geometric/reindex.py): compress a
+sub-graph's global node ids to a local contiguous numbering."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    xs = _np(x).astype(np.int64)
+    nb = _np(neighbors).astype(np.int64)
+    cnt = _np(count).astype(np.int64)
+    # order: target nodes first, then first-seen neighbors
+    uniq = dict.fromkeys(xs.tolist())
+    for n in nb.tolist():
+        uniq.setdefault(n, None)
+    nodes = np.fromiter(uniq.keys(), np.int64)
+    remap = {g: i for i, g in enumerate(nodes.tolist())}
+    reindex_src = np.asarray([remap[n] for n in nb.tolist()], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return Tensor(reindex_src), Tensor(reindex_dst), Tensor(nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    xs = _np(x).astype(np.int64)
+    uniq = dict.fromkeys(xs.tolist())
+    for nb in neighbors:
+        for n in _np(nb).astype(np.int64).tolist():
+            uniq.setdefault(n, None)
+    nodes = np.fromiter(uniq.keys(), np.int64)
+    remap = {g: i for i, g in enumerate(nodes.tolist())}
+    srcs, dsts = [], []
+    for nb, cnt in zip(neighbors, count):
+        nb_np = _np(nb).astype(np.int64)
+        cnt_np = _np(cnt).astype(np.int64)
+        srcs.append(np.asarray([remap[n] for n in nb_np.tolist()], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt_np))
+    return Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)), Tensor(nodes)

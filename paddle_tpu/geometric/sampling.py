@@ -1,0 +1,78 @@
+"""Neighbor sampling (reference python/paddle/geometric/sampling/neighbors.py):
+CSR-graph neighbor sampling on host (IO-bound preprocessing, like the
+reference's CPU path)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    rows = _np(row).astype(np.int64)
+    ptr = _np(colptr).astype(np.int64)
+    nodes = _np(input_nodes).astype(np.int64)
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    eids_np = _np(eids).astype(np.int64) if eids is not None else None
+    for v in nodes.tolist():
+        beg, end = int(ptr[v]), int(ptr[v + 1])
+        neigh = rows[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[pick]
+            idx = idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if return_eids and eids_np is not None:
+            out_e.append(eids_np[idx])
+    neighbors = Tensor(np.concatenate(out_n) if out_n else np.zeros((0,), np.int64))
+    counts = Tensor(np.asarray(out_c, np.int64))
+    if return_eids:
+        return neighbors, counts, Tensor(np.concatenate(out_e) if out_e else np.zeros((0,), np.int64))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes, sample_size=-1,
+                              eids=None, return_eids=False, name=None):
+    rows = _np(row).astype(np.int64)
+    ptr = _np(colptr).astype(np.int64)
+    w = _np(edge_weight).astype(np.float64)
+    nodes = _np(input_nodes).astype(np.int64)
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    eids_np = _np(eids).astype(np.int64) if eids is not None else None
+    for v in nodes.tolist():
+        beg, end = int(ptr[v]), int(ptr[v + 1])
+        neigh = rows[beg:end]
+        weights = w[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            wsum = weights.sum()
+            pos = int((weights > 0).sum())
+            if wsum <= 0:
+                # all-zero weights: fall back to uniform (reference keeps sampling)
+                pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            elif pos < sample_size:
+                # can't draw sample_size distinct positive-weight entries; take all
+                # positive ones (matches reference's effective behavior)
+                pick = np.flatnonzero(weights > 0)
+            else:
+                pick = rng.choice(len(neigh), size=sample_size, replace=False, p=weights / wsum)
+            neigh = neigh[pick]
+            idx = idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if return_eids and eids_np is not None:
+            out_e.append(eids_np[idx])
+    neighbors = Tensor(np.concatenate(out_n) if out_n else np.zeros((0,), np.int64))
+    counts = Tensor(np.asarray(out_c, np.int64))
+    if return_eids:
+        return neighbors, counts, Tensor(np.concatenate(out_e) if out_e else np.zeros((0,), np.int64))
+    return neighbors, counts

@@ -1,0 +1,48 @@
+"""paddle.sparse (reference python/paddle/sparse/__init__.py) — COO/CSR sparse
+tensors on jax.experimental.sparse."""
+from paddle_tpu.sparse.tensor import SparseCooTensor, SparseCsrTensor
+from paddle_tpu.sparse.creation import sparse_coo_tensor, sparse_csr_tensor
+from paddle_tpu.sparse.unary import (
+    sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square, log1p, abs,
+    pow, cast, neg, deg2rad, rad2deg, expm1, coalesce, transpose, reshape, sum,
+    isnan, slice, pca_lowrank,
+)
+from paddle_tpu.sparse.binary import (
+    add, subtract, multiply, divide, matmul, mv, masked_matmul, addmm, mask_as,
+    is_same_shape,
+)
+from paddle_tpu.sparse import nn
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor', 'sin', 'tan', 'asin', 'atan',
+    'sinh', 'tanh', 'asinh', 'atanh', 'sqrt', 'square', 'log1p', 'abs', 'pow',
+    'pca_lowrank', 'cast', 'neg', 'deg2rad', 'rad2deg', 'expm1', 'mv', 'matmul',
+    'mask_as', 'masked_matmul', 'addmm', 'add', 'subtract', 'transpose', 'sum',
+    'multiply', 'divide', 'coalesce', 'is_same_shape', 'reshape', 'isnan', 'slice',
+]
+
+
+def _patch_dense_methods():
+    """paddle Tensor.to_sparse_coo()/to_sparse_csr() (reference
+    python/paddle/tensor/to_string.py method patch)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    from paddle_tpu.tensor.tensor import Tensor
+
+    def to_sparse_coo(self, sparse_dim=None):
+        n_sparse = sparse_dim if sparse_dim is not None else self.ndim
+        mat = jsparse.BCOO.fromdense(self.data, n_dense=self.ndim - n_sparse)
+        return SparseCooTensor(mat)
+
+    def to_sparse_csr(self):
+        return SparseCooTensor(jsparse.BCOO.fromdense(self.data)).to_sparse_csr()
+
+    Tensor.to_sparse_coo = to_sparse_coo
+    Tensor.to_sparse_csr = to_sparse_csr
+    Tensor.is_sparse = lambda self: False
+    Tensor.is_sparse_coo = lambda self: False
+    Tensor.is_sparse_csr = lambda self: False
+
+
+_patch_dense_methods()

@@ -1,0 +1,95 @@
+"""Sparse binary ops (reference python/paddle/sparse/binary.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.sparse.tensor import (
+    SparseCooTensor, SparseCsrTensor, SparseTensor, _coo, _wrap_like,
+)
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _elementwise(op_name, fn):
+    def op(x, y, name=None):
+        if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+            out = jsparse.sparsify(fn)(_coo(x), _coo(y))
+            return _wrap_like(x, out)
+        raise TypeError(f"sparse.{op_name} expects two sparse tensors")
+
+    return op
+
+
+add = _elementwise("add", jnp.add)
+subtract = _elementwise("subtract", jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    # sparsify(multiply) of two sparse operands keeps union structure with zeros —
+    # fine numerically (paddle semantics are elementwise on the dense view)
+    out = jsparse.sparsify(jnp.multiply)(_coo(x), _coo(y))
+    return _wrap_like(x, out)
+
+
+def divide(x, y, name=None):
+    xd, yd = _coo(x).todense(), _coo(y).todense()
+    return _wrap_like(x, jsparse.BCOO.fromdense(xd / yd))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense, sparse @ sparse, dense @ sparse (reference binary.py matmul)."""
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        out = _coo(x) @ _coo(y)
+        return _wrap_like(x, out if isinstance(out, jsparse.BCOO) else jsparse.BCOO.fromdense(out))
+    if isinstance(x, SparseTensor):
+        yd = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(_coo(x) @ yd)
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(xd @ _coo(y))
+
+
+def mv(x, vec, name=None):
+    v = vec.data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_coo(x) @ v)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense x dense) * sparse-mask → sparse (reference masked_matmul): compute only
+    the entries present in mask via gather-dot — SDDMM."""
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    m = _coo(mask)
+    # supports batched [*, M, K] @ [*, K, N]: leading index columns are batch dims
+    rows = m.indices[:, -2]
+    cols = m.indices[:, -1]
+    batch = tuple(m.indices[:, i] for i in range(m.indices.shape[1] - 2))
+    x_rows = xd[batch + (rows,)] if batch else xd[rows]                    # (nnz, K)
+    yt = jnp.swapaxes(yd, -1, -2)
+    y_cols = yt[batch + (cols,)] if batch else yt[cols]                    # (nnz, K)
+    vals = jnp.einsum("nk,nk->n", x_rows, y_cols)
+    return _wrap_like(mask, jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) (reference binary.py addmm)."""
+    xy = matmul(x, y)
+    if isinstance(xy, SparseTensor) and isinstance(input, SparseTensor):
+        out = jsparse.sparsify(lambda a, b: beta * a + alpha * b)(_coo(input), _coo(xy))
+        return _wrap_like(input, out)
+    inp = input.data if isinstance(input, Tensor) else _coo(input).todense()
+    xyd = xy.data if isinstance(xy, Tensor) else _coo(xy).todense()
+    return Tensor(beta * inp + alpha * xyd)
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at mask's sparsity pattern."""
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    m = _coo(mask)
+    idx = tuple(m.indices[:, i] for i in range(m.indices.shape[1]))
+    vals = xd[idx]
+    return _wrap_like(mask, jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

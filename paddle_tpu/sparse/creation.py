@@ -1,0 +1,44 @@
+"""Sparse tensor creation (reference python/paddle/sparse/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.sparse.tensor import SparseCooTensor, SparseCsrTensor
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _arr(x, dtype=None):
+    if isinstance(x, Tensor):
+        a = x.data
+    else:
+        a = jnp.asarray(np.asarray(x))
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    idx = _arr(indices).astype(jnp.int32)  # (sparse_dim, nnz) paddle layout
+    vals = _arr(values, dtype)
+    if vals.dtype == jnp.float64 and dtype is None:
+        vals = vals.astype(jnp.float32)
+    if shape is None:
+        dense_part = vals.shape[1:]
+        sp_shape = tuple(int(i) for i in (idx.max(axis=1) + 1)) if idx.size else (0,) * idx.shape[0]
+        shape = sp_shape + dense_part
+    mat = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    indptr = _arr(crows).astype(jnp.int32)
+    indices = _arr(cols).astype(jnp.int32)
+    vals = _arr(values, dtype)
+    if vals.dtype == jnp.float64 and dtype is None:
+        vals = vals.astype(jnp.float32)
+    if shape is None:
+        shape = (indptr.shape[0] - 1, int(indices.max()) + 1)
+    mat = jsparse.BCSR((vals, indices, indptr), shape=tuple(shape))
+    return SparseCsrTensor(mat)

@@ -1,0 +1,2 @@
+"""Sparse multiary ops (reference python/paddle/sparse/multiary.py)."""
+from paddle_tpu.sparse.binary import addmm  # noqa: F401

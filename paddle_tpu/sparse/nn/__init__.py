@@ -1,0 +1,11 @@
+"""paddle.sparse.nn (reference python/paddle/sparse/nn/__init__.py)."""
+from paddle_tpu.sparse.nn import functional
+from paddle_tpu.sparse.nn.layers import (
+    ReLU, ReLU6, LeakyReLU, Softmax, BatchNorm, SyncBatchNorm,
+    Conv2D, Conv3D, SubmConv2D, SubmConv3D, MaxPool3D,
+)
+
+__all__ = [
+    'ReLU', 'ReLU6', 'LeakyReLU', 'Softmax', 'BatchNorm', 'SyncBatchNorm',
+    'Conv2D', 'Conv3D', 'SubmConv2D', 'SubmConv3D', 'MaxPool3D', 'functional',
+]

@@ -1,0 +1,101 @@
+"""paddle.sparse.nn.functional (reference python/paddle/sparse/nn/functional/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.sparse.tensor import SparseCooTensor, SparseCsrTensor, _coo, _wrap_like
+from paddle_tpu.sparse.unary import _valmap
+from paddle_tpu.tensor.tensor import Tensor
+
+relu = _valmap(jax.nn.relu)
+relu6 = _valmap(lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _valmap(lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the non-zero entries of each row (reference sparse softmax
+    semantics: zeros are treated as -inf / excluded)."""
+    dense = x._mat.todense()
+    neg = jnp.where(dense != 0, dense, -jnp.inf)
+    sm = jax.nn.softmax(neg, axis=axis)
+    sm = jnp.where(dense != 0, sm, 0.0)
+    out = jsparse.BCOO.fromdense(sm)
+    return _wrap_like(x, out)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None, attn_mask=None, name=None):
+    """Sparse-mask scaled-dot-product attention (reference
+    sparse/nn/functional/transformer.py): scores computed only at mask nnz."""
+    from paddle_tpu.sparse.binary import masked_matmul
+
+    q = query.data
+    k = key.data
+    v = value.data
+    d = q.shape[-1]
+    # batched dense fallback over the mask pattern (B,H small on TPU tests)
+    scores = jnp.einsum("...id,...jd->...ij", q, k) / jnp.sqrt(d)
+    mask_dense = _coo(sparse_mask).todense() != 0
+    # paddle documents mask shape [batch*num_heads, L, L]; scores are (B, H, L, L)
+    if mask_dense.ndim == 3 and scores.ndim == 4:
+        mask_dense = mask_dense.reshape(scores.shape)
+    scores = jnp.where(mask_dense, scores, -jnp.inf)
+    if key_padding_mask is not None:
+        scores = scores + key_padding_mask.data[:, None, None, :]
+    if attn_mask is not None:
+        scores = scores + attn_mask.data
+    att = jax.nn.softmax(scores, -1)
+    att = jnp.where(jnp.isnan(att), 0.0, att)
+    return Tensor(jnp.einsum("...ij,...jd->...id", att, v))
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups, dims, subm):
+    """Reference sparse convs (conv2d/conv3d/subm_*) computed on the dense view;
+    sparsity of the output follows conv(dense) (submanifold: input pattern)."""
+    from paddle_tpu.nn.functional.conv import conv2d, conv3d
+
+    dense = Tensor(_coo(x).todense())
+    # paddle sparse conv layout is channels-last (NDHWC); dense conv expects NCDHW
+    perm_in = (0, dims + 1) + tuple(range(1, dims + 1))
+    perm_out = (0,) + tuple(range(2, dims + 2)) + (1,)
+    xt = Tensor(jnp.transpose(dense.data, perm_in))
+    # paddle sparse weight layout (k..., Cin, Cout) → dense conv (Cout, Cin, k...)
+    w = jnp.transpose(weight.data, (dims + 1, dims) + tuple(range(dims)))
+    fn = conv3d if dims == 3 else conv2d
+    out = fn(xt, Tensor(w), bias=bias, stride=stride, padding=padding,
+             dilation=dilation, groups=groups)
+    out_cl = jnp.transpose(out.data, perm_out)
+    if subm:
+        mask = (_coo(x).todense() != 0).any(-1, keepdims=True)
+        out_cl = jnp.where(mask, out_cl, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out_cl, n_dense=1))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NHWC", name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups, 2, False)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NDHWC", name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups, 3, False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups, 2, True)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NDHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups, 3, True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NDHWC", name=None):
+    from paddle_tpu.nn.functional.pooling import max_pool3d as dense_mp3
+
+    dense = Tensor(_coo(x).todense())
+    xt = Tensor(jnp.transpose(dense.data, (0, 4, 1, 2, 3)))
+    out = dense_mp3(xt, kernel_size, stride=stride, padding=padding, ceil_mode=ceil_mode)
+    out_cl = jnp.transpose(out.data, (0, 2, 3, 4, 1))
+    return SparseCooTensor(jsparse.BCOO.fromdense(out_cl, n_dense=1))

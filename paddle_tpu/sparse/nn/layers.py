@@ -1,0 +1,142 @@
+"""paddle.sparse.nn layers (reference python/paddle/sparse/nn/layer/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.sparse.nn import functional as F
+from paddle_tpu.sparse.tensor import SparseCooTensor, _coo, _wrap_like
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm (reference sparse/nn/layer/norm.py): normalizes the
+    values tensor over nnz per channel (channels-last)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC", name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        from paddle_tpu.nn import initializer as I
+
+        self.weight = self.create_parameter([num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        mat = _coo(x)
+        vals = mat.data  # (nnz, C)
+        if self.training:
+            mean = vals.mean(0)
+            var = vals.var(0)
+            m = self._momentum
+            self._mean.copy_(Tensor(m * self._mean.data + (1 - m) * mean))
+            self._variance.copy_(Tensor(m * self._variance.data + (1 - m) * var))
+        else:
+            mean, var = self._mean.data, self._variance.data
+        out = (vals - mean) / jnp.sqrt(var + self._epsilon)
+        out = out * self.weight.data + self.bias.data
+        return _wrap_like(x, jsparse.BCOO((out, mat.indices), shape=mat.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-process fallback == BatchNorm; under pjit the mean/var reduce is
+    global automatically (XLA SPMD)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class _SparseConv(Layer):
+    def __init__(self, dims, subm, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        self._dims = dims
+        self._subm = subm
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * dims
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        w_shape = tuple(ks) + (in_channels // groups, out_channels)
+        self.weight = self.create_parameter(list(w_shape))
+        self.bias = self.create_parameter([out_channels], is_bias=True) if bias_attr is not False else None
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d, (3, False): F.conv3d,
+            (2, True): F.subm_conv2d, (3, True): F.subm_conv3d,
+        }[(self._dims, self._subm)]
+        return fn(x, self.weight, bias=self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation, groups=self._groups)
+
+
+class Conv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(2, False, in_channels, out_channels, kernel_size, **kw)
+
+
+class Conv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(3, False, in_channels, out_channels, kernel_size, **kw)
+
+
+class SubmConv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.pop("key", None)
+        super().__init__(2, True, in_channels, out_channels, kernel_size, **kw)
+
+
+class SubmConv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.pop("key", None)
+        super().__init__(3, True, in_channels, out_channels, kernel_size, **kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, stride=self._stride,
+                            padding=self._padding, ceil_mode=self._ceil_mode)

@@ -1,0 +1,123 @@
+"""Sparse tensor types backed by jax.experimental.sparse (BCOO/BCSR).
+
+TPU-native analog of the reference's SparseCooTensor/SparseCsrTensor
+(paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h): COO keeps an
+(nnz, ndim) index matrix + values vector; CSR keeps crows/cols/values.
+Compute routes through jax.experimental.sparse kernels (bcoo_dot_general uses
+gather/scatter lowering that XLA maps onto the TPU efficiently).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class SparseTensor:
+    """Common behavior for COO/CSR wrappers."""
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def ndim(self):
+        return self._mat.ndim
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def to_dense(self):
+        return Tensor(self._mat.todense())
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return isinstance(self, SparseCooTensor)
+
+    def is_sparse_csr(self):
+        return isinstance(self, SparseCsrTensor)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(shape={self.shape}, nnz={self.nnz()}, dtype={self.dtype})"
+
+
+class SparseCooTensor(SparseTensor):
+    def __init__(self, mat: jsparse.BCOO):
+        self._mat = mat
+
+    def indices(self):
+        return Tensor(self._mat.indices.T.astype(jnp.int64))
+
+    def values(self):
+        return Tensor(self._mat.data)
+
+    def coalesce(self):
+        from paddle_tpu.sparse.unary import coalesce
+
+        return coalesce(self)
+
+    def to_sparse_csr(self):
+        m = self._mat.sum_duplicates(remove_zeros=False)
+        bcsr = jsparse.BCSR.from_bcoo(m)
+        return SparseCsrTensor(bcsr)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def transpose(self, perm):
+        from paddle_tpu.sparse.unary import transpose
+
+        return transpose(self, perm)
+
+
+class SparseCsrTensor(SparseTensor):
+    def __init__(self, mat: jsparse.BCSR):
+        self._mat = mat
+
+    def crows(self):
+        return Tensor(self._mat.indptr.astype(jnp.int64))
+
+    def cols(self):
+        return Tensor(self._mat.indices.astype(jnp.int64))
+
+    def values(self):
+        return Tensor(self._mat.data)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._mat.to_bcoo())
+
+    def to_sparse_csr(self):
+        return self
+
+
+def _dense_data(x):
+    if isinstance(x, Tensor):
+        return x.data
+    if isinstance(x, SparseTensor):
+        return x._mat.todense()
+    return jnp.asarray(x)
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._mat
+    if isinstance(x, SparseCsrTensor):
+        return x._mat.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def _wrap_like(x, mat: jsparse.BCOO):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat))
+    return SparseCooTensor(mat)

@@ -1,0 +1,117 @@
+"""Sparse unary ops (reference python/paddle/sparse/unary.py): applied to the
+values, preserving structure."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.sparse.tensor import (
+    SparseCooTensor, SparseCsrTensor, SparseTensor, _coo, _wrap_like,
+)
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _valmap(fn):
+    def op(x, name=None):
+        mat = x._mat
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(jsparse.BCOO((fn(mat.data), mat.indices), shape=mat.shape))
+        return SparseCsrTensor(jsparse.BCSR((fn(mat.data), mat.indices, mat.indptr), shape=mat.shape))
+
+    return op
+
+
+sin = _valmap(jnp.sin)
+tan = _valmap(jnp.tan)
+asin = _valmap(jnp.arcsin)
+atan = _valmap(jnp.arctan)
+sinh = _valmap(jnp.sinh)
+tanh = _valmap(jnp.tanh)
+asinh = _valmap(jnp.arcsinh)
+atanh = _valmap(jnp.arctanh)
+sqrt = _valmap(jnp.sqrt)
+square = _valmap(jnp.square)
+log1p = _valmap(jnp.log1p)
+abs = _valmap(jnp.abs)
+neg = _valmap(jnp.negative)
+expm1 = _valmap(jnp.expm1)
+deg2rad = _valmap(jnp.deg2rad)
+rad2deg = _valmap(jnp.rad2deg)
+isnan = _valmap(jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _valmap(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+
+    mat = _coo(x)
+    data = mat.data if value_dtype is None else mat.data.astype(convert_dtype(value_dtype))
+    idx = mat.indices if index_dtype is None else mat.indices.astype(convert_dtype(index_dtype))
+    return _wrap_like(x, jsparse.BCOO((data, idx), shape=mat.shape))
+
+
+def coalesce(x, name=None):
+    mat = _coo(x).sum_duplicates(remove_zeros=False)
+    return SparseCooTensor(mat)
+
+
+def transpose(x, perm, name=None):
+    mat = _coo(x)
+    out = jsparse.bcoo_transpose(mat, permutation=tuple(perm))
+    return _wrap_like(x, out)
+
+
+def reshape(x, shape, name=None):
+    mat = _coo(x)
+    shape = tuple(int(s) if s != -1 else -1 for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        total = 1
+        for s in mat.shape:
+            total *= s
+        shape = tuple(total // known if s == -1 else s for s in shape)
+    out = jsparse.bcoo_reshape(mat, new_sizes=shape)
+    return _wrap_like(x, out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    mat = _coo(x)
+    if axis is None:
+        out = mat.data.sum()
+        return Tensor(out if dtype is None else out.astype(dtype))
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % mat.ndim for a in axes)
+    out = jsparse.sparsify(lambda m: m.sum(axes))(mat)
+    if not isinstance(out, jsparse.BCOO):
+        return Tensor(out)
+    if keepdim:
+        kshape = tuple(1 if i in axes else s for i, s in enumerate(mat.shape))
+        out = jsparse.bcoo_reshape(out, new_sizes=kshape)
+    return _wrap_like(x, out)
+
+
+def slice(x, axes, starts, ends, name=None):
+    mat = _coo(x)
+    start = [0] * mat.ndim
+    limit = list(mat.shape)
+    for a, s, e in zip(axes, starts, ends):
+        a = a % mat.ndim
+        s = s if s >= 0 else mat.shape[a] + s
+        e = e if e >= 0 else mat.shape[a] + e
+        start[a] = s
+        limit[a] = min(e, mat.shape[a])
+    out = jsparse.bcoo_slice(mat, start_indices=start, limit_indices=limit)
+    return _wrap_like(x, out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from paddle_tpu.tensor.linalg import pca_lowrank as dense_pca
+
+    return dense_pca(x.to_dense(), q=q, center=center, niter=niter)

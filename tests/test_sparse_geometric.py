@@ -1,0 +1,211 @@
+"""paddle.sparse + paddle.geometric parity tests (reference test/legacy_test/
+test_sparse_*, test/legacy_test/test_graph_send_recv.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+import paddle_tpu.geometric as geometric
+
+
+def _rand_coo(shape=(4, 5), density=0.4, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.random(shape).astype("float32") * (rng.random(shape) < density)
+    return dense, paddle.to_tensor(dense).to_sparse_coo()
+
+
+class TestSparseCreation:
+    def test_coo_roundtrip(self):
+        s = sparse.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0], [3, 3])
+        dense = s.to_dense().numpy()
+        assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+        assert s.nnz() == 3 and s.is_sparse_coo() and not s.is_sparse_csr()
+        idx = s.indices().numpy()
+        assert idx.shape == (2, 3)
+
+    def test_csr_roundtrip(self):
+        s = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0], [3, 3])
+        assert s.is_sparse_csr()
+        np.testing.assert_allclose(s.crows().numpy(), [0, 1, 2, 3])
+        dense = s.to_dense().numpy()
+        assert dense[0, 1] == 1.0
+
+    def test_dense_conversions(self):
+        dense, s = _rand_coo()
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        csr = paddle.to_tensor(dense).to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        np.testing.assert_allclose(csr.to_sparse_coo().to_dense().numpy(), dense)
+        np.testing.assert_allclose(s.to_sparse_csr().to_dense().numpy(), dense)
+
+
+class TestSparseOps:
+    def test_unary(self):
+        dense, s = _rand_coo()
+        np.testing.assert_allclose(sparse.sin(s).to_dense().numpy(), np.sin(dense), rtol=1e-6)
+        np.testing.assert_allclose(sparse.sqrt(s).to_dense().numpy(), np.sqrt(dense), rtol=1e-6)
+        np.testing.assert_allclose(sparse.neg(s).to_dense().numpy(), -dense)
+        np.testing.assert_allclose(sparse.pow(s, 2).to_dense().numpy(), dense ** 2, rtol=1e-6)
+
+    def test_binary_addsub(self):
+        d1, s1 = _rand_coo(seed=1)
+        d2, s2 = _rand_coo(seed=2)
+        np.testing.assert_allclose(sparse.add(s1, s2).to_dense().numpy(), d1 + d2, rtol=1e-6)
+        np.testing.assert_allclose(sparse.subtract(s1, s2).to_dense().numpy(), d1 - d2, rtol=1e-6)
+        np.testing.assert_allclose(sparse.multiply(s1, s2).to_dense().numpy(), d1 * d2, rtol=1e-6)
+
+    def test_matmul(self):
+        d1, s1 = _rand_coo((4, 5), seed=3)
+        dense_w = np.random.rand(5, 6).astype("float32")
+        out = sparse.matmul(s1, paddle.to_tensor(dense_w))
+        np.testing.assert_allclose(out.numpy(), d1 @ dense_w, rtol=1e-5)
+        v = np.random.rand(5).astype("float32")
+        np.testing.assert_allclose(sparse.mv(s1, paddle.to_tensor(v)).numpy(), d1 @ v, rtol=1e-5)
+
+    def test_masked_matmul_addmm(self):
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        mask_dense, mask = _rand_coo((4, 4), seed=4)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        ref = (x @ y) * (mask_dense != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5, atol=1e-6)
+        inp = np.random.rand(4, 4).astype("float32")
+        d1, s1 = _rand_coo((4, 3), seed=5)
+        got = sparse.addmm(paddle.to_tensor(inp), s1, paddle.to_tensor(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(got.numpy(), 0.5 * inp + 2.0 * (d1 @ y), rtol=1e-5)
+
+    def test_transpose_reshape_sum_slice(self):
+        dense, s = _rand_coo((3, 4))
+        np.testing.assert_allclose(sparse.transpose(s, [1, 0]).to_dense().numpy(), dense.T)
+        np.testing.assert_allclose(sparse.reshape(s, [4, 3]).to_dense().numpy(), dense.reshape(4, 3))
+        np.testing.assert_allclose(float(sparse.sum(s).numpy()), dense.sum(), rtol=1e-6)
+        got = sparse.sum(s, axis=1)
+        np.testing.assert_allclose(got.to_dense().numpy(), dense.sum(1), rtol=1e-6)
+        sl = sparse.slice(s, [0], [1], [3])
+        np.testing.assert_allclose(sl.to_dense().numpy(), dense[1:3], rtol=1e-6)
+
+    def test_coalesce_cast_is_same_shape(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], [2, 2])
+        c = s.coalesce()
+        assert c.nnz() <= 2 and float(c.to_dense().numpy()[0, 1]) == 3.0
+        cast = sparse.cast(s, value_dtype="float64")
+        assert "float64" in str(cast.values().numpy().dtype)
+        assert sparse.is_same_shape(s, c)
+
+
+class TestSparseNN:
+    def test_activations(self):
+        dense = np.array([[-1.0, 0.0, 2.0], [3.0, -0.5, 0.0]], "float32")
+        s = paddle.to_tensor(dense).to_sparse_coo()
+        relu = sparse.nn.ReLU()(s).to_dense().numpy()
+        np.testing.assert_allclose(relu, np.maximum(dense, 0))
+        lrelu = sparse.nn.LeakyReLU(0.1)(s).to_dense().numpy()
+        # leaky applies to stored values only; zero entries stay zero
+        assert lrelu[0, 0] == pytest.approx(-0.1)
+
+    def test_softmax_rows(self):
+        dense, s = _rand_coo((3, 5), density=0.6, seed=7)
+        out = sparse.nn.functional.softmax(s.to_sparse_csr()).to_dense().numpy()
+        for i in range(3):
+            nz = dense[i] != 0
+            if nz.any():
+                np.testing.assert_allclose(out[i][nz].sum(), 1.0, rtol=1e-5)
+                assert (out[i][~nz] == 0).all()
+
+    def test_batchnorm(self):
+        vals = np.random.rand(10, 4).astype("float32") + 1.0
+        idx = np.stack([np.arange(10) % 3, np.arange(10) % 5, np.arange(10) % 7], 0)
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 5, 7, 4])
+        bn = sparse.nn.BatchNorm(4)
+        out = bn(s)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(v.std(0), np.ones(4), atol=1e-2)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        rng = np.random.default_rng(0)
+        dense = np.zeros((1, 4, 4, 4, 2), "float32")
+        pts = rng.integers(0, 4, (6, 3))
+        for p in pts:
+            dense[0, p[0], p[1], p[2]] = rng.random(2)
+        s = paddle.to_tensor(dense).to_sparse_coo(4)
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(s)
+        out_dense = out.to_dense().numpy()
+        mask = (dense != 0).any(-1)
+        assert out_dense.shape == (1, 4, 4, 4, 3)
+        assert (out_dense[~mask] == 0).all()
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], "float32")
+        ids = np.array([0, 0, 1, 1])
+        t, i = paddle.to_tensor(data), paddle.to_tensor(ids)
+        np.testing.assert_allclose(geometric.segment_sum(t, i).numpy(), [[4, 6], [12, 14]])
+        np.testing.assert_allclose(geometric.segment_mean(t, i).numpy(), [[2, 3], [6, 7]])
+        np.testing.assert_allclose(geometric.segment_min(t, i).numpy(), [[1, 2], [5, 6]])
+        np.testing.assert_allclose(geometric.segment_max(t, i).numpy(), [[3, 4], [7, 8]])
+
+    def test_send_u_recv_reduce_ops(self):
+        x = np.arange(12, dtype="float32").reshape(4, 3)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        t = paddle.to_tensor(x)
+        out = geometric.send_u_recv(t, paddle.to_tensor(src), paddle.to_tensor(dst), "sum").numpy()
+        ref = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            ref[d] += x[s]
+        np.testing.assert_allclose(out, ref)
+        out_max = geometric.send_u_recv(t, paddle.to_tensor(src), paddle.to_tensor(dst), "max").numpy()
+        assert out_max[1].tolist() == np.maximum(x[0], x[2]).tolist()
+
+    def test_send_ue_recv_send_uv(self):
+        x = np.arange(8, dtype="float32").reshape(4, 2)
+        e = np.ones((3, 2), "float32")
+        src = np.array([0, 1, 2])
+        dst = np.array([1, 0, 3])
+        out = geometric.send_ue_recv(
+            paddle.to_tensor(x), paddle.to_tensor(e), paddle.to_tensor(src), paddle.to_tensor(dst), "add", "sum"
+        ).numpy()
+        ref = np.zeros_like(x)
+        for k, (s, d) in enumerate(zip(src, dst)):
+            ref[d] += x[s] + e[k]
+        np.testing.assert_allclose(out, ref)
+        uv = geometric.send_uv(
+            paddle.to_tensor(x), paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst), "mul"
+        ).numpy()
+        np.testing.assert_allclose(uv, x[src] * x[dst])
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(np.ones((3, 2), "float32"))
+        x.stop_gradient = False
+        out = geometric.send_u_recv(
+            x, paddle.to_tensor(np.array([0, 1])), paddle.to_tensor(np.array([1, 1])), "sum"
+        )
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [1, 1], [0, 0]])
+
+    def test_reindex_graph(self):
+        x = np.array([10, 20])
+        neighbors = np.array([30, 10, 40])
+        count = np.array([2, 1])
+        src, dst, nodes = geometric.reindex_graph(
+            paddle.to_tensor(x), paddle.to_tensor(neighbors), paddle.to_tensor(count)
+        )
+        assert nodes.numpy().tolist()[:2] == [10, 20]
+        remap = {g: i for i, g in enumerate(nodes.numpy().tolist())}
+        np.testing.assert_array_equal(src.numpy(), [remap[30], remap[10], remap[40]])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+
+    def test_sample_neighbors(self):
+        # CSR graph: node0 -> {1,2,3}, node1 -> {0}, node2 -> {}
+        row = paddle.to_tensor(np.array([1, 2, 3, 0]))
+        colptr = paddle.to_tensor(np.array([0, 3, 4, 4]))
+        nbrs, counts = geometric.sample_neighbors(row, colptr, paddle.to_tensor(np.array([0, 1, 2])), sample_size=2)
+        c = counts.numpy()
+        assert c[0] == 2 and c[1] == 1 and c[2] == 0
+        assert set(nbrs.numpy()[:2]).issubset({1, 2, 3})
+        w = paddle.to_tensor(np.array([0.1, 0.1, 10.0, 1.0], "float32"))
+        nbrs2, counts2 = geometric.weighted_sample_neighbors(row, colptr, w, paddle.to_tensor(np.array([0])), sample_size=1)
+        assert counts2.numpy()[0] == 1
