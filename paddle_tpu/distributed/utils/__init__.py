@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.utils.moe_utils import global_gather, global_scatter
+
+__all__ = ['global_scatter', 'global_gather']

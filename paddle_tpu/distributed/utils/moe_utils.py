@@ -1,0 +1,53 @@
+"""global_scatter / global_gather (reference python/paddle/distributed/utils/
+moe_utils.py; CUDA kernels paddle/fluid/operators/collective/global_scatter_op.*).
+
+Expert-parallel token exchange.  Single-controller SPMD semantics: with the
+replicated eager emulation (1 process) these are local row selections; under
+pjit the same row-gather pattern with a sharded expert axis lowers to the
+all-to-all the reference issues explicitly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Rows of x are grouped by (expert, rank) according to local_count; returns
+    the rows this rank's experts receive (global_count layout)."""
+
+    def f(xd, lc, gc):
+        # local_count[i]: #rows this rank sends to expert-slot i (len = n_expert*world)
+        # replicated emulation (world==1): rows are already ordered by slot; the
+        # receive side orders by global_count — identical here.
+        total = int(jnp.sum(gc))
+        starts = jnp.cumsum(lc) - lc
+        pieces = []
+        off = 0
+        import numpy as np
+
+        lc_np = np.asarray(lc)
+        for i, c in enumerate(lc_np):
+            pieces.append(xd[off:off + int(c)])
+            off += int(c)
+        return jnp.concatenate(pieces, 0) if pieces else xd[:0]
+
+    return apply("global_scatter", f, x, local_count, global_count)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to token owners."""
+
+    def f(xd, lc, gc):
+        import numpy as np
+
+        gc_np = np.asarray(gc)
+        pieces = []
+        off = 0
+        for c in gc_np:
+            pieces.append(xd[off:off + int(c)])
+            off += int(c)
+        return jnp.concatenate(pieces, 0) if pieces else xd[:0]
+
+    return apply("global_gather", f, x, local_count, global_count)

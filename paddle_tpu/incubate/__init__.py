@@ -1,0 +1,84 @@
+"""paddle.incubate (reference python/paddle/incubate/__init__.py)."""
+import jax.numpy as _jnp
+
+from paddle_tpu.autograd.engine import apply as _apply
+from paddle_tpu.incubate import asp  # noqa: F401
+from paddle_tpu.incubate import autograd  # noqa: F401
+from paddle_tpu.incubate import distributed  # noqa: F401
+from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+from paddle_tpu.incubate import optimizer  # noqa: F401
+
+# graph aliases (the pre-paddle.geometric API surface)
+from paddle_tpu.geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from paddle_tpu.geometric import reindex_graph as graph_reindex  # noqa: F401
+from paddle_tpu.geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, name=None):
+    from paddle_tpu.geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type, out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                       return_eids=False, name=None):
+    """Multi-hop sampling built on sample_neighbors (reference
+    incubate/operators/graph_khop_sampler.py)."""
+    import numpy as np
+
+    from paddle_tpu.geometric import reindex_graph, sample_neighbors
+    from paddle_tpu.tensor.tensor import Tensor
+
+    nodes = input_nodes
+    all_neighbors = []
+    all_counts = []
+    for size in sample_sizes:
+        nbrs, counts = sample_neighbors(row, colptr, nodes, sample_size=size)
+        all_neighbors.append(nbrs)
+        all_counts.append(counts)
+        nodes = Tensor(np.unique(np.concatenate([np.asarray(nodes.numpy()), nbrs.numpy()])))
+    neighbors = Tensor(np.concatenate([n.numpy() for n in all_neighbors]))
+    counts = Tensor(np.concatenate([c.numpy() for c in all_counts]))
+    edge_src, edge_dst, sample_index = reindex_graph(input_nodes, neighbors, counts)
+    return edge_src, edge_dst, sample_index, None
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference incubate/operators/softmax_mask_fuse.py)."""
+    import jax
+
+    return _apply("softmax_mask_fuse", lambda a, m: jax.nn.softmax(a + m, -1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with causal (upper-triangle) mask fused (reference
+    softmax_mask_fuse_upper_triangle.py)."""
+    import jax
+
+    def f(a):
+        s = a.shape[-1]
+        causal = _jnp.tril(_jnp.ones((a.shape[-2], s), bool))
+        scores = _jnp.where(causal, a, _jnp.finfo(a.dtype).min)
+        return jax.nn.softmax(scores, -1)
+
+    return _apply("softmax_mask_fuse_ut", f, x)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as loss (IPU legacy; reference incubate/__init__.py)."""
+    if reduction in ("mean", 1):
+        return _apply("mean", _jnp.mean, x)
+    if reduction in ("sum", 0):
+        return _apply("sum", _jnp.sum, x)
+    return x
+
+
+__all__ = [
+    'LookAhead', 'ModelAverage', 'softmax_mask_fuse_upper_triangle',
+    'softmax_mask_fuse', 'graph_send_recv', 'graph_khop_sampler',
+    'graph_sample_neighbors', 'graph_reindex', 'segment_sum', 'segment_mean',
+    'segment_max', 'segment_min', 'identity_loss',
+]

@@ -1,0 +1,18 @@
+"""paddle.incubate.asp — 2:4 structured sparsity (reference
+python/paddle/incubate/asp/): mask calculation + pruning + masked optimizer.
+
+TPU note: the reference targets Ampere sparse tensor cores; on TPU the masks are
+plain weight pruning (the MXU has no 2:4 path), kept for API/workflow parity."""
+from paddle_tpu.incubate.asp.asp import (
+    ASPHelper, calculate_density, decorate, prune_model, reset_excluded_layers,
+    set_excluded_layers,
+)
+from paddle_tpu.incubate.asp.utils import (
+    MaskAlgo, CheckMethod, check_mask_1d, check_mask_2d, check_sparsity,
+    create_mask, get_mask_1d, get_mask_2d_best, get_mask_2d_greedy,
+)
+
+__all__ = [
+    'calculate_density', 'decorate', 'prune_model', 'set_excluded_layers',
+    'reset_excluded_layers',
+]

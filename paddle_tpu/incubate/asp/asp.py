@@ -1,0 +1,83 @@
+"""ASP workflow (reference python/paddle/incubate/asp/asp.py): decorate the
+optimizer so gradients respect the sparsity masks; prune_model computes masks."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.incubate.asp.utils import (
+    CheckMethod, MaskAlgo, calculate_density, check_sparsity, create_mask,
+)
+from paddle_tpu.tensor.tensor import Tensor
+
+_EXCLUDED_LAYERS = []
+
+
+def set_excluded_layers(param_names, main_program=None):
+    # one process-global exclusion list (eager mode has no program scoping)
+    _EXCLUDED_LAYERS.clear()
+    _EXCLUDED_LAYERS.extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED_LAYERS.clear()
+
+
+class ASPHelper:
+    MASK_APPENDDED_NAME = '_asp_mask'
+    _masks = {}
+
+    @classmethod
+    def _is_supported_layer(cls, param_name):
+        if any(e in param_name for e in _EXCLUDED_LAYERS):
+            return False
+        return ('w_' in param_name or 'weight' in param_name) and '_asp_mask' not in param_name
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
+        algo = {'mask_1d': MaskAlgo.MASK_1D, 'mask_2d_greedy': MaskAlgo.MASK_2D_GREEDY,
+                'mask_2d_best': MaskAlgo.MASK_2D_BEST}[mask_algo]
+        for name, param in model.named_parameters():
+            # match exclusions against both the attribute path ("fc1.weight") and
+            # the parameter's unique name ("linear_0.w_0"), like the reference
+            full = f"{name}|{getattr(param, 'name', '')}"
+            if not cls._is_supported_layer(full):
+                continue
+            if param.ndim < 2:
+                continue
+            arr = np.asarray(param.numpy())
+            mask = create_mask(arr, func_name=algo, n=n, m=m)
+            import jax.numpy as jnp
+
+            param._data = jnp.asarray(arr * mask)
+            param._asp_mask = jnp.asarray(mask, param.data.dtype)  # mask travels with the param
+            cls._masks[name] = mask
+        return cls._masks
+
+    @classmethod
+    def decorate(cls, optimizer):
+        return OptimizerWithSparsityGuarantee(optimizer)
+
+
+class OptimizerWithSparsityGuarantee:
+    """After every step, re-applies the masks so pruned weights stay zero."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = getattr(p, '_asp_mask', None)
+            if mask is not None:
+                p._data = p.data * mask
+
+
+def decorate(optimizer):
+    return ASPHelper.decorate(optimizer)
+
+
+def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo, with_mask=with_mask)

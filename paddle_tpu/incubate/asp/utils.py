@@ -1,0 +1,113 @@
+"""n:m sparsity mask utilities (reference python/paddle/incubate/asp/utils.py)."""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+
+import numpy as np
+
+
+class MaskAlgo(Enum):
+    MASK_1D = 'get_mask_1d'
+    MASK_2D_GREEDY = 'get_mask_2d_greedy'
+    MASK_2D_BEST = 'get_mask_2d_best'
+
+
+class CheckMethod(Enum):
+    CHECK_1D = 'check_mask_1d'
+    CHECK_2D = 'check_mask_2d'
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D else CheckMethod.CHECK_2D
+
+
+def calculate_density(x):
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _reshape_1d(mat, m):
+    pad = (m - mat.shape[1] % m) % m
+    padded = np.pad(mat, ((0, 0), (0, pad)), 'constant')
+    return padded.reshape(-1, m), padded.shape
+
+
+def get_mask_1d(mat, n, m):
+    """Keep n largest-|.| of every m consecutive elements (rows)."""
+    mat2, padded_shape = _reshape_1d(np.asarray(mat), m)
+    mask = np.zeros_like(mat2)
+    order = np.argsort(np.abs(mat2), axis=1)[:, -n:]
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    mask = mask.reshape(padded_shape)[: mat.shape[0], : mat.shape[1]]
+    return mask
+
+
+def check_mask_1d(mat, n, m):
+    mat2, _ = _reshape_1d(np.asarray(mat), m)
+    nnz = (mat2 != 0).sum(1)
+    return bool((nnz <= n).all())
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy m x m block selection (reference get_mask_2d_greedy)."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    pad_h, pad_w = (m - h % m) % m, (m - w % m) % m
+    padded = np.pad(np.abs(mat), ((0, pad_h), (0, pad_w)), 'constant')
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            bmask = np.zeros_like(block)
+            order = np.argsort(block.flatten())[::-1]
+            row_cnt = np.zeros(m, int)
+            col_cnt = np.zeros(m, int)
+            for o in order:
+                r, c = divmod(int(o), m)
+                if row_cnt[r] < n and col_cnt[c] < n:
+                    bmask[r, c] = 1.0
+                    row_cnt[r] += 1
+                    col_cnt[c] += 1
+            mask[bi:bi + m, bj:bj + m] = bmask
+    return mask[:h, :w]
+
+
+def get_mask_2d_best(mat, n, m):
+    return get_mask_2d_greedy(mat, n, m)
+
+
+def check_mask_2d(mat, n, m):
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    pad_h, pad_w = (m - h % m) % m, (m - w % m) % m
+    padded = np.pad(mat, ((0, pad_h), (0, pad_w)), 'constant')
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m] != 0
+            if (block.sum(0) > n).any() or (block.sum(1) > n).any():
+                return False
+    return True
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    mat = np.asarray(tensor)
+    shape = mat.shape
+    if mat.ndim == 1:
+        mat = mat.reshape(1, -1)
+    elif mat.ndim > 2:
+        mat = mat.reshape(shape[0], -1)
+    fn = {MaskAlgo.MASK_1D: get_mask_1d, MaskAlgo.MASK_2D_GREEDY: get_mask_2d_greedy,
+          MaskAlgo.MASK_2D_BEST: get_mask_2d_best}[func_name]
+    mask = fn(mat, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    mat = np.asarray(tensor)
+    if mat.ndim == 1:
+        mat = mat.reshape(1, -1)
+    elif mat.ndim > 2:
+        mat = mat.reshape(mat.shape[0], -1)
+    fn = {CheckMethod.CHECK_1D: check_mask_1d, CheckMethod.CHECK_2D: check_mask_2d}[func_name]
+    return fn(mat, n, m)

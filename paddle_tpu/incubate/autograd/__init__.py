@@ -1,0 +1,8 @@
+"""paddle.incubate.autograd (reference python/paddle/incubate/autograd/__init__.py)."""
+from paddle_tpu.incubate.autograd.functional import (
+    Hessian, Jacobian, forward_grad, grad, jvp, vjp,
+)
+from paddle_tpu.incubate.autograd.primapi import disable_prim, enable_prim, prim_enabled
+
+__all__ = ['vjp', 'jvp', 'Jacobian', 'Hessian', 'enable_prim', 'disable_prim',
+           'forward_grad', 'grad']

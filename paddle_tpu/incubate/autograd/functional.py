@@ -1,0 +1,175 @@
+"""Functional autograd: jvp/vjp/Jacobian/Hessian (reference
+python/paddle/incubate/autograd/functional.py).
+
+TPU-native: these delegate to jax.jvp/jax.vjp/jax.jacobian on a jnp-level view
+of the user function, so the whole Jacobian computation is one XLA program
+(the reference builds per-row tape replays instead)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _jax_fn(func, nin):
+    meta = {"single": True}
+
+    def jfn(*arrays):
+        ins = [Tensor(a) for a in arrays]
+        out = func(*ins)
+        meta["single"] = not isinstance(out, (list, tuple))
+        outs = _as_list(out)
+        return tuple(o.data if isinstance(o, Tensor) else jnp.asarray(o) for o in outs)
+
+    jfn.meta = meta
+    return jfn
+
+
+def _wrap(outs, single):
+    ts = [Tensor(o) for o in outs]
+    return ts[0] if single and len(ts) == 1 else ts
+
+
+def vjp(func, xs, v=None):
+    """Returns (func(xs), vjp(v)) (reference functional.py vjp)."""
+    xs_l = _as_list(xs)
+    arrays = [x.data for x in xs_l]
+    jfn = _jax_fn(func, len(arrays))
+    out, pullback = jax.vjp(lambda *a: jfn(*a), *arrays)
+    single_out = jfn.meta["single"]
+    if v is None:
+        cot = tuple(jnp.ones_like(o) for o in out)
+    else:
+        cot = tuple(t.data for t in _as_list(v))
+    grads = pullback(cot)
+    return _wrap(out, single_out), _wrap(grads, not isinstance(xs, (list, tuple)))
+
+
+def jvp(func, xs, v=None):
+    """Returns (func(xs), jvp(v)) (reference functional.py jvp)."""
+    xs_l = _as_list(xs)
+    arrays = [x.data for x in xs_l]
+    jfn = _jax_fn(func, len(arrays))
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        tangents = tuple(t.data for t in _as_list(v))
+    out, jv = jax.jvp(lambda *a: jfn(*a), tuple(arrays), tangents)
+    single_out = jfn.meta["single"]
+    return _wrap(out, single_out), _wrap(jv, single_out)
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    raise NotImplementedError(
+        "forward_grad operates on the static prim program in the reference; "
+        "use paddle.incubate.autograd.jvp for forward-mode derivatives."
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from paddle_tpu.autograd.engine import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs=grad_outputs, allow_unused=True)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference functional.py Jacobian): J[i, j] =
+    d f_i / d x_j on flattened in/out; is_batched keeps the leading batch dim."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = _as_list(xs)
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrays = [x.data for x in self._xs]
+        jfn = _jax_fn(self._func, len(arrays))
+
+        if not self._is_batched:
+            def flat_fn(flat_in):
+                parts = []
+                off = 0
+                for a in arrays:
+                    parts.append(flat_in[off:off + a.size].reshape(a.shape))
+                    off += a.size
+                outs = jfn(*parts)
+                return jnp.concatenate([o.reshape(-1) for o in outs])
+
+            flat = jnp.concatenate([a.reshape(-1) for a in arrays])
+            self._mat = jax.jacobian(flat_fn)(flat)
+        else:
+            # batched: func maps (B, n) -> (B, m); J is (B, m, n)
+            def single_fn(flat_in):
+                parts = []
+                off = 0
+                for a in arrays:
+                    n = a.size // a.shape[0]
+                    parts.append(flat_in[off:off + n].reshape(a.shape[1:]))
+                    off += n
+                outs = jfn(*[p[None] for p in parts])
+                return jnp.concatenate([o.reshape(-1) for o in outs])
+
+            per_sample = jnp.stack(
+                [jnp.concatenate([a[i].reshape(-1) for a in arrays]) for i in range(arrays[0].shape[0])]
+            )
+            self._mat = jax.vmap(jax.jacobian(single_fn))(per_sample)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._compute())
+
+
+class Hessian(Jacobian):
+    """Hessian of a scalar-output func (reference functional.py Hessian)."""
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrays = [x.data for x in self._xs]
+        jfn = _jax_fn(self._func, len(arrays))
+
+        if not self._is_batched:
+            def flat_fn(flat_in):
+                parts = []
+                off = 0
+                for a in arrays:
+                    parts.append(flat_in[off:off + a.size].reshape(a.shape))
+                    off += a.size
+                outs = jfn(*parts)
+                return outs[0].reshape(())
+
+            flat = jnp.concatenate([a.reshape(-1) for a in arrays])
+            self._mat = jax.hessian(flat_fn)(flat)
+        else:
+            def single_fn(flat_in):
+                parts = []
+                off = 0
+                for a in arrays:
+                    n = a.size // a.shape[0]
+                    parts.append(flat_in[off:off + n].reshape(a.shape[1:]))
+                    off += n
+                outs = jfn(*[p[None] for p in parts])
+                return outs[0].reshape(())
+
+            per_sample = jnp.stack(
+                [jnp.concatenate([a[i].reshape(-1) for a in arrays]) for i in range(arrays[0].shape[0])]
+            )
+            self._mat = jax.vmap(jax.hessian(single_fn))(per_sample)
+        return self._mat

@@ -1,0 +1,20 @@
+"""Prim-mode switches (reference python/paddle/incubate/autograd/primapi.py).
+
+The reference lowers big ops to primitives so its compiler (CINN) sees a small
+op set; on TPU, XLA already consumes HLO primitives, so these are bookkeeping
+flags kept for API parity (decomposition registry: paddle_tpu.decomposition)."""
+_PRIM_ENABLED = False
+
+
+def enable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED

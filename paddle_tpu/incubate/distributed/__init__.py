@@ -1,0 +1,1 @@
+from paddle_tpu.incubate.distributed import models  # noqa: F401

@@ -1,0 +1,1 @@
+from paddle_tpu.incubate.distributed.models import moe  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed.models.moe (reference __init__.py)."""
+from paddle_tpu.incubate.distributed.models.moe.gate import (
+    BaseGate, GShardGate, NaiveGate, SwitchGate,
+)
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import MoELayer
+
+__all__ = ['MoELayer', 'BaseGate', 'GShardGate', 'NaiveGate', 'SwitchGate']

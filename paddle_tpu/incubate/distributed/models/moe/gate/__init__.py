@@ -1,0 +1,6 @@
+from paddle_tpu.incubate.distributed.models.moe.gate.base_gate import BaseGate
+from paddle_tpu.incubate.distributed.models.moe.gate.naive_gate import NaiveGate
+from paddle_tpu.incubate.distributed.models.moe.gate.gshard_gate import GShardGate
+from paddle_tpu.incubate.distributed.models.moe.gate.switch_gate import SwitchGate
+
+__all__ = ['BaseGate', 'NaiveGate', 'GShardGate', 'SwitchGate']

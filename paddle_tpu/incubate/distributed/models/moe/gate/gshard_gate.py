@@ -1,0 +1,34 @@
+"""GShardGate (reference .../moe/gate/gshard_gate.py): NaiveGate + capacity +
+load-balance auxiliary loss, the GShard paper's gating."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.incubate.distributed.models.moe.gate.naive_gate import NaiveGate
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.group = group
+
+    def forward(self, x):
+        topk_val, topk_idx, gate_score = super().forward(x, return_all_scores=True)
+
+        s = x.shape[0]
+        top1_idx = topk_idx[:, 0] if hasattr(topk_idx, "__getitem__") else topk_idx
+
+        def aux(g, t1):
+            probs = jax.nn.softmax(g, -1)
+            c_e = jnp.zeros((self.tot_expert,), g.dtype).at[t1.astype(jnp.int32)].add(1.0) / s
+            m_e = probs.mean(0)
+            return jnp.sum(c_e * m_e) * self.tot_expert
+
+        self.set_loss(apply("gshard_aux", aux, gate_score, top1_idx))
+        return topk_val, topk_idx

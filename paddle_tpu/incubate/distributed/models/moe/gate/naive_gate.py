@@ -1,0 +1,28 @@
+"""NaiveGate (reference .../moe/gate/naive_gate.py): linear scorer + top-k."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.incubate.distributed.models.moe.gate.base_gate import BaseGate
+from paddle_tpu.nn.layer.common import Linear
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate_score = self.gate(inp)
+
+        def topk_fn(g):
+            val, idx = jax.lax.top_k(g, self.top_k)
+            return val, idx.astype(jnp.int64)
+
+        gate_top_k_val, gate_top_k_idx = apply("gate_topk", topk_fn, gate_score)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate_score
+        return gate_top_k_val, gate_top_k_idx

@@ -1,0 +1,42 @@
+"""SwitchGate (reference .../moe/gate/switch_gate.py): top-1 routing with
+Switch-Transformer load-balancing loss."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.incubate.distributed.models.moe.gate.naive_gate import NaiveGate
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.group = group
+
+    def forward(self, inp):
+        score = self.gate(inp)
+
+        def route(g, key_seed):
+            if self.training:
+                noise = jax.random.uniform(jax.random.key(key_seed), g.shape, g.dtype,
+                                           minval=-self.switch_eps, maxval=self.switch_eps)
+                g = g + noise
+            probs = jax.nn.softmax(g, -1)
+            top1_val, top1_idx = jax.lax.top_k(probs, 1)
+            # switch load-balance loss
+            c_e = jnp.zeros((self.tot_expert,), g.dtype).at[top1_idx[:, 0].astype(jnp.int32)].add(1.0) / g.shape[0]
+            m_e = probs.mean(0)
+            loss = jnp.sum(c_e * m_e) * self.tot_expert
+            return top1_val, top1_idx.astype(jnp.int64), loss
+
+        import numpy as np
+
+        seed = int(np.random.randint(0, 2**31 - 1))
+        val, idx, loss = apply("switch_route", lambda g: route(g, seed), score)
+        self.set_loss(loss)
+        return val, idx

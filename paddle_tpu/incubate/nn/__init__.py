@@ -1,0 +1,13 @@
+"""paddle.incubate.nn (reference python/paddle/incubate/nn/__init__.py)."""
+from paddle_tpu.incubate.nn import functional
+from paddle_tpu.incubate.nn.layer import (
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    'FusedMultiHeadAttention', 'FusedFeedForward', 'FusedTransformerEncoderLayer',
+    'FusedMultiTransformer', 'FusedLinear', 'FusedBiasDropoutResidualLayerNorm',
+    'FusedDropoutAdd',
+]

@@ -1,0 +1,464 @@
+"""paddle.incubate.nn.functional (reference python/paddle/incubate/nn/functional/).
+
+On TPU these "fused" ops are single jnp expressions handed to XLA whole — the
+fusion the reference does with hand-written CUDA kernels
+(paddle/phi/kernels/fusion/) falls out of the compiler here."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+
+
+def _ln_args(x, scale, bias):
+    """Collect the optional scale/bias tensors for a last-axis LN apply() call."""
+    args = [x]
+    if scale is not None:
+        args.append(_t(scale))
+    if bias is not None:
+        args.append(_t(bias))
+    return args
+
+
+def _ln_closure(has_scale, has_bias, eps):
+    """Last-axis layer-norm as one jnp closure (signature: (a, [scale], [bias]))."""
+
+    def ln(a, *wb):
+        mean = a.mean(-1, keepdims=True)
+        var = a.var(-1, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        i = 0
+        if has_scale:
+            out = out * wb[i]
+            i += 1
+        if has_bias:
+            out = out + wb[i]
+        return out
+
+    return ln
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    """reference incubate/nn/functional/fused_matmul_bias.py."""
+
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out + rest[0] if rest else out
+
+    args = [_t(x), _t(y)] + ([_t(bias)] if bias is not None else [])
+    return apply("fused_matmul_bias", f, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu", name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda v: v}[activation]
+    return apply("fused_act", act, out)
+
+
+def swiglu(x, y=None, name=None):
+    """reference incubate/nn/functional/swiglu.py: silu(x) * y (y = second half
+    of x when not given)."""
+
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply("swiglu", f, _t(x))
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y))
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0, name=None):
+    """reference incubate/nn/functional/fused_bias_act.py (quant paths omitted:
+    quantization on TPU flows through paddle.quantization fake-quant)."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "swiglu": None, "geglu": None}
+    if act_method in ("swiglu", "geglu"):
+        inner = jax.nn.silu if act_method == "swiglu" else jax.nn.gelu
+
+        def f(a, *rest):
+            if rest:
+                a = a + rest[0]
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return inner(a1) * a2
+    else:
+        act = acts[act_method]
+
+        def f(a, *rest):
+            if rest:
+                a = a + rest[0]
+            return act(a)
+
+    args = [_t(x)] + ([_t(bias)] if bias is not None else [])
+    return apply("fused_bias_act", f, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, residual_alpha=1.0,
+                     begin_norm_axis=1, bias=None, residual=None, quant_scale=-1,
+                     quant_round_type=0, quant_max_bound=0, quant_min_bound=0, name=None):
+    """reference incubate/nn/functional/fused_layer_norm.py: (x + bias +
+    residual*alpha) → layernorm; returns (out, residual_out) when residual given."""
+
+    def f(a, w, b, *rest):
+        res_out = a
+        i = 0
+        if bias is not None:
+            res_out = res_out + rest[i]
+            i += 1
+        if residual is not None:
+            res_out = res_out + residual_alpha * rest[i]
+            i += 1
+        axes = tuple(range(begin_norm_axis, a.ndim))
+        mean = res_out.mean(axes, keepdims=True)
+        var = res_out.var(axes, keepdims=True)
+        out = (res_out - mean) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return (out, res_out) if residual is not None else out
+
+    args = [_t(x), _t(norm_weight) if norm_weight is not None else None,
+            _t(norm_bias) if norm_bias is not None else None]
+    extra = []
+    if bias is not None:
+        extra.append(_t(bias))
+    if residual is not None:
+        extra.append(_t(residual))
+    return apply("fused_layer_norm", f, *(args + extra))
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                   bias=None, residual=None, quant_scale=-1, quant_round_type=0,
+                   quant_max_bound=0, quant_min_bound=0, name=None):
+    """reference incubate/nn/functional/fused_rms_norm.py."""
+
+    def f(a, w, *rest):
+        res_out = a
+        i = 0
+        if bias is not None:
+            res_out = res_out + rest[i]
+            i += 1
+        if residual is not None:
+            res_out = res_out + rest[i]
+            i += 1
+        axes = tuple(range(begin_norm_axis, a.ndim))
+        ms = jnp.mean(jnp.square(res_out), axes, keepdims=True)
+        out = res_out * jax.lax.rsqrt(ms + epsilon)
+        if w is not None:
+            out = out * w
+        return (out, res_out) if residual is not None else out
+
+    args = [_t(x), _t(norm_weight) if norm_weight is not None else None]
+    extra = []
+    if bias is not None:
+        extra.append(_t(bias))
+    if residual is not None:
+        extra.append(_t(residual))
+    out = apply("fused_rms_norm", f, *(args + extra))
+    if norm_bias is not None:
+        nb = _t(norm_bias)
+        if residual is not None:
+            return apply("add", jnp.add, out[0], nb), out[1]
+        return apply("add", jnp.add, out, nb)
+    return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """reference incubate/nn/functional/fused_dropout_add.py: dropout(x) + y."""
+    from paddle_tpu.nn.functional.common import dropout
+
+    return apply("add", jnp.add, dropout(_t(x), p=p, training=training, mode=mode), _t(y))
+
+
+def fused_bias_dropout_residual_layer_norm(
+    x, residual, bias=None, ln_scale=None, ln_bias=None, dropout_rate=0.5,
+    ln_epsilon=1e-5, training=True, mode='upscale_in_train', name=None,
+):
+    """reference incubate/nn/functional/fused_transformer.py:
+    layer_norm(residual + dropout(x + bias))."""
+    from paddle_tpu.nn.functional.common import dropout
+
+    h = _t(x)
+    if bias is not None:
+        h = apply("add", jnp.add, h, _t(bias))
+    h = dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = apply("add", jnp.add, h, _t(residual))
+
+    ln = _ln_closure(ln_scale is not None, ln_bias is not None, ln_epsilon)
+    return apply("bias_dropout_residual_ln", ln, *_ln_args(h, ln_scale, ln_bias))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0, name=None):
+    """reference incubate/nn/functional/fused_rotary_position_embedding.py.
+
+    q/k/v: (batch, seq, heads, head_dim).  Returns rotated (q, k, v) (None where
+    input None)."""
+
+    def rot(a, cos_t, sin_t):
+        if use_neox_rotary_style:
+            half = a.shape[-1] // 2
+            a1, a2 = a[..., :half], a[..., half:]
+            rotated = jnp.concatenate([-a2, a1], -1)
+            return a * cos_t + rotated * sin_t
+        a1 = a[..., 0::2]
+        a2 = a[..., 1::2]
+        rot_a = jnp.stack([-a2, a1], -1).reshape(a.shape)
+        return a * cos_t + rot_a * sin_t
+
+    def f(qa, *rest):
+        seq_axis = 0 if time_major else 1
+        seq_len = qa.shape[seq_axis]
+        dim = qa.shape[-1]
+        rest = list(rest)
+        i = 0
+        ka = rest[i] if k is not None else None
+        i += k is not None
+        va = rest[i] if v is not None else None
+        i += v is not None
+        if sin is not None:
+            sin_t, cos_t = rest[i], rest[i + 1]
+            i += 2
+        else:
+            inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+            t = jnp.arange(seq_len, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)
+            emb = jnp.concatenate([freqs, freqs], -1) if use_neox_rotary_style else jnp.repeat(freqs, 2, -1)
+            sin_t = jnp.sin(emb).astype(qa.dtype)
+            cos_t = jnp.cos(emb).astype(qa.dtype)
+        if position_ids is not None:
+            pid = rest[-1].astype(jnp.int32)
+            sin_t = jnp.squeeze(sin_t)[pid]  # (b, s, d)
+            cos_t = jnp.squeeze(cos_t)[pid]
+            if time_major:  # layout (s, b, h, d)
+                sin_t = jnp.swapaxes(sin_t, 0, 1)[:, :, None, :]
+                cos_t = jnp.swapaxes(cos_t, 0, 1)[:, :, None, :]
+            else:
+                sin_t = sin_t[:, :, None, :]
+                cos_t = cos_t[:, :, None, :]
+        else:
+            sin_t = jnp.squeeze(sin_t).reshape(1, seq_len, 1, dim) if not time_major else jnp.squeeze(sin_t).reshape(seq_len, 1, 1, dim)
+            cos_t = jnp.squeeze(cos_t).reshape(1, seq_len, 1, dim) if not time_major else jnp.squeeze(cos_t).reshape(seq_len, 1, 1, dim)
+        outs = [rot(qa, cos_t, sin_t)]
+        if ka is not None:
+            outs.append(rot(ka, cos_t, sin_t))
+        if va is not None:
+            outs.append(rot(va, cos_t, sin_t))
+        return tuple(outs)
+
+    args = [_t(q)]
+    if k is not None:
+        args.append(_t(k))
+    if v is not None:
+        args.append(_t(v))
+    if sin is not None:
+        args += [_t(sin), _t(cos)]
+    if position_ids is not None:
+        args.append(_t(position_ids))
+    outs = apply("fused_rope", f, *args)
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    res = [outs.pop(0)]
+    res.append(outs.pop(0) if k is not None else None)
+    res.append(outs.pop(0) if v is not None else None)
+    return tuple(res)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode='upscale_in_train', ring_id=-1,
+                               add_residual=True, num_heads=-1, transpose_qkv_wb=False,
+                               name=None):
+    """reference incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention: full pre/post-LN MHA block in one op."""
+    from paddle_tpu.nn.functional.common import dropout
+    from paddle_tpu.tensor.random import default_generator
+
+    attn_key = default_generator.next_key()
+
+    def f(xa, qkvw, lw, *rest):
+        names = []
+        if qkv_bias is not None:
+            names.append("qkvb")
+        if linear_bias is not None:
+            names.append("lb")
+        if pre_ln_scale is not None:
+            names.append("pls")
+        if pre_ln_bias is not None:
+            names.append("plb")
+        if ln_scale is not None:
+            names.append("lns")
+        if ln_bias is not None:
+            names.append("lnb")
+        if attn_mask is not None:
+            names.append("mask")
+        r = dict(zip(names, rest))
+        b, s, d = xa.shape
+        h = xa
+        if pre_layer_norm:
+            mean = h.mean(-1, keepdims=True)
+            var = h.var(-1, keepdims=True)
+            h = (h - mean) / jnp.sqrt(var + pre_ln_epsilon)
+            if "pls" in r:
+                h = h * r["pls"]
+            if "plb" in r:
+                h = h + r["plb"]
+        if transpose_qkv_wb:
+            nh = num_heads
+            qkv = h @ qkvw  # (b, s, 3d)
+            if "qkvb" in r:
+                qkv = qkv + r["qkvb"]
+            qkv = qkv.reshape(b, s, 3, nh, d // nh)
+        else:
+            nh = qkvw.shape[1]
+            hd = qkvw.shape[2]
+            qkv = jnp.einsum("bsd,thkd->bsthk", h, qkvw)  # (b,s,3,nh,hd)
+            if "qkvb" in r:
+                qkv = qkv + r["qkvb"].reshape(1, 1, 3, nh, hd)
+        q, kk, vv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scores = jnp.einsum("bshd,bthd->bhst", q, kk) / jnp.sqrt(q.shape[-1])
+        if "mask" in r:
+            scores = scores + r["mask"]
+        att = jax.nn.softmax(scores, -1)
+        if training and attn_dropout_rate > 0.0:
+            keep = jax.random.bernoulli(attn_key, 1.0 - attn_dropout_rate, att.shape)
+            att = jnp.where(keep, att / (1.0 - attn_dropout_rate), 0.0)
+        ctx = jnp.einsum("bhst,bthd->bshd", att, vv).reshape(b, s, -1)
+        out = ctx @ (lw.reshape(-1, lw.shape[-1]) if lw.ndim > 2 else lw)
+        if "lb" in r:
+            out = out + r["lb"]
+        return out, xa
+
+    args = [_t(x), _t(qkv_weight), _t(linear_weight)]
+    for t in (qkv_bias, linear_bias, pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, attn_mask):
+        if t is not None:
+            args.append(_t(t))
+    out, residual = apply("fused_mha", f, *args)
+    out = dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = apply("add", jnp.add, out, residual)
+    if not pre_layer_norm:
+        ln = _ln_closure(ln_scale is not None, ln_bias is not None, ln_epsilon)
+        out = apply("post_ln", ln, *_ln_args(out, ln_scale, ln_bias))
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, ring_id=-1,
+                      mode='upscale_in_train', name=None):
+    """reference fused_feedforward: LN → linear1 → act → dropout → linear2 →
+    dropout → residual (+post-LN)."""
+    from paddle_tpu.nn.functional.common import dropout
+
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    residual = _t(x)
+    h = residual
+    if pre_layer_norm:
+        ln1 = _ln_closure(ln1_scale is not None, ln1_bias is not None, ln1_epsilon)
+        h = apply("ffn_pre_ln", ln1, *_ln_args(h, ln1_scale, ln1_bias))
+
+    def lin1(a, w, *bias):
+        o = a @ w
+        if bias:
+            o = o + bias[0]
+        return act(o)
+
+    h = apply("ffn_lin1", lin1, h, _t(linear1_weight), *([_t(linear1_bias)] if linear1_bias is not None else []))
+    h = dropout(h, p=dropout1_rate, training=training, mode=mode)
+
+    def lin2(a, w, *bias):
+        o = a @ w
+        if bias:
+            o = o + bias[0]
+        return o
+
+    h = apply("ffn_lin2", lin2, h, _t(linear2_weight), *([_t(linear2_bias)] if linear2_bias is not None else []))
+    h = dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = apply("add", jnp.add, h, residual)
+    if not pre_layer_norm:
+        ln2 = _ln_closure(ln2_scale is not None, ln2_bias is not None, ln2_epsilon)
+        out = apply("ffn_post_ln", ln2, *_ln_args(out, ln2_scale, ln2_bias))
+    return out
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2, norm_topk_prob=True, name=None):
+    """reference incubate/nn/functional/fused_moe.py: token → top-k experts →
+    weighted combine, dense einsum formulation (MXU-friendly; EP sharding via
+    paddle.incubate.distributed.models.moe.MoELayer)."""
+
+    def f(xa, gw, w1, w2, *rest):
+        b, s, d = xa.shape
+        tokens = xa.reshape(-1, d)
+        logits = tokens @ gw
+        probs = jax.nn.softmax(logits, -1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / topv.sum(-1, keepdims=True)
+        i = 0
+        b1 = rest[i] if ffn1_bias is not None else None
+        i += ffn1_bias is not None
+        b2 = rest[i] if ffn2_bias is not None else None
+        # dense dispatch: compute all experts (E small) — one big batched matmul
+        h = jnp.einsum("td,edf->tef", tokens, w1)
+        if b1 is not None:
+            h = h + b1[None]
+        h = jax.nn.gelu(h)
+        o = jnp.einsum("tef,efd->ted", h, w2)
+        if b2 is not None:
+            o = o + b2[None]
+        weight = jnp.zeros((tokens.shape[0], w1.shape[0]), xa.dtype)
+        weight = weight.at[jnp.arange(tokens.shape[0])[:, None], topi].set(topv)
+        out = jnp.einsum("ted,te->td", o, weight)
+        return out.reshape(b, s, d)
+
+    args = [_t(x), _t(gate_weight), _t(ffn1_weight), _t(ffn2_weight)]
+    if ffn1_bias is not None:
+        args.append(_t(ffn1_bias))
+    if ffn2_bias is not None:
+        args.append(_t(ffn2_bias))
+    return apply("fused_moe", f, *args)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None, **kw):
+    raise NotImplementedError(
+        "masked_multihead_attention is a GPU decoding kernel; use "
+        "paddle.nn.functional.scaled_dot_product_attention with cache on TPU."
+    )
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, name=None):
+    """reference incubate/nn/memory_efficient_attention.py — on TPU the
+    flash-attention pallas kernel IS the memory-efficient path."""
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+
+    mask = attn_bias if not hasattr(attn_bias, "materialize") else attn_bias.materialize()
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        dropout_p=p, training=training, scale=scale)

@@ -1,0 +1,5 @@
+"""paddle.incubate.optimizer (reference python/paddle/incubate/optimizer/)."""
+from paddle_tpu.incubate.optimizer.lookahead import LookAhead
+from paddle_tpu.incubate.optimizer.modelaverage import ModelAverage
+
+__all__ = ['LookAhead', 'ModelAverage']

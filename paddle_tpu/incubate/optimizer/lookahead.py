@@ -1,0 +1,58 @@
+"""LookAhead optimizer (reference python/paddle/incubate/optimizer/lookahead.py):
+slow weights updated every k steps toward the fast (inner) weights."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1 and isinstance(k, int)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._global_step = 0
+        self._slow_params = None
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def state_dict(self):
+        sd = dict(self.inner_optimizer.state_dict())
+        sd["@LOOKAHEAD_STEP"] = self._global_step
+        if self._slow_params is not None:
+            sd["@LOOKAHEAD_SLOW"] = [jnp.array(s) for s in self._slow_params]
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._global_step = sd.pop("@LOOKAHEAD_STEP", 0)
+        slow = sd.pop("@LOOKAHEAD_SLOW", None)
+        if slow is not None:
+            self._slow_params = [jnp.asarray(s) for s in slow]
+        self.inner_optimizer.set_state_dict(sd)
+
+    def step(self):
+        self.inner_optimizer.step()
+        params = self.inner_optimizer._parameter_list
+        if self._slow_params is None:
+            self._slow_params = [jnp.array(p.data) for p in params]
+        self._global_step += 1
+        if self._global_step % self.k == 0:
+            for p, slow in zip(params, self._slow_params):
+                new_slow = slow + self.alpha * (p.data - slow)
+                p._data = new_slow
+            self._slow_params = [jnp.array(p.data) for p in params]
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

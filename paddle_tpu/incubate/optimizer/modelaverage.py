@@ -1,0 +1,56 @@
+"""ModelAverage (reference python/paddle/incubate/optimizer/modelaverage.py):
+maintains running averages of parameters; apply()/restore() swap them in/out."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p.data) for p in self._params]
+        self._num_accum = 0
+        self._backup = None
+
+    def step(self):
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p.data
+        self._num_accum += 1
+        window = max(self.min_window, min(self.max_window, int(self._num_accum * self.avg_rate) + 1))
+        if self._num_accum > window:
+            # restart accumulation from the current average so apply() stays valid
+            avg = [s / self._num_accum for s in self._sum]
+            self._sum = avg
+            self._num_accum = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in (context-manager style like the reference)."""
+
+        @contextmanager
+        def ctx():
+            self._backup = [jnp.array(p.data) for p in self._params]
+            n = max(self._num_accum, 1)
+            for p, s in zip(self._params, self._sum):
+                p._data = (s / n).astype(p.data.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()

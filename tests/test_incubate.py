@@ -1,0 +1,247 @@
+"""paddle.incubate parity tests (reference test/autograd/, test/legacy_test/
+test_fused_*, test/asp/, test/collective/test_moe_api)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.autograd import Hessian, Jacobian, jvp, vjp
+import paddle_tpu.incubate.nn.functional as IF
+
+
+class TestFunctionalAutograd:
+    def test_vjp_matches_backward(self):
+        x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+        w = np.random.rand(4, 2).astype("float32")
+        func = lambda t: paddle.matmul(t, paddle.to_tensor(w))
+        out, g = vjp(func, x)
+        assert list(out.shape) == [3, 2]
+        np.testing.assert_allclose(g.numpy(), np.ones((3, 2)) @ w.T, rtol=1e-5)
+
+    def test_jvp(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        out, jv = jvp(lambda t: t * t, x)
+        np.testing.assert_allclose(jv.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        J = Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]), rtol=1e-6)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        H = Hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+class TestFusedFunctional:
+    def test_fused_linear_matches_linear(self):
+        x = np.random.rand(4, 8).astype("float32")
+        w = np.random.rand(8, 6).astype("float32")
+        b = np.random.rand(6).astype("float32")
+        out = IF.fused_linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_swiglu(self):
+        x = np.random.rand(4, 8).astype("float32")
+        out = IF.swiglu(paddle.to_tensor(x)).numpy()
+        a, b = np.split(x, 2, -1)
+        silu = a / (1 + np.exp(-a)) * a if False else a * (1 / (1 + np.exp(-a)))
+        np.testing.assert_allclose(out, silu * b, rtol=1e-5)
+
+    def test_fused_rms_norm(self):
+        x = np.random.rand(2, 4, 8).astype("float32")
+        w = np.random.rand(8).astype("float32")
+        out = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w), None, 1e-6, 2)
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_fused_rms_norm_residual(self):
+        x = np.random.rand(2, 4, 8).astype("float32")
+        res = np.random.rand(2, 4, 8).astype("float32")
+        w = np.ones(8, "float32")
+        out, res_out = IF.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w), None, 1e-6, 2,
+            residual=paddle.to_tensor(res),
+        )
+        np.testing.assert_allclose(res_out.numpy(), x + res, rtol=1e-5)
+
+    def test_fused_layer_norm(self):
+        x = np.random.rand(3, 8).astype("float32")
+        w, b = np.random.rand(8).astype("float32"), np.random.rand(8).astype("float32")
+        out = IF.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b), 1e-5)
+        mean, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), (x - mean) / np.sqrt(var + 1e-5) * w + b, rtol=1e-4)
+
+    def test_fused_rope_matches_manual(self):
+        q = np.random.rand(2, 6, 4, 8).astype("float32")
+        oq, ok, _ = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(q)
+        )
+        np.testing.assert_allclose(oq.numpy(), ok.numpy(), rtol=1e-6)
+        # position 0 is identity rotation
+        np.testing.assert_allclose(oq.numpy()[:, 0], q[:, 0], rtol=1e-5)
+        # norms preserved per (pair) rotation
+        np.testing.assert_allclose(
+            np.linalg.norm(oq.numpy(), axis=-1), np.linalg.norm(q, axis=-1), rtol=1e-4
+        )
+
+    def test_fused_dropout_add_eval(self):
+        x = np.random.rand(4, 4).astype("float32")
+        y = np.random.rand(4, 4).astype("float32")
+        out = IF.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y), p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+
+    def test_fused_moe_functional(self):
+        x = np.random.rand(2, 4, 8).astype("float32")
+        gw = np.random.rand(8, 4).astype("float32")
+        w1 = np.random.rand(4, 8, 16).astype("float32")
+        w2 = np.random.rand(4, 16, 8).astype("float32")
+        out = IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                           paddle.to_tensor(w1), paddle.to_tensor(w2), moe_topk=2)
+        assert list(out.shape) == [2, 4, 8]
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestFusedLayers:
+    def test_fused_mha_shape_and_grad(self):
+        layer = incubate.nn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.rand(2, 5, 16).astype("float32"))
+        out = layer(x)
+        assert list(out.shape) == [2, 5, 16]
+        out.sum().backward()
+        assert layer.qkv_weight.grad is not None
+
+    def test_fused_encoder_matches_composition(self):
+        enc = incubate.nn.FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.rand(2, 3, 8).astype("float32"))
+        out = enc(x)
+        assert list(out.shape) == [2, 3, 8] and np.isfinite(out.numpy()).all()
+
+    def test_fused_multi_transformer(self):
+        mt = incubate.nn.FusedMultiTransformer(8, 2, 16, num_layers=2, dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.rand(2, 3, 8).astype("float32"))
+        assert list(mt(x).shape) == [2, 3, 8]
+
+    def test_fused_bias_dropout_residual_ln(self):
+        layer = incubate.nn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.rand(2, 3, 8).astype("float32"))
+        res = paddle.to_tensor(np.random.rand(2, 3, 8).astype("float32"))
+        out = layer(x, res)
+        np.testing.assert_allclose(out.numpy().mean(-1), np.zeros((2, 3)), atol=1e-5)
+
+
+class TestMoELayer:
+    def _expert(self):
+        class Expert(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 8)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.gelu(self.fc1(x)))
+
+        return Expert()
+
+    def test_gshard_moe_trains(self):
+        moe = incubate.distributed.models.moe.MoELayer(
+            8, [self._expert() for _ in range(4)], gate={"type": "gshard", "top_k": 2}
+        )
+        x = paddle.to_tensor(np.random.rand(2, 6, 8).astype("float32"))
+        out = moe(x)
+        assert list(out.shape) == [2, 6, 8]
+        aux = moe.gate.get_loss()
+        assert aux is not None and float(aux.numpy()) > 0
+        out.sum().backward()
+        assert moe.experts[0].fc1.weight.grad is not None
+        assert moe.gate.gate.weight.grad is not None
+
+    def test_switch_and_naive_gates(self):
+        for gate in ({"type": "switch"}, {"type": "naive", "top_k": 2}):
+            moe = incubate.distributed.models.moe.MoELayer(8, [self._expert() for _ in range(2)], gate=gate)
+            out = moe(paddle.to_tensor(np.random.rand(1, 4, 8).astype("float32")))
+            assert np.isfinite(out.numpy()).all()
+
+    def test_global_scatter_gather(self):
+        toks = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+        lc = paddle.to_tensor(np.array([2, 1, 3]))
+        gc = paddle.to_tensor(np.array([2, 1, 3]))
+        gs = paddle.distributed.utils.global_scatter(toks, lc, gc)
+        gg = paddle.distributed.utils.global_gather(gs, lc, gc)
+        np.testing.assert_allclose(gg.numpy(), toks.numpy())
+
+
+class TestASP:
+    def test_prune_and_masked_training(self):
+        model = nn.Linear(16, 8)
+        incubate.asp.prune_model(model)
+        from paddle_tpu.incubate.asp.utils import CheckMethod, check_sparsity
+
+        assert incubate.asp.calculate_density(model.weight.numpy()) == pytest.approx(0.5)
+        assert check_sparsity(model.weight.numpy(), CheckMethod.CHECK_1D, 2, 4)
+        opt = incubate.asp.decorate(paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters()))
+        for _ in range(3):
+            y = model(paddle.to_tensor(np.random.rand(4, 16).astype("float32")))
+            y.sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert incubate.asp.calculate_density(model.weight.numpy()) == pytest.approx(0.5)
+
+    def test_mask_2d(self):
+        from paddle_tpu.incubate.asp.utils import check_mask_2d, get_mask_2d_greedy
+
+        w = np.random.rand(8, 8)
+        mask = get_mask_2d_greedy(w, 2, 4)
+        assert check_mask_2d(w * mask, 2, 4)
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_converges(self):
+        model = nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        la = incubate.LookAhead(inner, alpha=0.5, k=3)
+        x = np.random.rand(32, 4).astype("float32")
+        w_true = np.array([[1.0], [2.0], [-1.0], [0.5]], "float32")
+        y = x @ w_true
+        for _ in range(300):
+            loss = ((model(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert float(loss.numpy()) < 1e-2
+
+    def test_model_average_apply_restore(self):
+        model = nn.Linear(4, 2)
+        ma = incubate.ModelAverage(0.5, parameters=model.parameters())
+        orig = model.weight.numpy().copy()
+        ma.step()
+        with ma.apply():
+            inside = model.weight.numpy().copy()
+        np.testing.assert_allclose(model.weight.numpy(), orig)
+        np.testing.assert_allclose(inside, orig, rtol=1e-6)
+
+
+class TestIncubateMisc:
+    def test_softmax_mask_fuse(self):
+        x = np.random.rand(2, 2, 4, 4).astype("float32")
+        mask = np.zeros_like(x)
+        mask[..., 2:] = -1e9
+        out = incubate.softmax_mask_fuse(paddle.to_tensor(x), paddle.to_tensor(mask)).numpy()
+        assert (out[..., 2:] < 1e-6).all()
+        np.testing.assert_allclose(out.sum(-1), np.ones((2, 2, 4)), rtol=1e-5)
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        x = np.random.rand(1, 1, 4, 4).astype("float32")
+        out = incubate.softmax_mask_fuse_upper_triangle(paddle.to_tensor(x)).numpy()
+        assert out[0, 0, 0, 1] == 0  # strictly causal row 0
+        np.testing.assert_allclose(out.sum(-1), np.ones((1, 1, 4)), rtol=1e-5)
+
+    def test_graph_aliases(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([1, 2]))
+        out = incubate.graph_send_recv(x, src, dst, "sum")
+        assert list(out.shape) == [4, 2]
+        assert incubate.segment_sum is paddle.geometric.segment_sum
