@@ -45,6 +45,13 @@ from paddle_tpu import distribution  # noqa: F401,E402
 from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402
+from paddle_tpu import profiler  # noqa: F401,E402
+from paddle_tpu import quantization  # noqa: F401,E402
+from paddle_tpu import regularizer  # noqa: F401,E402
+from paddle_tpu import decomposition  # noqa: F401,E402
+from paddle_tpu import audio  # noqa: F401,E402
+from paddle_tpu import text  # noqa: F401,E402
+from paddle_tpu import inference  # noqa: F401,E402
 from paddle_tpu.tensor.random import (  # noqa: F401,E402
     bernoulli, binomial, gaussian, get_rng_state, multinomial, normal, poisson,
     rand, randint, randint_like, randn, randperm, seed, set_rng_state,

@@ -1,0 +1,5 @@
+"""paddle.audio (reference python/paddle/audio/__init__.py)."""
+from paddle_tpu.audio import backends, datasets, features, functional
+from paddle_tpu.audio.backends import info, load, save
+
+__all__ = ["functional", "features", "datasets", "backends", "load", "info", "save"]

@@ -1,0 +1,20 @@
+"""paddle.audio.backends (reference python/paddle/audio/backends/): wave-file
+IO via the stdlib wave module (the in-tree 'wave_backend')."""
+from paddle_tpu.audio.backends.wave_backend import AudioInfo, info, load, save
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError("only wave_backend is available")
+
+
+__all__ = ['info', 'load', 'save', 'list_available_backends',
+           'get_current_backend', 'set_backend']

@@ -1,0 +1,61 @@
+"""WAV IO (reference python/paddle/audio/backends/wave_backend.py)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with wave.open(filepath, 'rb') as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True, channels_first=True):
+    with wave.open(filepath, 'rb') as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames == -1 else num_frames
+        raw = w.readframes(n)
+    # 8-bit PCM WAV is unsigned with a 128 offset; 16/32-bit are signed
+    if width == 1:
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(-1, nch).astype(np.int16) - 128
+    else:
+        dtype = {2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16", bits_per_sample=16):
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        arr = arr.T
+    width = bits_per_sample // 8
+    if arr.dtype.kind == 'f':
+        arr = np.clip(arr, -1, 1) * (2 ** (bits_per_sample - 1) - 1)
+        if width == 1:  # 8-bit PCM stores unsigned with +128 offset
+            arr = (arr + 128).astype(np.uint8)
+        else:
+            arr = arr.astype({2: np.int16, 4: np.int32}[width])
+    with wave.open(filepath, 'wb') as w:
+        w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr.tobytes())

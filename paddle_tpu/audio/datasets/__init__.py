@@ -1,0 +1,24 @@
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/): TESS / ESC50
+require downloads — constructors raise with instructions (zero-egress build)."""
+from paddle_tpu.io import Dataset
+
+
+class _DownloadDataset(Dataset):
+    name = "dataset"
+
+    def __init__(self, *a, **kw):
+        raise RuntimeError(
+            f"{self.name} requires downloading; place the files locally and use "
+            "paddle.audio.load + a custom paddle.io.Dataset."
+        )
+
+
+class TESS(_DownloadDataset):
+    name = "TESS"
+
+
+class ESC50(_DownloadDataset):
+    name = "ESC50"
+
+
+__all__ = ['TESS', 'ESC50']

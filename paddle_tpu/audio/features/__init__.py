@@ -1,0 +1,4 @@
+"""paddle.audio.features (reference python/paddle/audio/features/layers.py)."""
+from paddle_tpu.audio.features.layers import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+__all__ = ['LogMelSpectrogram', 'MelSpectrogram', 'MFCC', 'Spectrogram']

@@ -1,0 +1,84 @@
+"""Audio feature layers (reference python/paddle/audio/features/layers.py) on
+paddle.signal.stft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.audio.functional import (
+    compute_fbank_matrix, create_dct, get_window, power_to_db,
+)
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None, window='hann',
+                 power=2.0, center=True, pad_mode='reflect', dtype='float32'):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, self.win_length, fftbins=True, dtype=dtype)
+
+    def forward(self, x):
+        from paddle_tpu.signal import stft
+
+        spec = stft(x, n_fft=self.n_fft, hop_length=self.hop_length,
+                    win_length=self.win_length, window=self.fft_window,
+                    center=self.center, pad_mode=self.pad_mode)
+        return apply("spec_power", lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window='hann', power=2.0, center=True, pad_mode='reflect',
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm='slaney',
+                 dtype='float32'):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min,
+            f_max=f_max if f_max is not None else sr / 2, htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        return apply("mel", lambda fb, s: jnp.matmul(fb, s), self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window='hann', power=2.0, center=True, pad_mode='reflect',
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm='slaney',
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype='float32'):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                              window, power, center, pad_mode,
+                                              n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window='hann', power=2.0, center=True,
+                 pad_mode='reflect', n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm='slaney', ref_value=1.0, amin=1e-10, top_db=None, dtype='float32'):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+        return apply("mfcc", lambda d, s: jnp.einsum("mk,...mt->...kt", d, s),
+                     self.dct_matrix, logmel)

@@ -1,0 +1,10 @@
+"""paddle.audio.functional (reference python/paddle/audio/functional/functional.py
++ window.py)."""
+from paddle_tpu.audio.functional.functional import (
+    compute_fbank_matrix, create_dct, fft_frequencies, hz_to_mel, mel_frequencies,
+    mel_to_hz, power_to_db,
+)
+from paddle_tpu.audio.functional.window import get_window
+
+__all__ = ['compute_fbank_matrix', 'create_dct', 'fft_frequencies', 'hz_to_mel',
+           'mel_frequencies', 'mel_to_hz', 'power_to_db', 'get_window']

@@ -1,0 +1,100 @@
+"""Mel/DCT audio math (reference python/paddle/audio/functional/functional.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, Tensor)
+    f = freq.data if isinstance(freq, Tensor) else jnp.asarray(float(freq))
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = jnp.where(f >= min_log_hz,
+                         min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep, mels)
+        out = mels
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = mel.data if isinstance(mel, Tensor) else jnp.asarray(float(mel))
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = jnp.where(m >= min_log_mel,
+                          min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+        out = freqs
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype='float32'):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels, dtype=dtype)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr, n_fft, dtype='float32'):
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2, dtype=dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm='slaney', dtype='float32'):
+    """Triangular mel filterbank (reference functional.py compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft, dtype).data
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype).data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == 'slaney':
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply("power_to_db", f, _t(spect))
+
+
+def create_dct(n_mfcc, n_mels, norm='ortho', dtype='float32'):
+    """DCT-II matrix (reference functional.py create_dct)."""
+    n = jnp.arange(n_mels, dtype=dtype)
+    k = jnp.arange(n_mfcc, dtype=dtype)[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == 'ortho':
+        dct = dct.at[0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(2.0 / n_mels)
+    else:
+        dct = dct * 2
+    return Tensor(dct.T.astype(dtype))

@@ -1,0 +1,41 @@
+"""Window functions (reference python/paddle/audio/functional/window.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def get_window(window, win_length, fftbins=True, dtype='float32'):
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length
+    sym = not fftbins
+    m = n if sym else n + 1
+    x = jnp.arange(m, dtype=dtype)
+    if name in ('hann', 'hanning'):
+        w = 0.5 - 0.5 * jnp.cos(2 * jnp.pi * x / (m - 1))
+    elif name == 'hamming':
+        w = 0.54 - 0.46 * jnp.cos(2 * jnp.pi * x / (m - 1))
+    elif name == 'blackman':
+        w = (0.42 - 0.5 * jnp.cos(2 * jnp.pi * x / (m - 1))
+             + 0.08 * jnp.cos(4 * jnp.pi * x / (m - 1)))
+    elif name == 'bartlett':
+        w = 1 - jnp.abs(2 * x / (m - 1) - 1)
+    elif name == 'rect' or name == 'boxcar':
+        w = jnp.ones(m, dtype=dtype)
+    elif name == 'gaussian':
+        std = args[0] if args else 7
+        w = jnp.exp(-0.5 * ((x - (m - 1) / 2) / std) ** 2)
+    elif name == 'taylor':
+        import scipy.signal.windows as sw
+        import numpy as np
+
+        w = jnp.asarray(sw.taylor(m, sym=True).astype(dtype))
+    else:
+        raise ValueError(f"unsupported window: {name}")
+    if not sym:
+        w = w[:-1]
+    return Tensor(w.astype(dtype))

@@ -1,0 +1,9 @@
+"""paddle.decomposition (reference python/paddle/decomposition/): registry of
+composite-op → primitive decompositions (§2.9).
+
+On TPU the compiler (XLA) already receives primitives, so rules here serve
+introspection/custom-lowering parity; `decompose` applies a rule eagerly."""
+from paddle_tpu.decomposition.register import register_decomp, get_decomp_rule, has_decomp
+from paddle_tpu.decomposition.decomp import decompose
+
+__all__ = ['register_decomp', 'get_decomp_rule', 'has_decomp', 'decompose']

@@ -1,0 +1,82 @@
+"""Built-in decomposition rules (reference python/paddle/decomposition/rules.py):
+big ops expressed in primitives.  Used by tests and custom compiler passes."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.decomposition.register import get_decomp_rule, register_decomp
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def decompose(op_name, *args, **kwargs):
+    rule = get_decomp_rule(op_name)
+    if rule is None:
+        raise NotImplementedError(f"no decomposition registered for {op_name}")
+    return rule(*args, **kwargs)
+
+
+@register_decomp("softmax")
+def _softmax(x, axis=-1):
+    def f(a):
+        m = jnp.max(a, axis, keepdims=True)
+        e = jnp.exp(a - m)
+        return e / jnp.sum(e, axis, keepdims=True)
+
+    return apply("decomp_softmax", f, x)
+
+
+@register_decomp("log_softmax")
+def _log_softmax(x, axis=-1):
+    def f(a):
+        m = jnp.max(a, axis, keepdims=True)
+        s = a - m
+        return s - jnp.log(jnp.sum(jnp.exp(s), axis, keepdims=True))
+
+    return apply("decomp_log_softmax", f, x)
+
+
+@register_decomp("layer_norm")
+def _layer_norm(x, weight=None, bias=None, epsilon=1e-5):
+    def f(a, *wb):
+        mean = a.mean(-1, keepdims=True)
+        var = ((a - mean) ** 2).mean(-1, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("decomp_layer_norm", f, *args)
+
+
+@register_decomp("dropout")
+def _dropout(x, p=0.5, training=True):
+    from paddle_tpu.nn.functional.common import dropout
+
+    return dropout(x, p=p, training=training)
+
+
+@register_decomp("gelu")
+def _gelu(x, approximate=False):
+    def f(a):
+        if approximate:
+            return 0.5 * a * (1 + jnp.tanh(jnp.sqrt(2 / jnp.pi) * (a + 0.044715 * a ** 3)))
+        return 0.5 * a * (1 + jax.lax.erf(a / jnp.sqrt(2.0)))
+
+    return apply("decomp_gelu", f, x)
+
+
+@register_decomp("mean")
+def _mean(x, axis=None, keepdim=False):
+    def f(a):
+        total = jnp.sum(a, axis, keepdims=keepdim)
+        cnt = a.size if axis is None else a.shape[axis]
+        return total / cnt
+
+    return apply("decomp_mean", f, x)

@@ -1,0 +1,18 @@
+"""Decomp rule registry (reference python/paddle/decomposition/register.py)."""
+_RULES = {}
+
+
+def register_decomp(op_name):
+    def wrapper(fn):
+        _RULES[op_name] = fn
+        return fn
+
+    return wrapper
+
+
+def get_decomp_rule(op_name):
+    return _RULES.get(op_name)
+
+
+def has_decomp(op_name):
+    return op_name in _RULES

@@ -1,0 +1,111 @@
+"""Distributed pass framework (reference python/paddle/distributed/passes/
+pass_base.py): named program-transform passes with a registry.
+
+On TPU the heavy passes (amp/sharding/recompute) are jit-time transforms; the
+framework keeps the registry/apply contract so strategy code stays portable."""
+from __future__ import annotations
+
+_PASSES = {}
+
+
+def register_pass(name):
+    def wrapper(cls):
+        cls.name = name
+        _PASSES[name] = cls
+        return cls
+
+    return wrapper
+
+
+class PassContext:
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+
+class PassBase:
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+        return self
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        context = context or PassContext()
+        self._apply_impl(main_programs, startup_programs, context)
+        return context
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        raise NotImplementedError
+
+
+def new_pass(name, pass_attrs=None):
+    cls = _PASSES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown pass {name!r}; registered: {sorted(_PASSES)}")
+    p = cls()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    def __init__(self, passes):
+        self._passes = list(passes)
+        self._context = PassContext()
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self._context)
+        return self._context
+
+    @property
+    def context(self):
+        return self._context
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Marks the program for bf16 autocast (applied at jit time by paddle.amp)."""
+
+    def _apply_impl(self, mains, startups, ctx):
+        ctx.set_attr("amp", dict(self._attrs) or {"dtype": "bfloat16"})
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Marks segments for jax.checkpoint rematerialization."""
+
+    def _apply_impl(self, mains, startups, ctx):
+        ctx.set_attr("recompute", dict(self._attrs) or {"enable": True})
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """Records ZeRO stage + degree; realized by fleet sharding wrappers."""
+
+    def _apply_impl(self, mains, startups, ctx):
+        ctx.set_attr("sharding", dict(self._attrs) or {"stage": 1})
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    def _apply_impl(self, mains, startups, ctx):
+        ctx.set_attr("gradient_merge", dict(self._attrs) or {"k_steps": 1})

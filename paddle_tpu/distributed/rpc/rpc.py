@@ -1,0 +1,96 @@
+"""paddle.distributed.rpc (reference python/paddle/distributed/rpc/rpc.py over
+the C++ brpc agent paddle/fluid/distributed/rpc/).
+
+TPU-native runtime is single-controller, so cross-worker RPC degenerates to
+local execution in 1-process mode; multi-process mode serves requests over a
+TCP socket server thread (the brpc analog, stdlib-only)."""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_STATE = {"workers": {}, "current": None, "server": None, "pool": None}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        data = pickle.load(self.rfile)
+        fn, args, kwargs = data
+        try:
+            res = ("ok", fn(*args, **kwargs))
+        except Exception as e:  # pragma: no cover
+            res = ("err", e)
+        pickle.dump(res, self.wfile)
+        self.wfile.flush()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    import os
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    # serve on an ephemeral port
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    info = WorkerInfo(name, rank, "127.0.0.1", srv.server_address[1])
+    _STATE["workers"][name] = info
+    _STATE["current"] = info
+    _STATE["server"] = srv
+    _STATE["pool"] = ThreadPoolExecutor(max_workers=8)
+    return info
+
+
+def _call(to, fn, args, kwargs):
+    info = _STATE["workers"].get(to)
+    if info is None:
+        raise RuntimeError(f"unknown rpc worker {to}")
+    with socket.create_connection((info.ip, info.port)) as s:
+        f = s.makefile("rwb")
+        pickle.dump((fn, args or (), kwargs or {}), f)
+        f.flush()
+        status, res = pickle.load(f)
+    if status == "err":
+        raise res
+    return res
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    return _call(to, fn, args, kwargs)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
+    pool = _STATE["pool"]
+    if pool is None:
+        raise RuntimeError("call init_rpc first")
+    return pool.submit(_call, to, fn, args, kwargs)
+
+
+def shutdown():
+    if _STATE["server"] is not None:
+        _STATE["server"].shutdown()
+        _STATE["server"] = None
+    if _STATE["pool"] is not None:
+        _STATE["pool"].shutdown()
+        _STATE["pool"] = None
+    _STATE["workers"].clear()
+    _STATE["current"] = None
+
+
+def get_worker_info(name):
+    return _STATE["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_STATE["workers"].values())
+
+
+def get_current_worker_info():
+    return _STATE["current"]

@@ -1,0 +1,26 @@
+"""paddle.inference (reference python/paddle/inference/__init__.py over
+paddle/fluid/inference/api/analysis_predictor.h:105).
+
+TPU-native deployment: the saved model is a serialized jax.export artifact
+(paddle.jit.save writes model.jaxexport next to the weights); the Predictor
+deserializes and executes it — the analysis-pass pipeline of the reference is
+XLA's own optimization pipeline here."""
+from paddle_tpu.inference.wrapper import (
+    Config, DataType, PlaceType, Predictor, PredictorPool, Tensor,
+    convert_to_mixed_precision, create_predictor, get_num_bytes_of_data_type,
+    get_trt_compile_version, get_trt_runtime_version, get_version,
+)
+
+__all__ = [
+    'Config', 'DataType', 'PlaceType', 'PrecisionType', 'Tensor', 'Predictor',
+    'PredictorPool', 'create_predictor', 'get_version',
+    'get_num_bytes_of_data_type', 'get_trt_compile_version',
+    'get_trt_runtime_version', 'convert_to_mixed_precision',
+]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3

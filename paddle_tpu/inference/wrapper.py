@@ -1,0 +1,208 @@
+"""Predictor/Config (reference python/paddle/inference/wrapper.py)."""
+from __future__ import annotations
+
+import enum
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT32 = 2
+    INT64 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+
+
+def get_num_bytes_of_data_type(dtype):
+    return {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT32: 4,
+            DataType.INT64: 8, DataType.UINT8: 1, DataType.INT8: 1,
+            DataType.BOOL: 1, DataType.BFLOAT16: 2}[dtype]
+
+
+class PlaceType(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class Config:
+    """reference paddle_infer.Config: model paths + device/optimization knobs.
+    XLA replaces the IR-pass pipeline, so most switches are bookkeeping."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._ir_optim = True
+        self._num_threads = 1
+
+    def set_prog_file(self, path):
+        self._model_path = path
+
+    def set_params_file(self, path):
+        self._params_path = path
+
+    def prog_file(self):
+        return self._model_path
+
+    def params_file(self):
+        return self._params_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0, precision=None):
+        self._device, self._device_id = "gpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **kw):
+        self._device = "xpu"
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device, self._device_id = device_type, device_id
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def enable_memory_optim(self, x=True):
+        self._enable_memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = n
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # TensorRT is CUDA-only; XLA compiles the graph on TPU
+
+    def summary(self):
+        return f"Config(model={self._model_path}, device={self._device})"
+
+
+class Tensor:
+    """Handle to one predictor input/output (reference paddle_infer.Tensor)."""
+
+    def __init__(self, name, store):
+        self._name = name
+        self._store = store
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        self._store[self._name] = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self._name])
+
+    def shape(self):
+        return list(np.asarray(self._store[self._name]).shape)
+
+    def reshape(self, shape):
+        self._store[self._name] = np.zeros(shape, np.float32)
+
+
+class Predictor:
+    """Loads a paddle.jit.save'd model and runs it (AnalysisPredictor parity:
+    load → (XLA) optimize → run)."""
+
+    def __init__(self, config):
+        self._config = config
+        base = config.prog_file()
+        if base is None:
+            raise ValueError("Config needs the model path prefix")
+        import json
+
+        with open(base + ".pdmodel.json") as f:
+            meta = json.load(f)
+        self._specs = meta["input_specs"]
+        self._exported = None
+        if os.path.exists(base + ".jaxexport"):
+            from jax import export as _jexport
+
+            with open(base + ".jaxexport", "rb") as f:
+                self._exported = _jexport.deserialize(bytearray(f.read()))
+        self._inputs = {f"x{i}": None for i in range(len(self._specs))}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._inputs.keys())
+
+    def get_input_handle(self, name):
+        return Tensor(name, self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs.keys())
+
+    def get_output_handle(self, name):
+        return Tensor(name, self._outputs)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            arrs = [np.asarray(t) if not hasattr(t, "numpy") else t.numpy() for t in inputs]
+        else:
+            arrs = [self._inputs[k] for k in self.get_input_names()]
+        if self._exported is None:
+            raise RuntimeError("no executable artifact (.jaxexport) next to the model")
+        out = self._exported.call(*[jnp.asarray(a) for a in arrs])
+        leaves = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs.clear()
+        res = []
+        for i, o in enumerate(leaves):
+            self._outputs[f"out{i}"] = np.asarray(o)
+            from paddle_tpu.tensor.tensor import Tensor as EagerTensor
+
+            res.append(EagerTensor(jnp.asarray(o)))
+        return res
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+class PredictorPool:
+    def __init__(self, config, size=1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def get_version():
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True, black_list=None,
+                               **kw):
+    """On TPU, precision policy is applied at jit time (paddle.amp); copy through."""
+    import shutil
+
+    for src, dst in ((model_file, mixed_model_file), (params_file, mixed_params_file)):
+        if src and dst and os.path.exists(src):
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            shutil.copy(src, dst)

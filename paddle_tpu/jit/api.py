@@ -177,6 +177,21 @@ def save(layer, path, input_spec=None, **configs):
     stablehlo = lowered.as_text()
     with open(path + ".stablehlo", "w") as f:
         f.write(stablehlo)
+    # self-contained executable artifact (weights closed over): the
+    # AnalysisPredictor-style load-and-run deployment story (paddle.inference)
+    try:
+        from jax import export as _jexport
+
+        exported = _jexport.export(jax.jit(lambda *arrs: fwd(params, buffers, *arrs)))(*example)
+        with open(path + ".jaxexport", "wb") as f:
+            f.write(exported.serialize())
+    except Exception as e:  # pragma: no cover - serialization best-effort
+        import warnings
+
+        warnings.warn(
+            f"jit.save: could not write {path}.jaxexport ({e!r}); "
+            "paddle.inference will not be able to run this model standalone"
+        )
     paddle.save({"params": params, "buffers": buffers}, path + ".pdparams")
     with open(path + ".pdmodel.json", "w") as f:
         json.dump({"input_specs": specs}, f)

@@ -101,7 +101,12 @@ class Optimizer:
 
     def _decay_grad(self, p, g):
         """L2 regularization folded into grad (paddle L2Decay semantics); decoupled
-        decay (AdamW) overrides _update instead."""
+        decay (AdamW) overrides _update instead.  A per-parameter regularizer
+        (ParamAttr(regularizer=paddle.regularizer.L1Decay(...))) takes priority
+        over the optimizer-level coefficient, as in the reference."""
+        reg = getattr(p, "regularizer", None)
+        if reg is not None and hasattr(reg, "grad_term"):
+            return g + reg.grad_term(p.data.astype(g.dtype))
         if self._l2_coeff and getattr(self, "_decoupled", False) is False:
             return g + self._l2_coeff * p.data.astype(g.dtype)
         return g

@@ -1,0 +1,13 @@
+"""paddle.profiler (reference python/paddle/profiler/__init__.py)."""
+from paddle_tpu.profiler.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SortedKeys,
+    SummaryView, export_chrome_tracing, export_protobuf, load_profiler_result,
+    make_scheduler,
+)
+from paddle_tpu.profiler import utils
+
+__all__ = [
+    'ProfilerState', 'ProfilerTarget', 'make_scheduler', 'export_chrome_tracing',
+    'export_protobuf', 'Profiler', 'RecordEvent', 'load_profiler_result',
+    'SortedKeys', 'SummaryView',
+]

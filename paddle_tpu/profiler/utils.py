@@ -1,0 +1,4 @@
+"""paddle.profiler.utils (reference python/paddle/profiler/utils.py)."""
+from paddle_tpu.profiler.profiler import RecordEvent, benchmark  # noqa: F401
+
+__all__ = ['RecordEvent', 'benchmark']

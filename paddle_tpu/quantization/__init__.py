@@ -1,0 +1,12 @@
+"""paddle.quantization (reference python/paddle/quantization/__init__.py):
+QAT/PTQ over a config/factory/observer/quanter architecture."""
+from paddle_tpu.quantization.config import QuantConfig
+from paddle_tpu.quantization.base_observer import BaseObserver
+from paddle_tpu.quantization.base_quanter import BaseQuanter
+from paddle_tpu.quantization.factory import quanter
+from paddle_tpu.quantization.qat import QAT
+from paddle_tpu.quantization.ptq import PTQ
+from paddle_tpu.quantization import observers, quanters
+
+__all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
+           "observers", "quanters"]

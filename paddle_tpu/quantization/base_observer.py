@@ -1,0 +1,29 @@
+"""BaseObserver (reference python/paddle/quantization/base_observer.py):
+collects tensor statistics during calibration (PTQ)."""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class BaseObserver(Layer):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8

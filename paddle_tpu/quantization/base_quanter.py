@@ -1,0 +1,22 @@
+"""BaseQuanter (reference python/paddle/quantization/base_quanter.py):
+fake-quantizes activations/weights during QAT."""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class BaseQuanter(Layer):
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8

@@ -1,0 +1,47 @@
+"""QuantConfig (reference python/paddle/quantization/config.py): maps layers to
+activation/weight quanter factories."""
+from __future__ import annotations
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_config = SingleLayerConfig(activation, weight) if (activation or weight) else None
+        self._layer2config = {}
+        self._prefix2config = {}
+        self._type2config = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer2config[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._prefix2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def _get_config_by_layer(self, name, layer):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for prefix, cfg in self._prefix2config.items():
+            if name.startswith(prefix):
+                return cfg
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+    @property
+    def default_qat_layer_mapping(self):
+        return {}

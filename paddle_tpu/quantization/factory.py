@@ -1,0 +1,38 @@
+"""quanter factory decorator (reference python/paddle/quantization/factory.py)."""
+from __future__ import annotations
+
+
+class QuanterFactory:
+    """Partial-like holder: stores the quanter class + ctor args; _instance(layer)
+    builds the quanter for a given layer (reference ClassWithArguments)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return QuanterFactory(self.cls, *args, **kwargs)
+
+
+def quanter(class_name):
+    """Class decorator registering a quanter under a partial-factory name."""
+
+    def wrapper(cls):
+        import sys
+
+        factory_cls = type(class_name, (QuanterFactory,), {})
+
+        def init(self, *args, **kwargs):
+            QuanterFactory.__init__(self, cls, *args, **kwargs)
+
+        factory_cls.__init__ = init
+        mod = sys.modules[cls.__module__]
+        setattr(mod, class_name, factory_cls)
+        cls._factory_name = class_name
+        return cls
+
+    return wrapper

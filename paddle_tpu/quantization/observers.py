@@ -1,0 +1,32 @@
+"""Observers (reference python/paddle/quantization/observers/abs_max.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.quantization.base_observer import BaseObserver
+from paddle_tpu.quantization.factory import QuanterFactory
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._abs_max = 1e-9
+
+    def _observe(self, x):
+        self._abs_max = max(self._abs_max, float(jnp.max(jnp.abs(x.data))))
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._abs_max / (2 ** (self._quant_bits - 1) - 1), jnp.float32))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsmaxObserver(QuanterFactory):
+    def __init__(self, quant_bits=8):
+        super().__init__(AbsmaxObserverLayer, quant_bits=quant_bits)

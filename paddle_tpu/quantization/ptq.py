@@ -1,0 +1,23 @@
+"""PTQ (reference python/paddle/quantization/ptq.py): insert observers, run
+calibration data, then convert observed stats into quant params."""
+from __future__ import annotations
+
+from paddle_tpu.quantization.qat import QuantedWrapper, _QUANTABLE, _convert
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        return _convert(model, self._config)
+
+    def convert(self, model, inplace=False):
+        """After calibration: freeze observer scales (kept as attributes)."""
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, QuantedWrapper):
+                if sub.activation_quanter is not None and hasattr(sub.activation_quanter, "scales"):
+                    sub._act_scale = sub.activation_quanter.scales()
+                if sub.weight_quanter is not None and hasattr(sub.weight_quanter, "scales"):
+                    sub._w_scale = sub.weight_quanter.scales()
+        return model

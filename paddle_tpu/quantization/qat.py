@@ -1,0 +1,65 @@
+"""QAT (reference python/paddle/quantization/qat.py): wrap quantizable layers
+with fake-quant on weights/activations for quantization-aware training."""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.layer.conv import Conv2D
+
+
+class QuantedWrapper(Layer):
+    """Wraps one layer: activation quanter on input, weight quanter on weight."""
+
+    def __init__(self, inner, cfg):
+        super().__init__()
+        self._inner = inner
+        self.activation_quanter = cfg.activation._instance(inner) if cfg.activation else None
+        self.weight_quanter = cfg.weight._instance(inner) if cfg.weight else None
+        self.add_sublayer("inner", inner)
+        if self.activation_quanter is not None:
+            self.add_sublayer("activation_quanter", self.activation_quanter)
+        if self.weight_quanter is not None:
+            self.add_sublayer("weight_quanter", self.weight_quanter)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._inner, "weight"):
+            orig = self._inner.weight
+            fq = self.weight_quanter(orig)
+            # run inner with fake-quantized weight, restoring afterwards
+            self._inner.weight = fq
+            try:
+                out = self._inner(x)
+            finally:
+                self._inner.weight = orig
+            return out
+        return self._inner(x)
+
+
+_QUANTABLE = (Linear, Conv2D)
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        return _convert(model, self._config)
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+def _convert(model, config, prefix=""):
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if "." in name:
+            continue
+        full = f"{prefix}{name}"
+        if isinstance(sub, _QUANTABLE):
+            cfg = config._get_config_by_layer(full, sub)
+            if cfg is not None:
+                setattr(model, name, QuantedWrapper(sub, cfg))
+        else:
+            _convert(sub, config, prefix=f"{full}.")
+    return model

@@ -1,0 +1,54 @@
+"""Quanters (reference python/paddle/quantization/quanters/abs_max.py):
+moving-average absmax fake quant with straight-through estimator."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.quantization.base_quanter import BaseQuanter
+from paddle_tpu.quantization.factory import QuanterFactory
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype='float32', name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self._state = 1.0
+        self._accum = 1.0
+        self._scale = 1.0
+
+    def forward(self, x):
+        qmax = 2 ** (self._bit_length - 1) - 1
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x.data)))
+            r = self._moving_rate
+            self._state = r * self._state + 1.0
+            self._accum = r * self._accum + cur
+            self._scale = max(self._accum / self._state, 1e-9)
+        scale = self._scale
+
+        def fake_quant(a):
+            q = jnp.clip(jnp.round(a / scale * qmax), -qmax, qmax)
+            deq = q / qmax * scale
+            # straight-through estimator: identity gradient
+            return a + jax.lax.stop_gradient(deq - a)
+
+        return apply("fake_quant_absmax", fake_quant, x)
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver(QuanterFactory):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype='float32', name=None):
+        super().__init__(FakeQuanterWithAbsMaxObserverLayer, moving_rate=moving_rate,
+                         bit_length=bit_length, dtype=dtype, name=name)

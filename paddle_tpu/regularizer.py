@@ -1,0 +1,42 @@
+"""paddle.regularizer (reference python/paddle/regularizer.py): L1Decay/L2Decay.
+
+Applied by the optimizer when a parameter carries `regularizer` (the reference
+appends regularization ops in Optimizer.append_regularization_ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def grad_term(self, param_data):
+        return self._coeff * jnp.sign(param_data)
+
+    def __repr__(self):
+        return f"L1Decay, coeff={self._coeff}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def grad_term(self, param_data):
+        return self._coeff * param_data
+
+    def __repr__(self):
+        return f"L2Decay, coeff={self._coeff}"

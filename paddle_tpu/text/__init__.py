@@ -1,0 +1,8 @@
+"""paddle.text (reference python/paddle/text/__init__.py)."""
+from paddle_tpu.text.datasets import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+from paddle_tpu.text.viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ['Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'WMT14',
+           'WMT16', 'ViterbiDecoder', 'viterbi_decode']

@@ -1,0 +1,44 @@
+"""paddle.text.datasets (reference python/paddle/text/datasets/): all require
+downloads — zero-egress build raises with instructions."""
+from paddle_tpu.io import Dataset
+
+
+class _DownloadDataset(Dataset):
+    name = "dataset"
+
+    def __init__(self, *a, **kw):
+        raise RuntimeError(
+            f"{self.name} requires downloading the corpus; provide local files "
+            "via a custom paddle.io.Dataset."
+        )
+
+
+class Conll05st(_DownloadDataset):
+    name = "Conll05st"
+
+
+class Imdb(_DownloadDataset):
+    name = "Imdb"
+
+
+class Imikolov(_DownloadDataset):
+    name = "Imikolov"
+
+
+class Movielens(_DownloadDataset):
+    name = "Movielens"
+
+
+class UCIHousing(_DownloadDataset):
+    name = "UCIHousing"
+
+
+class WMT14(_DownloadDataset):
+    name = "WMT14"
+
+
+class WMT16(_DownloadDataset):
+    name = "WMT16"
+
+
+__all__ = ['Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16']

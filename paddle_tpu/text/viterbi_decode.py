@@ -1,0 +1,69 @@
+"""Viterbi decoding (reference python/paddle/text/viterbi_decode.py): CRF-style
+max-path decode as a lax.scan — compiler-friendly sequential DP on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    """potentials: (B, L, T) emissions; transition_params: (T, T);
+    lengths: (B,).  Returns (scores, paths)."""
+
+    def f(emis, trans, lens):
+        b, L, T = emis.shape
+        if include_bos_eos_tag:
+            # last two tags are BOS (T-2) / EOS (T-1) (reference semantics):
+            # start scores include the transition from BOS; BOS/EOS are not
+            # valid path states, so mask them out of the lattice
+            tag_mask = jnp.where(jnp.arange(T) < T - 2, 0.0, -1e30).astype(emis.dtype)
+            init = emis[:, 0] + trans[T - 2][None, :] + tag_mask[None, :]
+        else:
+            tag_mask = jnp.zeros((T,), emis.dtype)
+            init = emis[:, 0]
+
+        lens32 = lens.astype(jnp.int32)
+
+        def step(alpha, t):
+            scores = alpha[:, :, None] + trans[None, :, :]  # (B, from, to)
+            best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+            alpha_t = jnp.max(scores, axis=1) + emis[:, t] + tag_mask[None, :]
+            active = (t < lens32)[:, None]  # advance only while t < length
+            return jnp.where(active, alpha_t, alpha), best_prev
+
+        alpha, backptrs = jax.lax.scan(step, init, jnp.arange(1, L, dtype=jnp.int32))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, T - 1][None, :]
+        scores = jnp.max(alpha, -1)
+        last_tag = jnp.argmax(alpha, -1).astype(jnp.int32)
+
+        # backtrace: path[t-1] = backptrs[t][path[t]] while t < len, else keep tag
+        def back(tag, xs):
+            bp_t, t = xs
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            prev = jnp.where(t < lens32, prev, tag)
+            return prev, prev
+
+        ts = jnp.arange(1, L, dtype=jnp.int32)
+        _, rev_path = jax.lax.scan(back, last_tag, (backptrs[::-1], ts[::-1]))
+        path = jnp.concatenate([rev_path[::-1], last_tag[None]], 0)
+        return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return apply("viterbi_decode", f,
+                 potentials if isinstance(potentials, Tensor) else Tensor(jnp.asarray(potentials)),
+                 transition_params if isinstance(transition_params, Tensor) else Tensor(jnp.asarray(transition_params)),
+                 lengths if isinstance(lengths, Tensor) else Tensor(jnp.asarray(lengths)))
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)

@@ -1,5 +1,5 @@
 """paddle.vision namespace (python/paddle/vision parity, SURVEY.md §2.10)."""
-from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
 from paddle_tpu.vision.models import (  # noqa: F401
     LeNet, MobileNetV1, ResNet, VGG, mobilenet_v1, resnet18, resnet34,
     resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,

@@ -1,0 +1,670 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py): detection ops.
+
+TPU-native formulations: box ops are vectorized jnp; NMS-style sequential
+selection uses host numpy (it is post-processing, as in the reference's CPU
+kernels); roi_align/deform_conv are gather+einsum programs XLA can fuse."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.container import Sequential
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# --------------------------------------------------------------------- nms ----
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2 - x1) * (y2 - y1)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None, name=None):
+    """reference vision/ops.py:1934 nms (optionally category-aware)."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes, np.float64)
+    n = b.shape[0]
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores, np.float64) if scores is not None else None
+
+    def _nms_single(idxs):
+        order = idxs[np.argsort(-s[idxs])] if s is not None else idxs
+        keep = []
+        iou = _iou_matrix(b)
+        suppressed = np.zeros(n, bool)
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            suppressed |= iou[i] > iou_threshold
+            suppressed[i] = True
+        return np.asarray(keep, np.int64)
+
+    if category_idxs is None:
+        keep = _nms_single(np.arange(n))
+    else:
+        cat = np.asarray(category_idxs.numpy() if isinstance(category_idxs, Tensor) else category_idxs)
+        parts = [
+            _nms_single(np.flatnonzero(cat == c)) for c in (categories or np.unique(cat))
+        ]
+        keep = np.concatenate([p for p in parts if len(p)]) if parts else np.zeros(0, np.int64)
+        if s is not None:
+            keep = keep[np.argsort(-s[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference vision/ops.py:2358 matrix_nms (SOLOv2 decay formulation)."""
+    bb = np.asarray(bboxes.numpy(), np.float64)  # (N, M, 4)
+    sc = np.asarray(scores.numpy(), np.float64)  # (N, C, M)
+    all_out, all_idx, rois_num = [], [], []
+    for bi in range(bb.shape[0]):
+        outs = []
+        idxs = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s_c = sc[bi, c]
+            valid = np.flatnonzero(s_c > score_threshold)
+            if valid.size == 0:
+                continue
+            order = valid[np.argsort(-s_c[valid])][:nms_top_k]
+            boxes_c = bb[bi][order]
+            scores_c = s_c[order]
+            iou = _iou_matrix(boxes_c)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0) if len(order) else np.zeros(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2) / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :], 1e-10)).min(0)
+            decayed = scores_c * decay
+            keep = decayed > post_threshold
+            for j in np.flatnonzero(keep):
+                outs.append([c, decayed[j], *boxes_c[j]])
+                idxs.append(order[j] + bi * bb.shape[1])
+        outs = np.asarray(outs, np.float32).reshape(-1, 6)
+        idxs = np.asarray(idxs, np.int64)
+        if keep_top_k > 0 and len(outs) > keep_top_k:  # -1 = keep all
+            sel = np.argsort(-outs[:, 1])[:keep_top_k]
+            outs, idxs = outs[sel], idxs[sel]
+        all_out.append(outs)
+        all_idx.append(idxs)
+        rois_num.append(len(outs))
+    out = Tensor(np.concatenate(all_out, 0) if all_out else np.zeros((0, 6), np.float32))
+    res = [out]
+    if return_index:
+        res.append(Tensor(np.concatenate(all_idx, 0)))
+    if return_rois_num:
+        res.append(Tensor(np.asarray(rois_num, np.int32)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+# --------------------------------------------------------------- roi pooling --
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference vision/ops.py:1705: bilinear-sampled average pooling per RoI."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    # adaptive sampling count (reference: ceil(roi_size / bin) per RoI).  XLA
+    # needs a static grid, so take the max over the (concrete, eager) boxes,
+    # bounded to keep the gather tractable.
+    if sampling_ratio <= 0:
+        bx_np = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes, np.float64)
+        if bx_np.size:
+            max_h = float(np.max(bx_np[:, 3] - bx_np[:, 1])) * spatial_scale
+            max_w = float(np.max(bx_np[:, 2] - bx_np[:, 0])) * spatial_scale
+            sampling_ratio = int(min(8, max(1, np.ceil(max(max_h / ph, max_w / pw)))))
+        else:
+            sampling_ratio = 2
+
+    def f(feat, bxs, bnum):
+        n, c, h, w = feat.shape
+        # map each roi to its batch image
+        batch_idx = jnp.repeat(jnp.arange(n), bnum, total_repeat_length=bxs.shape[0])
+        offset = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - offset
+        y1 = bxs[:, 1] * spatial_scale - offset
+        x2 = bxs[:, 2] * spatial_scale - offset
+        y2 = bxs[:, 3] * spatial_scale - offset
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        sr = sampling_ratio
+        # sample grid: (R, ph, sr) x (R, pw, sr)
+        ys = (y1[:, None, None] + (jnp.arange(ph)[None, :, None] +
+              (jnp.arange(sr)[None, None, :] + 0.5) / sr) * (roi_h[:, None, None] / ph))
+        xs = (x1[:, None, None] + (jnp.arange(pw)[None, :, None] +
+              (jnp.arange(sr)[None, None, :] + 0.5) / sr) * (roi_w[:, None, None] / pw))
+
+        def bilinear(img, yy, xx):
+            # img: (C, H, W); yy/xx: grids
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, h - 1)
+            x1_ = jnp.minimum(x0 + 1, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1_]
+            v10 = img[:, y1_, x0]
+            v11 = img[:, y1_, x1_]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        def per_roi(r):
+            img = feat[batch_idx[r]]
+            yy = ys[r][:, None, :, None]            # (ph,1,sr,1)
+            xx = xs[r][None, :, None, :]            # (1,pw,1,sr)
+            yy = jnp.broadcast_to(yy, (ph, pw, sr, sr))
+            xx = jnp.broadcast_to(xx, (ph, pw, sr, sr))
+            vals = bilinear(img, yy.reshape(-1), xx.reshape(-1))  # (C, ph*pw*sr*sr)
+            vals = vals.reshape(c, ph, pw, sr, sr)
+            return vals.mean((-1, -2))
+
+        return jax.vmap(per_roi)(jnp.arange(bxs.shape[0]))
+
+    return apply("roi_align", f, _t(x), _t(boxes), _t(boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference vision/ops.py:1572: max pooling per RoI bin (host loop: RoI
+    counts are small post-processing work)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    feat = x.numpy()
+    bxs = boxes.numpy()
+    bnum = np.asarray(boxes_num.numpy(), np.int64)
+    n, c, h, w = feat.shape
+    batch_idx = np.repeat(np.arange(n), bnum)
+    outs = np.zeros((bxs.shape[0], c, ph, pw), feat.dtype)
+    for r in range(bxs.shape[0]):
+        img = feat[batch_idx[r]]
+        x1 = int(np.round(bxs[r, 0] * spatial_scale))
+        y1 = int(np.round(bxs[r, 1] * spatial_scale))
+        x2 = int(np.round(bxs[r, 2] * spatial_scale))
+        y2 = int(np.round(bxs[r, 3] * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                ys0 = min(max(y1 + int(np.floor(i * rh / ph)), 0), h)
+                ys1 = min(max(y1 + int(np.ceil((i + 1) * rh / ph)), 0), h)
+                xs0 = min(max(x1 + int(np.floor(j * rw / pw)), 0), w)
+                xs1 = min(max(x1 + int(np.ceil((j + 1) * rw / pw)), 0), w)
+                if ys1 > ys0 and xs1 > xs0:
+                    outs[r, :, i, j] = img[:, ys0:ys1, xs0:xs1].max((1, 2))
+    return Tensor(outs)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference vision/ops.py:1441: position-sensitive RoI average pooling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    feat = x.numpy()
+    bxs = boxes.numpy()
+    bnum = np.asarray(boxes_num.numpy(), np.int64)
+    n, c, h, w = feat.shape
+    assert c % (ph * pw) == 0, "channels must be divisible by pooled_h*pooled_w"
+    oc = c // (ph * pw)
+    batch_idx = np.repeat(np.arange(n), bnum)
+    outs = np.zeros((bxs.shape[0], oc, ph, pw), feat.dtype)
+    for r in range(bxs.shape[0]):
+        img = feat[batch_idx[r]]
+        x1 = bxs[r, 0] * spatial_scale
+        y1 = bxs[r, 1] * spatial_scale
+        x2 = bxs[r, 2] * spatial_scale
+        y2 = bxs[r, 3] * spatial_scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        for i in range(ph):
+            for j in range(pw):
+                ys0 = min(max(int(np.floor(y1 + i * rh / ph)), 0), h)
+                ys1 = min(max(int(np.ceil(y1 + (i + 1) * rh / ph)), 0), h)
+                xs0 = min(max(int(np.floor(x1 + j * rw / pw)), 0), w)
+                xs1 = min(max(int(np.ceil(x1 + (j + 1) * rw / pw)), 0), w)
+                ch = (i * pw + j) * oc
+                if ys1 > ys0 and xs1 > xs0:
+                    outs[r, :, i, j] = img[ch:ch + oc, ys0:ys1, xs0:xs1].mean((1, 2))
+    return Tensor(outs)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size, self._spatial_scale)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size, self._spatial_scale)
+
+
+# ------------------------------------------------------------- deform conv ----
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """reference vision/ops.py:766 (DCNv1 when mask None, DCNv2 with mask):
+    bilinear sampling at offset positions + matmul — pure gather/einsum."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xa, off, wgt, *rest):
+        n, cin, h, w = xa.shape
+        cout, cin_g, kh, kw = wgt.shape
+        sh, sw = stride
+        ph, pw = padding
+        dh, dw = dilation
+        out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xa_p = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        hp, wp = h + 2 * ph, w + 2 * pw
+        # base sampling grid: (out_h, out_w, kh, kw)
+        base_y = (jnp.arange(out_h) * sh)[:, None, None, None] + (jnp.arange(kh) * dh)[None, None, :, None]
+        base_x = (jnp.arange(out_w) * sw)[None, :, None, None] + (jnp.arange(kw) * dw)[None, None, None, :]
+        base_y = jnp.broadcast_to(base_y, (out_h, out_w, kh, kw)).astype(xa.dtype)
+        base_x = jnp.broadcast_to(base_x, (out_h, out_w, kh, kw)).astype(xa.dtype)
+        # offsets: (N, 2*dg*kh*kw, out_h, out_w) → (N, dg, kh, kw, 2, oh, ow)
+        off = off.reshape(n, deformable_groups, kh * kw, 2, out_h, out_w)
+        off_y = jnp.moveaxis(off[:, :, :, 0], -2, 2)  # (n, dg, oh, khkw, ow)? keep simple:
+        off_y = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(n, deformable_groups, out_h, out_w, kh, kw)
+        off_x = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(n, deformable_groups, out_h, out_w, kh, kw)
+        sample_y = base_y[None, None] + off_y
+        sample_x = base_x[None, None] + off_x
+
+        if mask is not None:
+            m = rest[-1].reshape(n, deformable_groups, kh * kw, out_h, out_w)
+            m = m.transpose(0, 1, 3, 4, 2).reshape(n, deformable_groups, out_h, out_w, kh, kw)
+        else:
+            m = None
+
+        cpg = cin // deformable_groups  # channels per deformable group
+
+        def bilinear(img, yy, xx):
+            # img: (C, H, W), yy/xx: (...,) returns (C, ...)
+            valid = (yy > -1) & (yy < hp) & (xx > -1) & (xx < wp)
+            yy = jnp.clip(yy, 0, hp - 1)
+            xx = jnp.clip(xx, 0, wp - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, hp - 1)
+            x1 = jnp.minimum(x0 + 1, wp - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx) + img[:, y0, x1] * (1 - wy) * wx
+                 + img[:, y1, x0] * wy * (1 - wx) + img[:, y1, x1] * wy * wx)
+            return v * valid
+
+        def per_image(img, sy, sx, mm):
+            # per deformable group sampling
+            cols = []
+            for g in range(deformable_groups):
+                sub = img[g * cpg:(g + 1) * cpg]
+                vals = bilinear(sub, sy[g].reshape(-1), sx[g].reshape(-1))
+                vals = vals.reshape(cpg, out_h, out_w, kh, kw)
+                if mm is not None:
+                    vals = vals * mm[g][None]
+                cols.append(vals)
+            return jnp.concatenate(cols, 0)  # (cin, oh, ow, kh, kw)
+
+        cols = jax.vmap(per_image)(xa_p, sample_y, sample_x,
+                                   m if m is not None else jnp.ones((n, deformable_groups, out_h, out_w, kh, kw), xa.dtype))
+        # grouped conv as einsum
+        cols = cols.reshape(n, groups, cin // groups, out_h, out_w, kh, kw)
+        wgt_g = wgt.reshape(groups, cout // groups, cin_g, kh, kw)
+        out = jnp.einsum("ngcxyhw,gochw->ngoxy", cols, wgt_g).reshape(n, cout, out_h, out_w)
+        if bias is not None:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [_t(x), _t(offset), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    if mask is not None:
+        args.append(_t(mask))
+    return apply("deform_conv2d", f, *args)
+
+
+class DeformConv2D(Layer):
+    """reference vision/ops.py:973."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter([out_channels, in_channels // groups, *ks],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._deformable_groups,
+                             self._groups, mask)
+
+
+# ------------------------------------------------------------------- boxes ----
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """reference vision/ops.py:584."""
+
+    def f(pb, tb, *rest):
+        pbv = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        phh = pb[:, 3] - pb[:, 1] + norm
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            ox = (tx[:, None] - px[None, :]) / pw[None, :]
+            oy = (ty[:, None] - py[None, :]) / phh[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / phh[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], -1)
+            if pbv is not None:
+                v = pbv if pbv.ndim == 1 else pbv
+                out = out / (v[None, :, :] if v.ndim == 2 else v[None, None, :])
+            return out
+        # decode_center_size
+        if axis == 0:
+            pw_, ph_, px_, py_ = pw[:, None], phh[:, None], px[:, None], py[:, None]
+            if pbv is not None:
+                v = pbv[:, None, :] if pbv.ndim == 2 else pbv[None, None, :]
+            slice_axis = 1
+        else:
+            pw_, ph_, px_, py_ = pw[None, :], phh[None, :], px[None, :], py[None, :]
+            if pbv is not None:
+                v = pbv[None, :, :] if pbv.ndim == 2 else pbv[None, None, :]
+        t = tb
+        if pbv is not None:
+            t = tb * v
+        ox = t[..., 0] * pw_ + px_
+        oy = t[..., 1] * ph_ + py_
+        ow = jnp.exp(t[..., 2]) * pw_
+        oh = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([ox - ow / 2,
+                          oy - oh / 2,
+                          ox + ow / 2 - norm,
+                          oy + oh / 2 - norm], -1)
+
+    args = [_t(prior_box), _t(target_box)]
+    if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)):
+        args.append(_t(prior_box_var))
+        return apply("box_coder", f, *args)
+    elif isinstance(prior_box_var, (list, tuple)):
+        args.append(_t(jnp.asarray(prior_box_var, jnp.float32)))
+        return apply("box_coder", f, *args)
+    return apply("box_coder", f, *args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False, steps=[0.0, 0.0],
+              offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """reference vision/ops.py:438 (SSD prior boxes)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    vars_ = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((ms, ms))
+                if max_sizes:
+                    bs = np.sqrt(ms * max_sizes[k])
+                    cell.append((bs, bs))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    cell.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            for (bw, bh) in cell:
+                box = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                       (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+                if clip:
+                    box = np.clip(box, 0, 1).tolist()
+                boxes.append(box)
+                vars_.append(variance)
+    nprior = len(boxes) // (fh * fw)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, nprior, 4)
+    var = np.asarray(vars_, np.float32).reshape(fh, fw, nprior, 4)
+    return Tensor(out), Tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """reference vision/ops.py:277: decode YOLOv3 head output to boxes+scores."""
+
+    def f(xa, imgs):
+        n, c, h, w = xa.shape
+        na = len(anchors) // 2
+        anc = jnp.asarray(anchors, xa.dtype).reshape(na, 2)
+        pred = xa.reshape(n, na, -1, h, w)  # (N, na, 5+cls(+iou), H, W)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(pred[:, :, -1])
+            pred = pred[:, :, :-1]
+        gx = jnp.arange(w, dtype=xa.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xa.dtype)[None, None, :, None]
+        bx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+        by = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+        bw = jnp.exp(pred[:, :, 2]) * anc[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(pred[:, :, 3]) * anc[None, :, 1, None, None] / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+        probs = jax.nn.sigmoid(pred[:, :, 5:5 + class_num]) * conf[:, :, None]
+        conf_mask = conf > conf_thresh
+        imgw = imgs[:, 1].astype(xa.dtype)[:, None, None, None]
+        imgh = imgs[:, 0].astype(xa.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
+        boxes = boxes.reshape(n, -1, 4)
+        scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", f, _t(x), _t(img_size))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None, name=None):
+    """reference vision/ops.py:1175: route each RoI to an FPN level by scale."""
+    rois = np.asarray(fpn_rois.numpy(), np.float64)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip(rois[:, 2] - rois[:, 0] + off, 0, None)
+                    * np.clip(rois[:, 3] - rois[:, 1] + off, 0, None))
+    level = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+    outs, idxs, nums = [], [], []
+    # per-image ownership of each RoI (for per-level per-image counts)
+    if rois_num is not None:
+        rn = np.asarray(rois_num.numpy() if isinstance(rois_num, Tensor) else rois_num, np.int64)
+        img_of_roi = np.repeat(np.arange(len(rn)), rn)
+    for lv in range(min_level, max_level + 1):
+        sel = np.flatnonzero(level == lv)
+        outs.append(Tensor(rois[sel].astype(np.float32)))
+        idxs.append(sel)
+        if rois_num is not None:
+            nums.append(Tensor(np.bincount(img_of_roi[sel], minlength=len(rn)).astype(np.int32)))
+    order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
+    restore = np.argsort(order)
+    restore_ind = Tensor(restore.astype(np.int32).reshape(-1, 1))
+    if rois_num is not None:
+        return outs, restore_ind, nums
+    return outs, restore_ind
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """reference vision/ops.py:2106 (RPN proposal generation, single-image loop)."""
+    sc = np.asarray(scores.numpy(), np.float64)       # (N, A, H, W)
+    deltas = np.asarray(bbox_deltas.numpy(), np.float64)  # (N, 4A, H, W)
+    anchs = np.asarray(anchors.numpy(), np.float64).reshape(-1, 4)
+    vars_ = np.asarray(variances.numpy(), np.float64).reshape(-1, 4)
+    imgs = np.asarray(img_size.numpy(), np.float64)
+    n = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = deltas[b].reshape(-1, 4, sc.shape[2], sc.shape[3]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anchs[order], vars_[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw / 2
+        ay = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        ww = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000 / 16))) * aw
+        hh = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000 / 16))) * ah
+        props = np.stack([cx - ww / 2 + 0 * off, cy - hh / 2, cx + ww / 2 - off, cy + hh / 2 - off], -1)
+        ih, iw = imgs[b][0], imgs[b][1]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, iw - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, ih - off)
+        keep = ((props[:, 2] - props[:, 0] + off >= min_size)
+                & (props[:, 3] - props[:, 1] + off >= min_size))
+        props, s = props[keep], s[keep]
+        keep_idx = nms(Tensor(props.astype(np.float32)), nms_thresh, Tensor(s.astype(np.float32))).numpy()[:post_nms_top_n]
+        all_rois.append(props[keep_idx].astype(np.float32))
+        all_scores.append(s[keep_idx].astype(np.float32))
+        nums.append(len(keep_idx))
+    rois = Tensor(np.concatenate(all_rois, 0) if all_rois else np.zeros((0, 4), np.float32))
+    rscores = Tensor(np.concatenate(all_scores, 0) if all_scores else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(nums, np.int32))
+    return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thresh,
+              downsample_ratio, gt_score=None, use_label_smooth=True, name=None,
+              scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: compose the YOLOv3 loss from yolo_box decode + paddle.nn "
+        "losses; the reference's fused CUDA kernel has no TPU counterpart yet."
+    )
+
+
+# --------------------------------------------------------------------- misc ----
+class ConvNormActivation(Sequential):
+    """reference vision/ops.py:1877."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=None, activation_layer=None,
+                 dilation=1, bias=None):
+        from paddle_tpu.nn.layer.conv import Conv2D
+        from paddle_tpu.nn.layer.norm import BatchNorm2D
+        from paddle_tpu.nn.layer.activation import ReLU
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is None:
+            norm_layer = BatchNorm2D
+        if activation_layer is None:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [Conv2D(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation=dilation, groups=groups,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x.numpy(), np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == 'gray':
+        img = img.convert('L')
+    elif mode == 'rgb':
+        img = img.convert('RGB')
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))

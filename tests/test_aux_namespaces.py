@@ -1,0 +1,318 @@
+"""Tests for profiler/quantization/regularizer/decomposition/audio/text/
+vision.ops/inference/rpc/passes (reference test/legacy_test + test/quantization
++ test/deprecated/rpc)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestProfiler:
+    def test_record_and_summary(self):
+        import paddle_tpu.profiler as profiler
+
+        p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+        p.start()
+        with profiler.RecordEvent("matmul_scope"):
+            _ = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+        p.step(num_samples=8)
+        p.stop()
+        table = p.summary()
+        assert "matmul_scope" in table
+        assert "ips" in p.step_info()
+
+    def test_scheduler_and_chrome_export(self):
+        import paddle_tpu.profiler as profiler
+
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == profiler.ProfilerState.CLOSED
+        assert states[1] == profiler.ProfilerState.READY
+        assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+        with tempfile.TemporaryDirectory() as d:
+            p = profiler.Profiler(on_trace_ready=profiler.export_chrome_tracing(d))
+            p.start()
+            with profiler.RecordEvent("e"):
+                pass
+            p.stop()
+            files = os.listdir(d)
+            assert any(f.endswith(".json") for f in files)
+            data = profiler.load_profiler_result(os.path.join(d, files[0]))
+            assert "traceEvents" in data
+
+
+class TestQuantization:
+    def _model(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        return M()
+
+    def test_qat_quantize_and_train(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+        from paddle_tpu.quantization.quanters import FakeQuanterWithAbsMaxObserver
+
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver(bit_length=8))
+        m = QAT(cfg).quantize(self._model())
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        out = m(x)
+        out.sum().backward()
+        from paddle_tpu.quantization.qat import QuantedWrapper
+
+        assert isinstance(m.fc1, QuantedWrapper)
+        assert m.fc1._inner.weight.grad is not None
+        # fake-quant output is close to float output but not identical
+        assert np.isfinite(out.numpy()).all()
+
+    def test_ptq_observers(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+        from paddle_tpu.quantization.observers import AbsmaxObserver
+
+        cfg = QuantConfig(activation=AbsmaxObserver(), weight=AbsmaxObserver())
+        m = PTQ(cfg).quantize(self._model())
+        for _ in range(3):
+            m(paddle.to_tensor(np.random.rand(4, 8).astype("float32")))
+        m = PTQ(cfg).convert(m)
+        scale = m.fc1.activation_quanter.scales()
+        assert float(scale.numpy()) > 0
+
+
+class TestRegularizer:
+    def test_l1_l2_applied_by_optimizer(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        for reg, expect in ((L2Decay(0.5), "l2"), (L1Decay(0.5), "l1")):
+            lin = nn.Linear(4, 4, bias_attr=False)
+            lin.weight.regularizer = reg
+            w0 = lin.weight.numpy().copy()
+            opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=lin.parameters())
+            out = lin(paddle.to_tensor(np.zeros((1, 4), "float32")))
+            out.sum().backward()
+            opt.step()
+            # grad is 0 (zero input) so update is purely the regularization term
+            if expect == "l2":
+                np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.5 * w0, rtol=1e-5)
+            else:
+                np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.5 * np.sign(w0), rtol=1e-5)
+
+
+class TestDecomposition:
+    def test_rules(self):
+        import paddle_tpu.decomposition as dec
+
+        x = paddle.to_tensor(np.random.rand(3, 5).astype("float32"))
+        sm = dec.decompose("softmax", x).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+        assert dec.has_decomp("layer_norm") and not dec.has_decomp("nope")
+
+        @dec.register_decomp("my_square")
+        def _sq(t):
+            return t * t
+
+        np.testing.assert_allclose(
+            dec.decompose("my_square", x).numpy(), x.numpy() ** 2, rtol=1e-6
+        )
+
+
+class TestAudio:
+    def test_mel_pipeline(self):
+        import paddle_tpu.audio as audio
+
+        sig = paddle.to_tensor(np.sin(np.linspace(0, 200, 2048)).astype("float32")[None])
+        spec = audio.features.Spectrogram(n_fft=256)(sig)
+        assert spec.shape[1] == 129
+        mel = audio.features.MelSpectrogram(sr=8000, n_fft=256, n_mels=20)(sig)
+        assert mel.shape[1] == 20
+        logmel = audio.features.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=20)(sig)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=20)(sig)
+        assert mfcc.shape[1] == 13
+
+    def test_functional_matches_librosa_formulas(self):
+        import paddle_tpu.audio.functional as F
+
+        assert abs(F.hz_to_mel(1000.0) - 15.0) < 0.1  # slaney: 1000 Hz = 15 mel*? sanity
+        hz = F.mel_to_hz(F.hz_to_mel(440.0))
+        assert abs(hz - 440.0) < 1e-3
+        fb = F.compute_fbank_matrix(8000, 256, n_mels=10)
+        assert list(fb.shape) == [10, 129]
+        w = F.get_window("hann", 16)
+        assert abs(float(w.numpy()[0])) < 1e-6
+
+    def test_wave_io(self):
+        import paddle_tpu.audio as audio
+
+        sig = paddle.to_tensor((np.sin(np.linspace(0, 50, 800)) * 0.5).astype("float32")[None])
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.wav")
+            audio.save(p, sig, 8000)
+            back, sr = audio.load(p)
+            assert sr == 8000
+            np.testing.assert_allclose(back.numpy(), sig.numpy(), atol=1e-3)
+            assert audio.info(p).sample_rate == 8000
+
+
+class TestText:
+    def test_viterbi_decode(self):
+        from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+        emis = np.random.rand(2, 4, 5).astype("float32")
+        trans = np.random.rand(5, 5).astype("float32")
+        lens = np.array([4, 3])
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans), paddle.to_tensor(lens),
+            include_bos_eos_tag=False,
+        )
+        assert list(paths.shape) == [2, 4]
+        # greedy sanity: viterbi score >= greedy path score
+        greedy = emis[0, 0].max()
+        tag = emis[0, 0].argmax()
+        for t in range(1, 4):
+            nxt = (trans[tag] + emis[0, t]).argmax()
+            greedy += trans[tag][nxt] + emis[0, t][nxt]
+            tag = nxt
+        assert float(scores.numpy()[0]) >= greedy - 1e-5
+        dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+        s2, p2 = dec(paddle.to_tensor(emis), paddle.to_tensor(lens))
+        np.testing.assert_allclose(s2.numpy(), scores.numpy())
+
+    def test_datasets_raise(self):
+        import paddle_tpu.text as text
+
+        with pytest.raises(RuntimeError):
+            text.Imdb()
+
+
+class TestVisionOps:
+    def test_nms(self):
+        import paddle_tpu.vision.ops as ops
+
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], "float32")
+        scores = np.array([0.9, 0.8, 0.7], "float32")
+        keep = ops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores))
+        assert keep.numpy().tolist() == [0, 2]
+        cat = np.array([0, 1, 0])
+        keep2 = ops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                        paddle.to_tensor(cat), categories=[0, 1])
+        assert 1 in keep2.numpy()  # different category not suppressed
+
+    def test_roi_align_constant_feature(self):
+        import paddle_tpu.vision.ops as ops
+
+        feat = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, "float32"))
+        rois = paddle.to_tensor(np.array([[1, 1, 6, 6]], "float32"))
+        out = ops.roi_align(feat, rois, paddle.to_tensor(np.array([1])), 2)
+        np.testing.assert_allclose(out.numpy(), np.full((1, 2, 2, 2), 3.0), rtol=1e-5)
+
+    def test_deform_conv_zero_offset(self):
+        import paddle_tpu.vision.ops as ops
+        from paddle_tpu.nn.functional.conv import conv2d
+
+        x = paddle.to_tensor(np.random.rand(2, 4, 8, 8).astype("float32"))
+        w = paddle.to_tensor(np.random.rand(6, 4, 3, 3).astype("float32"))
+        off = paddle.to_tensor(np.zeros((2, 18, 6, 6), "float32"))
+        np.testing.assert_allclose(
+            ops.deform_conv2d(x, off, w).numpy(), conv2d(x, w).numpy(), rtol=1e-4, atol=1e-4
+        )
+
+    def test_deform_conv_layer_and_grad(self):
+        import paddle_tpu.vision.ops as ops
+
+        layer = ops.DeformConv2D(3, 5, 3, padding=1)
+        x = paddle.to_tensor(np.random.rand(1, 3, 6, 6).astype("float32"))
+        off = paddle.to_tensor(np.random.rand(1, 18, 6, 6).astype("float32") * 0.1)
+        out = layer(x, off)
+        assert list(out.shape) == [1, 5, 6, 6]
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_box_coder_roundtrip(self):
+        import paddle_tpu.vision.ops as ops
+
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], "float32")
+        targets = np.array([[1, 1, 12, 12], [4, 6, 22, 18]], "float32")
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = ops.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(targets))
+        # decode: target_box is (M, N, 4) per-prior codes
+        dec = ops.box_coder(paddle.to_tensor(priors), var, enc,
+                            code_type="decode_center_size", axis=0)
+        # the diagonal (code i decoded with prior i) must reproduce the target
+        got = np.stack([dec.numpy()[i, i] for i in range(2)])
+        np.testing.assert_allclose(got, targets, rtol=1e-3, atol=1e-3)
+
+    def test_yolo_prior_fpn(self):
+        import paddle_tpu.vision.ops as ops
+
+        yb, ys = ops.yolo_box(
+            paddle.to_tensor(np.random.rand(1, 3 * 7, 4, 4).astype("float32")),
+            paddle.to_tensor(np.array([[64, 64]], "int32")), [10, 13, 16, 30, 33, 23],
+            2, 0.01, 16)
+        assert list(yb.shape) == [1, 48, 4] and list(ys.shape) == [1, 48, 2]
+        pb, pv = ops.prior_box(
+            paddle.to_tensor(np.zeros((1, 3, 4, 4), "float32")),
+            paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32")), min_sizes=[8.0])
+        assert pb.shape[-1] == 4
+        outs, restore = ops.distribute_fpn_proposals(
+            paddle.to_tensor(np.array([[0, 0, 10, 10], [0, 0, 200, 200]], "float32")),
+            2, 5, 4, 224)
+        assert sum(o.shape[0] for o in outs) == 2
+
+
+class TestInference:
+    def test_save_load_predict(self):
+        m = nn.Linear(4, 2)
+        x = np.random.rand(1, 4).astype("float32")
+        ref = m(paddle.to_tensor(x)).numpy()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+            cfg = paddle.inference.Config(path)
+            pred = paddle.inference.create_predictor(cfg)
+            out = pred.run([x])
+            np.testing.assert_allclose(out[0].numpy(), ref, rtol=1e-5)
+            # handle-style API
+            names = pred.get_input_names()
+            h = pred.get_input_handle(names[0])
+            h.copy_from_cpu(x)
+            out2 = pred.run()
+            np.testing.assert_allclose(out2[0].numpy(), ref, rtol=1e-5)
+
+
+class TestRPC:
+    def test_sync_async(self):
+        import paddle_tpu.distributed.rpc as rpc
+
+        rpc.init_rpc("w0")
+        try:
+            assert rpc.rpc_sync("w0", max, args=((2, 9, 4),)) == 9
+            assert rpc.rpc_async("w0", sum, args=((1, 2, 3),)).result() == 6
+            info = rpc.get_worker_info("w0")
+            assert info.name == "w0" and rpc.get_current_worker_info().rank == 0
+        finally:
+            rpc.shutdown()
+
+
+class TestPasses:
+    def test_pass_manager(self):
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+
+        pm = PassManager([
+            new_pass("auto_parallel_amp", {"dtype": "bfloat16"}),
+            new_pass("auto_parallel_sharding", {"stage": 2}),
+        ])
+        ctx = pm.apply([None])
+        assert ctx.get_attr("amp")["dtype"] == "bfloat16"
+        assert ctx.get_attr("sharding")["stage"] == 2
+        with pytest.raises(ValueError):
+            new_pass("not_a_pass")
