@@ -1,0 +1,282 @@
+"""Native runtime components (C++, ctypes-bound).
+
+The reference is a two-language framework; these are the pieces where the
+TPU-native rebuild keeps native code because XLA does not supply the
+capability (SURVEY.md §7 design stance):
+
+* ``TCPStore``/``TCPStoreServer`` — KV rendezvous (reference
+  paddle/phi/core/distributed/store/tcp_store.h:121)
+* ``Watchdog`` — hung-collective detection (reference
+  paddle/phi/core/distributed/collective/comm_task_manager.h:37)
+* ``PluginHost`` + ``device_ext.h`` — out-of-tree device plugin ABI
+  (reference paddle/phi/backends/device_ext.h:95)
+* ``ShmRing`` — shared-memory sample queue for the DataLoader
+  (reference paddle/fluid/framework/data_feed.cc blocking queue)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from paddle_tpu.core.native import build as _build
+
+
+def _load(name):
+    return ctypes.CDLL(_build.lib_path(name))
+
+
+# --------------------------------------------------------------------- store
+class TCPStoreServer:
+    def __init__(self, port=0):
+        self._lib = _load("libpt_store.so")
+        self._lib.tcpstore_server_start.restype = ctypes.c_void_p
+        self._lib.tcpstore_server_start.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        self._lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+        out_port = ctypes.c_int(0)
+        self._h = self._lib.tcpstore_server_start(port, ctypes.byref(out_port))
+        if not self._h:
+            raise RuntimeError("failed to start TCPStore server")
+        self.port = out_port.value
+
+    def stop(self):
+        if self._h:
+            self._lib.tcpstore_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client handle (reference Store API: set/get/add/wait)."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
+                 timeout=900):
+        self._lib = _load("libpt_store.so")
+        self._lib.tcpstore_client_connect.restype = ctypes.c_void_p
+        self._lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        self._lib.tcpstore_client_close.argtypes = [ctypes.c_void_p]
+        self._lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_char_p, ctypes.c_uint32]
+        self._lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_char_p, ctypes.c_uint32]
+        self._lib.tcpstore_add.restype = ctypes.c_int64
+        self._lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        self._lib.tcpstore_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_int64, ctypes.c_char_p,
+                                            ctypes.c_uint32, ctypes.POINTER(ctypes.c_int)]
+        self._lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        self._h = self._lib.tcpstore_client_connect(host.encode(), port)
+        if not self._h:
+            raise RuntimeError(f"cannot connect to TCPStore at {host}:{port}")
+        self.timeout = timeout
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.tcpstore_set(self._h, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError("tcpstore set failed")
+
+    def get(self, key, _cap=1 << 20):
+        buf = ctypes.create_string_buffer(_cap)
+        n = self._lib.tcpstore_get(self._h, key.encode(), buf, len(buf))
+        if n < 0:
+            raise KeyError(key)
+        if n > _cap:  # value larger than the buffer: retry with the exact size
+            return self.get(key, _cap=n)
+        return buf.raw[:n]
+
+    def add(self, key, delta):
+        v = self._lib.tcpstore_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError("tcpstore add failed")
+        return v
+
+    def wait(self, key, timeout_ms=None):
+        buf = ctypes.create_string_buffer(1 << 20)
+        out_len = ctypes.c_int(0)
+        t = int((timeout_ms if timeout_ms is not None else self.timeout * 1000))
+        rc = self._lib.tcpstore_wait(self._h, key.encode(), t, buf, len(buf),
+                                     ctypes.byref(out_len))
+        if rc != 0 or out_len.value < 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {t} ms")
+        if out_len.value > len(buf):  # truncated: the value is now set, re-get it
+            return self.get(key, _cap=out_len.value)
+        return buf.raw[:out_len.value]
+
+    def delete(self, key):
+        self._lib.tcpstore_delete(self._h, key.encode())
+
+    def close(self):
+        if self._h:
+            self._lib.tcpstore_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------ watchdog
+class Watchdog:
+    """Background hung-task detector (CommTaskManager analog)."""
+
+    def __init__(self):
+        self._lib = _load("libpt_store.so")
+        self._lib.watchdog_start.restype = ctypes.c_void_p
+        self._lib.watchdog_stop.argtypes = [ctypes.c_void_p]
+        self._lib.watchdog_task_start.restype = ctypes.c_int64
+        self._lib.watchdog_task_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                                  ctypes.c_int64]
+        self._lib.watchdog_task_end.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        self._lib.watchdog_poll_timeouts.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                                     ctypes.c_uint32]
+        self._h = self._lib.watchdog_start()
+
+    def task_start(self, name, timeout_ms):
+        return self._lib.watchdog_task_start(self._h, name.encode(), timeout_ms)
+
+    def task_end(self, task_id):
+        self._lib.watchdog_task_end(self._h, task_id)
+
+    def poll_timeouts(self):
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.watchdog_poll_timeouts(self._h, buf, len(buf))
+        if n == 0:
+            return []
+        return buf.value.decode().split(";")
+
+    def stop(self):
+        if self._h:
+            self._lib.watchdog_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------- plugin host
+class PluginHost:
+    """dlopen-based device plugin loader (DeviceManager registration path)."""
+
+    def __init__(self):
+        self._lib = _load("libpt_plugin_host.so")
+        self._lib.plugin_host_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                               ctypes.c_uint32]
+        self._lib.plugin_host_device_count.argtypes = [ctypes.c_char_p]
+        self._lib.plugin_host_memcpy_roundtrip.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        self._lib.plugin_host_allreduce_check.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t]
+
+    def load(self, so_path):
+        buf = ctypes.create_string_buffer(256)
+        rc = self._lib.plugin_host_load(so_path.encode(), buf, len(buf))
+        if rc != 0:
+            raise RuntimeError(f"plugin load failed ({rc}): {so_path}")
+        return buf.value.decode()
+
+    def count(self):
+        return self._lib.plugin_host_count()
+
+    def device_count(self, device_type):
+        return self._lib.plugin_host_device_count(device_type.encode())
+
+    def memcpy_roundtrip(self, device_type, data: bytes) -> bytes:
+        out = ctypes.create_string_buffer(len(data))
+        rc = self._lib.plugin_host_memcpy_roundtrip(device_type.encode(), data,
+                                                    out, len(data))
+        if rc != 0:
+            raise RuntimeError(f"plugin memcpy roundtrip failed ({rc})")
+        return out.raw
+
+    def allreduce_check(self, device_type, values):
+        import numpy as np
+
+        arr = np.asarray(values, np.float32)
+        out = np.zeros_like(arr)
+        rc = self._lib.plugin_host_allreduce_check(
+            device_type.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), arr.size)
+        if rc != 0:
+            raise RuntimeError(f"plugin allreduce check failed ({rc})")
+        return out
+
+
+def fake_cpu_plugin_path():
+    """The in-tree test-double plugin (fake_cpu_device.h analog)."""
+    return _build.lib_path("libpt_fake_cpu.so")
+
+
+# ------------------------------------------------------------------ shm ring
+class ShmRing:
+    """Cross-process byte-message ring over POSIX shared memory."""
+
+    def __init__(self, name, capacity=None, create=False):
+        self._lib = _load("libpt_shm.so")
+        self._lib.shm_ring_create.restype = ctypes.c_void_p
+        self._lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        self._lib.shm_ring_open.restype = ctypes.c_void_p
+        self._lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        self._lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_uint64]
+        self._lib.shm_ring_pop.restype = ctypes.c_int64
+        self._lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_uint64,
+                                           ctypes.POINTER(ctypes.c_uint64)]
+        self._lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        self._lib.shm_ring_destroy.argtypes = [ctypes.c_void_p]
+        if create:
+            self._h = self._lib.shm_ring_create(name.encode(), capacity or (64 << 20))
+        else:
+            self._h = self._lib.shm_ring_open(name.encode())
+        if not self._h:
+            raise RuntimeError(f"shm ring {'create' if create else 'open'} failed: {name}")
+        self.name = name
+
+    def push(self, payload: bytes):
+        rc = self._lib.shm_ring_push(self._h, payload, len(payload))
+        if rc == -1:
+            raise BrokenPipeError("ring closed")
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+
+    def pop(self, max_size=16 << 20):
+        buf = ctypes.create_string_buffer(max_size)
+        req = ctypes.c_uint64(0)
+        n = self._lib.shm_ring_pop(self._h, buf, max_size, ctypes.byref(req))
+        if n == -1:
+            raise EOFError("ring closed and drained")
+        if n == -3:
+            return self.pop(max_size=int(req.value))
+        return buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+
+    def destroy(self):
+        if self._h:
+            self._lib.shm_ring_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+__all__ = ["TCPStore", "TCPStoreServer", "Watchdog", "PluginHost", "ShmRing",
+           "fake_cpu_plugin_path"]

@@ -1,0 +1,57 @@
+"""Build the native runtime components with g++ (no pybind11 in this image;
+bindings are ctypes).  Invoked lazily on first import, cached by mtime."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(_HERE, "csrc")
+LIBDIR = os.path.join(_HERE, "lib")
+
+_TARGETS = {
+    "libpt_store.so": ["tcp_store.cc"],
+    "libpt_plugin_host.so": ["plugin_host.cc"],
+    "libpt_fake_cpu.so": ["fake_cpu_plugin.cc"],
+    "libpt_shm.so": ["shm_ring.cc"],
+}
+
+_FLAGS = ["-O2", "-fPIC", "-shared", "-std=c++17", "-pthread"]
+_EXTRA = {"libpt_plugin_host.so": ["-ldl"], "libpt_shm.so": ["-lrt"]}
+
+
+def _stale(target, sources):
+    tpath = os.path.join(LIBDIR, target)
+    if not os.path.exists(tpath):
+        return True
+    tmt = os.path.getmtime(tpath)
+    return any(os.path.getmtime(os.path.join(CSRC, s)) > tmt for s in sources)
+
+
+def build(force=False):
+    import fcntl
+
+    os.makedirs(LIBDIR, exist_ok=True)
+    built = []
+    # cross-process lock: concurrent importers must not race g++ -o on the
+    # same path (a CDLL of a half-written .so segfaults)
+    with open(os.path.join(LIBDIR, ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        for target, sources in _TARGETS.items():
+            if not force and not _stale(target, sources):
+                continue
+            tmp = os.path.join(LIBDIR, f".{target}.tmp.{os.getpid()}")
+            cmd = (["g++"] + _FLAGS + [os.path.join(CSRC, s) for s in sources]
+                   + ["-o", tmp] + _EXTRA.get(target, []))
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of {target} failed:\n{proc.stderr}")
+            os.replace(tmp, os.path.join(LIBDIR, target))
+            built.append(target)
+    return built
+
+
+def lib_path(name):
+    build()
+    return os.path.join(LIBDIR, name)

@@ -1,0 +1,95 @@
+/* Plug-in device C ABI — the extensibility story for non-TPU backends.
+ *
+ * Model: the reference's CustomDevice interface
+ * (paddle/phi/backends/device_ext.h:95 C_DeviceInterface, ~70 fn pointers).
+ * This TPU-native framework keeps the same out-of-tree contract: a plugin .so
+ * exports InitPlugin(PT_DeviceInterface*) and the host (plugin_host.cc)
+ * registers it with the DeviceManager; XCCL-style collective hooks let a
+ * plugin supply its own communication library.
+ */
+#ifndef PADDLE_TPU_DEVICE_EXT_H_
+#define PADDLE_TPU_DEVICE_EXT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PT_DEVICE_ABI_VERSION 1
+
+typedef enum { PT_SUCCESS = 0, PT_FAILED = 1 } PT_Status;
+
+typedef struct PT_Stream_st* PT_Stream;
+typedef struct PT_Event_st* PT_Event;
+
+typedef struct {
+  /* ------------------------------------------------ device control */
+  PT_Status (*init)(void);
+  PT_Status (*init_device)(int device);
+  PT_Status (*set_device)(int device);
+  PT_Status (*get_device)(int* device);
+  PT_Status (*deinit_device)(int device);
+  PT_Status (*finalize)(void);
+
+  /* ------------------------------------------------ streams/events */
+  PT_Status (*create_stream)(int device, PT_Stream* stream);
+  PT_Status (*destroy_stream)(int device, PT_Stream stream);
+  PT_Status (*synchronize_stream)(int device, PT_Stream stream);
+  PT_Status (*create_event)(int device, PT_Event* event);
+  PT_Status (*record_event)(int device, PT_Stream stream, PT_Event event);
+  PT_Status (*destroy_event)(int device, PT_Event event);
+  PT_Status (*synchronize_event)(int device, PT_Event event);
+
+  /* ------------------------------------------------ memory */
+  PT_Status (*device_malloc)(int device, void** ptr, size_t size);
+  PT_Status (*device_free)(int device, void* ptr);
+  PT_Status (*memory_copy_h2d)(int device, void* dst, const void* src, size_t n);
+  PT_Status (*memory_copy_d2h)(int device, void* dst, const void* src, size_t n);
+  PT_Status (*memory_copy_d2d)(int device, void* dst, const void* src, size_t n);
+  PT_Status (*device_memory_stats)(int device, size_t* total, size_t* free_mem);
+
+  /* ------------------------------------------------ info */
+  PT_Status (*get_device_count)(int* count);
+  PT_Status (*get_compute_capability)(int device, int* major, int* minor);
+
+  /* ------------------------------------------------ XCCL-style collectives */
+  PT_Status (*xccl_get_unique_id_size)(size_t* size);
+  PT_Status (*xccl_get_unique_id)(void* unique_id);
+  PT_Status (*xccl_comm_init_rank)(int nranks, void* unique_id, int rank,
+                                   void** comm);
+  PT_Status (*xccl_destroy_comm)(void* comm);
+  PT_Status (*xccl_all_reduce)(void* comm, void* in, void* out, size_t numel,
+                               int dtype, int red_op, PT_Stream stream);
+  PT_Status (*xccl_broadcast)(void* comm, void* buf, size_t numel, int dtype,
+                              int root, PT_Stream stream);
+  PT_Status (*xccl_all_gather)(void* comm, void* in, void* out, size_t numel,
+                               int dtype, PT_Stream stream);
+  PT_Status (*xccl_reduce_scatter)(void* comm, void* in, void* out, size_t numel,
+                                   int dtype, int red_op, PT_Stream stream);
+  PT_Status (*xccl_send)(void* comm, void* buf, size_t numel, int dtype,
+                         int peer, PT_Stream stream);
+  PT_Status (*xccl_recv)(void* comm, void* buf, size_t numel, int dtype,
+                         int peer, PT_Stream stream);
+
+  /* ------------------------------------------------ profiler hooks */
+  PT_Status (*profiler_initialize)(void);
+  PT_Status (*profiler_start_tracing)(void);
+  PT_Status (*profiler_stop_tracing)(void);
+  PT_Status (*profiler_collect_data)(char* buf, size_t cap, size_t* written);
+} PT_DeviceInterface;
+
+typedef struct {
+  size_t struct_size;
+  int abi_version;
+  const char* device_type; /* e.g. "fake_cpu" */
+  PT_DeviceInterface interface_;
+} PT_RuntimeParams;
+
+/* A plugin .so must export: void InitPlugin(PT_RuntimeParams*) */
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_DEVICE_EXT_H_ */

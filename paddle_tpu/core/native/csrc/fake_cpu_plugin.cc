@@ -1,0 +1,124 @@
+// FakeCPU plugin: in-tree test double for the plugin ABI (model: reference
+// paddle/phi/backends/custom/fake_cpu_device.h:225-242, DEVICE_TYPE "FakeCPU").
+// Device memory is host memory; collectives are single-rank identities.
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
+
+#include "device_ext.h"
+
+namespace {
+
+PT_Status ok_init(void) { return PT_SUCCESS; }
+PT_Status dev_noarg(int) { return PT_SUCCESS; }
+PT_Status get_dev(int* d) { *d = 0; return PT_SUCCESS; }
+
+PT_Status create_stream(int, PT_Stream* s) { *s = nullptr; return PT_SUCCESS; }
+PT_Status destroy_stream(int, PT_Stream) { return PT_SUCCESS; }
+PT_Status sync_stream(int, PT_Stream) { return PT_SUCCESS; }
+PT_Status create_event(int, PT_Event* e) { *e = nullptr; return PT_SUCCESS; }
+PT_Status record_event(int, PT_Stream, PT_Event) { return PT_SUCCESS; }
+PT_Status destroy_event(int, PT_Event) { return PT_SUCCESS; }
+PT_Status sync_event(int, PT_Event) { return PT_SUCCESS; }
+
+PT_Status dmalloc(int, void** p, size_t n) {
+  *p = std::malloc(n);
+  return *p ? PT_SUCCESS : PT_FAILED;
+}
+PT_Status dfree(int, void* p) { std::free(p); return PT_SUCCESS; }
+PT_Status copy(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return PT_SUCCESS;
+}
+PT_Status mem_stats(int, size_t* total, size_t* free_mem) {
+  *total = 16ull << 30;
+  *free_mem = 8ull << 30;
+  return PT_SUCCESS;
+}
+PT_Status dev_count(int* c) { *c = 4; return PT_SUCCESS; }
+PT_Status capability(int, int* maj, int* min) { *maj = 1; *min = 0; return PT_SUCCESS; }
+
+PT_Status uid_size(size_t* s) { *s = 16; return PT_SUCCESS; }
+PT_Status uid(void* p) { std::memset(p, 0x42, 16); return PT_SUCCESS; }
+PT_Status comm_init(int, void*, int, void** comm) {
+  *comm = reinterpret_cast<void*>(0x1);
+  return PT_SUCCESS;
+}
+PT_Status comm_destroy(void*) { return PT_SUCCESS; }
+
+size_t dtype_size(int dtype) {
+  switch (dtype) {
+    case 0: return 4;  // f32
+    case 1: return 2;  // f16/bf16
+    case 2: return 8;  // f64/i64
+    default: return 4;
+  }
+}
+
+PT_Status allreduce(void*, void* in, void* out, size_t numel, int dtype, int,
+                    PT_Stream) {
+  std::memcpy(out, in, numel * dtype_size(dtype));  // 1-rank: identity
+  return PT_SUCCESS;
+}
+PT_Status bcast(void*, void*, size_t, int, int, PT_Stream) { return PT_SUCCESS; }
+PT_Status allgather(void*, void* in, void* out, size_t numel, int dtype, PT_Stream) {
+  std::memcpy(out, in, numel * dtype_size(dtype));
+  return PT_SUCCESS;
+}
+PT_Status reducescatter(void*, void* in, void* out, size_t numel, int dtype, int,
+                        PT_Stream) {
+  std::memcpy(out, in, numel * dtype_size(dtype));
+  return PT_SUCCESS;
+}
+PT_Status sendrecv(void*, void*, size_t, int, int, PT_Stream) { return PT_SUCCESS; }
+
+PT_Status prof_noarg(void) { return PT_SUCCESS; }
+PT_Status prof_collect(char* buf, size_t cap, size_t* written) {
+  const char* msg = "{\"events\":[]}";
+  std::snprintf(buf, cap, "%s", msg);
+  *written = std::strlen(msg);
+  return PT_SUCCESS;
+}
+
+}  // namespace
+
+extern "C" void InitPlugin(PT_RuntimeParams* params) {
+  params->abi_version = PT_DEVICE_ABI_VERSION;
+  params->device_type = "fake_cpu";
+  auto& i = params->interface_;
+  i.init = ok_init;
+  i.init_device = dev_noarg;
+  i.set_device = dev_noarg;
+  i.get_device = get_dev;
+  i.deinit_device = dev_noarg;
+  i.finalize = ok_init;
+  i.create_stream = create_stream;
+  i.destroy_stream = destroy_stream;
+  i.synchronize_stream = sync_stream;
+  i.create_event = create_event;
+  i.record_event = record_event;
+  i.destroy_event = destroy_event;
+  i.synchronize_event = sync_event;
+  i.device_malloc = dmalloc;
+  i.device_free = dfree;
+  i.memory_copy_h2d = copy;
+  i.memory_copy_d2h = copy;
+  i.memory_copy_d2d = copy;
+  i.device_memory_stats = mem_stats;
+  i.get_device_count = dev_count;
+  i.get_compute_capability = capability;
+  i.xccl_get_unique_id_size = uid_size;
+  i.xccl_get_unique_id = uid;
+  i.xccl_comm_init_rank = comm_init;
+  i.xccl_destroy_comm = comm_destroy;
+  i.xccl_all_reduce = allreduce;
+  i.xccl_broadcast = bcast;
+  i.xccl_all_gather = allgather;
+  i.xccl_reduce_scatter = reducescatter;
+  i.xccl_send = sendrecv;
+  i.xccl_recv = sendrecv;
+  i.profiler_initialize = prof_noarg;
+  i.profiler_start_tracing = prof_noarg;
+  i.profiler_stop_tracing = prof_noarg;
+  i.profiler_collect_data = prof_collect;
+}

@@ -1,0 +1,113 @@
+// Plugin host: dlopen a device plugin, call InitPlugin, expose its interface
+// through a flat C API for the Python DeviceManager.
+//
+// Model: LoadCustomRuntimeLib (reference
+// paddle/phi/backends/custom/custom_device.cc:1072-1097) + DeviceManager
+// registration (device_manager.h:136).
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "device_ext.h"
+
+namespace {
+struct Loaded {
+  void* dl = nullptr;
+  PT_RuntimeParams params{};
+};
+std::map<std::string, Loaded>& registry() {
+  static std::map<std::string, Loaded> r;
+  return r;
+}
+std::mutex g_mu;
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; fills type_buf with the registered device type.
+int plugin_host_load(const char* so_path, char* type_buf, uint32_t cap) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  void* dl = ::dlopen(so_path, RTLD_NOW | RTLD_LOCAL);
+  if (!dl) return -1;
+  using InitFn = void (*)(PT_RuntimeParams*);
+  auto init = reinterpret_cast<InitFn>(::dlsym(dl, "InitPlugin"));
+  if (!init) {
+    ::dlclose(dl);
+    return -2;
+  }
+  Loaded l;
+  l.dl = dl;
+  l.params.struct_size = sizeof(PT_RuntimeParams);
+  init(&l.params);
+  if (l.params.abi_version != PT_DEVICE_ABI_VERSION || !l.params.device_type) {
+    ::dlclose(dl);
+    return -3;
+  }
+  std::snprintf(type_buf, cap, "%s", l.params.device_type);
+  registry()[l.params.device_type] = l;
+  if (l.params.interface_.init) l.params.interface_.init();
+  return 0;
+}
+
+int plugin_host_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int>(registry().size());
+}
+
+int plugin_host_device_count(const char* type) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = registry().find(type);
+  if (it == registry().end() || !it->second.params.interface_.get_device_count)
+    return -1;
+  int n = 0;
+  if (it->second.params.interface_.get_device_count(&n) != PT_SUCCESS) return -1;
+  return n;
+}
+
+// Round-trips `n` bytes host->device->host through plugin memory ops; the
+// plugin-ABI conformance check (reference fake_cpu_device.h test double).
+int plugin_host_memcpy_roundtrip(const char* type, const uint8_t* src,
+                                 uint8_t* dst, size_t n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = registry().find(type);
+  if (it == registry().end()) return -1;
+  auto& ifc = it->second.params.interface_;
+  if (!ifc.device_malloc || !ifc.memory_copy_h2d || !ifc.memory_copy_d2h ||
+      !ifc.device_free)
+    return -2;
+  void* dev = nullptr;
+  if (ifc.device_malloc(0, &dev, n) != PT_SUCCESS) return -3;
+  if (ifc.memory_copy_h2d(0, dev, src, n) != PT_SUCCESS) return -4;
+  if (ifc.memory_copy_d2h(0, dst, dev, n) != PT_SUCCESS) return -5;
+  ifc.device_free(0, dev);
+  return 0;
+}
+
+// Runs the plugin's xccl_all_reduce on a single-rank comm with float32 sum —
+// exercises the collective hooks without hardware.
+int plugin_host_allreduce_check(const char* type, const float* in, float* out,
+                                size_t numel) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = registry().find(type);
+  if (it == registry().end()) return -1;
+  auto& ifc = it->second.params.interface_;
+  if (!ifc.xccl_get_unique_id || !ifc.xccl_comm_init_rank || !ifc.xccl_all_reduce)
+    return -2;
+  size_t id_size = 0;
+  ifc.xccl_get_unique_id_size(&id_size);
+  std::string uid(id_size, '\0');
+  ifc.xccl_get_unique_id(uid.data());
+  void* comm = nullptr;
+  if (ifc.xccl_comm_init_rank(1, uid.data(), 0, &comm) != PT_SUCCESS) return -3;
+  int rc = ifc.xccl_all_reduce(comm, const_cast<float*>(in), out, numel,
+                               /*dtype=f32*/ 0, /*sum*/ 0, nullptr);
+  if (ifc.xccl_destroy_comm) ifc.xccl_destroy_comm(comm);
+  return rc == PT_SUCCESS ? 0 : -4;
+}
+
+}  // extern "C"

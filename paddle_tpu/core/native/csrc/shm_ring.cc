@@ -1,0 +1,177 @@
+// Shared-memory ring buffer for multiprocess DataLoader sample transfer.
+//
+// Model: the reference's C++ data-feed path (paddle/fluid/framework/data_feed.cc
+// blocking queues) — worker processes serialize batches into a lock-protected
+// POSIX shared-memory ring; the trainer process pops without a pickle pipe hop.
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct RingHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint64_t capacity;   // payload bytes
+  uint64_t head;       // write offset
+  uint64_t tail;       // read offset
+  uint64_t used;       // bytes in use
+  uint32_t closed;
+};
+
+struct Ring {
+  RingHeader* hdr = nullptr;
+  uint8_t* data = nullptr;
+  std::string name;
+  bool owner = false;
+  size_t total = 0;
+};
+
+// record: u64 length | payload
+void write_bytes(Ring* r, uint64_t off, const void* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t first = std::min(n, cap - (off % cap));
+  std::memcpy(r->data + (off % cap), src, first);
+  if (n > first) std::memcpy(r->data, static_cast<const uint8_t*>(src) + first, n - first);
+}
+
+void read_bytes(Ring* r, uint64_t off, void* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t first = std::min(n, cap - (off % cap));
+  std::memcpy(dst, r->data + (off % cap), first);
+  if (n > first) std::memcpy(static_cast<uint8_t*>(dst) + first, r->data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, uint64_t capacity) {
+  auto* r = new Ring();
+  r->name = name;
+  r->owner = true;
+  r->total = sizeof(RingHeader) + capacity;
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0 || ::ftruncate(fd, static_cast<off_t>(r->total)) != 0) {
+    if (fd >= 0) ::close(fd);
+    delete r;
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, r->total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    delete r;
+    return nullptr;
+  }
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = reinterpret_cast<uint8_t*>(r->hdr + 1);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&r->hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&r->hdr->not_full, &ca);
+  pthread_cond_init(&r->hdr->not_empty, &ca);
+  r->hdr->capacity = capacity;
+  r->hdr->head = r->hdr->tail = r->hdr->used = 0;
+  r->hdr->closed = 0;
+  return r;
+}
+
+void* shm_ring_open(const char* name) {
+  auto* r = new Ring();
+  r->name = name;
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) {
+    delete r;
+    return nullptr;
+  }
+  struct stat st;
+  ::fstat(fd, &st);
+  r->total = static_cast<size_t>(st.st_size);
+  void* mem = ::mmap(nullptr, r->total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    delete r;
+    return nullptr;
+  }
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = reinterpret_cast<uint8_t*>(r->hdr + 1);
+  return r;
+}
+
+// 0 ok, -1 closed, -2 message too large
+int shm_ring_push(void* h, const uint8_t* payload, uint64_t n) {
+  auto* r = static_cast<Ring*>(h);
+  uint64_t need = n + 8;
+  if (need > r->hdr->capacity) return -2;
+  pthread_mutex_lock(&r->hdr->mu);
+  while (r->hdr->capacity - r->hdr->used < need && !r->hdr->closed)
+    pthread_cond_wait(&r->hdr->not_full, &r->hdr->mu);
+  if (r->hdr->closed) {
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -1;
+  }
+  write_bytes(r, r->hdr->head, &n, 8);
+  write_bytes(r, r->hdr->head + 8, payload, n);
+  r->hdr->head += need;
+  r->hdr->used += need;
+  pthread_cond_signal(&r->hdr->not_empty);
+  pthread_mutex_unlock(&r->hdr->mu);
+  return 0;
+}
+
+// Returns payload length (>=0), -1 if closed+empty, -3 if buffer too small
+// (then *required is set and the record is left in place).
+int64_t shm_ring_pop(void* h, uint8_t* buf, uint64_t cap, uint64_t* required) {
+  auto* r = static_cast<Ring*>(h);
+  pthread_mutex_lock(&r->hdr->mu);
+  while (r->hdr->used == 0 && !r->hdr->closed)
+    pthread_cond_wait(&r->hdr->not_empty, &r->hdr->mu);
+  if (r->hdr->used == 0 && r->hdr->closed) {
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -1;
+  }
+  uint64_t n;
+  read_bytes(r, r->hdr->tail, &n, 8);
+  if (n > cap) {
+    if (required) *required = n;
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -3;
+  }
+  read_bytes(r, r->hdr->tail + 8, buf, n);
+  r->hdr->tail += n + 8;
+  r->hdr->used -= n + 8;
+  pthread_cond_signal(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+  return static_cast<int64_t>(n);
+}
+
+void shm_ring_close(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  pthread_mutex_lock(&r->hdr->mu);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void shm_ring_destroy(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  if (!r) return;
+  ::munmap(r->hdr, r->total);
+  if (r->owner) ::shm_unlink(r->name.c_str());
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,417 @@
+// TCPStore: native KV rendezvous server/client with wait/barrier and a
+// watchdog for hung waits.
+//
+// TPU-native counterpart of the reference's C++ TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, store.h:24) — the
+// bootstrap KV used by init_parallel_env before jax.distributed takes over.
+// Exposed as a C API for ctypes (no pybind11 in this image).
+//
+// Protocol (length-prefixed): u8 op | u32 klen | key | u32 vlen | value
+//   ops: 0=SET 1=GET 2=ADD(i64 delta) 3=WAIT 4=DELETE 5=BARRIER_ENTER
+// Replies: u8 status (0=ok 1=missing/timeout) | u32 vlen | value
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> kv;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // live connections, shut down on stop
+  std::mutex conn_mu;
+  int port = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void reply(int fd, uint8_t status, const std::vector<uint8_t>& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  write_full(fd, &status, 1);
+  write_full(fd, &vlen, 4);
+  if (vlen) write_full(fd, val.data(), vlen);
+}
+
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    s->conn_fds.push_back(fd);
+  }
+  for (;;) {
+    uint8_t op;
+    uint32_t klen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, key.data(), klen)) break;
+    uint32_t vlen;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+    switch (op) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv[key] = val;
+        }
+        s->cv.notify_all();
+        reply(fd, 0, {});
+        break;
+      }
+      case 1: {  // GET
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->kv.find(key);
+        if (it == s->kv.end())
+          reply(fd, 1, {});
+        else
+          reply(fd, 0, it->second);
+        break;
+      }
+      case 2: {  // ADD: value = i64 delta; returns new value as i64
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->kv.find(key);
+          if (it != s->kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::vector<uint8_t> nv(8);
+          std::memcpy(nv.data(), &cur, 8);
+          s->kv[key] = nv;
+        }
+        s->cv.notify_all();
+        std::vector<uint8_t> out(8);
+        std::memcpy(out.data(), &cur, 8);
+        reply(fd, 0, out);
+        break;
+      }
+      case 3: {  // WAIT: value = i64 timeout_ms
+        int64_t timeout_ms = 0;
+        if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> lk(s->mu);
+        bool ok = s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return s->kv.count(key) > 0 || s->stop.load();
+        });
+        if (ok && s->kv.count(key))
+          reply(fd, 0, s->kv[key]);
+        else
+          reply(fd, 1, {});  // timeout — the comm-watchdog signal
+        break;
+      }
+      case 4: {  // DELETE
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv.erase(key);
+        reply(fd, 0, {});
+        break;
+      }
+      default:
+        reply(fd, 1, {});
+        break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (>0) or 0 on failure; *out_port gets the bound port.
+void* tcpstore_server_start(int port, int* out_port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = s->port;
+  s->accept_thread = std::thread([s] {
+    while (!s->stop.load()) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      s->workers.emplace_back(serve_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    // unblock worker threads stuck in recv() on still-open client connections
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int cfd : s->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+struct Client {
+  int fd = -1;
+};
+
+void* tcpstore_client_connect(const char* host, int port) {
+  auto* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // not a numeric address: resolve the hostname (launcher sets MASTER_ADDR
+    // to a worker hostname on real clusters)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(c->fd);
+      delete c;
+      return nullptr;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
+  if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+void tcpstore_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c) return;
+  ::close(c->fd);
+  delete c;
+}
+
+static bool request(Client* c, uint8_t op, const char* key, const void* val,
+                    uint32_t vlen, std::vector<uint8_t>* out) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &op, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen) || !write_full(c->fd, &vlen, 4))
+    return false;
+  if (vlen && !write_full(c->fd, val, vlen)) return false;
+  uint8_t status;
+  uint32_t rlen;
+  if (!read_full(c->fd, &status, 1) || !read_full(c->fd, &rlen, 4)) return false;
+  out->resize(rlen);
+  if (rlen && !read_full(c->fd, out->data(), rlen)) return false;
+  return status == 0;
+}
+
+int tcpstore_set(void* h, const char* key, const uint8_t* val, uint32_t vlen) {
+  std::vector<uint8_t> out;
+  return request(static_cast<Client*>(h), 0, key, val, vlen, &out) ? 0 : -1;
+}
+
+// Returns length (>=0) or -1 if missing; copies at most cap bytes into buf.
+int tcpstore_get(void* h, const char* key, uint8_t* buf, uint32_t cap) {
+  std::vector<uint8_t> out;
+  if (!request(static_cast<Client*>(h), 1, key, nullptr, 0, &out)) return -1;
+  uint32_t n = static_cast<uint32_t>(out.size());
+  std::memcpy(buf, out.data(), n < cap ? n : cap);
+  return static_cast<int>(n);
+}
+
+int64_t tcpstore_add(void* h, const char* key, int64_t delta) {
+  std::vector<uint8_t> out;
+  if (!request(static_cast<Client*>(h), 2, key, &delta, 8, &out) || out.size() != 8)
+    return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+// 0 on success, -1 on timeout (watchdog fires at the Python layer)
+int tcpstore_wait(void* h, const char* key, int64_t timeout_ms, uint8_t* buf,
+                  uint32_t cap, int* out_len) {
+  std::vector<uint8_t> out;
+  if (!request(static_cast<Client*>(h), 3, key, &timeout_ms, 8, &out)) return -1;
+  uint32_t n = static_cast<uint32_t>(out.size());
+  std::memcpy(buf, out.data(), n < cap ? n : cap);
+  if (out_len) *out_len = static_cast<int>(n);
+  return 0;
+}
+
+int tcpstore_delete(void* h, const char* key) {
+  std::vector<uint8_t> out;
+  return request(static_cast<Client*>(h), 4, key, nullptr, 0, &out) ? 0 : -1;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Comm watchdog: background thread tracking started collective tasks with
+// deadlines (reference CommTaskManager, comm_task_manager.h:37-57 + comm_task.h
+// IsTimeout).  On timeout it records the hung task; the Python layer polls.
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Watchdog {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop{false};
+  struct Task {
+    int64_t id;
+    std::string name;
+    std::chrono::steady_clock::time_point deadline;
+    bool done = false;
+    bool timed_out = false;
+  };
+  std::map<int64_t, Task> tasks;
+  std::thread thread;
+  std::atomic<int64_t> next_id{1};
+  std::vector<std::string> timeouts;  // names of timed-out tasks
+};
+
+void watchdog_loop(Watchdog* w) {
+  std::unique_lock<std::mutex> lk(w->mu);
+  while (!w->stop.load()) {
+    w->cv.wait_for(lk, std::chrono::milliseconds(50));
+    auto now = std::chrono::steady_clock::now();
+    for (auto it = w->tasks.begin(); it != w->tasks.end();) {
+      auto& t = it->second;
+      if (t.done) {
+        it = w->tasks.erase(it);  // bounded memory in long runs
+        continue;
+      }
+      if (!t.timed_out && now > t.deadline) {
+        t.timed_out = true;
+        w->timeouts.push_back(t.name);
+      }
+      ++it;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* watchdog_start() {
+  auto* w = new Watchdog();
+  w->thread = std::thread(watchdog_loop, w);
+  return w;
+}
+
+void watchdog_stop(void* h) {
+  auto* w = static_cast<Watchdog*>(h);
+  if (!w) return;
+  w->stop.store(true);
+  w->cv.notify_all();
+  if (w->thread.joinable()) w->thread.join();
+  delete w;
+}
+
+int64_t watchdog_task_start(void* h, const char* name, int64_t timeout_ms) {
+  auto* w = static_cast<Watchdog*>(h);
+  int64_t id = w->next_id.fetch_add(1);
+  std::lock_guard<std::mutex> lk(w->mu);
+  w->tasks[id] = {id, name,
+                  std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms),
+                  false, false};
+  return id;
+}
+
+void watchdog_task_end(void* h, int64_t id) {
+  auto* w = static_cast<Watchdog*>(h);
+  std::lock_guard<std::mutex> lk(w->mu);
+  auto it = w->tasks.find(id);
+  if (it != w->tasks.end()) it->second.done = true;
+}
+
+// Copies up to cap bytes of ';'-joined hung-task names; returns count.
+int watchdog_poll_timeouts(void* h, char* buf, uint32_t cap) {
+  auto* w = static_cast<Watchdog*>(h);
+  std::lock_guard<std::mutex> lk(w->mu);
+  std::string joined;
+  for (auto& n : w->timeouts) {
+    if (!joined.empty()) joined += ';';
+    joined += n;
+  }
+  int count = static_cast<int>(w->timeouts.size());
+  w->timeouts.clear();
+  std::snprintf(buf, cap, "%s", joined.c_str());
+  return count;
+}
+
+}  // extern "C"

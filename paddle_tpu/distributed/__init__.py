@@ -6,8 +6,8 @@ SPMD rules → GSPMD propagation)."""
 from __future__ import annotations
 
 from paddle_tpu.distributed.parallel_env import (  # noqa: F401
-    ParallelEnv, barrier, get_rank, get_world_size, init_parallel_env, is_initialized,
-    world_mesh,
+    ParallelEnv, barrier, create_tcp_store, destroy_tcp_store, get_rank,
+    get_world_size, init_parallel_env, is_initialized, world_mesh,
 )
 from paddle_tpu.distributed.collective import (  # noqa: F401
     Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,

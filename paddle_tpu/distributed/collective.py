@@ -395,3 +395,57 @@ def batch_isend_irecv(p2p_op_list):
 
 def barrier(group=None):
     _env.barrier(group if group is not None else None)
+
+
+# ---------------------------------------------------------------------------------
+# Comm watchdog (native): hung-collective detection over the C++ watchdog thread
+# (reference CommTaskManager, phi/core/distributed/collective/comm_task_manager.h).
+# enable_comm_watchdog() wraps every eager collective in a deadline-tracked task;
+# poll_comm_timeouts() surfaces names of collectives that exceeded their deadline.
+# ---------------------------------------------------------------------------------
+_WATCHDOG = {"wd": None, "timeout_ms": 30 * 60 * 1000}
+
+
+def enable_comm_watchdog(timeout_s=1800):
+    from paddle_tpu.core.native import Watchdog
+
+    if _WATCHDOG["wd"] is None:
+        _WATCHDOG["wd"] = Watchdog()
+    _WATCHDOG["timeout_ms"] = int(timeout_s * 1000)
+    return _WATCHDOG["wd"]
+
+
+def disable_comm_watchdog():
+    if _WATCHDOG["wd"] is not None:
+        _WATCHDOG["wd"].stop()
+        _WATCHDOG["wd"] = None
+
+
+def poll_comm_timeouts():
+    if _WATCHDOG["wd"] is None:
+        return []
+    return _WATCHDOG["wd"].poll_timeouts()
+
+
+def _watched(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        wd = _WATCHDOG["wd"]
+        if wd is None:
+            return fn(*args, **kwargs)
+        tid = wd.task_start(fn.__name__, _WATCHDOG["timeout_ms"])
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            wd.task_end(tid)
+
+    return wrapper
+
+
+for _name in ("all_reduce", "reduce", "all_gather", "broadcast", "scatter",
+              "reduce_scatter", "all_to_all", "all_to_all_single", "send",
+              "recv", "barrier"):
+    globals()[_name] = _watched(globals()[_name])
+del _name

@@ -141,3 +141,63 @@ class ParallelEnv:
     @property
     def current_endpoint(self):
         return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+_STORE = {"server": None, "client": None}
+
+
+def create_tcp_store(master_addr=None, master_port=None, is_master=None,
+                     world_size=None, timeout=900):
+    """Framework-level KV rendezvous on the native C++ TCPStore (reference
+    python/paddle/distributed/parallel.py:921 spawning phi TCPStore).  Rank 0
+    hosts the server; everyone gets a connected client."""
+    from paddle_tpu.core.native import TCPStore, TCPStoreServer
+
+    if _STORE["client"] is not None:
+        return _STORE["client"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if is_master is None:
+        is_master = rank == 0
+    master_addr = master_addr or os.environ.get("MASTER_ADDR", "127.0.0.1")
+    master_port = int(master_port or os.environ.get("MASTER_PORT", "0") or 0)
+    if is_master:
+        _STORE["server"] = TCPStoreServer(port=master_port)
+        master_port = _STORE["server"].port
+        # publish the actually-bound port (setdefault would keep a stale '0')
+        os.environ["MASTER_PORT"] = str(master_port)
+    _STORE["client"] = TCPStore(host=master_addr, port=master_port,
+                                is_master=is_master,
+                                world_size=world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                                timeout=timeout)
+    return _STORE["client"]
+
+
+def destroy_tcp_store():
+    if _STORE["client"] is not None:
+        _STORE["client"].close()
+        _STORE["client"] = None
+    if _STORE["server"] is not None:
+        _STORE["server"].stop()
+        _STORE["server"] = None
+
+
+def _watchdog_barrier(orig):
+    import functools
+
+    @functools.wraps(orig)
+    def wrapper(*a, **kw):
+        from paddle_tpu.distributed import collective as _coll
+
+        wd = _coll._WATCHDOG["wd"]
+        if wd is None:
+            return orig(*a, **kw)
+        tid = wd.task_start("barrier", _coll._WATCHDOG["timeout_ms"])
+        try:
+            return orig(*a, **kw)
+        finally:
+            wd.task_end(tid)
+
+    return wrapper
+
+
+barrier = _watchdog_barrier(barrier)

@@ -1,0 +1,189 @@
+"""Native C++ runtime tests: TCPStore, watchdog, plugin ABI, shm ring
+(reference test model: test/custom_runtime/test_custom_cpu_plugin.py and the
+TCPStore C++ tests)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.native import (
+    PluginHost, ShmRing, TCPStore, TCPStoreServer, Watchdog, fake_cpu_plugin_path,
+)
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        srv = TCPStoreServer()
+        try:
+            a = TCPStore(port=srv.port)
+            b = TCPStore(port=srv.port)
+            a.set("k", b"v1")
+            assert b.get("k") == b"v1"
+            assert a.add("counter", 2) == 2
+            assert b.add("counter", 3) == 5
+            a.delete("k")
+            with pytest.raises(KeyError):
+                b.get("k")
+        finally:
+            srv.stop()
+
+    def test_wait_and_timeout(self):
+        srv = TCPStoreServer()
+        try:
+            a = TCPStore(port=srv.port)
+            b = TCPStore(port=srv.port)
+            threading.Timer(0.2, lambda: a.set("late", b"x")).start()
+            assert b.wait("late", 5000) == b"x"
+            with pytest.raises(TimeoutError):
+                b.wait("missing", 200)
+        finally:
+            srv.stop()
+
+    def test_cross_process_rendezvous(self):
+        # real subprocesses (not mp.spawn: it re-imports pytest's __main__)
+        import subprocess
+        import sys
+
+        srv = TCPStoreServer()
+        script = """
+import sys
+sys.path.insert(0, {repo!r})
+from paddle_tpu.core.native import TCPStore
+rank = int(sys.argv[1]); port = int(sys.argv[2])
+c = TCPStore(port=port)
+if rank == 0:
+    c.set("rank0", b"0")
+    got = c.wait("rank1", 15000)
+else:
+    got = c.wait("rank0", 15000)
+    c.set("rank1", b"1")
+print("saw", got.decode())
+"""
+        script = script.format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            p0 = subprocess.Popen([sys.executable, "-c", script, "0", str(srv.port)],
+                                  stdout=subprocess.PIPE, text=True)
+            p1 = subprocess.Popen([sys.executable, "-c", script, "1", str(srv.port)],
+                                  stdout=subprocess.PIPE, text=True)
+            out0, _ = p0.communicate(timeout=60)
+            out1, _ = p1.communicate(timeout=60)
+            assert p0.returncode == 0 and "saw 1" in out0
+            assert p1.returncode == 0 and "saw 0" in out1
+        finally:
+            srv.stop()
+
+    def test_parallel_env_store_helper(self):
+        import paddle_tpu.distributed as dist
+
+        os.environ["MASTER_PORT"] = "0"
+        store = dist.create_tcp_store()
+        try:
+            store.set("x", b"y")
+            assert store.get("x") == b"y"
+        finally:
+            dist.destroy_tcp_store()
+            os.environ.pop("MASTER_PORT", None)
+
+
+class TestWatchdog:
+    def test_timeout_detection(self):
+        w = Watchdog()
+        try:
+            slow = w.task_start("hung_allreduce", 100)
+            fast = w.task_start("quick_bcast", 5000)
+            w.task_end(fast)
+            time.sleep(0.3)
+            hung = w.poll_timeouts()
+            assert hung == ["hung_allreduce"]
+            assert w.poll_timeouts() == []  # drained
+        finally:
+            w.stop()
+
+    def test_collective_integration(self):
+        import jax
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        dist.collective.enable_comm_watchdog(timeout_s=600)
+        try:
+            t = paddle.to_tensor(np.ones(4, "float32"))
+            dist.all_reduce(t)
+            assert dist.collective.poll_comm_timeouts() == []
+        finally:
+            dist.collective.disable_comm_watchdog()
+
+
+class TestPluginABI:
+    def test_load_and_conformance(self):
+        host = PluginHost()
+        dtype = host.load(fake_cpu_plugin_path())
+        assert dtype == "fake_cpu"
+        assert host.device_count(dtype) == 4
+        data = os.urandom(4096)
+        assert host.memcpy_roundtrip(dtype, data) == data
+        out = host.allreduce_check(dtype, [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(out, [1.0, 2.0, 3.0])
+
+    def test_bad_plugin_rejected(self):
+        host = PluginHost()
+        with pytest.raises(RuntimeError):
+            host.load("/nonexistent/plugin.so")
+
+
+class TestShmRing:
+    def test_roundtrip_same_process(self):
+        r = ShmRing(f"/pt_ring_{os.getpid()}", capacity=1 << 16, create=True)
+        try:
+            r.push(b"hello")
+            r.push(b"A" * 10000)
+            assert r.pop() == b"hello"
+            assert len(r.pop()) == 10000
+        finally:
+            r.destroy()
+
+    def test_wraparound(self):
+        r = ShmRing(f"/pt_wrap_{os.getpid()}", capacity=1 << 12, create=True)
+        try:
+            for i in range(50):
+                msg = bytes([i % 256]) * 500
+                r.push(msg)
+                assert r.pop() == msg
+        finally:
+            r.destroy()
+
+    def test_cross_process_producer(self):
+        import subprocess
+        import sys
+
+        name = f"/pt_xproc_{os.getpid()}"
+        r = ShmRing(name, capacity=1 << 20, create=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = f"""
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from paddle_tpu.core.native import ShmRing
+w = ShmRing({name!r}, create=False)
+for i in range(20):
+    w.push(np.full(1000, i, np.float32).tobytes())
+w.close()
+"""
+        try:
+            p = subprocess.Popen([sys.executable, "-c", script])
+            # wait for the producer so a failed child can't deadlock pop()
+            assert p.wait(timeout=60) == 0
+            got = []
+            for _ in range(20):
+                arr = np.frombuffer(r.pop(), np.float32)
+                got.append(int(arr[0]))
+                assert (arr == arr[0]).all()
+            assert got == list(range(20))
+            with pytest.raises(EOFError):
+                r.pop()
+        finally:
+            r.destroy()
