@@ -69,21 +69,28 @@ class TCPStore:
                                             ctypes.c_int64, ctypes.c_char_p,
                                             ctypes.c_uint32, ctypes.POINTER(ctypes.c_int)]
         self._lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        self._host, self._port = host, port
         self._h = self._lib.tcpstore_client_connect(host.encode(), port)
         if not self._h:
             raise RuntimeError(f"cannot connect to TCPStore at {host}:{port}")
         self.timeout = timeout
+        # one request/response in flight per connection: serialize callers
+        import threading
+
+        self._lock = threading.Lock()
 
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        rc = self._lib.tcpstore_set(self._h, key.encode(), value, len(value))
+        with self._lock:
+            rc = self._lib.tcpstore_set(self._h, key.encode(), value, len(value))
         if rc != 0:
             raise RuntimeError("tcpstore set failed")
 
     def get(self, key, _cap=1 << 20):
         buf = ctypes.create_string_buffer(_cap)
-        n = self._lib.tcpstore_get(self._h, key.encode(), buf, len(buf))
+        with self._lock:
+            n = self._lib.tcpstore_get(self._h, key.encode(), buf, len(buf))
         if n < 0:
             raise KeyError(key)
         if n > _cap:  # value larger than the buffer: retry with the exact size
@@ -91,17 +98,27 @@ class TCPStore:
         return buf.raw[:n]
 
     def add(self, key, delta):
-        v = self._lib.tcpstore_add(self._h, key.encode(), delta)
+        with self._lock:
+            v = self._lib.tcpstore_add(self._h, key.encode(), delta)
         if v == -(2 ** 63):
             raise RuntimeError("tcpstore add failed")
         return v
 
     def wait(self, key, timeout_ms=None):
+        # wait blocks server-side for up to the timeout — run it on a dedicated
+        # connection so it cannot starve set/get/add from other threads (e.g.
+        # the ElasticManager heartbeat) behind this client's lock
         buf = ctypes.create_string_buffer(1 << 20)
         out_len = ctypes.c_int(0)
         t = int((timeout_ms if timeout_ms is not None else self.timeout * 1000))
-        rc = self._lib.tcpstore_wait(self._h, key.encode(), t, buf, len(buf),
-                                     ctypes.byref(out_len))
+        h = self._lib.tcpstore_client_connect(self._host.encode(), self._port)
+        if not h:
+            raise RuntimeError(f"cannot connect to TCPStore at {self._host}:{self._port}")
+        try:
+            rc = self._lib.tcpstore_wait(h, key.encode(), t, buf, len(buf),
+                                         ctypes.byref(out_len))
+        finally:
+            self._lib.tcpstore_client_close(h)
         if rc != 0 or out_len.value < 0:
             raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {t} ms")
         if out_len.value > len(buf):  # truncated: the value is now set, re-get it
@@ -109,7 +126,8 @@ class TCPStore:
         return buf.raw[:out_len.value]
 
     def delete(self, key):
-        self._lib.tcpstore_delete(self._h, key.encode())
+        with self._lock:
+            self._lib.tcpstore_delete(self._h, key.encode())
 
     def close(self):
         if self._h:

@@ -127,3 +127,60 @@ class _Utils:
 
 
 utils = _Utils()
+
+
+def collective_perf(comm_type, round=50, size_and_time=None):
+    """Collective micro-bench with expected-bandwidth warnings (reference
+    python/paddle/distributed/fleet/fleet.py:414-632 collective_perf /
+    _collective_perf_impl:572).  Returns {size_bytes: GB/s}."""
+    import time as _time
+
+    import numpy as _np
+
+    import paddle_tpu as _paddle
+    from paddle_tpu.distributed import collective as _coll
+
+    # expected-bandwidth warn thresholds (GB/s) by message size, the reference's
+    # embedded table shape; TPU ICI numbers are far higher — these are floors.
+    default_sizes = {1 << 20: 1.0, 8 << 20: 4.0, 64 << 20: 8.0}
+    sizes = size_and_time or default_sizes
+    results = {}
+    for size_bytes, expect_gbs in sizes.items():
+        numel = max(size_bytes // 4, 1)
+        t = _paddle.to_tensor(_np.ones(numel, _np.float32))
+        def fn():
+            if comm_type == "allreduce":
+                _coll.all_reduce(t)
+                return t
+            if comm_type == "reduce":
+                _coll.reduce(t, dst=0)
+                return t
+            if comm_type == "broadcast":
+                _coll.broadcast(t, src=0)
+                return t
+            if comm_type == "allgather":
+                outs = []
+                _coll.all_gather(outs, t)
+                return outs[-1] if outs else t
+            if comm_type == "reduce_scatter":
+                _coll.reduce_scatter(t, t)
+                return t
+            raise ValueError(comm_type)
+
+        fn()  # warm
+        t0 = _time.perf_counter()
+        for _ in range(round):
+            last = fn()
+        if hasattr(last.data, "block_until_ready"):
+            last.data.block_until_ready()
+        dt = (_time.perf_counter() - t0) / round
+        gbs = size_bytes / dt / 1e9
+        results[size_bytes] = gbs
+        if gbs < expect_gbs:
+            import logging
+
+            logging.getLogger("paddle_tpu.fleet").warning(
+                "collective_perf(%s): %.2f GB/s at %d bytes below expected %.1f GB/s",
+                comm_type, gbs, size_bytes, expect_gbs,
+            )
+    return results

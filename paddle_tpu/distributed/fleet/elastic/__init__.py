@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.fleet.elastic.manager import ElasticManager, ElasticStatus
+
+__all__ = ['ElasticManager', 'ElasticStatus']

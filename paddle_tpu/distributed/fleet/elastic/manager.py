@@ -1,0 +1,122 @@
+"""Elastic training manager (reference python/paddle/distributed/fleet/elastic/
+manager.py:125 — etcd-backed node registry, watch callbacks, scale in/out
+detection, host-list rewrite and relaunch).
+
+TPU-native: the registry rides the native TCPStore (core/native) instead of
+etcd; nodes heartbeat `node:<host>` keys, the manager watches the alive set and
+flags scale events.  Recovery remains checkpoint-based resume (SURVEY.md §5.3)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store=None, np=None, host=None,
+                 heartbeat_interval=1.0, node_ttl=5.0):
+        self.args = args
+        self.np = int(np or os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.host = host or os.environ.get("POD_IP", f"node-{os.getpid()}")
+        self.heartbeat_interval = heartbeat_interval
+        self.node_ttl = node_ttl
+        self.elastic_level = int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+        if store is None:
+            from paddle_tpu.distributed.parallel_env import create_tcp_store
+
+            store = create_tcp_store()
+        self._store = store
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._watch_thread = None
+        self._callbacks = []
+        self.need_sync = False
+        self.enable = self.np > 1 or os.environ.get("PADDLE_ELASTIC_ENABLE") == "1"
+
+    # -------------------------------------------------------------- registry
+    def _beat(self):
+        self._store.set(f"node:{self.host}", json.dumps(
+            {"ts": time.time(), "host": self.host}).encode())
+
+    def alive_nodes(self):
+        nodes = []
+        now = time.time()
+        # ADD with delta 0 reads the binary i64 counter atomically
+        count = int(self._store.add("node_count", 0))
+        for slot in range(count):
+            try:
+                host = self._store.get(f"node_slot:{slot}").decode()
+                rec = json.loads(self._store.get(f"node:{host}").decode())
+            except KeyError:
+                continue
+            if now - rec["ts"] <= self.node_ttl:
+                nodes.append(host)
+        return sorted(set(nodes))
+
+    def _register(self):
+        # atomic slot claim via the store's ADD op (concurrent registrations
+        # cannot lose each other the way a read-modify-write of a list can)
+        slot = self._store.add("node_count", 1) - 1
+        self._store.set(f"node_slot:{slot}", self.host.encode())
+        self._beat()
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self):
+        self._register()
+
+        def hb():
+            while not self._stop.wait(self.heartbeat_interval):
+                self._beat()
+
+        def watch():
+            prev = self.alive_nodes()
+            while not self._stop.wait(self.heartbeat_interval):
+                cur = self.alive_nodes()
+                if cur != prev:
+                    event = "scale_out" if len(cur) > len(prev) else "scale_in"
+                    for cb in self._callbacks:
+                        cb(event, prev, cur)
+                    prev = cur
+
+        self._hb_thread = threading.Thread(target=hb, daemon=True)
+        self._watch_thread = threading.Thread(target=watch, daemon=True)
+        self._hb_thread.start()
+        self._watch_thread.start()
+
+    def watch(self, callback):
+        """callback(event, old_hosts, new_hosts) on scale in/out (reference
+        manager.py:218-248 watch callbacks)."""
+        self._callbacks.append(callback)
+
+    def pre_hook(self):
+        pass
+
+    def exit(self, completed=True):
+        self._stop.set()
+        for t in (self._hb_thread, self._watch_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=2)
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+
+    # ---------------------------------------------------------------- checks
+    def should_restart(self):
+        """Scale event pending: alive set != expected np."""
+        return len(self.alive_nodes()) != self.np
+
+    def wait_for_np(self, timeout=60):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if len(self.alive_nodes()) >= self.np:
+                return True
+            time.sleep(self.heartbeat_interval)
+        return False

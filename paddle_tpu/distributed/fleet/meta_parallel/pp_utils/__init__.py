@@ -1,0 +1,1 @@
+from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import p2p_communication  # noqa: F401

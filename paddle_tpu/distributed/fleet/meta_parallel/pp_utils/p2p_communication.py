@@ -1,0 +1,78 @@
+"""Pipeline p2p API surface (reference python/paddle/distributed/fleet/
+meta_parallel/pp_utils/p2p_communication.py: batched isend/irecv on the pp group).
+
+TPU-native: inside compiled pipelines activations move via lax.ppermute
+(pipeline_parallel.pipeline_apply); this eager module keeps the reference's
+send/recv API for dygraph parity over the collective mailbox."""
+from __future__ import annotations
+
+from paddle_tpu.distributed import collective as _coll
+
+_HCG = {"hcg": None}
+
+
+def initialize_p2p_groups(hcg, enable_partial_send_recv=True):
+    _HCG["hcg"] = hcg
+
+
+def _pp_group():
+    hcg = _HCG["hcg"]
+    if hcg is not None and hasattr(hcg, "get_pipe_parallel_group"):
+        return hcg.get_pipe_parallel_group()
+    return None
+
+
+def _pp_rank_bounds():
+    hcg = _HCG["hcg"]
+    if hcg is None:
+        return 0, 1
+    return hcg.get_stage_id(), hcg.get_pipe_parallel_world_size()
+
+
+def send_forward(output_tensor, pp_last_stage=None):
+    rank, size = _pp_rank_bounds()
+    last = pp_last_stage if pp_last_stage is not None else rank == size - 1
+    if not last and output_tensor is not None:
+        _coll.send(output_tensor, dst=rank + 1, group=_pp_group())
+
+
+def recv_forward(pp_first_stage=None, shape=None, dtype=None):
+    rank, size = _pp_rank_bounds()
+    first = pp_first_stage if pp_first_stage is not None else rank == 0
+    if first:
+        return None
+
+    import paddle_tpu as paddle
+
+    buf = paddle.zeros(shape or [1], dtype=dtype or "float32")
+    _coll.recv(buf, src=rank - 1, group=_pp_group())
+    return buf
+
+
+def send_backward(input_tensor_grad, pp_first_stage=None):
+    rank, size = _pp_rank_bounds()
+    first = pp_first_stage if pp_first_stage is not None else rank == 0
+    if not first and input_tensor_grad is not None:
+        _coll.send(input_tensor_grad, dst=rank - 1, group=_pp_group())
+
+
+def recv_backward(pp_last_stage=None, shape=None, dtype=None):
+    rank, size = _pp_rank_bounds()
+    last = pp_last_stage if pp_last_stage is not None else rank == size - 1
+    if last:
+        return None
+    import paddle_tpu as paddle
+
+    buf = paddle.zeros(shape or [1], dtype=dtype or "float32")
+    _coll.recv(buf, src=rank + 1, group=_pp_group())
+    return buf
+
+
+def send_forward_recv_backward(output_tensor, pp_last_stage=None, shape=None, dtype=None):
+    send_forward(output_tensor, pp_last_stage)
+    return recv_backward(pp_last_stage, shape=shape, dtype=dtype)
+
+
+def send_backward_recv_forward(input_tensor_grad, pp_first_stage=None, shape=None, dtype=None):
+    send_backward(input_tensor_grad, pp_first_stage)
+    return recv_forward(pp_first_stage, shape=shape, dtype=dtype)

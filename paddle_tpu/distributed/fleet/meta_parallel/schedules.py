@@ -1,0 +1,105 @@
+"""Pipeline schedule generators (reference python/paddle/distributed/passes/
+pipeline_scheduler_pass/__init__.py:32-38 — FThenB, 1F1B, Eager1F1B, VPP,
+ZBH1, ZBVPP).
+
+Each generator yields the per-stage instruction stream as (op, microbatch_id,
+chunk_id) tuples, op ∈ {"F", "B", "W", "SEND_F", "RECV_F", "SEND_B", "RECV_B"}.
+On TPU the *compiled* pipeline (pipeline_apply) realizes the dataflow; these
+streams drive the eager train_batch path and make schedule semantics testable
+exactly like the reference's pass unit tests (test/distributed_passes)."""
+from __future__ import annotations
+
+__all__ = ["FThenB", "F1B1", "Eager1F1B", "VPP", "ZBH1", "get_schedule"]
+
+
+def FThenB(stage, num_stages, num_micro, num_chunks=1):
+    """All forwards, then all backwards (fill-drain / GPipe)."""
+    prog = [("F", m, 0) for m in range(num_micro)]
+    prog += [("B", m, 0) for m in range(num_micro)]
+    return prog
+
+
+def F1B1(stage, num_stages, num_micro, num_chunks=1):
+    """1F1B: warmup = (S-1-stage) forwards, then alternate F/B, then drain."""
+    warmup = min(num_stages - 1 - stage, num_micro)
+    prog = [("F", m, 0) for m in range(warmup)]
+    f_next, b_next = warmup, 0
+    while f_next < num_micro:
+        prog.append(("F", f_next, 0))
+        f_next += 1
+        prog.append(("B", b_next, 0))
+        b_next += 1
+    while b_next < num_micro:
+        prog.append(("B", b_next, 0))
+        b_next += 1
+    return prog
+
+
+def Eager1F1B(stage, num_stages, num_micro, num_chunks=1):
+    """Like 1F1B but with one extra in-flight forward per stage (reference
+    pipeline_eager_1f1b.py): warmup = S - stage forwards (capped)."""
+    warmup = min(num_stages - stage, num_micro)
+    prog = [("F", m, 0) for m in range(warmup)]
+    f_next, b_next = warmup, 0
+    while f_next < num_micro:
+        prog.append(("F", f_next, 0))
+        f_next += 1
+        prog.append(("B", b_next, 0))
+        b_next += 1
+    while b_next < num_micro:
+        prog.append(("B", b_next, 0))
+        b_next += 1
+    return prog
+
+
+def VPP(stage, num_stages, num_micro, num_chunks=2):
+    """Interleaved virtual-pipeline (reference PipelineParallelWithInterleave,
+    meta_parallel/pipeline_parallel.py:1174): chunks round-robin in groups of
+    num_stages microbatches."""
+    prog = []
+    group = num_stages
+    # forward: for each microbatch group, run every chunk over the group
+    for g0 in range(0, num_micro, group):
+        mbs = range(g0, min(g0 + group, num_micro))
+        for c in range(num_chunks):
+            prog += [("F", m, c) for m in mbs]
+    # backward mirrors in reverse chunk order
+    for g0 in reversed(range(0, num_micro, group)):
+        mbs = range(g0, min(g0 + group, num_micro))
+        for c in reversed(range(num_chunks)):
+            prog += [("B", m, c) for m in mbs]
+    return prog
+
+
+def ZBH1(stage, num_stages, num_micro, num_chunks=1):
+    """Zero-bubble H1 (reference pipeline_zero_bubble.py): split backward into
+    activation-grad (B) and weight-grad (W); W fills the drain bubble."""
+    warmup = min(num_stages - 1 - stage, num_micro)
+    prog = [("F", m, 0) for m in range(warmup)]
+    f_next, b_next, w_next = warmup, 0, 0
+    while f_next < num_micro:
+        prog.append(("F", f_next, 0))
+        f_next += 1
+        prog.append(("B", b_next, 0))
+        b_next += 1
+    while b_next < num_micro:
+        prog.append(("B", b_next, 0))
+        b_next += 1
+        # weight-grad work scheduled into what would be bubble
+        if w_next < b_next - 1:
+            prog.append(("W", w_next, 0))
+            w_next += 1
+    while w_next < num_micro:
+        prog.append(("W", w_next, 0))
+        w_next += 1
+    return prog
+
+
+_SCHEDULES = {"FThenB": FThenB, "1F1B": F1B1, "Eager1F1B": Eager1F1B,
+              "VPP": VPP, "ZBH1": ZBH1}
+
+
+def get_schedule(name):
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {name!r}; have {sorted(_SCHEDULES)}")
+    return _SCHEDULES[name]

@@ -1,0 +1,154 @@
+"""Pipeline schedules, interleaved VPP, p2p API, elastic manager,
+collective_perf (reference test/collective/fleet + test/distributed_passes)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+class TestSchedules:
+    @pytest.mark.parametrize("name", ["FThenB", "1F1B", "Eager1F1B", "VPP", "ZBH1"])
+    def test_invariants(self, name):
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import get_schedule
+
+        sched = get_schedule(name)
+        chunks = 2 if name == "VPP" else 1
+        for stage in range(4):
+            prog = sched(stage, 4, 8, num_chunks=chunks)
+            fs = sorted((m, c) for op, m, c in prog if op == "F")
+            bs = sorted((m, c) for op, m, c in prog if op == "B")
+            assert fs == bs
+            seen = set()
+            for op, m, c in prog:
+                if op == "F":
+                    seen.add((m, c))
+                elif op == "B":
+                    assert (m, c) in seen
+
+    def test_1f1b_warmup_depth(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import F1B1
+
+        # stage 0 of 4 stages has 3 warmup forwards before the first backward
+        prog = F1B1(0, 4, 8)
+        first_b = next(i for i, (op, _, _) in enumerate(prog) if op == "B")
+        assert first_b == 4  # F F F F B ...
+        # last stage alternates immediately
+        prog_last = F1B1(3, 4, 8)
+        assert [op for op, _, _ in prog_last[:4]] == ["F", "B", "F", "B"]
+
+    def test_zbh1_has_weight_pass(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import ZBH1
+
+        prog = ZBH1(0, 4, 8)
+        ws = [m for op, m, _ in prog if op == "W"]
+        assert sorted(ws) == list(range(8))
+
+
+class TestCompiledPipeline:
+    def _mesh(self, n=4):
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:n])
+        return Mesh(devs, ("pp",))
+
+    def test_pipeline_apply_matches_sequential(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            pipeline_apply, stack_stage_params,
+        )
+
+        rng = np.random.default_rng(0)
+        S, B, D = 4, 8, 16
+        ws = [rng.standard_normal((D, D)).astype(np.float32) * 0.1 for _ in range(S)]
+        x = rng.standard_normal((B, D)).astype(np.float32)
+
+        def stage_fn(p, a):
+            return jnp.tanh(a @ p["w"])
+
+        stacked = stack_stage_params([{"w": jnp.asarray(w)} for w in ws])
+        mesh = self._mesh(S)
+        out = pipeline_apply(stage_fn, stacked, jnp.asarray(x), 4, mesh)
+        ref = x
+        for w in ws:
+            ref = np.tanh(ref @ w)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_pipeline_interleave(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            pipeline_apply_interleave, stack_stage_params,
+        )
+
+        rng = np.random.default_rng(1)
+        S, V, B, D = 2, 2, 4, 8
+        ws = [rng.standard_normal((D, D)).astype(np.float32) * 0.1 for _ in range(S * V)]
+        x = rng.standard_normal((B, D)).astype(np.float32)
+
+        def stage_fn(p, a):
+            return jnp.tanh(a @ p["w"])
+
+        stacked = stack_stage_params([{"w": jnp.asarray(w)} for w in ws])
+        out = pipeline_apply_interleave(stage_fn, stacked, jnp.asarray(x), 2,
+                                        self._mesh(S), num_chunks=V)
+        ref = x
+        for w in ws:
+            ref = np.tanh(ref @ w)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestP2PAPI:
+    def test_send_recv_roundtrip(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import p2p_communication as p2p
+
+        dist.init_parallel_env()
+        p2p.initialize_p2p_groups(None)
+        # single stage: first and last — both send paths are no-ops
+        p2p.send_forward(paddle.to_tensor(np.ones(3, "float32")), pp_last_stage=True)
+        assert p2p.recv_forward(pp_first_stage=True) is None
+        # the mailbox is rank-addressed: a message sent to this rank is received
+        t = paddle.to_tensor(np.arange(3, dtype="float32"))
+        dist.send(t, dst=dist.get_rank())
+        buf = paddle.zeros([3])
+        dist.recv(buf, src=dist.get_rank())
+        np.testing.assert_allclose(buf.numpy(), [0, 1, 2])
+
+
+class TestElastic:
+    def test_scale_out_detection(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        os.environ["MASTER_PORT"] = "0"
+        try:
+            m = ElasticManager(np=2, heartbeat_interval=0.05, node_ttl=1.0)
+            events = []
+            m.watch(lambda e, old, new: events.append(e))
+            m.start()
+            assert m.should_restart()  # only 1 of 2 nodes present
+            m2 = ElasticManager(np=2, host="node-B", heartbeat_interval=0.05,
+                                node_ttl=1.0, store=m._store)
+            m2.start()
+            assert m.wait_for_np(timeout=5)
+            time.sleep(0.3)
+            assert not m.should_restart()
+            assert "scale_out" in events
+            m.exit()
+            m2.exit()
+        finally:
+            dist.destroy_tcp_store()
+            os.environ.pop("MASTER_PORT", None)
+
+
+class TestCollectivePerf:
+    def test_bandwidth_numbers(self):
+        dist.init_parallel_env()
+        for op in ("allreduce", "broadcast", "reduce_scatter"):
+            res = paddle.distributed.fleet.collective_perf(
+                op, round=2, size_and_time={1 << 14: 0.0001})
+            assert all(v > 0 for v in res.values()), op
