@@ -130,9 +130,10 @@ class TCPStore:
             self._lib.tcpstore_delete(self._h, key.encode())
 
     def close(self):
-        if self._h:
-            self._lib.tcpstore_client_close(self._h)
-            self._h = None
+        with self._lock:  # wait for any in-flight request before freeing
+            if self._h:
+                self._lib.tcpstore_client_close(self._h)
+                self._h = None
 
     def __del__(self):
         try:

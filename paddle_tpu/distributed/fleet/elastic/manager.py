@@ -41,6 +41,7 @@ class ElasticManager:
         self._watch_thread = None
         self._callbacks = []
         self.need_sync = False
+        self._slot = None
         self.enable = self.np > 1 or os.environ.get("PADDLE_ELASTIC_ENABLE") == "1"
 
     # -------------------------------------------------------------- registry
@@ -67,6 +68,7 @@ class ElasticManager:
         # atomic slot claim via the store's ADD op (concurrent registrations
         # cannot lose each other the way a read-modify-write of a list can)
         slot = self._store.add("node_count", 1) - 1
+        self._slot = slot
         self._store.set(f"node_slot:{slot}", self.host.encode())
         self._beat()
 
@@ -74,19 +76,32 @@ class ElasticManager:
     def start(self):
         self._register()
 
+        import logging
+
+        log = logging.getLogger("paddle_tpu.elastic")
+
         def hb():
             while not self._stop.wait(self.heartbeat_interval):
-                self._beat()
+                try:
+                    self._beat()
+                except Exception:
+                    log.exception("elastic heartbeat failed; retrying")
 
         def watch():
             prev = self.alive_nodes()
             while not self._stop.wait(self.heartbeat_interval):
-                cur = self.alive_nodes()
-                if cur != prev:
-                    event = "scale_out" if len(cur) > len(prev) else "scale_in"
-                    for cb in self._callbacks:
-                        cb(event, prev, cur)
-                    prev = cur
+                try:
+                    cur = self.alive_nodes()
+                    if cur != prev:
+                        event = "scale_out" if len(cur) > len(prev) else "scale_in"
+                        for cb in self._callbacks:
+                            try:
+                                cb(event, prev, cur)
+                            except Exception:
+                                log.exception("elastic watch callback raised")
+                        prev = cur
+                except Exception:
+                    log.exception("elastic watch tick failed; retrying")
 
         self._hb_thread = threading.Thread(target=hb, daemon=True)
         self._watch_thread = threading.Thread(target=watch, daemon=True)
@@ -106,6 +121,13 @@ class ElasticManager:
         for t in (self._hb_thread, self._watch_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=2)
+        # deregister so stale slots don't accumulate round-trips for peers
+        try:
+            if self._slot is not None:
+                self._store.delete(f"node_slot:{self._slot}")
+            self._store.delete(f"node:{self.host}")
+        except Exception:
+            pass
         return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
 
     # ---------------------------------------------------------------- checks

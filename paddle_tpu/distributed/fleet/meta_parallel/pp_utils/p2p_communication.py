@@ -9,6 +9,10 @@ from __future__ import annotations
 from paddle_tpu.distributed import collective as _coll
 
 _HCG = {"hcg": None}
+# stage-addressed activation mailbox for the eager path: collective.send/recv
+# key by *global* rank, but pipeline messages are addressed by pp stage id —
+# with dp/mp degree > 1 those domains differ, so p2p keeps its own box.
+_STAGE_BOX = {}
 
 
 def initialize_p2p_groups(hcg, enable_partial_send_recv=True):
@@ -33,7 +37,7 @@ def send_forward(output_tensor, pp_last_stage=None):
     rank, size = _pp_rank_bounds()
     last = pp_last_stage if pp_last_stage is not None else rank == size - 1
     if not last and output_tensor is not None:
-        _coll.send(output_tensor, dst=rank + 1, group=_pp_group())
+        _STAGE_BOX[("fwd", rank + 1)] = output_tensor.detach()
 
 
 def recv_forward(pp_first_stage=None, shape=None, dtype=None):
@@ -61,11 +65,7 @@ def recv_backward(pp_last_stage=None, shape=None, dtype=None):
     last = pp_last_stage if pp_last_stage is not None else rank == size - 1
     if last:
         return None
-    import paddle_tpu as paddle
-
-    buf = paddle.zeros(shape or [1], dtype=dtype or "float32")
-    _coll.recv(buf, src=rank + 1, group=_pp_group())
-    return buf
+    return _STAGE_BOX.pop(("bwd", rank), None)
 
 
 def send_forward_recv_backward(output_tensor, pp_last_stage=None, shape=None, dtype=None):

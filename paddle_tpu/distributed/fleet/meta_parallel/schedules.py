@@ -19,9 +19,9 @@ def FThenB(stage, num_stages, num_micro, num_chunks=1):
     return prog
 
 
-def F1B1(stage, num_stages, num_micro, num_chunks=1):
-    """1F1B: warmup = (S-1-stage) forwards, then alternate F/B, then drain."""
-    warmup = min(num_stages - 1 - stage, num_micro)
+def _one_f_one_b(warmup, num_micro):
+    """Shared 1F1B body: warmup forwards, steady-state F/B alternation, drain."""
+    warmup = min(warmup, num_micro)
     prog = [("F", m, 0) for m in range(warmup)]
     f_next, b_next = warmup, 0
     while f_next < num_micro:
@@ -33,23 +33,17 @@ def F1B1(stage, num_stages, num_micro, num_chunks=1):
         prog.append(("B", b_next, 0))
         b_next += 1
     return prog
+
+
+def F1B1(stage, num_stages, num_micro, num_chunks=1):
+    """1F1B: warmup = (S-1-stage) forwards, then alternate F/B, then drain."""
+    return _one_f_one_b(num_stages - 1 - stage, num_micro)
 
 
 def Eager1F1B(stage, num_stages, num_micro, num_chunks=1):
     """Like 1F1B but with one extra in-flight forward per stage (reference
     pipeline_eager_1f1b.py): warmup = S - stage forwards (capped)."""
-    warmup = min(num_stages - stage, num_micro)
-    prog = [("F", m, 0) for m in range(warmup)]
-    f_next, b_next = warmup, 0
-    while f_next < num_micro:
-        prog.append(("F", f_next, 0))
-        f_next += 1
-        prog.append(("B", b_next, 0))
-        b_next += 1
-    while b_next < num_micro:
-        prog.append(("B", b_next, 0))
-        b_next += 1
-    return prog
+    return _one_f_one_b(num_stages - stage, num_micro)
 
 
 def VPP(stage, num_stages, num_micro, num_chunks=2):

@@ -13,3 +13,9 @@ from paddle_tpu.models.llama import (  # noqa: F401
     llama_shardings,
     shard_llama,
 )
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    ErnieConfig, ErnieForMaskedLM, ErnieForSequenceClassification, ErnieModel,
+)
+from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM  # noqa: F401

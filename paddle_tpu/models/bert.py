@@ -1,0 +1,180 @@
+"""BERT/ERNIE-style masked-LM encoder (reference surface: PaddleNLP bert/ernie
+modeling; BASELINE.json's ERNIE-3.0 pretraining track).
+
+ERNIE's architecture is the BERT encoder (token+position+segment embeddings,
+post-LN blocks, pooler); ErnieModel aliases BertModel with ERNIE defaults."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Dropout, Embedding, Linear
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.norm import LayerNorm
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "ErnieConfig", "ErnieModel",
+           "ErnieForMaskedLM", "ErnieForSequenceClassification"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    dtype="float32")
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, h, attn_mask=None):
+        b, s, d = h.shape
+        qkv = self.qkv(h)
+
+        def split(a):
+            q, k, v = jnp.split(a, 3, -1)
+            f = lambda t: t.reshape(b, s, self.num_heads, self.head_dim)
+            return f(q), f(k), f(v)
+
+        q, k, v = apply("split_qkv", split, qkv)
+        ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=False, training=self.training)
+        return self.out(ctx.reshape([b, s, d]))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (the BERT/ERNIE convention)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.ffn_in = Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.ffn_out = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ffn_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, h, attn_mask=None):
+        h = self.attn_norm(h + self.dropout(self.attention(h, attn_mask)))
+        ffn = self.ffn_out(F.gelu(self.ffn_in(h)))
+        return self.ffn_norm(h + self.dropout(ffn))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, h):
+        return F.tanh(self.dense(h[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = LayerList([BertLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            # (b, s) 1/0 mask → additive (b, 1, 1, s)
+            attention_mask = apply(
+                "mask", lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32)) * -1e4,
+                attention_mask,
+            )
+        h = self.embeddings(input_ids, token_type_ids)
+        for blk in self.encoder:
+            h = blk(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.config = cfg
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        h, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        logits = apply(
+            "mlm_head", lambda a, w: a @ w.T.astype(a.dtype), h,
+            self.bert.embeddings.word_embeddings.weight,
+        )
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels.reshape([-1]), ignore_index=-100,
+        )
+        return loss, logits
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits.astype("float32"), labels), logits
+
+
+# ERNIE = BERT encoder with ERNIE defaults (knowledge-masking lives in data prep)
+ErnieConfig = BertConfig
+ErnieModel = BertModel
+ErnieForMaskedLM = BertForMaskedLM
+ErnieForSequenceClassification = BertForSequenceClassification

@@ -1,0 +1,125 @@
+"""GPT-family causal LM (reference surface: PaddleNLP gpt modeling; the
+reference repo's fleet configs train GPT with hybrid parallelism).
+
+TPU-first: pre-LN transformer with learned positions; attention routes through
+F.scaled_dot_product_attention (Pallas flash kernel on TPU); bf16 default."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Dropout, Embedding, Linear
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.norm import LayerNorm
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128, dtype="float32")
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv_proj = Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out_proj = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout = cfg.dropout
+
+    def forward(self, h, attn_mask=None):
+        b, s, d = h.shape
+        qkv = self.qkv_proj(h)
+
+        def split_heads(a):
+            q, k, v = jnp.split(a, 3, axis=-1)
+            f = lambda t: t.reshape(b, s, self.num_heads, self.head_dim)
+            return f(q), f(k), f(v)
+
+        q, k, v = apply("split_qkv", split_heads, qkv)
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=True, training=self.training,
+        )
+        ctx = ctx.reshape([b, s, d])
+        return self.out_proj(ctx)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.fc_in = Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc_out = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, h, attn_mask=None):
+        h = h + self.drop(self.attn(self.ln_1(h), attn_mask))
+        mlp = self.fc_out(F.gelu(self.fc_in(self.ln_2(h))))
+        return h + self.drop(mlp)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = Dropout(cfg.dropout)
+        self.h = LayerList([GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        b, s = input_ids.shape
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        h = self.wte(input_ids) + self.wpe(pos)
+        h = self.drop(h)
+        for blk in self.h:
+            h = blk(h, attn_mask)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.config = cfg
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.gpt(input_ids, attn_mask)
+        # weight-tied head (wte^T), the GPT convention
+        logits = apply(
+            "lm_head", lambda a, w: a @ w.T.astype(a.dtype), h, self.gpt.wte.weight
+        )
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits[:, :-1].reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels[:, 1:].reshape([-1]),
+        )
+        return loss, logits

@@ -1,0 +1,158 @@
+"""Mixture-of-Experts causal LM (reference surface: the MoE track in
+BASELINE.json — DeepSeek-MoE-style auto_parallel semi-auto; reference MoE
+machinery: python/paddle/incubate/distributed/models/moe/moe_layer.py:263).
+
+TPU-first: MoE FFN uses the dense top-k einsum dispatch (fused_moe) so every
+tensor is static-shaped; under pjit with the expert axis sharded over the
+'ep' mesh axis the dispatch einsums lower to XLA all-to-alls over ICI."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.models.llama import (
+    LlamaAttention, LlamaConfig, _rope_cos_sin,
+)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Embedding, Linear
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn.layer.norm import RMSNorm
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["MoEConfig", "MoEForCausalLM"]
+
+
+@dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    intermediate_size: int = 2816
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 8
+    top_k: int = 2
+    aux_loss_weight: float = 0.01
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=4, num_experts=4, top_k=2,
+                    max_position_embeddings=128, dtype="float32")
+        base.update(kw)
+        return MoEConfig(**base)
+
+    def as_llama(self):
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            dtype=self.dtype,
+        )
+
+
+class MoEMLP(Layer):
+    """Top-k gated expert SwiGLU FFN, GShard load-balance aux loss."""
+
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        d, f, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+        self.gate = Linear(d, e, bias_attr=False)
+        # packed expert weights: (E, d, f)/(E, f, d) — one einsum per matmul
+        self.w_gate = self.create_parameter([e, d, f])
+        self.w_up = self.create_parameter([e, d, f])
+        self.w_down = self.create_parameter([e, f, d])
+        self.aux_loss = None
+
+    def forward(self, x):
+        cfg = self.cfg
+        logits = self.gate(x)  # (b, s, E)
+
+        def moe(xa, ga, wg, wu, wd):
+            b, s, d = xa.shape
+            tokens = xa.reshape(-1, d)
+            g = ga.reshape(-1, cfg.num_experts)
+            probs = jax.nn.softmax(g.astype(jnp.float32), -1)
+            topv, topi = jax.lax.top_k(probs, cfg.top_k)
+            topv = (topv / topv.sum(-1, keepdims=True)).astype(xa.dtype)
+            combine = jnp.zeros_like(probs, xa.dtype).at[
+                jnp.arange(tokens.shape[0])[:, None], topi
+            ].set(topv)  # (T, E)
+            # dense dispatch: every expert computes all tokens, output combined
+            h = jnp.einsum("td,edf->tef", tokens, wg)
+            u = jnp.einsum("td,edf->tef", tokens, wu)
+            act = jax.nn.silu(h) * u
+            o = jnp.einsum("tef,efd->ted", act, wd)
+            out = jnp.einsum("ted,te->td", o, combine).reshape(b, s, d)
+            # GShard aux loss: fraction-routed × mean-prob per expert
+            c_e = jnp.zeros((cfg.num_experts,), jnp.float32).at[
+                topi[:, 0].astype(jnp.int32)
+            ].add(1.0) / tokens.shape[0]
+            aux = jnp.sum(c_e * probs.mean(0)) * cfg.num_experts
+            return out, aux
+
+        out, aux = apply("moe_mlp", moe, x, logits, self.w_gate, self.w_up, self.w_down)
+        self.aux_loss = aux
+        return out
+
+
+class MoEDecoderLayer(Layer):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        lcfg = cfg.as_llama()
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(lcfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.mlp = MoEMLP(cfg)
+
+    def forward(self, h, attn_mask=None):
+        h = h + self.self_attn(self.input_layernorm(h), attn_mask)
+        h = h + self.mlp(self.post_attention_layernorm(h))
+        return h
+
+
+class MoEForCausalLM(Layer):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.config = cfg
+        self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = LayerList([MoEDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        if self.config.dtype == "bfloat16":
+            h = h.astype("bfloat16")
+        for blk in self.layers:
+            h = blk(h, attn_mask)
+        h = self.norm(h)
+        logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits[:, :-1].reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels[:, 1:].reshape([-1]),
+        )
+        aux = None
+        for blk in self.layers:
+            a = blk.mlp.aux_loss
+            if a is not None:
+                aux = a if aux is None else aux + a
+        if aux is not None:
+            loss = loss + self.config.aux_loss_weight * aux
+        return loss, logits

@@ -1,0 +1,109 @@
+"""OpTest fixture (model: reference test/legacy_test/op_test.py:418).
+
+The reference's OpTest runs an op through program+executor against a NumPy
+reference and checks analytic grads against a numeric Jacobian.  The TPU-native
+equivalent checks each op three ways:
+
+1. **eager forward** vs the NumPy reference,
+2. **compiled forward** (the op under ``jax.jit``) vs the same reference —
+   the static-graph/executor cross-check,
+3. **analytic gradient** (autograd engine) vs a central-difference numeric
+   Jacobian-vector product.
+
+Per-op tolerance policy (SURVEY.md §7 hard parts): float32 defaults below;
+pass ``max_relative_error`` per op like the reference's white_list overrides.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class OpTest:
+    """Subclass and call ``self.check_output`` / ``self.check_grad``."""
+
+    # forward tolerances (float32)
+    rtol = 1e-5
+    atol = 1e-6
+    # gradient tolerances
+    grad_rtol = 1e-2
+    grad_atol = 1e-3
+    fd_eps = 1e-3
+
+    # ------------------------------------------------------------- forward
+    def check_output(self, op, np_ref, inputs, rtol=None, atol=None, **op_kwargs):
+        """op(*Tensors, **kw) vs np_ref(*ndarrays): eager AND jitted."""
+        rtol = rtol if rtol is not None else self.rtol
+        atol = atol if atol is not None else self.atol
+        np_inputs = [np.asarray(a) for a in inputs]
+        ref = np_ref(*np_inputs)
+        refs = ref if isinstance(ref, (list, tuple)) else [ref]
+
+        # eager
+        outs = op(*[paddle.to_tensor(a) for a in np_inputs], **op_kwargs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        for got, want in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(got.numpy(), np.float64), np.asarray(want, np.float64),
+                rtol=rtol, atol=atol, err_msg="eager forward mismatch",
+            )
+
+        # compiled (the executor path: op traced once, run as XLA program)
+        def jit_fn(*arrs):
+            res = op(*[Tensor(a) for a in arrs], **op_kwargs)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return [r.data for r in res]
+
+        jitted = jax.jit(jit_fn)(*[np.asarray(a) for a in np_inputs])
+        for got, want in zip(jitted, refs):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64), np.asarray(want, np.float64),
+                rtol=rtol, atol=atol, err_msg="compiled forward mismatch",
+            )
+
+    # ------------------------------------------------------------ gradient
+    def check_grad(self, op, inputs, grad_input_idx=None, rtol=None, atol=None,
+                   **op_kwargs):
+        """Analytic dL/dx (L = sum(op(x))) vs central differences."""
+        rtol = rtol if rtol is not None else self.grad_rtol
+        atol = atol if atol is not None else self.grad_atol
+        np_inputs = [np.asarray(a, np.float64).astype(np.float32) for a in inputs]
+        idxs = grad_input_idx if grad_input_idx is not None else range(len(np_inputs))
+
+        # analytic
+        tensors = [paddle.to_tensor(a) for a in np_inputs]
+        for i in idxs:
+            tensors[i].stop_gradient = False
+        out = op(*tensors, **op_kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        for o in outs:
+            s = o.sum()
+            loss = s if loss is None else loss + s
+        loss.backward()
+
+        def scalar_loss(arrs):
+            res = op(*[paddle.to_tensor(a) for a in arrs], **op_kwargs)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return sum(float(np.asarray(r.numpy(), np.float64).sum()) for r in res)
+
+        for i in idxs:
+            analytic = np.asarray(tensors[i].grad.numpy(), np.float64)
+            numeric = np.zeros_like(np_inputs[i], np.float64)
+            flat = np_inputs[i].reshape(-1)
+            for j in range(flat.size):
+                plus = [a.copy() for a in np_inputs]
+                minus = [a.copy() for a in np_inputs]
+                plus[i].reshape(-1)[j] += self.fd_eps
+                minus[i].reshape(-1)[j] -= self.fd_eps
+                numeric.reshape(-1)[j] = (
+                    scalar_loss(plus) - scalar_loss(minus)
+                ) / (2 * self.fd_eps)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for input {i}",
+            )

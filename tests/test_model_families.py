@@ -1,0 +1,115 @@
+"""Model-family tests: GPT, BERT/ERNIE, MoE LLM (reference model: test/book/
+end-to-end classic models + PaddleNLP smoke tests)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, ErnieModel,
+    GPTConfig, GPTForCausalLM, MoEConfig, MoEForCausalLM,
+)
+
+
+def _ids(b=2, s=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, vocab, (b, s)), dtype="int64")
+
+
+class TestGPT:
+    def test_forward_backward(self):
+        m = GPTForCausalLM(GPTConfig.tiny())
+        ids = _ids()
+        loss, logits = m(ids, labels=ids)
+        assert list(logits.shape) == [2, 16, 256]
+        loss.backward()
+        assert m.gpt.wte.weight.grad is not None
+        assert m.gpt.h[0].attn.qkv_proj.weight.grad is not None
+
+    def test_overfits_tiny_sequence(self):
+        paddle.seed(0)
+        cfg = GPTConfig.tiny(num_hidden_layers=1, hidden_size=32, vocab_size=16)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=2e-3, parameters=m.parameters())
+        data = paddle.to_tensor(np.tile(np.arange(8), (4, 2)), dtype="int64")
+        for _ in range(150):
+            loss, _ = m(data, labels=data)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.5
+
+
+class TestBertErnie:
+    def test_mlm_and_classification(self):
+        cfg = BertConfig.tiny()
+        mlm = BertForMaskedLM(cfg)
+        ids = _ids()
+        labels = _ids(seed=1)
+        loss, logits = mlm(ids, labels=labels)
+        loss.backward()
+        assert list(logits.shape) == [2, 16, 256]
+        cls = BertForSequenceClassification(cfg, num_classes=4)
+        l2, lg = cls(ids, labels=paddle.to_tensor(np.array([1, 3]), dtype="int64"))
+        l2.backward()
+        assert list(lg.shape) == [2, 4]
+
+    def test_attention_mask_effect(self):
+        cfg = BertConfig.tiny()
+        m = ErnieModel(cfg)
+        m.eval()
+        ids = _ids()
+        full = np.ones((2, 16), "float32")
+        half = full.copy()
+        half[:, 8:] = 0
+        h_full, _ = m(ids, attention_mask=paddle.to_tensor(full))
+        h_half, _ = m(ids, attention_mask=paddle.to_tensor(half))
+        # masking the tail must change the representation of visible tokens
+        assert not np.allclose(h_full.numpy()[:, :8], h_half.numpy()[:, :8], atol=1e-5)
+
+    def test_token_type_embeddings(self):
+        cfg = BertConfig.tiny()
+        m = ErnieModel(cfg)
+        m.eval()
+        ids = _ids()
+        tt0 = paddle.to_tensor(np.zeros((2, 16)), dtype="int64")
+        tt1 = paddle.to_tensor(np.ones((2, 16)), dtype="int64")
+        h0, _ = m(ids, token_type_ids=tt0)
+        h1, _ = m(ids, token_type_ids=tt1)
+        assert not np.allclose(h0.numpy(), h1.numpy())
+
+
+class TestMoELLM:
+    def test_forward_backward_and_aux(self):
+        cfg = MoEConfig.tiny()
+        m = MoEForCausalLM(cfg)
+        ids = _ids()
+        loss, logits = m(ids, labels=ids)
+        assert list(logits.shape) == [2, 16, 256]
+        loss.backward()
+        assert m.layers[0].mlp.w_gate.grad is not None
+        assert m.layers[0].mlp.gate.weight.grad is not None
+        aux = m.layers[0].mlp.aux_loss
+        assert aux is not None and float(aux.numpy()) > 0
+
+    def test_topk_routing_sparsifies(self):
+        # with top-1 routing, combine weights per token form a one-hot
+        cfg = MoEConfig.tiny(top_k=1, num_experts=4)
+        m = MoEForCausalLM(cfg)
+        out = m(_ids())
+        assert np.isfinite(out.numpy()).all()
+
+    def test_moe_trains(self):
+        paddle.seed(1)
+        cfg = MoEConfig.tiny(num_hidden_layers=1, hidden_size=32, vocab_size=16,
+                             num_experts=2, intermediate_size=64)
+        m = MoEForCausalLM(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=2e-3, parameters=m.parameters())
+        data = paddle.to_tensor(np.tile(np.arange(8), (4, 2)), dtype="int64")
+        first = None
+        for _ in range(100):
+            loss, _ = m(data, labels=data)
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < first * 0.5

@@ -1,0 +1,163 @@
+"""Op unit tests on the OpTest fixture (model: test/legacy_test op tests) —
+forward vs NumPy in eager AND compiled mode, grads vs numeric jacobian."""
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+from tests.op_test import OpTest
+
+
+def _r(*shape, seed=0, lo=-1.0, hi=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.random(shape) * (hi - lo) + lo).astype("float32")
+
+
+class TestElementwiseOps(OpTest):
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp),
+        (paddle.log, lambda a: np.log(a)),
+        (paddle.sqrt, np.sqrt),
+        (paddle.tanh, np.tanh),
+        (paddle.sigmoid, scipy.special.expit),
+        (paddle.erf, scipy.special.erf),
+        (paddle.sin, np.sin),
+        (paddle.floor, np.floor),
+        (paddle.round, np.round),
+        (paddle.rsqrt, lambda a: 1 / np.sqrt(a)),
+    ])
+    def test_unary_forward(self, op, ref):
+        x = _r(3, 5, lo=0.1, hi=2.0)
+        self.check_output(op, ref, [x])
+
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, None), (paddle.tanh, None), (paddle.sqrt, None),
+    ])
+    def test_unary_grad(self, op, ref):
+        x = _r(2, 3, lo=0.5, hi=2.0)
+        self.check_grad(op, [x])
+
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add),
+        (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply),
+        (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum),
+        (paddle.minimum, np.minimum),
+        (paddle.pow, np.power),
+    ])
+    def test_binary_forward_and_grad(self, op, ref):
+        x = _r(3, 4, seed=1, lo=0.5, hi=2.0)
+        y = _r(3, 4, seed=2, lo=0.5, hi=2.0)
+        self.check_output(op, ref, [x, y])
+        if op not in (paddle.maximum, paddle.minimum):
+            self.check_grad(op, [x, y])
+
+    def test_broadcast_binary(self):
+        x = _r(3, 4, seed=3)
+        y = _r(4, seed=4)
+        self.check_output(paddle.add, np.add, [x, y])
+        self.check_grad(paddle.add, [x, y])
+
+
+class TestMatmulOps(OpTest):
+    def test_matmul(self):
+        x, y = _r(4, 6, seed=5), _r(6, 3, seed=6)
+        self.check_output(paddle.matmul, np.matmul, [x, y])
+        self.check_grad(paddle.matmul, [x, y])
+
+    def test_batched_matmul(self):
+        x, y = _r(2, 4, 5, seed=7), _r(2, 5, 3, seed=8)
+        self.check_output(paddle.matmul, np.matmul, [x, y])
+
+    def test_transpose_matmul(self):
+        x, y = _r(5, 4, seed=9), _r(5, 3, seed=10)
+        self.check_output(
+            lambda a, b: paddle.matmul(a, b, transpose_x=True),
+            lambda a, b: a.T @ b, [x, y],
+        )
+
+
+class TestReduceOps(OpTest):
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean),
+        (paddle.max, np.max), (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_full_reduce(self, op, ref):
+        x = _r(3, 4, seed=11, lo=0.5, hi=1.5)
+        self.check_output(op, ref, [x], rtol=1e-4)
+
+    def test_axis_reduce_grad(self):
+        x = _r(3, 4, seed=12)
+        self.check_output(lambda a: paddle.sum(a, axis=1), lambda a: a.sum(1), [x])
+        self.check_grad(lambda a: paddle.sum(a, axis=1), [x])
+        self.check_grad(lambda a: paddle.mean(a, axis=0), [x])
+
+
+class TestActivationOps(OpTest):
+    def test_softmax(self):
+        x = _r(4, 8, seed=13)
+        self.check_output(
+            paddle.nn.functional.softmax, lambda a: scipy.special.softmax(a, -1), [x]
+        )
+        self.check_grad(paddle.nn.functional.softmax, [x])
+
+    def test_gelu(self):
+        x = _r(3, 5, seed=14)
+        ref = lambda a: 0.5 * a * (1 + scipy.special.erf(a / np.sqrt(2)))
+        self.check_output(paddle.nn.functional.gelu, ref, [x], rtol=1e-4, atol=1e-5)
+
+    def test_relu_silu(self):
+        x = _r(3, 5, seed=15)
+        self.check_output(paddle.nn.functional.relu, lambda a: np.maximum(a, 0), [x])
+        self.check_output(
+            paddle.nn.functional.silu, lambda a: a * scipy.special.expit(a), [x]
+        )
+        self.check_grad(paddle.nn.functional.silu, [x])
+
+
+class TestShapeOps(OpTest):
+    def test_reshape_transpose_concat(self):
+        x = _r(2, 6, seed=16)
+        self.check_output(lambda a: paddle.reshape(a, [3, 4]), lambda a: a.reshape(3, 4), [x])
+        self.check_output(lambda a: paddle.transpose(a, [1, 0]), lambda a: a.T, [x])
+        y = _r(2, 6, seed=17)
+        self.check_output(
+            lambda a, b: paddle.concat([a, b], axis=0),
+            lambda a, b: np.concatenate([a, b], 0), [x, y],
+        )
+        self.check_grad(lambda a: paddle.reshape(a, [3, 4]), [x])
+
+    def test_gather_and_grad(self):
+        x = _r(5, 3, seed=18)
+        idx = np.array([0, 2, 4])
+        self.check_output(
+            lambda a: paddle.gather(a, paddle.to_tensor(idx)), lambda a: a[idx], [x]
+        )
+        self.check_grad(lambda a: paddle.gather(a, paddle.to_tensor(idx)), [x])
+
+
+class TestLossOps(OpTest):
+    def test_cross_entropy(self):
+        logits = _r(4, 6, seed=19)
+        labels = np.array([0, 2, 5, 1])
+
+        def ref(lg):
+            lse = scipy.special.logsumexp(lg, -1)
+            return (lse - lg[np.arange(4), labels]).mean()
+
+        self.check_output(
+            lambda a: paddle.nn.functional.cross_entropy(a, paddle.to_tensor(labels)),
+            ref, [logits], rtol=1e-4,
+        )
+        self.check_grad(
+            lambda a: paddle.nn.functional.cross_entropy(a, paddle.to_tensor(labels)),
+            [logits],
+        )
+
+    def test_mse(self):
+        x, y = _r(3, 4, seed=20), _r(3, 4, seed=21)
+        self.check_output(
+            lambda a, b: paddle.nn.functional.mse_loss(a, b),
+            lambda a, b: ((a - b) ** 2).mean(), [x, y],
+        )
