@@ -24,6 +24,8 @@ from paddle_tpu.distributed import communication  # noqa: F401
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed import sharding  # noqa: F401
 from paddle_tpu.distributed import utils  # noqa: F401
+from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict  # noqa: F401
 
 ParallelMode = type("ParallelMode", (), {"DATA_PARALLEL": 0, "TENSOR_PARALLEL": 1,
                                          "PIPELINE_PARALLEL": 2, "SHARDING_PARALLEL": 3})

@@ -6,8 +6,6 @@ TPU-native: inside compiled pipelines activations move via lax.ppermute
 send/recv API for dygraph parity over the collective mailbox."""
 from __future__ import annotations
 
-from paddle_tpu.distributed import collective as _coll
-
 _HCG = {"hcg": None}
 # stage-addressed activation mailbox for the eager path: collective.send/recv
 # key by *global* rank, but pipeline messages are addressed by pp stage id —
@@ -17,13 +15,6 @@ _STAGE_BOX = {}
 
 def initialize_p2p_groups(hcg, enable_partial_send_recv=True):
     _HCG["hcg"] = hcg
-
-
-def _pp_group():
-    hcg = _HCG["hcg"]
-    if hcg is not None and hasattr(hcg, "get_pipe_parallel_group"):
-        return hcg.get_pipe_parallel_group()
-    return None
 
 
 def _pp_rank_bounds():
@@ -45,19 +36,14 @@ def recv_forward(pp_first_stage=None, shape=None, dtype=None):
     first = pp_first_stage if pp_first_stage is not None else rank == 0
     if first:
         return None
-
-    import paddle_tpu as paddle
-
-    buf = paddle.zeros(shape or [1], dtype=dtype or "float32")
-    _coll.recv(buf, src=rank - 1, group=_pp_group())
-    return buf
+    return _STAGE_BOX.pop(("fwd", rank), None)
 
 
 def send_backward(input_tensor_grad, pp_first_stage=None):
     rank, size = _pp_rank_bounds()
     first = pp_first_stage if pp_first_stage is not None else rank == 0
     if not first and input_tensor_grad is not None:
-        _coll.send(input_tensor_grad, dst=rank - 1, group=_pp_group())
+        _STAGE_BOX[("bwd", rank - 1)] = input_tensor_grad.detach()
 
 
 def recv_backward(pp_last_stage=None, shape=None, dtype=None):

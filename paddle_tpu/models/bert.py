@@ -120,6 +120,8 @@ class BertModel(Layer):
         self.embeddings = BertEmbeddings(cfg)
         self.encoder = LayerList([BertLayer(cfg) for _ in range(cfg.num_hidden_layers)])
         self.pooler = BertPooler(cfg)
+        if cfg.dtype != "float32":
+            self.to(dtype=cfg.dtype)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         if attention_mask is not None:

@@ -93,6 +93,8 @@ class GPTModel(Layer):
         self.drop = Dropout(cfg.dropout)
         self.h = LayerList([GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        if cfg.dtype != "float32":
+            self.to(dtype=cfg.dtype)
 
     def forward(self, input_ids, attn_mask=None):
         b, s = input_ids.shape

@@ -13,9 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.autograd.engine import apply
-from paddle_tpu.models.llama import (
-    LlamaAttention, LlamaConfig, _rope_cos_sin,
-)
+from paddle_tpu.models.llama import LlamaAttention, LlamaConfig
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer.common import Embedding, Linear
 from paddle_tpu.nn.layer.container import LayerList
@@ -133,11 +131,11 @@ class MoEForCausalLM(Layer):
         self.layers = LayerList([MoEDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+        if cfg.dtype != "float32":
+            self.to(dtype=cfg.dtype)
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         h = self.embed_tokens(input_ids)
-        if self.config.dtype == "bfloat16":
-            h = h.astype("bfloat16")
         for blk in self.layers:
             h = blk(h, attn_mask)
         h = self.norm(h)

@@ -1,8 +1,11 @@
 """Normalization layers (python/paddle/nn/layer/norm.py parity)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+from paddle_tpu.autograd import engine as _engine
+from paddle_tpu.autograd.engine import apply
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.tensor.tensor import Tensor
@@ -215,7 +218,60 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
-                 dtype="float32"):
+    """Standalone spectral-norm layer (reference python/paddle/nn/layer/norm.py
+    SpectralNorm): power-iteration estimate of the largest singular value of
+    ``weight`` reshaped at ``dim``; forward(weight) returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer: use nn.utils.spectral_norm")
+        from paddle_tpu.core.dtype import convert_dtype
+        from paddle_tpu.tensor.random import default_generator
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        dt = convert_dtype(dtype)
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        import jax as _jax
+
+        ku, kv = _jax.random.split(default_generator.next_key())
+        self.weight_u = self.create_parameter([h], dtype=dtype)
+        self.weight_v = self.create_parameter([w], dtype=dtype)
+        with _engine.no_grad():
+            u = _jax.random.normal(ku, (h,))
+            v = _jax.random.normal(kv, (w,))
+            self.weight_u._data = (u / (jnp.linalg.norm(u) + eps)).astype(dt)
+            self.weight_v._data = (v / (jnp.linalg.norm(v) + eps)).astype(dt)
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+        u0, v0 = self.weight_u.data, self.weight_v.data
+
+        def f(w):
+            perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            u, v = u0, v0
+            for _ in range(max(iters, 1)):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            # reference semantics: u/v are constants for the gradient; only the
+            # sigma = u^T W v path backprops (matches nn.utils.spectral_norm)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u_new, v_new = apply("spectral_norm", f, x)
+        with _engine.no_grad():
+            self.weight_u._data = u_new.data
+            self.weight_v._data = v_new.data
+        out.stop_gradient = x.stop_gradient
+        return out

@@ -1,0 +1,20 @@
+"""paddle.static.amp (reference python/paddle/static/amp/): static-graph AMP.
+
+The TPU static path compiles through jax.jit, so decorate/auto_cast reuse the
+eager AMP machinery (paddle_tpu.amp) — the compiled program captures the casts."""
+from paddle_tpu.amp.auto_cast import auto_cast, decorate  # noqa: F401
+from paddle_tpu.amp.grad_scaler import GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "decorate", "GradScaler"]
+
+
+class CustomOpLists:
+    """White/black custom op lists (reference static/amp/fp16_lists.py)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        from paddle_tpu.amp.auto_cast import black_list, white_list
+
+        self.white_list = set(white_list()) | set(custom_white_list or [])
+        self.black_list = set(black_list()) | set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])

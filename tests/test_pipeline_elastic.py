@@ -119,6 +119,31 @@ class TestP2PAPI:
         dist.recv(buf, src=dist.get_rank())
         np.testing.assert_allclose(buf.numpy(), [0, 1, 2])
 
+    def test_stage_mailbox_roundtrip(self):
+        """Middle-stage send/recv pair through the stage-addressed mailbox."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import p2p_communication as p2p
+
+        class FakeHCG:
+            def get_stage_id(self):
+                return self._stage
+
+            def get_pipe_parallel_world_size(self):
+                return 3
+
+        hcg = FakeHCG()
+        p2p.initialize_p2p_groups(hcg)
+        act = paddle.to_tensor(np.arange(4, dtype="float32"))
+        hcg._stage = 0
+        p2p.send_forward(act)                      # stage 0 → stage 1
+        hcg._stage = 1
+        got = p2p.recv_forward()
+        np.testing.assert_allclose(got.numpy(), act.numpy())
+        grad = paddle.to_tensor(np.full(4, 2.0, "float32"))
+        p2p.send_backward(grad)                    # stage 1 → stage 0
+        hcg._stage = 0
+        gback = p2p.recv_backward()
+        np.testing.assert_allclose(gback.numpy(), grad.numpy())
+
 
 class TestElastic:
     def test_scale_out_detection(self):
