@@ -254,6 +254,11 @@ class ShmRing:
         self._lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                            ctypes.c_uint64,
                                            ctypes.POINTER(ctypes.c_uint64)]
+        self._lib.shm_ring_pop_timed.restype = ctypes.c_int64
+        self._lib.shm_ring_pop_timed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                                 ctypes.c_uint64,
+                                                 ctypes.POINTER(ctypes.c_uint64),
+                                                 ctypes.c_int64]
         self._lib.shm_ring_close.argtypes = [ctypes.c_void_p]
         self._lib.shm_ring_destroy.argtypes = [ctypes.c_void_p]
         if create:
@@ -271,14 +276,21 @@ class ShmRing:
         if rc == -2:
             raise ValueError("message larger than ring capacity")
 
-    def pop(self, max_size=16 << 20):
+    def pop(self, max_size=16 << 20, timeout_ms=None):
+        """Blocking pop; with timeout_ms raises TimeoutError on expiry."""
         buf = ctypes.create_string_buffer(max_size)
         req = ctypes.c_uint64(0)
-        n = self._lib.shm_ring_pop(self._h, buf, max_size, ctypes.byref(req))
+        if timeout_ms is None:
+            n = self._lib.shm_ring_pop(self._h, buf, max_size, ctypes.byref(req))
+        else:
+            n = self._lib.shm_ring_pop_timed(self._h, buf, max_size,
+                                             ctypes.byref(req), int(timeout_ms))
         if n == -1:
             raise EOFError("ring closed and drained")
+        if n == -2:
+            raise TimeoutError(f"shm ring pop timed out after {timeout_ms} ms")
         if n == -3:
-            return self.pop(max_size=int(req.value))
+            return self.pop(max_size=int(req.value), timeout_ms=timeout_ms)
         return buf.raw[:n]
 
     def close(self):

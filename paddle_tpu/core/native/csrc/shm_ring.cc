@@ -9,6 +9,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <ctime>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -131,13 +132,45 @@ int shm_ring_push(void* h, const uint8_t* payload, uint64_t n) {
   return 0;
 }
 
-// Returns payload length (>=0), -1 if closed+empty, -3 if buffer too small
-// (then *required is set and the record is left in place).
+// Returns payload length (>=0), -1 if closed+empty, -2 on timeout, -3 if
+// buffer too small (then *required is set and the record is left in place).
+// timeout_ms < 0 waits forever.
+static int64_t pop_impl(Ring* r, uint8_t* buf, uint64_t cap, uint64_t* required,
+                        int64_t timeout_ms);
+
 int64_t shm_ring_pop(void* h, uint8_t* buf, uint64_t cap, uint64_t* required) {
-  auto* r = static_cast<Ring*>(h);
+  return pop_impl(static_cast<Ring*>(h), buf, cap, required, -1);
+}
+
+int64_t shm_ring_pop_timed(void* h, uint8_t* buf, uint64_t cap,
+                           uint64_t* required, int64_t timeout_ms) {
+  return pop_impl(static_cast<Ring*>(h), buf, cap, required, timeout_ms);
+}
+
+static int64_t pop_impl(Ring* r, uint8_t* buf, uint64_t cap, uint64_t* required,
+                        int64_t timeout_ms) {
   pthread_mutex_lock(&r->hdr->mu);
-  while (r->hdr->used == 0 && !r->hdr->closed)
-    pthread_cond_wait(&r->hdr->not_empty, &r->hdr->mu);
+  if (timeout_ms < 0) {
+    while (r->hdr->used == 0 && !r->hdr->closed)
+      pthread_cond_wait(&r->hdr->not_empty, &r->hdr->mu);
+  } else {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (r->hdr->used == 0 && !r->hdr->closed) {
+      if (pthread_cond_timedwait(&r->hdr->not_empty, &r->hdr->mu, &ts) != 0) {
+        if (r->hdr->used == 0) {
+          pthread_mutex_unlock(&r->hdr->mu);
+          return -2;
+        }
+      }
+    }
+  }
   if (r->hdr->used == 0 && r->hdr->closed) {
     pthread_mutex_unlock(&r->hdr->mu);
     return -1;

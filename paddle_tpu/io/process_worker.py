@@ -1,0 +1,115 @@
+"""Multiprocess DataLoader workers over the native shared-memory ring.
+
+Reference: python/paddle/io/dataloader/worker.py — worker *processes* pull
+index batches and push samples through queues; the C++ side moves data through
+blocking queues (paddle/fluid/framework/data_feed.cc).  Here each worker is a
+real subprocess (not fork: safe with an initialized runtime) that receives the
+pickled dataset once, builds its share of the batches, and streams pickled
+(batch_index, batch) records through one core.native.ShmRing — a single shm
+copy instead of a pickle pipe per sample.
+
+Worker protocol (records in the ring):
+    pickle((batch_idx:int, payload:bytes)) — a finished batch
+    pickle(("__done__", worker_id))        — worker drained its share
+    pickle(("__error__", traceback_str))   — worker crashed
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import traceback
+
+
+def spawn_workers(dataset, batches, collate_fn, num_workers, ring_prefix,
+                  worker_init_fn=None, seed=None):
+    """Serialize the job once, launch ``num_workers`` subprocesses.
+
+    One ring per worker (``{ring_prefix}_w{i}``): each worker pushes its share
+    of batches *in its own order*, so the parent reads batch ``b`` directly
+    from ring ``b % num_workers`` — no reorder buffer, and a slow consumer
+    back-pressures exactly the worker that is ahead (bounded memory)."""
+    payload = {
+        "dataset": dataset,
+        "batches": batches,
+        "collate_fn": collate_fn,
+        "num_workers": num_workers,
+        "worker_init_fn": worker_init_fn,
+        "seed": seed,
+    }
+    fd, path = tempfile.mkstemp(suffix=".pdl")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            # frame 1: plain sys.path (always unpicklable-safe) so the worker
+            # can resolve user modules before touching frame 2
+            pickle.dump(list(sys.path), f)
+            pickle.dump(payload, f)
+    except (pickle.PicklingError, AttributeError, TypeError) as e:
+        os.unlink(path)
+        raise ValueError(
+            "use_process_workers=True requires the dataset/collate_fn/"
+            "worker_init_fn to be picklable by import path (defined in an "
+            "importable module, not __main__ or a REPL); use thread workers "
+            f"(use_process_workers=False) otherwise. Pickle error: {e}"
+        ) from e
+    procs = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # workers do host-side IO, never touch the TPU
+    for wid in range(num_workers):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.io.process_worker",
+             path, f"{ring_prefix}_w{wid}", str(wid)],
+            env=env,
+        ))
+    return procs, path
+
+
+def _worker_main(payload_path, ring_name, worker_id):
+    # adopt the parent's module search path BEFORE unpickling user classes
+    with open(payload_path, "rb") as f:
+        parent_path = pickle.load(f)
+        for entry in reversed(parent_path):
+            if entry not in sys.path:
+                sys.path.insert(0, entry)
+        job_blob = f.read()
+
+    from paddle_tpu.core.native import ShmRing
+
+    ring = ShmRing(ring_name, create=False)
+    try:
+        job = pickle.loads(job_blob)
+        dataset = job["dataset"]
+        collate = job["collate_fn"]
+        nw = job["num_workers"]
+        # populate get_worker_info() for per-worker dataset sharding logic
+        from paddle_tpu.io.reader import WorkerInfo, _worker_info
+
+        _worker_info.info = WorkerInfo(worker_id, nw, dataset)
+        if job.get("worker_init_fn"):
+            job["worker_init_fn"](worker_id)
+        if job.get("seed") is not None:
+            import numpy as np
+
+            np.random.seed(job["seed"] + worker_id)
+        for bi, indices in enumerate(job["batches"]):
+            if bi % nw != worker_id:
+                continue
+            samples = [dataset[i] for i in indices]
+            batch = collate(samples)
+            ring.push(pickle.dumps((bi, batch), protocol=pickle.HIGHEST_PROTOCOL))
+        ring.push(pickle.dumps(("__done__", worker_id)))
+    except Exception:
+        try:
+            ring.push(pickle.dumps(("__error__", traceback.format_exc())))
+        except Exception:
+            pass
+        raise
+    # NOTE: no ring.close() — the ring is shared by all workers; closing it
+    # here would cut off peers still streaming.  The "__done__" record is the
+    # per-worker end-of-stream signal; the parent destroys the ring.
+
+
+if __name__ == "__main__":
+    _worker_main(sys.argv[1], sys.argv[2], int(sys.argv[3]))

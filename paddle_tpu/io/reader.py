@@ -59,13 +59,47 @@ def default_collate_fn(batch):
     raise TypeError(f"batch data can not be a type of {type(sample)}")
 
 
+def _tree_to_numpy(obj):
+    """Tensors → ndarrays for cross-process pickling (namedtuple-safe)."""
+    import jax as _jax
+    import numpy as _np
+
+    from paddle_tpu.tensor.tensor import Tensor as _T
+
+    return _jax.tree_util.tree_map(
+        lambda o: _np.asarray(o.numpy()) if isinstance(o, _T) else o, obj,
+        is_leaf=lambda o: isinstance(o, _T),
+    )
+
+
+def _tree_to_tensor(obj):
+    import jax as _jax
+    import numpy as _np
+
+    from paddle_tpu.tensor.tensor import Tensor as _T
+
+    return _jax.tree_util.tree_map(
+        lambda o: _T(o) if isinstance(o, _np.ndarray) else o, obj,
+    )
+
+
+class _NumpyCollate:
+    """Picklable wrapper: run the user's collate in the worker, ship numpy."""
+
+    def __init__(self, collate_fn):
+        self._collate = collate_fn
+
+    def __call__(self, samples):
+        return _tree_to_numpy(self._collate(samples))
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -73,6 +107,7 @@ class DataLoader:
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_process_workers = use_process_workers
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -133,7 +168,93 @@ class DataLoader:
                 for idx in self._index_batches():
                     yield self._make_batch(idx)
             return
+        if self.use_process_workers:
+            if self._iterable:
+                raise ValueError(
+                    "use_process_workers=True does not support IterableDataset "
+                    "(the stream cannot be sharded by index); use map-style "
+                    "datasets or thread workers"
+                )
+            yield from self._iter_process_workers()
+            return
         yield from self._iter_prefetch()
+
+    # --------------------------------------------- process workers (shm ring)
+    _epoch_counter = itertools.count()
+
+    def _iter_process_workers(self):
+        """Real worker subprocesses streaming batches through native
+        shared-memory rings (reference python/paddle/io/dataloader/worker.py +
+        data_feed.cc blocking queues).
+
+        One ring per worker; worker w pushes batches w, w+nw, ... in order, so
+        the parent reads batch b straight from ring b % nw — sampler order with
+        no reorder buffer, and ring capacity gives per-worker backpressure."""
+        import os
+        import pickle
+        import subprocess
+
+        from paddle_tpu.core.native import ShmRing
+        from paddle_tpu.io.process_worker import spawn_workers
+
+        batches = list(self._index_batches())
+        if not batches:
+            return
+        nw = min(self.num_workers, len(batches))
+        prefix = f"/pdl_{os.getpid()}_{id(self)}_{next(DataLoader._epoch_counter)}"
+        rings = [ShmRing(f"{prefix}_w{w}", capacity=(64 << 20) // nw, create=True)
+                 for w in range(nw)]
+        numpy_collate = _NumpyCollate(self.collate_fn)
+        procs, payload_path = spawn_workers(
+            self.dataset, batches, numpy_collate, nw, prefix,
+            worker_init_fn=self.worker_init_fn,
+        )
+        poll_ms = 1000
+        deadline = self.timeout if self.timeout and self.timeout > 0 else None
+        try:
+            for bi in range(len(batches)):
+                w = bi % nw
+                waited = 0.0
+                while True:
+                    try:
+                        raw = rings[w].pop(timeout_ms=poll_ms)
+                        break
+                    except TimeoutError:
+                        waited += poll_ms / 1000.0
+                        rc = procs[w].poll()
+                        if rc is not None and rc != 0:
+                            raise RuntimeError(
+                                f"DataLoader worker {w} died with exit code {rc}"
+                            )
+                        if deadline is not None and waited >= deadline:
+                            raise TimeoutError(
+                                f"DataLoader batch {bi} not produced within "
+                                f"timeout={self.timeout}s"
+                            )
+                msg = pickle.loads(raw)
+                tag = msg[0]
+                if tag == "__error__":
+                    raise RuntimeError(f"DataLoader worker failed:\n{msg[1]}")
+                if tag == "__done__":
+                    raise RuntimeError(
+                        f"DataLoader worker {w} finished early (expected batch {bi})"
+                    )
+                yield _tree_to_tensor(msg[1])
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=5)
+            for r in rings:
+                r.destroy()
+            try:
+                os.unlink(payload_path)
+            except OSError:
+                pass
 
     def _iter_prefetch(self):
         """Bounded-queue prefetch with worker threads (order-preserving)."""

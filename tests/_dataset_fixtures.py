@@ -1,0 +1,27 @@
+"""Importable dataset fixtures for process-worker DataLoader tests (subprocess
+workers unpickle by import path, so these cannot live inside a test function)."""
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n=23, feat=4):
+        self.n = n
+        self.feat = feat
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((self.feat,), i, np.float32), np.int64(i % 3)
+
+
+class FailingDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at index 5")
+        return np.zeros(2, np.float32)

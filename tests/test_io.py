@@ -227,3 +227,61 @@ class TestDistributedCheckpoint:
         save_state_dict({"w": w}, path)
         files = [f for f in os.listdir(path) if f.endswith(".npy")]
         assert len(files) == 1  # 8 replicated device shards -> 1 file
+
+
+class TestProcessWorkers:
+    """Multiprocess DataLoader over the native shm ring (reference
+    python/paddle/io/dataloader/worker.py process workers)."""
+
+    def test_ordered_batches(self):
+        from tests._dataset_fixtures import RangeDataset
+
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(RangeDataset(23), batch_size=4, num_workers=3,
+                        use_process_workers=True)
+        seen = [x.numpy()[:, 0].tolist() for x, y in dl]
+        flat = [v for b in seen for v in b]
+        assert flat == [float(i) for i in range(23)]
+
+    def test_two_epochs(self):
+        from tests._dataset_fixtures import RangeDataset
+
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(RangeDataset(10), batch_size=5, num_workers=2,
+                        use_process_workers=True)
+        e1 = [x.numpy()[:, 0].tolist() for x, y in dl]
+        e2 = [x.numpy()[:, 0].tolist() for x, y in dl]
+        assert e1 == e2 and len(e1) == 2
+
+    def test_worker_error_propagates(self):
+        import pytest
+
+        from tests._dataset_fixtures import FailingDataset
+
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(FailingDataset(), batch_size=2, num_workers=2,
+                        use_process_workers=True)
+        with pytest.raises(RuntimeError, match="boom at index 5"):
+            list(dl)
+
+    def test_unpicklable_dataset_clear_error(self):
+        import pytest
+
+        import numpy as np
+
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Local(Dataset):  # defined in a function: not importable
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.zeros(2, np.float32)
+
+        dl = DataLoader(Local(), batch_size=2, num_workers=2,
+                        use_process_workers=True)
+        with pytest.raises(ValueError, match="picklable"):
+            list(dl)
