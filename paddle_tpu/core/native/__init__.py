@@ -278,7 +278,10 @@ class ShmRing:
 
     def pop(self, max_size=16 << 20, timeout_ms=None):
         """Blocking pop; with timeout_ms raises TimeoutError on expiry."""
-        buf = ctypes.create_string_buffer(max_size)
+        buf = getattr(self, "_pop_buf", None)
+        if buf is None or len(buf) < max_size:
+            buf = ctypes.create_string_buffer(max_size)
+            self._pop_buf = buf
         req = ctypes.c_uint64(0)
         if timeout_ms is None:
             n = self._lib.shm_ring_pop(self._h, buf, max_size, ctypes.byref(req))

@@ -1,0 +1,199 @@
+"""FleetExecutor — actor-style multi-program runner (reference
+paddle/fluid/distributed/fleet_executor/: Carrier + Interceptors passing
+messages over a brpc MessageBus; runtime_graph.cc wires source→compute→sink).
+
+TPU-native shape: interceptors are in-process actors with mailbox threads;
+the MessageBus is a thread-safe router (cross-host hops would ride
+paddle.distributed.rpc).  Compute interceptors run jitted XLA callables, so the
+actor graph orchestrates *compiled programs* — the same role the reference's
+carrier plays for its pipeline-style multi-program plans."""
+from __future__ import annotations
+
+import queue
+import threading
+
+__all__ = ["Message", "MessageBus", "Interceptor", "ComputeInterceptor",
+           "SourceInterceptor", "SinkInterceptor", "AmplifierInterceptor",
+           "CondInterceptor", "Carrier"]
+
+_STOP = "__stop__"
+_DATA = "data"
+
+
+class Message:
+    def __init__(self, msg_type, src_id, dst_id, payload=None, scope_idx=0):
+        self.msg_type = msg_type
+        self.src_id = src_id
+        self.dst_id = dst_id
+        self.payload = payload
+        self.scope_idx = scope_idx
+
+
+class MessageBus:
+    """Routes messages to interceptor mailboxes (message_bus.cc analog)."""
+
+    def __init__(self):
+        self._boxes = {}
+
+    def register(self, interceptor_id, mailbox):
+        self._boxes[interceptor_id] = mailbox
+
+    def send(self, msg: Message):
+        box = self._boxes.get(msg.dst_id)
+        if box is None:
+            raise KeyError(f"no interceptor {msg.dst_id} on the bus")
+        box.put(msg)
+        return True
+
+
+class Interceptor:
+    """Base actor: mailbox + handler thread (interceptor.h analog)."""
+
+    def __init__(self, interceptor_id, bus: MessageBus):
+        self.id = interceptor_id
+        self.bus = bus
+        self.mailbox: queue.Queue = queue.Queue()
+        bus.register(interceptor_id, self.mailbox)
+        self.downstreams = []
+        self.num_upstreams = 0  # set by Carrier.connect; 0 treated as 1
+        self._thread = None
+
+    def add_downstream(self, interceptor_id):
+        self.downstreams.append(interceptor_id)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _loop(self):
+        # fan-in: stop only after EVERY upstream has stopped (the reference
+        # carrier counts upstream stop notifications the same way)
+        stops_needed = max(self.num_upstreams, 1)
+        stops = 0
+        while True:
+            msg = self.mailbox.get()
+            if msg.msg_type == _STOP:
+                stops += 1
+                if stops >= stops_needed:
+                    for d in self.downstreams:
+                        self.bus.send(Message(_STOP, self.id, d))
+                    return
+                continue
+            self.handle(msg)
+
+    def handle(self, msg: Message):
+        raise NotImplementedError
+
+    def send_downstream(self, payload, scope_idx=0):
+        for d in self.downstreams:
+            self.bus.send(Message(_DATA, self.id, d, payload, scope_idx))
+
+
+class SourceInterceptor(Interceptor):
+    """Feeds micro-batches into the graph (source_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, bus, data_iter):
+        super().__init__(interceptor_id, bus)
+        self._data = data_iter
+
+    def run(self):
+        for i, item in enumerate(self._data):
+            self.send_downstream(item, scope_idx=i)
+        for d in self.downstreams:
+            self.bus.send(Message(_STOP, self.id, d))
+
+    def start(self):
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+
+class ComputeInterceptor(Interceptor):
+    """Runs a callable (a jitted program) on each message (compute_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, bus, fn):
+        super().__init__(interceptor_id, bus)
+        self._fn = fn
+
+    def handle(self, msg):
+        self.send_downstream(self._fn(msg.payload), msg.scope_idx)
+
+
+class AmplifierInterceptor(Interceptor):
+    """Fan-out: replays each message N times (amplifier_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, bus, times):
+        super().__init__(interceptor_id, bus)
+        self._times = times
+
+    def handle(self, msg):
+        for _ in range(self._times):
+            self.send_downstream(msg.payload, msg.scope_idx)
+
+
+class CondInterceptor(Interceptor):
+    """Routes by predicate: True → first downstream, False → second."""
+
+    def __init__(self, interceptor_id, bus, pred):
+        super().__init__(interceptor_id, bus)
+        self._pred = pred
+
+    def handle(self, msg):
+        branch = 0 if self._pred(msg.payload) else 1
+        dst = self.downstreams[branch]
+        self.bus.send(Message(_DATA, self.id, dst, msg.payload, msg.scope_idx))
+
+
+class SinkInterceptor(Interceptor):
+    """Collects results in scope order (sink_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, bus):
+        super().__init__(interceptor_id, bus)
+        self.results = {}
+        self.done = threading.Event()
+
+    def handle(self, msg):
+        self.results.setdefault(msg.scope_idx, []).append(msg.payload)
+
+    def _loop(self):
+        super()._loop()
+        self.done.set()
+
+    def ordered_results(self):
+        out = []
+        for k in sorted(self.results):
+            out.extend(self.results[k])
+        return out
+
+
+class Carrier:
+    """Owns the interceptors of one rank's sub-graph and runs them
+    (carrier.cc).  ``run`` blocks until every sink drains."""
+
+    def __init__(self):
+        self.bus = MessageBus()
+        self.interceptors = {}
+
+    def add(self, interceptor: Interceptor):
+        self.interceptors[interceptor.id] = interceptor
+        return interceptor
+
+    def connect(self, src_id, dst_id):
+        self.interceptors[src_id].add_downstream(dst_id)
+        self.interceptors[dst_id].num_upstreams += 1
+
+    def run(self, timeout=60):
+        sinks = [i for i in self.interceptors.values() if isinstance(i, SinkInterceptor)]
+        for i in self.interceptors.values():
+            if not isinstance(i, SourceInterceptor):
+                i.start()
+        for i in self.interceptors.values():
+            if isinstance(i, SourceInterceptor):
+                i.start()
+        for s in sinks:
+            if not s.done.wait(timeout):
+                raise TimeoutError("FleetExecutor sink did not drain in time")
+        return {s.id: s.ordered_results() for s in sinks}

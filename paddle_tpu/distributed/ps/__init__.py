@@ -1,0 +1,16 @@
+"""paddle.distributed.ps — parameter-server (sparse/CTR) track.
+
+Reference: the brpc-based PS stack (paddle/fluid/distributed/ps/ — BrpcPsClient/
+Server, sparse/dense tables, accessors; python the_one_ps.py runtimes).
+
+TPU-native shape: dense compute stays on the chip; the *sparse* side (huge
+embedding tables that don't fit HBM) lives on host parameter servers.  Tables
+are served over paddle.distributed.rpc (the brpc analog); workers pull rows for
+the ids in a batch, run the dense model on TPU, and push sparse grads back —
+the heter-PS pattern (SURVEY.md §2.6)."""
+from paddle_tpu.distributed.ps.table import DenseTable, SparseTable
+from paddle_tpu.distributed.ps.the_one_ps import PsServer, PsWorker, TheOnePSRuntime
+from paddle_tpu.distributed.ps.embedding import DistributedEmbedding
+
+__all__ = ['SparseTable', 'DenseTable', 'PsServer', 'PsWorker',
+           'TheOnePSRuntime', 'DistributedEmbedding']

@@ -1,0 +1,42 @@
+"""DistributedEmbedding: the heter-PS pattern — sparse rows pulled from the
+host parameter server, dense compute on TPU, sparse grads pushed back
+(reference paddle.static.nn.sparse_embedding + pull_sparse ops)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class DistributedEmbedding(Layer):
+    def __init__(self, worker, table_name, dim, accessor="sgd", **accessor_kwargs):
+        super().__init__()
+        self._worker = worker
+        self._table = table_name
+        self._dim = dim
+        worker.create_sparse_table(table_name, dim, accessor=accessor, **accessor_kwargs)
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids.numpy(), np.int64)
+        flat = ids_np.reshape(-1)
+        rows = self._worker.pull_sparse(self._table, flat)  # (N, dim) host pull
+        rows_t = Tensor(jnp.asarray(rows))
+        rows_t.stop_gradient = False
+
+        worker, table = self._worker, self._table
+
+        def push_hook(grad):
+            # sparse grad → server, off the device (detached host push)
+            worker.push_sparse(table, flat, np.asarray(grad.numpy(), np.float32))
+            return grad
+
+        rows_t.register_hook(push_hook)
+        out = apply(
+            "dist_embed_reshape", lambda r: r.reshape(ids_np.shape + (self._dim,)),
+            rows_t,
+        )
+        return out

@@ -1,0 +1,118 @@
+"""PS tables (reference paddle/fluid/distributed/ps/table/: memory_sparse_table,
+common_dense_table + CTR accessors).
+
+SparseTable: id → embedding row, lazily initialized on first pull (the
+reference's create-on-miss semantics for unbounded CTR id spaces), updated by
+a pluggable accessor (sgd / adagrad, the CtrCommonAccessor analogs)."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class _SGDAccessor:
+    def __init__(self, lr=0.05):
+        self.lr = lr
+
+    def init_row(self, dim, rng):
+        return (rng.standard_normal(dim) * 0.01).astype(np.float32), None
+
+    def update(self, row, state, grad):
+        return row - self.lr * grad, state
+
+
+class _AdagradAccessor:
+    def __init__(self, lr=0.05, eps=1e-8):
+        self.lr = lr
+        self.eps = eps
+
+    def init_row(self, dim, rng):
+        return (rng.standard_normal(dim) * 0.01).astype(np.float32), np.zeros(dim, np.float32)
+
+    def update(self, row, state, grad):
+        state = state + grad * grad
+        return row - self.lr * grad / (np.sqrt(state) + self.eps), state
+
+
+_ACCESSORS = {"sgd": _SGDAccessor, "adagrad": _AdagradAccessor}
+
+
+class SparseTable:
+    def __init__(self, dim, accessor="sgd", seed=0, **accessor_kwargs):
+        self.dim = dim
+        self._rows = {}
+        self._states = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._accessor = _ACCESSORS[accessor](**accessor_kwargs)
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(ids.tolist()):
+                row = self._rows.get(key)
+                if row is None:
+                    row, st = self._accessor.init_row(self.dim, self._rng)
+                    self._rows[key] = row
+                    self._states[key] = st
+                out[i] = row
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        # duplicate ids in one batch: accumulate grads first (reference merge)
+        merged = {}
+        for key, g in zip(ids.tolist(), grads):
+            if key in merged:
+                merged[key] = merged[key] + g
+            else:
+                merged[key] = g.copy()
+        with self._lock:
+            for key, g in merged.items():
+                if key not in self._rows:
+                    row, st = self._accessor.init_row(self.dim, self._rng)
+                    self._rows[key] = row
+                    self._states[key] = st
+                self._rows[key], self._states[key] = self._accessor.update(
+                    self._rows[key], self._states[key], g
+                )
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def save(self, path):
+        with self._lock:
+            keys = np.fromiter(self._rows.keys(), np.int64, len(self._rows))
+            vals = np.stack(list(self._rows.values())) if self._rows else np.zeros((0, self.dim), np.float32)
+        np.savez(path, keys=keys, vals=vals)
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            self._rows = {int(k): v for k, v in zip(data["keys"], data["vals"])}
+            # optimizer state is not persisted (reference CTR tables re-warm it);
+            # re-initialize so post-load pushes have valid accumulator state
+            self._states = {}
+            for key in self._rows:
+                _, st = self._accessor.init_row(self.dim, self._rng)
+                self._states[key] = st
+
+
+class DenseTable:
+    def __init__(self, shape, lr=0.05, seed=0):
+        rng = np.random.default_rng(seed)
+        self._param = (rng.standard_normal(shape) * 0.01).astype(np.float32)
+        self.lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self._param.copy()
+
+    def push(self, grad):
+        with self._lock:
+            self._param = self._param - self.lr * np.asarray(grad, np.float32)

@@ -1,0 +1,100 @@
+"""TheOnePS runtime (reference python/paddle/distributed/ps/the_one_ps.py +
+fleet/runtime/the_one_ps.py): server hosts tables, workers pull/push over rpc."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.distributed.ps.table import DenseTable, SparseTable
+
+_SERVER_TABLES = {}
+
+
+# ------------------------- functions executed ON the server via rpc ----------
+def _srv_create_sparse(name, dim, accessor, kwargs):
+    _SERVER_TABLES[name] = SparseTable(dim, accessor=accessor, **kwargs)
+    return True
+
+
+def _srv_pull_sparse(name, ids):
+    return _SERVER_TABLES[name].pull(ids)
+
+
+def _srv_push_sparse(name, ids, grads):
+    _SERVER_TABLES[name].push(ids, grads)
+    return True
+
+
+def _srv_table_size(name):
+    return _SERVER_TABLES[name].size()
+
+
+def _srv_save(name, path):
+    _SERVER_TABLES[name].save(path)
+    return True
+
+
+def _srv_load(name, path):
+    _SERVER_TABLES[name].load(path)
+    return True
+
+
+class PsServer:
+    """Server role: hosts the tables inside this process's rpc endpoint."""
+
+    def __init__(self, name="ps0"):
+        from paddle_tpu.distributed import rpc
+
+        self.name = name
+        if rpc.get_current_worker_info() is None:
+            rpc.init_rpc(name)
+
+    def run(self):  # the reference blocks in server loop; rpc serves in-thread
+        return self
+
+
+class PsWorker:
+    """Worker role: rpc client with pull/push API (BrpcPsClient analog)."""
+
+    def __init__(self, server_name="ps0"):
+        from paddle_tpu.distributed import rpc
+
+        self.server = server_name
+        self._rpc = rpc
+
+    def create_sparse_table(self, name, dim, accessor="sgd", **kwargs):
+        return self._rpc.rpc_sync(self.server, _srv_create_sparse,
+                                  args=(name, dim, accessor, kwargs))
+
+    def pull_sparse(self, name, ids):
+        return self._rpc.rpc_sync(self.server, _srv_pull_sparse, args=(name, np.asarray(ids)))
+
+    def push_sparse(self, name, ids, grads):
+        return self._rpc.rpc_sync(self.server, _srv_push_sparse,
+                                  args=(name, np.asarray(ids), np.asarray(grads)))
+
+    def push_sparse_async(self, name, ids, grads):
+        return self._rpc.rpc_async(self.server, _srv_push_sparse,
+                                   args=(name, np.asarray(ids), np.asarray(grads)))
+
+    def table_size(self, name):
+        return self._rpc.rpc_sync(self.server, _srv_table_size, args=(name,))
+
+    def save(self, name, path):
+        return self._rpc.rpc_sync(self.server, _srv_save, args=(name, path))
+
+    def load(self, name, path):
+        return self._rpc.rpc_sync(self.server, _srv_load, args=(name, path))
+
+
+class TheOnePSRuntime:
+    """Role dispatch (reference the_one_ps.py): SERVER hosts, WORKER connects."""
+
+    def __init__(self, role="worker", server_name="ps0"):
+        self.role = role
+        if role == "server":
+            self._impl = PsServer(server_name)
+        else:
+            self._impl = PsWorker(server_name)
+
+    def __getattr__(self, item):
+        return getattr(self._impl, item)

@@ -3,9 +3,10 @@
 TPU-native worker model: the reference forks worker *processes*
 (io/dataloader/worker.py) because CPython+CUDA tolerates fork; the TPU/JAX
 runtime does not (forking after backend init deadlocks the PJRT client), so
-``num_workers > 0`` here means a prefetching *thread* pool feeding a bounded
+``num_workers > 0`` defaults to a prefetching *thread* pool feeding a bounded
 queue — same overlap (host decode vs device step), no fork hazard.  True
-multiprocessing belongs to a spawn-based Dataset service (future work, mirrors
+``use_process_workers=True`` upgrades to real subprocess workers streaming
+batches through per-worker native shared-memory rings (mirrors
 the reference's Dataset/data_feed path).
 """
 from __future__ import annotations
@@ -81,6 +82,20 @@ def _tree_to_tensor(obj):
     return _jax.tree_util.tree_map(
         lambda o: _T(o) if isinstance(o, _np.ndarray) else o, obj,
     )
+
+
+def _numpy_default_collate(samples):
+    """default_collate_fn's numpy twin for subprocess workers: stacks with
+    numpy only, so workers never materialize jax arrays."""
+    import numpy as _np
+
+    first = samples[0]
+    if isinstance(first, (list, tuple)):
+        return type(first)(_numpy_default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _numpy_default_collate([s[k] for s in samples]) for k in first}
+    return _np.stack([_np.asarray(s) for s in samples])
 
 
 class _NumpyCollate:
@@ -202,19 +217,25 @@ class DataLoader:
             return
         nw = min(self.num_workers, len(batches))
         prefix = f"/pdl_{os.getpid()}_{id(self)}_{next(DataLoader._epoch_counter)}"
-        rings = [ShmRing(f"{prefix}_w{w}", capacity=(64 << 20) // nw, create=True)
-                 for w in range(nw)]
-        numpy_collate = _NumpyCollate(self.collate_fn)
-        procs, payload_path = spawn_workers(
-            self.dataset, batches, numpy_collate, nw, prefix,
-            worker_init_fn=self.worker_init_fn,
-        )
+        # workers collate straight to numpy (no per-worker jax arrays); the
+        # default collate gets a numpy-native twin
+        collate = (_numpy_default_collate if self.collate_fn is default_collate_fn
+                   else _NumpyCollate(self.collate_fn))
+        rings = []
+        procs, payload_path = [], None
         poll_ms = 1000
         deadline = self.timeout if self.timeout and self.timeout > 0 else None
         try:
+            rings = [ShmRing(f"{prefix}_w{w}", capacity=(64 << 20) // nw, create=True)
+                     for w in range(nw)]
+            procs, payload_path = spawn_workers(
+                self.dataset, batches, collate, nw, prefix,
+                worker_init_fn=self.worker_init_fn,
+            )
             for bi in range(len(batches)):
                 w = bi % nw
                 waited = 0.0
+                exited_at = None
                 while True:
                     try:
                         raw = rings[w].pop(timeout_ms=poll_ms)
@@ -226,6 +247,17 @@ class DataLoader:
                             raise RuntimeError(
                                 f"DataLoader worker {w} died with exit code {rc}"
                             )
+                        if rc == 0:
+                            # exited cleanly without this batch (e.g. sys.exit
+                            # in user code): allow one grace poll for in-flight
+                            # data, then fail instead of spinning forever
+                            if exited_at is None:
+                                exited_at = waited
+                            elif waited - exited_at >= 2 * poll_ms / 1000.0:
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} exited without "
+                                    f"producing batch {bi}"
+                                )
                         if deadline is not None and waited >= deadline:
                             raise TimeoutError(
                                 f"DataLoader batch {bi} not produced within "
@@ -251,10 +283,11 @@ class DataLoader:
                     p.wait(timeout=5)
             for r in rings:
                 r.destroy()
-            try:
-                os.unlink(payload_path)
-            except OSError:
-                pass
+            if payload_path is not None:
+                try:
+                    os.unlink(payload_path)
+                except OSError:
+                    pass
 
     def _iter_prefetch(self):
         """Bounded-queue prefetch with worker threads (order-preserving)."""

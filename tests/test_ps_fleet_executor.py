@@ -1,0 +1,150 @@
+"""Parameter-server track + FleetExecutor actor runner (reference test/ps/ and
+fleet_executor C++ tests)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import rpc
+
+
+@pytest.fixture(scope="module")
+def ps_rpc():
+    rpc.init_rpc("ps0")
+    yield
+    rpc.shutdown()
+
+
+class TestSparseTable:
+    def test_lazy_init_and_update(self):
+        from paddle_tpu.distributed.ps.table import SparseTable
+
+        t = SparseTable(dim=4, accessor="sgd", lr=0.5)
+        rows = t.pull([10, 20, 10])
+        assert rows.shape == (3, 4)
+        np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+        assert t.size() == 2
+        g = np.ones((2, 4), np.float32)
+        before = t.pull([10, 20])
+        t.push([10, 20], g)
+        after = t.pull([10, 20])
+        np.testing.assert_allclose(after, before - 0.5 * g, rtol=1e-6)
+
+    def test_duplicate_ids_accumulate(self):
+        from paddle_tpu.distributed.ps.table import SparseTable
+
+        t = SparseTable(dim=2, accessor="sgd", lr=1.0)
+        before = t.pull([5])[0]
+        t.push([5, 5], np.ones((2, 2), np.float32))
+        after = t.pull([5])[0]
+        np.testing.assert_allclose(after, before - 2.0, rtol=1e-6)
+
+    def test_adagrad_accessor(self):
+        from paddle_tpu.distributed.ps.table import SparseTable
+
+        t = SparseTable(dim=2, accessor="adagrad", lr=1.0)
+        before = t.pull([1])[0]
+        t.push([1], np.full((1, 2), 2.0, np.float32))
+        after = t.pull([1])[0]
+        # adagrad first step: lr * g / sqrt(g^2) = lr * sign(g)
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-4)
+
+    def test_save_load(self):
+        from paddle_tpu.distributed.ps.table import SparseTable
+
+        t = SparseTable(dim=3)
+        t.pull([1, 2, 3])
+        p = os.path.join(tempfile.mkdtemp(), "table")
+        t.save(p)
+        t2 = SparseTable(dim=3)
+        t2.load(p)
+        np.testing.assert_allclose(t2.pull([1, 2, 3]), t.pull([1, 2, 3]))
+
+
+class TestPsWorker:
+    def test_pull_push_over_rpc(self, ps_rpc):
+        from paddle_tpu.distributed.ps import PsWorker
+
+        w = PsWorker("ps0")
+        w.create_sparse_table("emb_t", 4, accessor="sgd", lr=0.1)
+        rows = w.pull_sparse("emb_t", [1, 2])
+        w.push_sparse("emb_t", [1], np.ones((1, 4), np.float32))
+        after = w.pull_sparse("emb_t", [1])
+        np.testing.assert_allclose(after[0], rows[0] - 0.1, rtol=1e-5)
+        assert w.table_size("emb_t") == 2
+
+    def test_distributed_embedding_trains(self, ps_rpc):
+        from paddle_tpu.distributed.ps import DistributedEmbedding, PsWorker
+
+        w = PsWorker("ps0")
+        emb = DistributedEmbedding(w, "user_vec", dim=8, accessor="sgd", lr=0.5)
+        dense = paddle.nn.Linear(8, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=dense.parameters())
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]]), dtype="int64")
+        before = w.pull_sparse("user_vec", [1]).copy()
+        for _ in range(3):
+            out = emb(ids)
+            loss = (dense(out) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        after = w.pull_sparse("user_vec", [1])
+        assert not np.allclose(before, after)  # sparse rows updated server-side
+        assert w.table_size("user_vec") == 3
+
+
+class TestFleetExecutor:
+    def test_compute_pipeline(self):
+        from paddle_tpu.distributed.fleet_executor import (
+            Carrier, ComputeInterceptor, SinkInterceptor, SourceInterceptor,
+        )
+
+        c = Carrier()
+        c.add(SourceInterceptor("src", c.bus, iter(range(8))))
+        c.add(ComputeInterceptor("sq", c.bus, lambda x: x * x))
+        c.add(SinkInterceptor("sink", c.bus))
+        c.connect("src", "sq")
+        c.connect("sq", "sink")
+        res = c.run()
+        assert res["sink"] == [i * i for i in range(8)]
+
+    def test_cond_and_amplifier(self):
+        from paddle_tpu.distributed.fleet_executor import (
+            AmplifierInterceptor, Carrier, CondInterceptor, SinkInterceptor,
+            SourceInterceptor,
+        )
+
+        c = Carrier()
+        c.add(SourceInterceptor("src", c.bus, iter(range(4))))
+        c.add(CondInterceptor("cond", c.bus, lambda x: x < 2))
+        c.add(AmplifierInterceptor("amp", c.bus, 2))
+        c.add(SinkInterceptor("low", c.bus))
+        c.add(SinkInterceptor("high", c.bus))
+        c.connect("src", "cond")
+        c.connect("cond", "amp")   # True branch → amplifier → low
+        c.connect("cond", "high")  # False branch
+        c.connect("amp", "low")
+        res = c.run()
+        assert sorted(res["low"]) == [0, 0, 1, 1]
+        assert res["high"] == [2, 3]
+
+    def test_jitted_compute(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.fleet_executor import (
+            Carrier, ComputeInterceptor, SinkInterceptor, SourceInterceptor,
+        )
+
+        fn = jax.jit(lambda x: jnp.sum(x * 2))
+        c = Carrier()
+        data = [jnp.ones(4) * i for i in range(3)]
+        c.add(SourceInterceptor("src", c.bus, iter(data)))
+        c.add(ComputeInterceptor("prog", c.bus, fn))
+        c.add(SinkInterceptor("sink", c.bus))
+        c.connect("src", "prog")
+        c.connect("prog", "sink")
+        res = c.run()
+        assert [float(r) for r in res["sink"]] == [0.0, 8.0, 16.0]
