@@ -167,3 +167,109 @@ def set_printoptions(**kwargs):
 
     _np.set_printoptions(**{k: v for k, v in kwargs.items() if k in (
         "precision", "threshold", "edgeitems", "linewidth", "suppress")})
+
+from paddle_tpu.tensor.extra_ops import *  # noqa: F401,F403,E402
+
+# top-level re-exports the reference keeps in paddle.* (python/paddle/__init__.py)
+from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401,E402
+from paddle_tpu.tensor.random import (  # noqa: F401,E402
+    get_rng_state as get_cuda_rng_state, set_rng_state as set_cuda_rng_state,
+)
+
+
+class LazyGuard:
+    """Deferred-init guard (reference python/paddle/base/dygraph/base.py
+    LazyGuard): parameters created inside materialize lazily.  Eager jax arrays
+    are cheap to build, so this is a bookkeeping context for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def is_complex(x):
+    from paddle_tpu.core import dtype as _dt
+    return _dt.is_complex(x.dtype)
+
+
+def is_integer(x):
+    from paddle_tpu.core import dtype as _dt
+    return _dt.is_integer(x.dtype)
+
+
+def is_floating_point(x):
+    from paddle_tpu.core import dtype as _dt
+    return _dt.is_floating_point(x.dtype)
+
+
+def check_shape(x):  # static-graph debugging helper (reference static/nn/control_flow)
+    return list(x.shape)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader combinator (reference python/paddle/reader): groups a
+    sample generator into batches."""
+
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
+
+
+def _register_inplace_variants():
+    """The reference exposes ``op_``-suffixed inplace twins for elementwise ops
+    (generated from ops.yaml inplace specs); here they wrap the out-of-place op
+    via Tensor._in_place, preserving autograd."""
+    import sys
+
+    mod = sys.modules[__name__]
+    names = [
+        "abs", "acos", "asin", "atan", "cos", "sin", "tan", "sinh", "cosh",
+        "tanh", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+        "rsqrt", "square", "floor", "ceil", "round", "trunc", "frac", "neg",
+        "erf", "erfinv", "lgamma", "digamma", "gammaln", "sigmoid", "logit",
+        "i0", "sinc", "nan_to_num", "add", "subtract", "multiply", "divide",
+        "floor_divide", "remainder", "mod", "floor_mod", "pow", "gcd", "lcm",
+        "hypot", "ldexp", "copysign", "cumsum", "cumprod", "clip", "scale",
+        "equal", "less_than", "less_equal", "greater_than", "greater_equal",
+        "not_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_left_shift", "bitwise_right_shift", "tril", "triu", "t",
+        "transpose", "addmm", "multigammaln", "gammainc", "gammaincc",
+        "masked_scatter",
+    ]  # fill-style randoms (normal_/bernoulli_/cauchy_/geometric_/log_normal_)
+       # have their own signatures and live in tensor/extra_ops.py
+    from paddle_tpu.tensor.tensor import Tensor as _T
+
+    def make(base_fn):
+        def inplace(x, *args, **kwargs):
+            return x._in_place(base_fn(x, *args, **kwargs))
+
+        inplace.__name__ = base_fn.__name__ + "_"
+        return inplace
+
+    for n in names:
+        base = getattr(mod, n, None)
+        if base is None or hasattr(mod, n + "_"):
+            continue
+        fn = make(base)
+        setattr(mod, n + "_", fn)
+        if hasattr(_T, n) and not hasattr(_T, n + "_"):
+            setattr(_T, n + "_", fn)
+
+
+_register_inplace_variants()

@@ -3,6 +3,7 @@
 from paddle_tpu.tensor.tensor import Tensor, Parameter, is_tensor  # noqa: F401
 from paddle_tpu.tensor import (  # noqa: F401
     creation,
+    extra_ops,
     linalg,
     logic,
     manipulation,
@@ -10,7 +11,7 @@ from paddle_tpu.tensor import (  # noqa: F401
     random,
 )
 
-_METHOD_SOURCES = [math, manipulation, logic, linalg, creation]
+_METHOD_SOURCES = [math, manipulation, logic, linalg, creation, extra_ops]
 
 # names that must NOT be patched as methods
 _SKIP = {

@@ -1,0 +1,282 @@
+"""Remaining paddle.* tensor ops (reference python/paddle/tensor/math.py,
+manipulation.py — the long tail of the 468-op surface)."""
+from __future__ import annotations
+
+import itertools as _it
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = [
+    "block_diag", "logcumsumexp", "cartesian_prod", "slice_scatter",
+    "select_scatter", "diagonal_scatter", "log_normal", "isin", "pdist",
+    "sinc", "gammainc", "gammaincc", "multigammaln", "reduce_as", "take",
+    "frexp", "ldexp", "unfold", "combinations", "signbit", "reverse",
+    "hypot", "copysign", "cauchy_", "log_normal_", "normal_", "bernoulli_",
+    "geometric_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def block_diag(inputs, name=None):
+    def f(mats):
+        mats = [m if m.ndim == 2 else m.reshape(1, -1) for m in mats]
+        rows = sum(m.shape[0] for m in mats)
+        cols = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((rows, cols), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype), (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply("block_diag", f, [_t(i) for i in inputs])
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        # exact parallel prefix with logaddexp (numerically stable)
+        out = jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+        return out.astype(dtype) if dtype else out
+
+    return apply("logcumsumexp", f, _t(x))
+
+
+def cartesian_prod(x, name=None):
+    def f(arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply("cartesian_prod", f, [_t(i) for i in x])
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return apply("slice_scatter", f, _t(x), _t(value))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return apply("select_scatter", f, _t(x), _t(values))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        # build index grid along the diagonal of (axis1, axis2)
+        n = min(a.shape[axis1], a.shape[axis2] - offset) if offset >= 0 else \
+            min(a.shape[axis1] + offset, a.shape[axis2])
+        i = jnp.arange(n)
+        rows = i - min(offset, 0)
+        cols = i + max(offset, 0)
+        idx = [slice(None)] * a.ndim
+        out = a
+        # move target axes to front for simple indexing
+        perm = [axis1, axis2] + [d for d in range(a.ndim) if d not in (axis1, axis2)]
+        inv = np.argsort(perm)
+        at = jnp.transpose(a, perm)
+        vt = jnp.moveaxis(v, -1, 0) if v.ndim == a.ndim - 1 else v
+        at = at.at[rows, cols].set(vt.astype(a.dtype))
+        return jnp.transpose(at, inv)
+
+    return apply("diagonal_scatter", f, _t(x), _t(y))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    dt = jnp.dtype(dtype) if dtype else jnp.float32
+    out = jnp.exp(mean + std * jax.random.normal(key, tuple(shape or ()), jnp.float32))
+    return Tensor(out.astype(dt), stop_gradient=True)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(
+        "isin", lambda a, b: jnp.isin(a, b, invert=invert), _t(x), _t(test_x)
+    )
+
+
+def pdist(x, p=2.0, name=None):
+    def f(a):
+        n = a.shape[0]
+        iu = jnp.triu_indices(n, k=1)
+        diff = a[iu[0]] - a[iu[1]]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1), 1.0 / p)
+
+    return apply("pdist", f, _t(x))
+
+
+def sinc(x, name=None):
+    return apply("sinc", jnp.sinc, _t(x))
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return apply("gammainc", jax.scipy.special.gammainc, _t(x), _t(y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return apply("gammaincc", jax.scipy.special.gammaincc, _t(x), _t(y))
+
+
+def multigammaln(x, p, name=None):
+    return apply("multigammaln", lambda a: jax.scipy.special.multigammaln(a, p), _t(x))
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference math.py reduce_as)."""
+
+    def f(a, tgt):
+        extra = a.ndim - tgt.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, (s, ts) in enumerate(zip(a.shape[extra:], tgt.shape))
+            if ts == 1 and s != 1
+        )
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(tgt.shape)
+
+    return apply("reduce_as", f, _t(x), _t(target))
+
+
+def take(x, index, mode="raise", name=None):
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        i = idx.astype(jnp.int64)
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply("take", f, _t(x), _t(index))
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply("frexp", f, _t(x))
+
+
+def ldexp(x, y, name=None):
+    return apply("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), _t(x), _t(y))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis (reference manipulation.py unfold/as_strided)."""
+
+    def f(a):
+        n = (a.shape[axis] - size) // step + 1
+        starts = jnp.arange(n) * step
+        def win(s):
+            return jax.lax.dynamic_slice_in_dim(a, s, size, axis)
+        out = jax.vmap(win)(starts)  # (n, ..., size at axis ...)
+        # paddle layout: windows appended as the LAST dim, axis keeps n
+        out = jnp.moveaxis(out, 0, axis)        # (... n ...) with extra dim after
+        return jnp.moveaxis(out, axis + 1, -1)  # window dim last
+
+    return apply("unfold", f, _t(x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    a = np.asarray(x.numpy())
+    idx = (_it.combinations_with_replacement(range(len(a)), r)
+           if with_replacement else _it.combinations(range(len(a)), r))
+    rows = [a[list(i)] for i in idx]
+    return Tensor(np.stack(rows) if rows else np.zeros((0, r), a.dtype))
+
+
+def signbit(x, name=None):
+    return apply("signbit", jnp.signbit, _t(x))
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply("reverse", lambda a: jnp.flip(a, ax), _t(x))
+
+
+def hypot(x, y, name=None):
+    return apply("hypot", jnp.hypot, _t(x), _t(y))
+
+
+def copysign(x, y, name=None):
+    return apply("copysign", lambda a, b: jnp.copysign(a, b), _t(x), _t(y))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Inplace fill with Cauchy samples (reference math.py cauchy_)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    out = loc + scale * jax.random.cauchy(key, tuple(x.shape), jnp.float32)
+    return x._in_place(Tensor(out.astype(x.data.dtype)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Inplace fill with N(mean, std) (reference Tensor.normal_)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    out = mean + std * jax.random.normal(key, tuple(x.shape), jnp.float32)
+    return x._in_place(Tensor(out.astype(x.data.dtype)))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """Inplace fill with Bernoulli(p) (reference Tensor.bernoulli_)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    out = jax.random.bernoulli(key, p, tuple(x.shape))
+    return x._in_place(Tensor(out.astype(x.data.dtype)))
+
+
+def geometric_(x, probs=0.5, name=None):
+    """Inplace fill with Geometric(probs) samples, support {1,2,...}
+    (reference Tensor.geometric_)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32, 1e-7, 1.0)
+    out = jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+    return x._in_place(Tensor(out.astype(x.data.dtype)))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Inplace fill with log-normal samples (reference math.py log_normal_)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    out = jnp.exp(mean + std * jax.random.normal(key, tuple(x.shape), jnp.float32))
+    return x._in_place(Tensor(out.astype(x.data.dtype)))
