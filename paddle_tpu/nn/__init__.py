@@ -53,3 +53,10 @@ from paddle_tpu.nn.layer.transformer import (  # noqa: F401
 )
 
 from paddle_tpu.nn import utils  # noqa: F401
+
+from paddle_tpu.nn.layer.extended import (  # noqa: F401,E402
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FeatureAlphaDropout,
+    FractionalMaxPool2D, FractionalMaxPool3D, HSigmoidLoss, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D, MultiMarginLoss, ParameterDict, RNNTLoss,
+    Softmax2D, Unflatten, ZeroPad1D, ZeroPad3D, dynamic_decode,
+)

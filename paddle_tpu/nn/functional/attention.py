@@ -93,5 +93,6 @@ def flash_attn_unpadded(*a, **k):  # pragma: no cover - varlen path
     raise NotImplementedError("varlen flash attention not yet implemented on TPU")
 
 
-def sparse_attention(*a, **k):  # pragma: no cover
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, *a, **k):  # pragma: no cover
     raise NotImplementedError

@@ -1,0 +1,593 @@
+"""Long-tail nn.functional ops (reference python/paddle/nn/functional/:
+activation.py inplace twins, pooling.py unpool/fractional, loss.py margin/
+rnnt/hsigmoid, vision.py affine_grid/grid_sample/temporal_shift, common.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ------------------------------------------------------------ inplace activations
+def tanh_(x, name=None):
+    return x._in_place(apply("tanh", jnp.tanh, _t(x)))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._in_place(apply("hardtanh", lambda a: jnp.clip(a, min, max), _t(x)))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._in_place(
+        apply("leaky_relu", lambda a: jnp.where(a >= 0, a, negative_slope * a), _t(x))
+    )
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._in_place(
+        apply("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), _t(x))
+    )
+
+
+# ------------------------------------------------------------------- dropout/pad
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (reference common.py)."""
+    if not training or p == 0:
+        return _t(x)
+    from paddle_tpu.tensor.random import default_generator
+
+    key = default_generator.next_key()
+    alpha = -1.7580993408473766
+
+    def f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        q = 1 - p
+        scale_a = (q + alpha ** 2 * q * (1 - q)) ** -0.5
+        scale_b = -scale_a * alpha * (1 - q)
+        return scale_a * jnp.where(keep, a, alpha) + scale_b
+
+    return apply("feature_alpha_dropout", f, _t(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    pl, pr, pt, pb = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        return jnp.pad(a, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+
+    return apply("zeropad2d", f, _t(x))
+
+
+# ---------------------------------------------------------------------- unpool
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, spatial_dims):
+    def f(a, idx):
+        lead = a.shape[:2]
+        in_spatial = a.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(output_size[-spatial_dims:])
+        else:
+            ks = (kernel_size,) * spatial_dims if isinstance(kernel_size, int) else tuple(kernel_size)
+            st = ks if stride is None else ((stride,) * spatial_dims if isinstance(stride, int) else tuple(stride))
+            pd = (padding,) * spatial_dims if isinstance(padding, int) else tuple(padding)
+            out_spatial = tuple(
+                (s - 1) * st[i] - 2 * pd[i] + ks[i] for i, s in enumerate(in_spatial)
+            )
+        flat_out = int(np.prod(out_spatial))
+        a2 = a.reshape(lead + (-1,))
+        i2 = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        out = jnp.zeros(lead + (flat_out,), a.dtype)
+        b_idx = jnp.arange(lead[0])[:, None, None]
+        c_idx = jnp.arange(lead[1])[None, :, None]
+        out = out.at[b_idx, c_idx, i2].set(a2)
+        return out.reshape(lead + out_spatial)
+
+    return apply("max_unpool", f, _t(x), _t(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+# ------------------------------------------------------------- fractional pool
+def _fractional_starts(in_size, out_size, u):
+    """Pseudo-random pooling-region boundaries (Graham 2014): alpha = in/out."""
+    alpha = in_size / out_size
+    starts = np.floor(alpha * (np.arange(out_size) + u)).astype(np.int64) - \
+        int(np.floor(alpha * u))
+    starts = np.clip(starts, 0, in_size - 1)
+    ends = np.concatenate([starts[1:], [in_size]])
+    return starts, ends
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if random_u is not None:
+        u = float(random_u)
+    else:  # reproducible under paddle.seed (package-global generator)
+        from paddle_tpu.tensor.random import default_generator
+
+        u = float(jax.random.uniform(default_generator.next_key(), ()))
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+    h, w = int(x.shape[2]), int(x.shape[3])
+    hs, he = _fractional_starts(h, oh, u)
+    ws, we = _fractional_starts(w, ow, u)
+    max_h = int((he - hs).max())
+    max_w = int((we - ws).max())
+
+    def f(a):
+        n, c = a.shape[0], a.shape[1]
+        # static gather grid: (oh, ow, max_h, max_w) absolute coords + validity
+        ri = hs[:, None] + np.arange(max_h)[None, :]          # (oh, max_h)
+        ci = ws[:, None] + np.arange(max_w)[None, :]          # (ow, max_w)
+        rv = np.arange(max_h)[None, :] < (he - hs)[:, None]
+        cv = np.arange(max_w)[None, :] < (we - ws)[:, None]
+        ri_c = jnp.asarray(np.minimum(ri, h - 1))
+        ci_c = jnp.asarray(np.minimum(ci, w - 1))
+        valid = jnp.asarray(rv[:, None, :, None] & cv[None, :, None, :])
+        win = a[:, :, ri_c[:, None, :, None], ci_c[None, :, None, :]]
+        win = jnp.where(valid, win, -jnp.inf)
+        flat = win.reshape(n, c, oh, ow, -1)
+        out = jnp.max(flat, -1)
+        local = jnp.argmax(flat, -1)
+        lr = local // max_w
+        lc = local % max_w
+        gmask = ((jnp.asarray(hs)[None, None, :, None] + lr) * w
+                 + jnp.asarray(ws)[None, None, None, :] + lc)
+        return out, gmask.astype(jnp.int64)
+
+    out, mask = apply("fractional_max_pool2d", f, _t(x))
+    if return_mask:
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if random_u is not None:
+        u = float(random_u)
+    else:
+        from paddle_tpu.tensor.random import default_generator
+
+        u = float(jax.random.uniform(default_generator.next_key(), ()))
+    od, oh, ow = (output_size,) * 3 if isinstance(output_size, int) else tuple(output_size)
+    d, h, w = int(x.shape[2]), int(x.shape[3]), int(x.shape[4])
+    ds_, de = _fractional_starts(d, od, u)
+    hs, he = _fractional_starts(h, oh, u)
+    ws, we = _fractional_starts(w, ow, u)
+    md = int((de - ds_).max())
+    mh = int((he - hs).max())
+    mw = int((we - ws).max())
+
+    def f(a):
+        n, c = a.shape[0], a.shape[1]
+        di = jnp.asarray(np.minimum(ds_[:, None] + np.arange(md)[None, :], d - 1))
+        ri = jnp.asarray(np.minimum(hs[:, None] + np.arange(mh)[None, :], h - 1))
+        ci = jnp.asarray(np.minimum(ws[:, None] + np.arange(mw)[None, :], w - 1))
+        dv = np.arange(md)[None, :] < (de - ds_)[:, None]
+        rv = np.arange(mh)[None, :] < (he - hs)[:, None]
+        cv = np.arange(mw)[None, :] < (we - ws)[:, None]
+        valid = jnp.asarray(
+            dv[:, None, None, :, None, None]
+            & rv[None, :, None, None, :, None]
+            & cv[None, None, :, None, None, :]
+        )
+        win = a[:, :,
+                di[:, None, None, :, None, None],
+                ri[None, :, None, None, :, None],
+                ci[None, None, :, None, None, :]]
+        win = jnp.where(valid, win, -jnp.inf)
+        flat = win.reshape(n, c, od, oh, ow, -1)
+        out = jnp.max(flat, -1)
+        local = jnp.argmax(flat, -1)
+        ld = local // (mh * mw)
+        lh = (local // mw) % mh
+        lw = local % mw
+        # global flat index over (d, h, w) — same contract as the 2d mask and
+        # what max_unpool3d expects
+        gmask = ((jnp.asarray(ds_)[None, None, :, None, None] + ld) * (h * w)
+                 + (jnp.asarray(hs)[None, None, None, :, None] + lh) * w
+                 + jnp.asarray(ws)[None, None, None, None, :] + lw)
+        return out, gmask.astype(jnp.int64)
+
+    out, mask = apply("fractional_max_pool3d", f, _t(x))
+    if return_mask:
+        return out, mask
+    return out
+
+
+# -------------------------------------------------------------------- losses
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(logits, lab, *rest):
+        n, C = logits.shape
+        correct = logits[jnp.arange(n), lab.astype(jnp.int32)]
+        diff = jnp.maximum(margin - correct[:, None] + logits, 0.0) ** p
+        if rest:
+            diff = diff * rest[0][lab.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(lab.astype(jnp.int32), C) == 0
+        per = jnp.sum(diff * mask, -1) / C
+        if reduction == "mean":
+            return per.mean()
+        if reduction == "sum":
+            return per.sum()
+        return per
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply("multi_margin_loss", f, *args)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference loss.py hsigmoid_loss)."""
+
+    def f(x, lab, w, *rest):
+        b = rest[0] if bias is not None else None
+        n = x.shape[0]
+        code_len = int(math.ceil(math.log2(num_classes)))
+        lab_i = lab.astype(jnp.int32)
+        losses = jnp.zeros((n,), x.dtype)
+        # complete-binary-tree path: node ids from the root, codes are label bits
+        node = jnp.zeros((n,), jnp.int32)
+        remaining = lab_i + num_classes  # leaf position in the implicit heap
+        # walk bits from MSB: the heap index path to the leaf
+        for d in range(code_len - 1, -1, -1):
+            bit = (remaining >> d) & 1
+            logits = jnp.sum(w[node] * x, -1)
+            if b is not None:
+                logits = logits + b[node]
+            # bit==1 → right child (sigmoid target 0 per paddle convention)
+            losses = losses + jax.nn.softplus(jnp.where(bit == 1, logits, -logits))
+            node = node * 2 + 1 + bit
+            node = jnp.clip(node, 0, w.shape[0] - 1)
+        return losses.mean()
+
+    args = [_t(input), _t(label), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("hsigmoid_loss", f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-style margin softmax (reference loss.py margin_cross_entropy)."""
+
+    def f(lg, lab):
+        n, C = lg.shape
+        lab_i = lab.astype(jnp.int32).reshape(-1)
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        target_theta = margin1 * theta[jnp.arange(n), lab_i] + margin2
+        target_logit = jnp.cos(target_theta) - margin3
+        modified = lg.at[jnp.arange(n), lab_i].set(target_logit)
+        modified = modified * scale
+        logp = jax.nn.log_softmax(modified, -1)
+        per = -logp[jnp.arange(n), lab_i]
+        sm = jax.nn.softmax(modified, -1)
+        if reduction == "mean":
+            loss = per.mean()
+        elif reduction == "sum":
+            loss = per.sum()
+        else:
+            loss = per
+        return loss, sm
+
+    loss, sm = apply("margin_cross_entropy", f, _t(logits), _t(label))
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference loss.py rnnt_loss over warprnnt):
+    log-space forward DP as a lax.scan over the anti-diagonal recursion.
+
+    FastEmit regularization (``fastemit_lambda``) is NOT applied yet — it is a
+    gradient-level rescaling in warprnnt that needs the backward DP; a nonzero
+    value warns so silent divergence from the reference can't happen."""
+    if fastemit_lambda:
+        import warnings
+
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda is accepted for API parity but the "
+            "FastEmit gradient rescaling is not applied on TPU yet",
+            stacklevel=2,
+        )
+
+    def f(acts, labels, act_lens, lab_lens):
+        # acts: (B, T, U+1, V) log-probs after log_softmax
+        logp = jax.nn.log_softmax(acts, -1)
+        B, T, U1, V = logp.shape
+
+        def single(lp, lab, t_len, u_len):
+            # alpha[t, u]: log prob of consuming t frames and emitting lab[:u]
+            neg = -1e30
+
+            def row(alpha_prev, t):
+                # alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                #                          alpha[t, u-1] + emit(t, u-1))
+                from_blank = alpha_prev + lp[t - 1, jnp.arange(U1), blank]
+
+                def emit_scan(carry, u):
+                    cur = jnp.logaddexp(
+                        from_blank[u],
+                        carry + jnp.where(u > 0, lp[t, u - 1, lab[jnp.maximum(u - 1, 0)]], neg),
+                    )
+                    cur = jnp.where(u == 0, from_blank[0], cur)
+                    return cur, cur
+
+                _, alpha_t = jax.lax.scan(emit_scan, neg, jnp.arange(U1))
+                return alpha_t, alpha_t
+
+            # t = 0 row: emissions only
+            def emit0(carry, u):
+                cur = carry + jnp.where(u > 0, lp[0, u - 1, lab[jnp.maximum(u - 1, 0)]], 0.0)
+                return cur, cur
+
+            _, alpha_t0 = jax.lax.scan(emit0, 0.0, jnp.arange(U1))
+            _, rows = jax.lax.scan(row, alpha_t0, jnp.arange(1, T))
+            full = jnp.concatenate([alpha_t0[None], rows], 0)  # (T, U1)
+            final = full[t_len - 1, u_len] + lp[t_len - 1, u_len, blank]
+            return -final
+
+        losses = jax.vmap(single)(logp, labels.astype(jnp.int32),
+                                  act_lens.astype(jnp.int32), lab_lens.astype(jnp.int32))
+        if reduction == "mean":
+            return losses.mean()
+        if reduction == "sum":
+            return losses.sum()
+        return losses
+
+    return apply("rnnt_loss", f, _t(input), _t(label), _t(input_lengths), _t(label_lengths))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py): head + clustered tails."""
+
+    def f(x, lab, hw, *rest):
+        i = 0
+        tails = []
+        for _ in tail_weights:
+            tails.append((rest[i], rest[i + 1]))
+            i += 2
+        hb = rest[i] if head_bias is not None else None
+        n = x.shape[0]
+        lab_i = lab.astype(jnp.int32)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, -1)
+        shortlist = cutoffs[0]
+        out = jnp.zeros((n,), x.dtype)
+        # in-shortlist tokens
+        in_short = lab_i < shortlist
+        out = jnp.where(in_short, head_logp[jnp.arange(n), jnp.clip(lab_i, 0, shortlist - 1)], out)
+        # clustered tokens: head cluster logit + within-cluster logit
+        for ci, (w1, w2) in enumerate(tails):
+            lo = cutoffs[ci]
+            hi = cutoffs[ci + 1]
+            in_cluster = (lab_i >= lo) & (lab_i < hi)
+            cluster_logp = head_logp[:, shortlist + ci]
+            h = x @ w1
+            tail_logits = h @ w2
+            tail_logp = jax.nn.log_softmax(tail_logits, -1)
+            rel = jnp.clip(lab_i - lo, 0, hi - lo - 1)
+            out = jnp.where(in_cluster, cluster_logp + tail_logp[jnp.arange(n), rel], out)
+        loss = -out.mean()
+        return out, loss
+
+    args = [_t(input), _t(label), _t(head_weight)]
+    for w1, w2 in tail_weights:
+        args += [_t(w1), _t(w2)]
+    if head_bias is not None:
+        args.append(_t(head_bias))
+    return apply("adaptive_log_softmax", f, *args)
+
+
+# --------------------------------------------------------------------- vision
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D affine sampling grid (reference vision.py affine_grid)."""
+
+    def f(th):
+        if len(out_shape) == 4:
+            n, c, h, w = out_shape
+            ys = jnp.linspace(-1, 1, h) if align_corners else \
+                jnp.linspace(-1 + 1 / h, 1 - 1 / h, h)
+            xs = jnp.linspace(-1, 1, w) if align_corners else \
+                jnp.linspace(-1 + 1 / w, 1 - 1 / w, w)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)  # (hw, 3)
+            grid = jnp.einsum("nij,pj->npi", th, base)  # (n, hw, 2)
+            return grid.reshape(n, h, w, 2)
+        n, c, d, h, w = out_shape
+        def axis(sz):
+            if align_corners:
+                return jnp.linspace(-1, 1, sz)
+            return jnp.linspace(-1 + 1 / sz, 1 - 1 / sz, sz)
+
+        zs = axis(d)
+        ys = axis(h)
+        xs = axis(w)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, gz, ones], -1).reshape(-1, 4)
+        grid = jnp.einsum("nij,pj->npi", th, base)
+        return grid.reshape(n, d, h, w, 3)
+
+    return apply("affine_grid", f, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """2D grid sampling (reference vision.py grid_sample)."""
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            if padding_mode == "border":
+                valid = jnp.ones_like(valid)
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            if mode == "nearest":
+                v = img[:, jnp.round(yy).astype(jnp.int32), jnp.round(xx).astype(jnp.int32)]
+                return v * valid
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, h - 1)
+            x1 = jnp.minimum(x0 + 1, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx) + img[:, y0, x1] * (1 - wy) * wx
+                 + img[:, y1, x0] * wy * (1 - wx) + img[:, y1, x1] * wy * wx)
+            return v * valid
+
+        return jax.vmap(lambda img, yy, xx: sample(img, yy.reshape(-1), xx.reshape(-1))
+                        .reshape(c, *yy.shape))(a, fy, fx)
+
+    return apply("grid_sample", f, _t(x), _t(grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (reference vision.py temporal_shift)."""
+
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], 1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
+
+    return apply("temporal_shift", f, _t(x))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry walk (reference vision.py gather_tree):
+    ids/parents: (max_time, batch, beam)."""
+
+    def f(step_ids, parent_ids):
+        T = step_ids.shape[0]
+
+        def back(carry, t):
+            beams = carry  # (batch, beam) current beam index per slot
+            tok = jnp.take_along_axis(step_ids[t], beams, axis=1)
+            parent = jnp.take_along_axis(parent_ids[t], beams, axis=1)
+            return parent.astype(beams.dtype), tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(step_ids.shape[2], dtype=step_ids.dtype),
+            step_ids.shape[1:],
+        )
+        _, toks = jax.lax.scan(back, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply("gather_tree", f, _t(ids), _t(parents))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC class-center sampling (reference common.py class_center_sample)."""
+    lab = np.asarray(label.numpy(), np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        from paddle_tpu.tensor.random import default_generator
+
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        seed = int(jax.random.randint(default_generator.next_key(), (), 0, 2**31 - 1))
+        extra = np.random.default_rng(seed).choice(
+            neg_pool, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = np.asarray([remap[c] for c in lab.tolist()], np.int64)
+    return Tensor(remapped), Tensor(sampled)
+
+
+# ------------------------------------------------------- flash-attention wrappers
+def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.0,
+                        causal=False, **kw):
+    """Mask-driven flash attention (reference flashmask_attention).
+
+    ``startend_row_indices`` [B, H, S, 1|2]: per key column j, query rows in
+    ``[start_j, end_j)`` are masked out (1-column form: ``[start_j, S)``, the
+    FlashMask LTS layout).  The mask composes into the fused attention program
+    (XLA fuses it; no separate masked kernel needed on TPU)."""
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+
+    mask = None
+    if startend_row_indices is not None:
+        def build(idx, q):
+            S = q.shape[1]
+            rows = jnp.arange(S)[None, None, :, None]  # query rows
+            start = idx[..., 0][:, :, None, :]          # (B, H, 1, S) per column
+            if idx.shape[-1] >= 2:
+                end = idx[..., 1][:, :, None, :]
+            else:
+                end = jnp.full_like(start, S)
+            banned = (rows >= start) & (rows < end)
+            return jnp.where(banned, jnp.asarray(-1e30, q.dtype), jnp.asarray(0.0, q.dtype))
+
+        mask = apply("flashmask_build", build, _t(startend_row_indices), _t(query))
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        dropout_p=dropout, is_causal=causal)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         **kw):
+    """Packed-QKV flash attention (reference flash_attn_qkvpacked):
+    qkv [B, S, 3, H, D]."""
+    from paddle_tpu.nn.functional.attention import flash_attention
+
+    def split(a):
+        return a[:, :, 0], a[:, :, 1], a[:, :, 2]
+
+    q, k, v = apply("split_qkv_packed", split, _t(qkv))
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q=None, cu_seqlens_k=None,
+                                max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                                dropout=0.0, causal=False, **kw):
+    raise NotImplementedError(
+        "varlen flash attention: pad to max_seqlen and use flash_attn_qkvpacked "
+        "(XLA requires static shapes; ragged batches should be bucketed)"
+    )

@@ -1,0 +1,272 @@
+"""Long-tail nn layers (reference python/paddle/nn/layer/: pooling unpool,
+loss wrappers, Softmax2D/Unflatten/ZeroPad, ParameterDict, beam search)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn.functional import extended as FE
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return FE.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference activation.py Softmax2D)."""
+
+    def forward(self, x):
+        import jax
+
+        return apply("softmax2d", lambda a: jax.nn.softmax(a, axis=-3), x)
+
+
+class ParameterDict(Layer):
+    """Dict-style parameter container (reference container.py ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for k, v in (parameters.items() if isinstance(parameters, dict) else parameters):
+                self.add_parameter(str(k), v)
+
+    def __getitem__(self, key):
+        return self._parameters[str(key)]
+
+    def __setitem__(self, key, value):
+        self.add_parameter(str(key), value)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def items(self):
+        return self._parameters.items()
+
+    def values(self):
+        return self._parameters.values()
+
+    def update(self, parameters):
+        for k, v in (parameters.items() if isinstance(parameters, dict) else parameters):
+            self.add_parameter(str(k), v)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        def f(a):
+            ax = self.axis % a.ndim
+            return a.reshape(a.shape[:ax] + tuple(self.shape) + a.shape[ax + 1:])
+
+        return apply("unflatten", f, x)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def forward(self, x):
+        pl, pr = self.padding
+        return apply("zeropad1d", lambda a: jnp.pad(a, ((0, 0), (0, 0), (pl, pr))), x)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        p = (padding,) * 6 if isinstance(padding, int) else tuple(padding)
+        self.padding = p
+
+    def forward(self, x):
+        pl, pr, pt, pb, pf, pbk = self.padding
+        return apply(
+            "zeropad3d",
+            lambda a: jnp.pad(a, ((0, 0), (0, 0), (pf, pbk), (pt, pb), (pl, pr))), x,
+        )
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        ks, st, pd, os_ = self._args
+        return FE.max_unpool1d(x, indices, ks, st, pd, output_size=os_)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        ks, st, pd, os_ = self._args
+        return FE.max_unpool2d(x, indices, ks, st, pd, output_size=os_)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        ks, st, pd, os_ = self._args
+        return FE.max_unpool3d(x, indices, ks, st, pd, output_size=os_)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        os_, ks, u, rm = self._args
+        return FE.fractional_max_pool2d(x, os_, ks, u, rm)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        os_, ks, u, rm = self._args
+        return FE.fractional_max_pool3d(x, os_, ks, u, rm)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._args
+        return FE.multi_margin_loss(input, label, p, m, w, r)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, fl, r = self._args
+        return FE.rnnt_loss(input, label, input_lengths, label_lengths, b, fl, r)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FE.hsigmoid_loss(input, label, self.num_classes, self.weight, self.bias,
+                                path_table, path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.shortlist = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [in_features, self.shortlist + self.n_clusters])
+        self.head_bias = (self.create_parameter([self.shortlist + self.n_clusters], is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter([in_features, hsz])
+            w2 = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_{i}_0", w1)
+            self.add_parameter(f"tail_{i}_1", w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        return FE.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference python/paddle/nn/
+    decode.py BeamSearchDecoder): used with dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Greedy-expanded beam search loop (reference decode.py dynamic_decode).
+    Host-side loop (decoding is autoregressive inference)."""
+    import numpy as np
+
+    cell = decoder.cell
+    beam = decoder.beam_size
+    state = inits
+    # single-batch host beam search
+    beams = [([decoder.start_token], 0.0, state)]
+    finished = []
+    for _ in range(max_step_num):
+        cand = []
+        for toks, score, st in beams:
+            if toks[-1] == decoder.end_token:
+                finished.append((toks, score))
+                continue
+            inp = Tensor(jnp.asarray([[toks[-1]]], jnp.int32))
+            if decoder.embedding_fn is not None:
+                inp = decoder.embedding_fn(inp)
+            out, new_st = cell(inp, st)
+            if decoder.output_fn is not None:
+                out = decoder.output_fn(out)
+            import jax
+
+            logp = np.asarray(jax.nn.log_softmax(out.data.reshape(-1)))
+            top = np.argsort(-logp)[:beam]
+            for t in top:
+                cand.append((toks + [int(t)], score + float(logp[t]), new_st))
+        if not cand:
+            break
+        cand.sort(key=lambda c: -c[1])
+        beams = cand[:beam]
+    finished.extend((t, s) for t, s, _ in beams)
+    finished.sort(key=lambda c: -c[1])
+    best = finished[0] if finished else ([decoder.start_token], 0.0)
+    ids = Tensor(jnp.asarray(best[0], jnp.int64))
+    scores = Tensor(jnp.asarray(best[1], jnp.float32))
+    return ids, scores

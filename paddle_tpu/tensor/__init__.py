@@ -18,6 +18,8 @@ _SKIP = {
     "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
     "eye", "meshgrid", "assign", "tril_indices", "triu_indices", "create_parameter",
     "broadcast_shape", "slice",
+    # first parameter is not a tensor (creation/list-taking ops)
+    "log_normal", "block_diag", "cartesian_prod",
 }
 
 

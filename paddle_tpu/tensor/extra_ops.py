@@ -167,6 +167,16 @@ def reduce_as(x, target, name=None):
 
 
 def take(x, index, mode="raise", name=None):
+    xt, it = _t(x), _t(index)
+    if mode == "raise":
+        # eager host check (the reference raises; JAX OOB gathers clamp silently)
+        idx_np = np.asarray(it.numpy())
+        n = int(np.prod(xt.shape))
+        if ((idx_np >= n) | (idx_np < -n)).any():
+            raise ValueError(
+                f"take(mode='raise'): index out of range for tensor with {n} elements"
+            )
+
     def f(a, idx):
         flat = a.reshape(-1)
         n = flat.shape[0]
@@ -179,7 +189,7 @@ def take(x, index, mode="raise", name=None):
             i = jnp.where(i < 0, i + n, i)
         return flat[i]
 
-    return apply("take", f, _t(x), _t(index))
+    return apply("take", f, xt, it)
 
 
 def frexp(x, name=None):
@@ -211,11 +221,12 @@ def unfold(x, axis, size, step, name=None):
 
 
 def combinations(x, r=2, with_replacement=False, name=None):
-    a = np.asarray(x.numpy())
-    idx = (_it.combinations_with_replacement(range(len(a)), r)
-           if with_replacement else _it.combinations(range(len(a)), r))
-    rows = [a[list(i)] for i in idx]
-    return Tensor(np.stack(rows) if rows else np.zeros((0, r), a.dtype))
+    n = int(x.shape[0])
+    idx = (_it.combinations_with_replacement(range(n), r)
+           if with_replacement else _it.combinations(range(n), r))
+    idx = np.asarray(list(idx), np.int32).reshape(-1, r)
+    # static index gather keeps the op differentiable
+    return apply("combinations", lambda a: a[jnp.asarray(idx)], _t(x))
 
 
 def signbit(x, name=None):

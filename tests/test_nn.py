@@ -347,3 +347,114 @@ class TestWeightNorm:
         assert out.shape == [1, 6]
         remove_weight_norm(lin)
         np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+
+class TestExtendedNN:
+    """Long-tail nn surface (reference nn/functional extended set)."""
+
+    def test_nn_all_parity(self):
+        import os
+        import re
+
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        for path, mod in [
+            ('/root/reference/python/paddle/nn/__init__.py', nn),
+            ('/root/reference/python/paddle/nn/functional/__init__.py', F),
+        ]:
+            if not os.path.exists(path):
+                import pytest
+
+                pytest.skip("reference not present")
+            src = open(path).read()
+            names = re.findall(r"'([A-Za-z_0-9]+)'",
+                               re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1))
+            missing = [n for n in names if not hasattr(mod, n)]
+            assert not missing, missing
+
+    def test_max_unpool2d_roundtrip(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.nn.functional.pooling import max_pool2d
+
+        x = paddle.to_tensor(np.random.rand(1, 2, 4, 4).astype("float32"))
+        pooled, mask = max_pool2d(x, 2, stride=2, return_mask=True)
+        unp = F.max_unpool2d(pooled, mask, 2, stride=2)
+        assert list(unp.shape) == [1, 2, 4, 4]
+        nz = unp.numpy()[unp.numpy() != 0]
+        np.testing.assert_allclose(np.sort(nz), np.sort(pooled.numpy().ravel()))
+
+    def test_rnnt_loss_decreases_for_confident_model(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        T, U, V = 4, 2, 5
+        labels = np.array([[1, 2]])
+        # logits heavily favoring the correct transducer path
+        good = np.full((1, T, U + 1, V), -5.0, "float32")
+        good[0, :, 0, 1] = 5.0
+        good[0, :, 1, 2] = 5.0
+        good[0, :, 2, 0] = 5.0
+        bad = np.zeros_like(good)
+        l_good = float(F.rnnt_loss(paddle.to_tensor(good), paddle.to_tensor(labels),
+                                   paddle.to_tensor(np.array([T])), paddle.to_tensor(np.array([U]))).numpy())
+        l_bad = float(F.rnnt_loss(paddle.to_tensor(bad), paddle.to_tensor(labels),
+                                  paddle.to_tensor(np.array([T])), paddle.to_tensor(np.array([U]))).numpy())
+        assert l_good < l_bad
+
+    def test_grid_sample_identity_and_shift(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.random.rand(1, 2, 5, 5).astype("float32"))
+        theta = paddle.to_tensor(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"))
+        grid = F.affine_grid(theta, (1, 2, 5, 5))
+        np.testing.assert_allclose(F.grid_sample(x, grid).numpy(), x.numpy(), atol=1e-5)
+
+    def test_hsigmoid_and_adaptive_softmax_train(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        feat = paddle.to_tensor(np.random.rand(8, 16).astype("float32"))
+        lab = paddle.to_tensor(np.random.randint(0, 32, 8))
+        hs = nn.HSigmoidLoss(16, 32)
+        loss = hs(feat, lab)
+        loss.backward()
+        assert hs.weight.grad is not None
+        als = nn.AdaptiveLogSoftmaxWithLoss(16, 50, [10])
+        out, l2 = als(feat, paddle.to_tensor(np.random.randint(0, 50, 8)))
+        l2.backward()
+        assert als.head_weight.grad is not None
+
+    def test_parameter_dict_and_unflatten(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        pd = nn.ParameterDict({"w": paddle.create_parameter([2, 2], "float32")})
+        pd["b"] = paddle.create_parameter([3], "float32")
+        assert set(pd.keys()) == {"w", "b"} and len(pd.parameters()) == 2
+        u = nn.Unflatten(1, [2, 3])
+        assert list(u(paddle.to_tensor(np.zeros((4, 6), "float32"))).shape) == [4, 2, 3]
+
+    def test_gather_tree(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        ids = paddle.to_tensor(np.array([[[2, 5]], [[3, 6]], [[4, 7]]]))
+        parents = paddle.to_tensor(np.array([[[0, 0]], [[0, 0]], [[1, 0]]]))
+        out = F.gather_tree(ids, parents).numpy()
+        # beam 0 at final step came from parent 1 → path follows beam 1's tokens
+        assert out.shape == (3, 1, 2)
