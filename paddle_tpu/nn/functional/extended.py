@@ -43,6 +43,10 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     """Alpha dropout over whole channels (reference common.py)."""
     if not training or p == 0:
         return _t(x)
+    if p == 1.0:  # degenerate: every channel dropped → the deterministic limit
+        alpha = -1.7580993408473766
+        return apply("feature_alpha_dropout_all",
+                     lambda a: jnp.full_like(a, alpha), _t(x))
     from paddle_tpu.tensor.random import default_generator
 
     key = default_generator.next_key()
@@ -71,6 +75,14 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 
 # ---------------------------------------------------------------------- unpool
+def _check_channel_first(data_format, allowed):
+    if data_format not in allowed:
+        raise ValueError(
+            f"data_format {data_format!r} not supported here (channel-first "
+            f"{allowed[0]!r} only); transpose the input instead"
+        )
+
+
 def _max_unpool(x, indices, kernel_size, stride, padding, output_size, spatial_dims):
     def f(a, idx):
         lead = a.shape[:2]
@@ -98,16 +110,19 @@ def _max_unpool(x, indices, kernel_size, stride, padding, output_size, spatial_d
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL",
                  output_size=None, name=None):
+    _check_channel_first(data_format, ("NCL",))
     return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
                  output_size=None, name=None):
+    _check_channel_first(data_format, ("NCHW",))
     return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW",
                  output_size=None, name=None):
+    _check_channel_first(data_format, ("NCDHW",))
     return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)
 
 
@@ -124,6 +139,12 @@ def _fractional_starts(in_size, out_size, u):
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
+    if kernel_size is not None:
+        import warnings
+
+        warnings.warn("fractional_max_pool2d: overlapping kernel_size windows "
+                      "are not implemented; using disjoint pseudo-random regions",
+                      stacklevel=2)
     if random_u is not None:
         u = float(random_u)
     else:  # reproducible under paddle.seed (package-global generator)
@@ -166,6 +187,12 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
 
 def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
+    if kernel_size is not None:
+        import warnings
+
+        warnings.warn("fractional_max_pool3d: overlapping kernel_size windows "
+                      "are not implemented; using disjoint pseudo-random regions",
+                      stacklevel=2)
     if random_u is not None:
         u = float(random_u)
     else:
@@ -243,6 +270,11 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
                   path_code=None, is_sparse=False, name=None):
     """Hierarchical sigmoid over the default complete binary tree
     (reference loss.py hsigmoid_loss)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss: custom trees (path_table/path_code) are not "
+            "implemented; only the default complete binary tree is supported"
+        )
 
     def f(x, lab, w, *rest):
         b = rest[0] if bias is not None else None
@@ -448,6 +480,16 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     """2D grid sampling (reference vision.py grid_sample)."""
 
     def f(a, g):
+        if a.ndim != 4:
+            raise NotImplementedError(
+                "grid_sample: only 4-D (NCHW) inputs are supported; 5-D "
+                "volumetric sampling is not implemented yet"
+            )
+        if padding_mode == "reflection":
+            raise NotImplementedError(
+                "grid_sample: padding_mode='reflection' is not implemented; "
+                "use 'zeros' or 'border'"
+            )
         n, c, h, w = a.shape
         gx = g[..., 0]
         gy = g[..., 1]
@@ -485,6 +527,7 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
     """TSM temporal shift (reference vision.py temporal_shift)."""
+    _check_channel_first(data_format, ("NCHW",))
 
     def f(a):
         nt, c, h, w = a.shape

@@ -118,3 +118,124 @@ Tensor.is_integer = lambda self: _dt.is_integer(self.dtype)
 Tensor.element_size = lambda self: self.dtype.itemsize
 Tensor.num_elements = lambda self: self.size
 Tensor.numel = lambda self: self.size
+
+
+def _patch_remaining_methods():
+    """Methods the reference binds but the auto-patch skips (special first-arg
+    semantics, cross-module sources, or inplace twins)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor.random import default_generator
+
+    def _inplace_of(fn):
+        def m(self, *a, **kw):
+            return self._in_place(fn(self, *a, **kw))
+
+        return m
+
+    for name in ("reciprocal", "atanh", "acosh", "asinh", "lerp",
+                 "put_along_axis"):
+        base = None
+        for mod in _METHOD_SOURCES:
+            base = getattr(mod, name, None)
+            if base is not None:
+                break
+        if base is not None and not hasattr(Tensor, name + "_"):
+            setattr(Tensor, name + "_", _inplace_of(base))
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        key = default_generator.next_key()
+        out = jax.random.uniform(key, tuple(self.shape), jnp.float32, min, max)
+        self._data = out.astype(self._data.dtype)
+        self._version += 1
+        return self
+
+    def exponential_(self, lam=1.0):
+        key = default_generator.next_key()
+        out = jax.random.exponential(key, tuple(self.shape)) / lam
+        self._data = out.astype(self._data.dtype)
+        self._version += 1
+        return self
+
+    def index_put_(self, indices, value, accumulate=False):
+        from paddle_tpu.tensor import manipulation as _m
+
+        return self._in_place(_m.index_put(self, indices, value, accumulate))
+
+    def index_fill_(self, index, axis, value):
+        from paddle_tpu.tensor import logic as _lg
+
+        return self._in_place(_lg.index_fill(self, index, axis, value))
+
+    def multinomial(self, num_samples=1, replacement=False, name=None):
+        from paddle_tpu.tensor import random as _r
+
+        return _r.multinomial(self, num_samples, replacement)
+
+    def stft_m(self, n_fft, hop_length=None, win_length=None, window=None,
+               center=True, pad_mode="reflect", normalized=False, onesided=True,
+               name=None):
+        from paddle_tpu import signal as _sig
+
+        return _sig.stft(self, n_fft, hop_length, win_length, window, center,
+                         pad_mode, normalized, onesided)
+
+    def istft_m(self, n_fft, hop_length=None, win_length=None, window=None,
+                center=True, normalized=False, onesided=True, length=None,
+                return_complex=False, name=None):
+        from paddle_tpu import signal as _sig
+
+        return _sig.istft(self, n_fft, hop_length, win_length, window, center,
+                          normalized, onesided, length, return_complex)
+
+    def top_p_sampling(self, ps, threshold=None, seed=None, name=None):
+        """Nucleus sampling over the last dim (reference top_p_sampling op)."""
+        import numpy as np
+
+        probs = self.numpy()
+        p_np = ps.numpy() if is_tensor(ps) else np.asarray(ps)
+        key = default_generator.next_key()
+        b, v = probs.shape
+        order = np.argsort(-probs, -1)
+        sorted_p = np.take_along_axis(probs, order, -1)
+        cum = np.cumsum(sorted_p, -1)
+        keep = cum - sorted_p <= p_np.reshape(-1, 1)
+        keep[:, 0] = True
+        masked = np.where(keep, sorted_p, 0.0).astype(np.float64)
+        masked = masked / masked.sum(-1, keepdims=True)  # float64: rng.choice validates sum
+        seeds = np.asarray(jax.random.randint(key, (b,), 0, 2**31 - 1))
+        picks = np.empty((b, 1), np.int64)
+        for i in range(b):
+            rng = np.random.default_rng(int(seeds[i]))
+            picks[i, 0] = order[i, rng.choice(v, p=masked[i])]
+        vals = np.take_along_axis(probs, picks, -1)
+        return Tensor(vals), Tensor(picks)
+
+    from paddle_tpu.tensor import creation as _c
+
+    Tensor.uniform_ = uniform_
+    Tensor.exponential_ = exponential_
+    Tensor.index_put_ = index_put_
+    Tensor.index_fill_ = index_fill_
+    Tensor.multinomial = multinomial
+    Tensor.stft = stft_m
+    Tensor.istft = istft_m
+    Tensor.top_p_sampling = top_p_sampling
+    Tensor.create_parameter = staticmethod(_c.create_parameter)
+    Tensor.create_tensor = lambda self, dtype=None: Tensor(
+        jnp.zeros((), _dt_mod.convert_dtype(dtype) if dtype else self.dtype))
+    from paddle_tpu.tensor.extra_ops import block_diag as _bd
+
+    Tensor.block_diag = lambda self, *others: _bd([self, *others])
+    from paddle_tpu.tensor.math import broadcast_shape as _bs
+
+    Tensor.broadcast_shape = staticmethod(_bs)
+    from paddle_tpu.tensor.manipulation import slice as _slice
+
+    Tensor.slice = _slice
+
+
+from paddle_tpu.core import dtype as _dt_mod  # noqa: E402
+
+_patch_remaining_methods()
