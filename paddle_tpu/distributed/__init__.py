@@ -10,6 +10,9 @@ from paddle_tpu.distributed.parallel_env import (  # noqa: F401
     get_world_size, init_parallel_env, is_initialized, world_mesh,
 )
 from paddle_tpu.distributed.collective import (  # noqa: F401
+    ReduceType, alltoall, alltoall_single, broadcast_object_list,
+    destroy_process_group, gather, gloo_barrier, gloo_init_parallel_env,
+    gloo_release, scatter_object_list, split, wait,
     Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
     all_to_all_single, batch_isend_irecv, broadcast, get_group, irecv, is_available,
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
@@ -47,3 +50,56 @@ def launch():
     from paddle_tpu.distributed.launch.main import launch as _launch
 
     return _launch()
+
+
+# ZeRO shard_fn objects for shard_optimizer (reference auto_parallel/api.py:
+# opt = dist.shard_optimizer(opt, dist.ShardingStage1(mesh))).  Stage 1/2 shard
+# the optimizer accumulators over the mesh's data axis; stage 3 additionally
+# expects parameters themselves sharded (pjit placement).
+class _ShardingStage:
+    stage = 0
+
+    def __init__(self, mesh=None, sharding_mesh_dim=0):
+        self.mesh = mesh
+        self.sharding_mesh_dim = sharding_mesh_dim
+
+    def __call__(self, name, param, state):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        jmesh = getattr(mesh, "jax_mesh", mesh)
+        if jmesh is None or not hasattr(state, "shape") or state.ndim == 0:
+            return state
+        axis = jmesh.axis_names[self.sharding_mesh_dim]
+        # shard the accumulator's leading dim over the sharding axis when divisible
+        if state.shape[0] % jmesh.shape[axis] == 0:
+            spec = P(axis, *(None,) * (state.ndim - 1))
+            return _jax.device_put(state, NamedSharding(jmesh, spec))
+        return state
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler sharding-aware (reference auto_parallel/api.py
+    shard_scaler): under SPMD the found-inf reduction is global automatically,
+    so the scaler passes through."""
+    return scaler
+
+
+from paddle_tpu.distributed import io  # noqa: F401,E402
+from paddle_tpu.distributed.ps_datasets import (  # noqa: F401,E402
+    CountFilterEntry, InMemoryDataset, ProbabilityEntry, QueueDataset,
+    ShowClickEntry,
+)

@@ -449,3 +449,80 @@ for _name in ("all_reduce", "reduce", "all_gather", "broadcast", "scatter",
               "recv", "barrier"):
     globals()[_name] = _watched(globals()[_name])
 del _name
+
+
+# --------------------------------------------------------------- surface parity
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference communication/gather.py).  Replicated eager
+    emulation: every rank holds the value, dst receives nranks copies."""
+    group = _resolve_group(group)
+    if gather_list is not None:
+        gather_list.extend(Tensor(jnp.array(tensor.data)) for _ in range(group.nranks))
+    return _Work(gather_list or tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return _Work(object_list)  # replicated: every rank already has the objects
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    group = _resolve_group(group)
+    rank = max(group.get_group_rank(_env.get_rank()), 0)
+    if in_object_list:
+        if len(in_object_list) != group.nranks:
+            raise ValueError(
+                f"scatter_object_list: in_object_list has {len(in_object_list)} "
+                f"entries but the group has {group.nranks} ranks"
+            )
+        out_object_list.append(in_object_list[rank])
+    return _Work(out_object_list)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor.data)
+    return tensor
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _group_registry.clear()
+    else:
+        _group_registry.pop(group.id, None)
+
+
+alltoall = all_to_all
+alltoall_single = all_to_all_single
+
+
+class ReduceType:
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style split op entry (reference fleet mp_ops paddle.distributed.split);
+    delegates to the mpu parallel layers."""
+    raise NotImplementedError(
+        "paddle.distributed.split: construct fleet.meta_parallel "
+        "ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding directly "
+        "(the auto-parallel shard_layer path is the recommended TPU route)"
+    )
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Gloo CPU bring-up (reference parallel.py): the CPU mesh needs no comm lib."""
+    from paddle_tpu.distributed.parallel_env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass

@@ -1,0 +1,41 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py):
+persistables save/load for the distributed/static path."""
+from __future__ import annotations
+
+import os
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable parameter of the program/layer (reference
+    io.py save_persistables)."""
+    import paddle_tpu as paddle
+
+    os.makedirs(dirname, exist_ok=True)
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    paddle.save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import paddle_tpu as paddle
+
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = paddle.load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """Load a jit-saved inference model (reference
+    io.py load_inference_model_distributed)."""
+    import paddle_tpu as paddle
+
+    prefix = os.path.join(dirname, (model_filename or "model").replace(".pdmodel", ""))
+    return paddle.jit.load(prefix)

@@ -1,0 +1,99 @@
+"""PS-mode datasets and sparse-table entry configs (reference
+python/paddle/distributed/fleet/dataset/ InMemoryDataset/QueueDataset and
+entry.py Count/Show-Click/Probability entries — the CTR data path)."""
+from __future__ import annotations
+
+
+class _Entry:
+    def __init__(self, **kw):
+        self._config = kw
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._config})"
+
+
+class CountFilterEntry(_Entry):
+    """Admit a sparse id into the table after `count_filter` occurrences."""
+
+    def __init__(self, count_filter=0):
+        super().__init__(count_filter=count_filter)
+
+
+class ShowClickEntry(_Entry):
+    """Show/click statistic slots for CTR accessors."""
+
+    def __init__(self, show_name="show", click_name="click"):
+        super().__init__(show_name=show_name, click_name=click_name)
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability=1.0):
+        super().__init__(probability=probability)
+
+
+class QueueDataset:
+    """Streaming file dataset (reference QueueDataset): files consumed once,
+    round-robin over workers."""
+
+    def __init__(self):
+        self._files = []
+        self._parse_fn = None
+        self._batch_size = 1
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None, **kw):
+        self._batch_size = batch_size
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def set_parse_func(self, fn):
+        self._parse_fn = fn
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    rec = self._parse_fn(line) if self._parse_fn else line.rstrip("\n")
+                    batch.append(rec)
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(QueueDataset):
+    """Loads files into memory; supports global shuffle (reference
+    InMemoryDataset.load_into_memory/global_shuffle)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = []
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    self._records.append(
+                        self._parse_fn(line) if self._parse_fn else line.rstrip("\n")
+                    )
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        import random
+
+        random.shuffle(self._records)
+
+    def local_shuffle(self):
+        self.global_shuffle()
+
+    def release_memory(self):
+        self._records = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self._batch_size):
+            yield self._records[i:i + self._batch_size]
