@@ -44,7 +44,7 @@ def main():
     step(ids, labels).numpy()  # compile + warm up
     step(ids, labels).numpy()
 
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, labels)

@@ -9,6 +9,15 @@ import numpy as np
 from paddle_tpu.optimizer.optimizer import Optimizer
 
 
+
+
+def _pow_t(beta, t):
+    """beta ** step in float32.  Under jax_enable_x64, python-float ** traced-int
+    promotes to float64 and drags the whole optimizer update into f64 — double
+    the HBM traffic on every accumulator (observed in the train-step HLO)."""
+    return jnp.power(jnp.float32(beta), jnp.asarray(t, jnp.float32))
+
+
 class SGD(Optimizer):
     _accum_names = ()
 
@@ -58,13 +67,13 @@ class Adam(Optimizer):
         t = self._global_step
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
-        mhat = m / (1 - self._beta1 ** t)
+        mhat = m / (1 - _pow_t(self._beta1, t))
         if self._amsgrad:
             vmax = jnp.maximum(state.get("moment2_max", v), v)
-            vhat = vmax / (1 - self._beta2 ** t)
+            vhat = vmax / (1 - _pow_t(self._beta2, t))
             new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
         else:
-            vhat = v / (1 - self._beta2 ** t)
+            vhat = v / (1 - _pow_t(self._beta2, t))
             new_state = {"moment1": m, "moment2": v}
         upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
         return p.data.astype(jnp.float32) - upd, new_state
@@ -97,13 +106,13 @@ class AdamW(Adam):
         t = self._global_step
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
-        mhat = m / (1 - self._beta1 ** t)
+        mhat = m / (1 - _pow_t(self._beta1, t))
         if self._amsgrad:
             vmax = jnp.maximum(state.get("moment2_max", v), v)
-            vhat = vmax / (1 - self._beta2 ** t)
+            vhat = vmax / (1 - _pow_t(self._beta2, t))
             new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
         else:
-            vhat = v / (1 - self._beta2 ** t)
+            vhat = v / (1 - _pow_t(self._beta2, t))
             new_state = {"moment1": m, "moment2": v}
         return p_decayed - lr * mhat / (jnp.sqrt(vhat) + self._eps), new_state
 
@@ -120,7 +129,7 @@ class Adamax(Optimizer):
         t = self._global_step
         m = self._beta1 * state["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
-        upd = lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        upd = lr / (1 - _pow_t(self._beta1, t)) * m / (u + self._eps)
         return p.data.astype(jnp.float32) - upd, {"moment": m, "inf_norm": u}
 
 
@@ -205,7 +214,7 @@ class NAdam(Optimizer):
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
         mhat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
-        vhat = v / (1 - self._beta2 ** t)
+        vhat = v / (1 - _pow_t(self._beta2, t))
         return (
             p.data.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + self._eps),
             {"moment1": m, "moment2": v, "mu_product": mu_prod},
@@ -224,11 +233,11 @@ class RAdam(Optimizer):
         t = self._global_step
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
-        mhat = m / (1 - self._beta1 ** t)
+        mhat = m / (1 - _pow_t(self._beta1, t))
         rho_inf = 2.0 / (1 - self._beta2) - 1
-        rho_t = rho_inf - 2.0 * t * self._beta2 ** t / (1 - self._beta2 ** t)
+        rho_t = rho_inf - 2.0 * t * _pow_t(self._beta2, t) / (1 - _pow_t(self._beta2, t))
         if rho_t > 4:
-            vhat = jnp.sqrt(v / (1 - self._beta2 ** t))
+            vhat = jnp.sqrt(v / (1 - _pow_t(self._beta2, t)))
             r = np.sqrt(
                 ((rho_t - 4) * (rho_t - 2) * rho_inf)
                 / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
@@ -254,8 +263,8 @@ class Lamb(Optimizer):
         t = self._global_step
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
-        mhat = m / (1 - self._beta1 ** t)
-        vhat = v / (1 - self._beta2 ** t)
+        mhat = m / (1 - _pow_t(self._beta1, t))
+        vhat = v / (1 - _pow_t(self._beta2, t))
         decay = self._lamb_decay
         if self._exclude_fn is not None and self._exclude_fn(p):
             decay = 0.0
