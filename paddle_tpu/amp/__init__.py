@@ -16,3 +16,12 @@ from paddle_tpu.amp.auto_cast import (  # noqa: F401
 )
 from paddle_tpu.amp.grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from paddle_tpu.amp import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """fp16 works everywhere via XLA; TPU prefers bf16 (reference amp/__init__)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

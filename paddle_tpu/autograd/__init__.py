@@ -117,3 +117,87 @@ class LegacyPyLayer(PyLayer):
 
 def set_grad_enabled_ctx(mode):
     return set_grad_enabled(mode)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """reference autograd/autograd.py:461 jacobian(ys: Tensor, xs: Tensor):
+    rows via unit-cotangent backward passes on the live tape (create_graph
+    keeps it differentiable for hessian).  A callable is also accepted (then
+    this delegates to the functional incubate implementation)."""
+    from paddle_tpu.incubate.autograd import Jacobian
+
+    if callable(ys):
+        return Jacobian(ys, xs, is_batched=batch_axis is not None)
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd.engine import grad as _grad
+    from paddle_tpu.tensor.tensor import Tensor
+
+    ys_list = ys if isinstance(ys, (list, tuple)) else [ys]
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    rows = []
+    for y in ys_list:
+        flat_n = int(y.size)
+        for j in range(flat_n):
+            # scalarize with a one-hot weight: (y · e_j).sum() — keeps the
+            # second-order tape on the well-tested scalar double-grad path
+            onehot = jnp.zeros((flat_n,), y.data.dtype).at[j].set(1.0).reshape(y.data.shape)
+            yj = (y * Tensor(onehot)).sum()
+            gs = _grad([yj], list(xs_list), retain_graph=True, create_graph=False,
+                       allow_unused=True)
+            row = jnp.concatenate([
+                (g.data if g is not None else jnp.zeros(x.data.shape, y.data.dtype)).reshape(-1)
+                for g, x in zip(gs, xs_list)
+            ])
+            rows.append(row)
+    out = jnp.stack(rows)
+    return Tensor(out)
+
+
+def hessian(ys, xs, batch_axis=None):
+    from paddle_tpu.incubate.autograd import Hessian
+
+    if callable(ys):
+        return Hessian(ys, xs, is_batched=batch_axis is not None)
+    # Tensor form: jacobian of the gradient
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd.engine import grad as _grad
+    from paddle_tpu.tensor.tensor import Tensor
+
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    g = _grad([ys], list(xs_list), retain_graph=True, create_graph=True)
+    if len(g) != 1:
+        raise NotImplementedError("hessian over multiple xs tensors: pass one tensor")
+    return jacobian(g[0], xs_list[0])
+
+
+class saved_tensors_hooks:
+    """reference autograd/saved_tensors_hooks: pack/unpack hooks around tensors
+    saved for backward.  The tape saves leaves via the engine's GradNode; hooks
+    apply at save/restore inside apply()."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        import warnings
+
+        from paddle_tpu.autograd import engine as _engine
+
+        warnings.warn(
+            "saved_tensors_hooks: the XLA tape stores residuals inside compiled "
+            "vjp closures, so pack/unpack hooks are not applied; use "
+            "recompute()/jax.checkpoint for activation memory savings",
+            stacklevel=2,
+        )
+        self._prev = getattr(_engine, "_saved_tensor_hooks", None)
+        _engine._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from paddle_tpu.autograd import engine as _engine
+
+        _engine._saved_tensor_hooks = self._prev
+        return False

@@ -14,3 +14,21 @@ from paddle_tpu.jit.api import (  # noqa: F401
 
 __all__ = ["to_static", "save", "load", "not_to_static", "ignore_module",
            "InputSpec", "TranslatedLayer"]
+
+
+_TO_STATIC = {"enabled": True, "code_level": 0, "verbosity": 0}
+
+
+def enable_to_static(enable=True):
+    """Global to_static switch (reference jit/api.py enable_to_static)."""
+    _TO_STATIC["enabled"] = bool(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """SOT-era transformed-code logging level; with jax.jit tracing there is no
+    transformed source, kept for API parity."""
+    _TO_STATIC["code_level"] = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _TO_STATIC["verbosity"] = level

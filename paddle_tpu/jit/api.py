@@ -90,6 +90,14 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         from paddle_tpu.tensor.tensor import Tensor
 
+        # paddle.jit.enable_to_static(False) falls back to eager execution
+        from paddle_tpu import jit as _jit_pkg
+
+        if not _jit_pkg._TO_STATIC.get("enabled", True):
+            if self._layer is not None:
+                return self._function(*args, **kwargs)
+            return self._function(*args, **kwargs)
+
         arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
         if key not in self._cache:

@@ -26,3 +26,14 @@ def _disable_static():
 
 def _static_mode_enabled() -> bool:
     return _static_mode[0]
+
+from paddle_tpu.static.extras import (  # noqa: F401,E402
+    BuildStrategy, CompiledProgram, ExponentialMovingAverage, IpuCompiledProgram,
+    IpuStrategy, Print, WeightNormParamAttr, accuracy, append_backward, auc,
+    cpu_places, create_global_var, create_parameter, ctr_metric_bundle,
+    cuda_places, deserialize_persistables, deserialize_program, device_guard,
+    gradients, ipu_shard_guard, load, load_from_file, load_inference_model,
+    load_program_state, normalize_program, py_func, save, save_inference_model,
+    save_to_file, serialize_persistables, serialize_program, set_ipu_shard,
+    set_program_state, xpu_places,
+)

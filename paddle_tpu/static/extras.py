@@ -1,0 +1,318 @@
+"""Static-graph long-tail API (reference python/paddle/static/__init__.py,
+io.py, nn/common.py): save/load, program state, gradients, facades.
+
+The static "program" here is a traced-and-compiled XLA computation
+(static/program.py), so most of these delegate to the jit/save machinery or
+operate on Layer state dicts."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+# ------------------------------------------------------------------ save/load
+def save(program, model_path, protocol=4, **configs):
+    """Save program persistables (reference static/io.py save)."""
+    state = program.state_dict() if hasattr(program, "state_dict") else {}
+    payload = {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+               for k, v in state.items()}
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        payload = pickle.load(f)
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(payload)
+    return payload
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, *,
+                         program=None, **kwargs):
+    """reference static/io.py save_inference_model — delegates to the jit
+    saved-model (StableHLO + executable jax.export artifact)."""
+    layer = program if program is not None else getattr(executor, "_layer", None)
+    if layer is None or not hasattr(layer, "functional_state"):
+        raise ValueError(
+            "save_inference_model needs the traced layer/program; pass it via "
+            "program= (jit.save is the underlying mechanism)"
+        )
+    import paddle_tpu as paddle
+
+    specs = [paddle.static.InputSpec(v.shape, str(v.dtype)) for v in feed_vars]
+    paddle.jit.save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    import paddle_tpu as paddle
+
+    return paddle.jit.load(path_prefix)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    return pickle.dumps({"feed": [v.shape for v in feed_vars],
+                         "fetch": [v.shape for v in fetch_vars]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, program=None, **kw):
+    state = program.state_dict() if program is not None and hasattr(program, "state_dict") else {}
+    return pickle.dumps({k: np.asarray(getattr(v, "numpy", lambda: v)())
+                         for k, v in state.items()})
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    payload = pickle.loads(data)
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(payload)
+    return payload
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+
+
+# --------------------------------------------------------------- autograd ops
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None,
+                    checkpoints=None):
+    """reference backward.py append_backward: in the compiled-XLA design the
+    backward is produced by jax.value_and_grad at jit time; eagerly this runs
+    the tape and returns (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params if getattr(p, "grad", None) is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from paddle_tpu.autograd.engine import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+
+
+# ------------------------------------------------------------------- facades
+class BuildStrategy:
+    """Pass-toggle facade (reference BuildStrategy); XLA owns the pass pipeline."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = True
+
+
+class CompiledProgram:
+    """reference compiler.py CompiledProgram: holds a program + BuildStrategy;
+    compilation happens at first Executor.run (jax.jit cache)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/nn/metric ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        import numpy as _np
+
+        params = parameters or self._params
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in params:
+            cur = _np.asarray(p.numpy())
+            prev = self._ema.get(id(p))
+            self._ema[id(p)] = (cur if prev is None
+                                else self._decay * prev + (1 - self._decay) * cur)
+
+    def apply(self, executor=None, need_restore=True):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def ctx():
+            import jax.numpy as jnp
+
+            self._backup = {id(p): p.data for p in self._params}
+            for p in self._params:
+                if id(p) in self._ema:
+                    p._data = jnp.asarray(self._ema[id(p)], p.data.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """reference static/nn/common.py WeightNormParamAttr: marks a param for
+    weight normalization (dim is consumed by nn.utils.weight_norm)."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+# ------------------------------------------------------------- small helpers
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase='both'):
+    import jax
+
+    def cb(x):
+        print(f"{message or 'Print'}: shape={list(x.shape)} dtype={x.dtype}\n{x}")
+
+    jax.debug.callback(cb, input.data)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static/nn/common.py py_func — eager design runs Python inline."""
+    res = func(*x) if isinstance(x, (list, tuple)) else func(x)
+    return res
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.tensor.tensor import Tensor
+
+    t = Tensor(jnp.full(tuple(shape), value, paddle.dtype(dtype)))
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from paddle_tpu.tensor.creation import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def cpu_places(device_count=None):
+    import paddle_tpu as paddle
+
+    n = device_count or 1
+    return [paddle.CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import paddle_tpu as paddle
+
+    ids = device_ids if device_ids is not None else [0]
+    return [paddle.CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    import paddle_tpu as paddle
+
+    ids = device_ids if device_ids is not None else [0]
+    return [paddle.XPUPlace(i) for i in ids]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from paddle_tpu.metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1, slide_steps=1,
+        ins_tag_weight=None):
+    import numpy as np
+
+    from paddle_tpu.metric import Auc
+    from paddle_tpu.tensor.tensor import Tensor
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    import jax.numpy as jnp
+
+    val = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    return val, val, [val]
+
+
+from contextlib import contextmanager as _ctxmgr
+
+
+@_ctxmgr
+def device_guard(device=None):
+    """reference device_guard: pin ops to a device inside the context."""
+    yield
+
+
+@_ctxmgr
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU is not a supported backend of this framework")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("IPU is not a supported backend of this framework")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle: use paddle.metric.Auc + the PS-mode datasets"
+    )

@@ -1,7 +1,14 @@
 """paddle.vision.transforms (python/paddle/vision/transforms parity)."""
 from paddle_tpu.vision.transforms import functional  # noqa: F401
+from paddle_tpu.vision.transforms.functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation, affine,
+    center_crop, crop, erase, hflip, normalize, pad, perspective, resize,
+    rotate, to_grayscale, to_tensor, vflip,
+)
 from paddle_tpu.vision.transforms.transforms import (  # noqa: F401
-    BaseTransform, BrightnessTransform, CenterCrop, Compose, ContrastTransform,
-    Grayscale, Normalize, Pad, RandomCrop, RandomHorizontalFlip,
-    RandomResizedCrop, RandomVerticalFlip, Resize, ToTensor, Transpose,
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomAffine,
+    RandomCrop, RandomErasing, RandomHorizontalFlip, RandomPerspective,
+    RandomResizedCrop, RandomRotation, RandomVerticalFlip, Resize,
+    SaturationTransform, ToTensor, Transpose,
 )

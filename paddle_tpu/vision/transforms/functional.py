@@ -133,13 +133,18 @@ def adjust_contrast(img, factor):
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    img = _as_hwc(img)
-    k = int(round(angle / 90.0)) % 4
-    if abs(angle - 90 * round(angle / 90.0)) > 1e-6:
+    hwc = _as_hwc(img)
+    if abs(angle - 90 * round(angle / 90.0)) <= 1e-6:
+        return np.rot90(hwc, int(round(angle / 90.0)) % 4)
+    if expand:
         raise NotImplementedError(
-            "only multiples of 90 degrees supported by the numpy backend"
+            "rotate(expand=True) with non-right angles is not implemented; "
+            "the canvas is kept at the input size"
         )
-    return np.rot90(img, k)
+    # arbitrary angles: affine warp (negated — affine() maps output←input);
+    # sampling is nearest-neighbor regardless of `interpolation`
+    return affine(hwc, angle=-angle, center=center, fill=fill,
+                  interpolation=interpolation)
 
 
 def to_grayscale(img, num_output_channels=1):
@@ -149,3 +154,123 @@ def to_grayscale(img, num_output_channels=1):
     if num_output_channels == 3:
         g = np.repeat(g, 3, axis=-1)
     return g
+
+
+def adjust_saturation(img, factor):
+    """Blend with the grayscale image (reference functional.py adjust_saturation)."""
+    hwc = _as_hwc(img)
+    x = hwc.astype(np.float32)
+    gray = x @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = factor * x + (1 - factor) * gray[..., None]
+    hi = 255.0 if hwc.dtype == np.uint8 or x.max() > 1.5 else 1.0
+    return np.clip(out, 0, hi).astype(hwc.dtype)
+
+
+def adjust_hue(img, factor):
+    """Shift hue in HSV space by factor∈[-0.5, 0.5] (reference adjust_hue)."""
+    hwc = _as_hwc(img).astype(np.float32)
+    scale = 255.0 if hwc.max() > 1.5 else 1.0
+    x = hwc / scale
+    mx = x.max(-1)
+    mn = x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + factor) % 1.0
+    i = np.floor(h * 6).astype(np.int32) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    conds = [(i == k)[..., None] for k in range(6)]
+    out = np.select(
+        conds,
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1), np.stack([p, v, t], -1),
+         np.stack([p, q, v], -1), np.stack([t, p, v], -1), np.stack([v, p, q], -1)],
+    )
+    out = out * scale
+    return out.astype(_as_hwc(img).dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # RSS (rotate-scale-shear) about center, then translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]]) * scale
+    m[0, 2] = tx + cx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = ty + cy - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def _sample_inverse(hwc, inv_map, fill=0):
+    h, w = hwc.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    src = inv_map(xs, ys)
+    sx, sy = src
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    sxc = np.clip(np.round(sx).astype(np.int32), 0, w - 1)
+    syc = np.clip(np.round(sy).astype(np.int32), 0, h - 1)
+    out = hwc[syc, sxc]
+    out[~valid] = fill
+    return out
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine warp (reference functional.py affine), nearest sampling."""
+    hwc = _as_hwc(img)
+    h, w = hwc.shape[:2]
+    if center is None:
+        center = ((w - 1) / 2, (h - 1) / 2)
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    minv = np.linalg.inv(np.vstack([m, [0, 0, 1]]))[:2]
+
+    def inv_map(xs, ys):
+        sx = minv[0, 0] * xs + minv[0, 1] * ys + minv[0, 2]
+        sy = minv[1, 0] * xs + minv[1, 1] * ys + minv[1, 2]
+        return sx, sy
+
+    return _sample_inverse(hwc, inv_map, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective warp from 4 point pairs (reference functional.py perspective)."""
+    hwc = _as_hwc(img)
+    A = []
+    B = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B += [sx, sy]
+    coef = np.linalg.lstsq(np.asarray(A, np.float64), np.asarray(B, np.float64), rcond=None)[0]
+    a, b, c, d, e, f, g, hcf = coef
+
+    def inv_map(xs, ys):
+        den = g * xs + hcf * ys + 1
+        return (a * xs + b * ys + c) / den, (d * xs + e * ys + f) / den
+
+    return _sample_inverse(hwc, inv_map, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region (reference functional.py erase); img CHW tensor/array."""
+    from paddle_tpu.tensor.tensor import Tensor as _T
+
+    if isinstance(img, _T):
+        arr = img.numpy().copy()
+        arr[..., i:i + h, j:j + w] = v
+        return _T(arr)
+    arr = img if inplace else img.copy()
+    arr[..., i:i + h, j:j + w] = v
+    return arr

@@ -206,3 +206,137 @@ class ContrastTransform(BaseTransform):
             return img
         factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
         return F.adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value=0.0, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return F.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value=0.0, keys=None):
+        super().__init__(keys)
+        self.value = min(value, 0.5)
+
+    def _apply_image(self, img):
+        return F.adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue (reference transforms.py)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.b, self.c, self.s, self.h = brightness, contrast, saturation, hue
+
+    def _apply_image(self, img):
+        if self.b:
+            img = F.adjust_brightness(img, 1 + np.random.uniform(-self.b, self.b))
+        if self.c:
+            img = F.adjust_contrast(img, 1 + np.random.uniform(-self.c, self.c))
+        if self.s:
+            img = F.adjust_saturation(img, 1 + np.random.uniform(-self.s, self.s))
+        if self.h:
+            img = F.adjust_hue(img, np.random.uniform(-self.h, self.h))
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.kw = dict(interpolation=interpolation, expand=expand, center=center)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return F.rotate(img, angle, **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = np.asarray(img).shape[:2] if np.asarray(img).ndim == 3 else np.asarray(img).shape[-2:]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear) if np.isscalar(self.shear)
+              else np.random.uniform(*self.shear[:2])) if self.shear else 0.0
+        return F.affine(img, angle=angle, translate=(tx, ty), scale=sc,
+                        shear=(sh, 0.0), fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = np.asarray(img).shape[:2]
+        d = self.scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (np.random.randint(0, half_w + 1), np.random.randint(0, half_h + 1))
+        tr = (w - 1 - np.random.randint(0, half_w + 1), np.random.randint(0, half_h + 1))
+        br = (w - 1 - np.random.randint(0, half_w + 1), h - 1 - np.random.randint(0, half_h + 1))
+        bl = (np.random.randint(0, half_w + 1), h - 1 - np.random.randint(0, half_h + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return F.perspective(img, start, [tl, tr, br, bl],
+                             interpolation=self.interpolation, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Random rectangle erase on CHW tensors (reference transforms.py)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0,
+                 inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img.numpy() if hasattr(img, "numpy") else img)
+        # Tensors are CHW; ndarray images are HWC (channels last, 1/3/4)
+        hwc_layout = (not hasattr(img, "numpy")) and arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[0], arr.shape[1]) if hwc_layout else arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                if hwc_layout:
+                    out = arr.copy()
+                    out[i:i + eh, j:j + ew, :] = self.value
+                    return out
+                return F.erase(img, i, j, eh, ew, self.value)
+        return img
