@@ -8,3 +8,5 @@ from paddle_tpu.distributed.auto_parallel.placement_type import (  # noqa: F401
 from paddle_tpu.distributed.auto_parallel.process_mesh import (  # noqa: F401
     ProcessMesh, get_mesh, set_mesh,
 )
+
+from paddle_tpu.distributed.auto_parallel.static import Engine  # noqa: F401,E402

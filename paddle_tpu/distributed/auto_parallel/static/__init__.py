@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.auto_parallel.static.engine import Engine
+
+__all__ = ['Engine']

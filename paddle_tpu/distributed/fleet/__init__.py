@@ -11,6 +11,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.distributed.fleet.base.distributed_strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet import auto  # noqa: F401
 from paddle_tpu.distributed.fleet.topology import (
     CommunicateTopology, HybridCommunicateGroup,
 )

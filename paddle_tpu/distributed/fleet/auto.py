@@ -1,0 +1,7 @@
+"""fleet.auto namespace (reference exposes the auto-parallel Engine et al. as
+paddle.distributed.fleet.auto in tutorials)."""
+from paddle_tpu.distributed.auto_parallel.api import (  # noqa: F401
+    Strategy, shard_tensor,
+)
+from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh  # noqa: F401
+from paddle_tpu.distributed.auto_parallel.static.engine import Engine  # noqa: F401

@@ -396,3 +396,42 @@ class TestDistributedSurfaceParity:
         out = dist.wait(t)
         assert out is t
         assert dist.ReduceType.kRedSum == 0
+
+
+class TestAutoParallelEngine:
+    def test_fit_evaluate_predict_save_load(self):
+        import tempfile
+
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet import auto
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 1)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+        engine = auto.Engine(net, loss=nn.MSELoss(), optimizer=opt)
+        X = np.random.rand(32, 4).astype("float32")
+        Y = X.sum(1, keepdims=True).astype("float32")
+        batches = [(paddle.to_tensor(X[i:i + 8]), paddle.to_tensor(Y[i:i + 8]))
+                   for i in range(0, 32, 8)]
+        logs = engine.fit(batches, epochs=40, verbose=0)
+        assert logs["loss"] < 0.1
+        ev = engine.evaluate(batches, verbose=0)
+        assert ev["eval_loss"] < 0.1
+        preds = engine.predict(batches)
+        assert len(preds) == 4 and list(preds[0].shape) == [8, 1]
+        path = tempfile.mkdtemp() + "/ckpt"
+        engine.save(path)
+        w0 = net.fc.weight.numpy().copy()
+        net.fc.weight._data = net.fc.weight.data * 0
+        engine.load(path)
+        np.testing.assert_allclose(net.fc.weight.numpy(), w0)
