@@ -161,3 +161,58 @@ class TestLossOps(OpTest):
             lambda a, b: paddle.nn.functional.mse_loss(a, b),
             lambda a, b: ((a - b) ** 2).mean(), [x, y],
         )
+
+
+class TestConvPoolGrads(OpTest):
+    """Conv/pool forward + grad coverage (the OpTest fixture over the layers
+    the vision models rely on)."""
+
+    def test_conv2d_forward_and_grad(self):
+        import scipy.signal
+
+        x = _r(1, 1, 6, 6, seed=30)
+        w = _r(1, 1, 3, 3, seed=31)
+
+        def ref(a, k):
+            out = scipy.signal.correlate(a[0, 0], k[0, 0], mode="valid")
+            return out[None, None]
+
+        self.check_output(
+            lambda a, k: paddle.nn.functional.conv2d(a, k), ref, [x, w],
+            rtol=1e-4, atol=1e-5,
+        )
+        self.check_grad(lambda a, k: paddle.nn.functional.conv2d(a, k), [x, w])
+
+    def test_avg_and_max_pool_grad(self):
+        x = _r(1, 2, 6, 6, seed=32)
+        self.check_output(
+            lambda a: paddle.nn.functional.avg_pool2d(a, 2),
+            lambda a: a.reshape(1, 2, 3, 2, 3, 2).mean((3, 5)), [x],
+        )
+        self.check_output(
+            lambda a: paddle.nn.functional.max_pool2d(a, 2),
+            lambda a: a.reshape(1, 2, 3, 2, 3, 2).max((3, 5)), [x],
+        )
+        self.check_grad(lambda a: paddle.nn.functional.avg_pool2d(a, 2), [x])
+        self.check_grad(lambda a: paddle.nn.functional.max_pool2d(a, 2), [x])
+
+    def test_batch_norm_layer_grad(self):
+        # sum(BN(x)) is constant in x (the uniform cotangent lies in the
+        # normalization Jacobian's null space) — weight the output with a fixed
+        # random tensor so the check exercises the interesting directions
+        x = _r(4, 3, 5, 5, seed=33)
+        w = paddle.to_tensor(_r(4, 3, 5, 5, seed=43))
+        bn = paddle.nn.BatchNorm2D(3)
+
+        def op(a):
+            return bn(a) * w
+
+        self.check_grad(op, [x], rtol=5e-2, atol=5e-3)
+
+    def test_layer_norm_grad(self):
+        x = _r(4, 8, seed=34)
+        w = paddle.to_tensor(_r(4, 8, seed=44))
+        self.check_grad(
+            lambda a: paddle.nn.functional.layer_norm(a, 8) * w, [x],
+            rtol=5e-2, atol=5e-3,
+        )
