@@ -9,6 +9,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <ctime>
 #include <cstdint>
 #include <cstring>
@@ -34,6 +35,50 @@ struct Ring {
   bool owner = false;
   size_t total = 0;
 };
+
+// Robust-mutex helpers: if a worker dies holding the lock, the next locker
+// gets EOWNERDEAD instead of blocking forever.  Recovery marks the mutex
+// consistent, then validates the header counters — a writer killed between
+// the head/used updates leaves them torn, and continuing with a broken
+// accounting would underflow `used` and wedge every producer.  On violation
+// the ring is poisoned (closed) so both sides error out instead of hanging;
+// a torn *payload* with consistent counters just means the record was never
+// published, which is safe.
+void recover_after_owner_death(RingHeader* h) {
+  pthread_mutex_consistent(&h->mu);
+  if (h->used > h->capacity || h->head - h->tail != h->used) {
+    h->closed = 1;
+    pthread_cond_broadcast(&h->not_empty);
+    pthread_cond_broadcast(&h->not_full);
+  }
+}
+
+int lock_robust(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    recover_after_owner_death(h);
+    rc = 0;
+  }
+  return rc;
+}
+
+int wait_robust(RingHeader* h, pthread_cond_t* cv) {
+  int rc = pthread_cond_wait(cv, &h->mu);
+  if (rc == EOWNERDEAD) {
+    recover_after_owner_death(h);
+    rc = 0;
+  }
+  return rc;
+}
+
+int timedwait_robust(RingHeader* h, pthread_cond_t* cv, const timespec* ts) {
+  int rc = pthread_cond_timedwait(cv, &h->mu, ts);
+  if (rc == EOWNERDEAD) {
+    recover_after_owner_death(h);
+    rc = 0;
+  }
+  return rc;
+}
 
 // record: u64 length | payload
 void write_bytes(Ring* r, uint64_t off, const void* src, uint64_t n) {
@@ -77,6 +122,7 @@ void* shm_ring_create(const char* name, uint64_t capacity) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&r->hdr->mu, &ma);
   pthread_condattr_t ca;
   pthread_condattr_init(&ca);
@@ -116,9 +162,9 @@ int shm_ring_push(void* h, const uint8_t* payload, uint64_t n) {
   auto* r = static_cast<Ring*>(h);
   uint64_t need = n + 8;
   if (need > r->hdr->capacity) return -2;
-  pthread_mutex_lock(&r->hdr->mu);
+  lock_robust(r->hdr);
   while (r->hdr->capacity - r->hdr->used < need && !r->hdr->closed)
-    pthread_cond_wait(&r->hdr->not_full, &r->hdr->mu);
+    wait_robust(r->hdr, &r->hdr->not_full);
   if (r->hdr->closed) {
     pthread_mutex_unlock(&r->hdr->mu);
     return -1;
@@ -149,10 +195,10 @@ int64_t shm_ring_pop_timed(void* h, uint8_t* buf, uint64_t cap,
 
 static int64_t pop_impl(Ring* r, uint8_t* buf, uint64_t cap, uint64_t* required,
                         int64_t timeout_ms) {
-  pthread_mutex_lock(&r->hdr->mu);
+  lock_robust(r->hdr);
   if (timeout_ms < 0) {
     while (r->hdr->used == 0 && !r->hdr->closed)
-      pthread_cond_wait(&r->hdr->not_empty, &r->hdr->mu);
+      wait_robust(r->hdr, &r->hdr->not_empty);
   } else {
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
@@ -163,7 +209,8 @@ static int64_t pop_impl(Ring* r, uint8_t* buf, uint64_t cap, uint64_t* required,
       ts.tv_nsec -= 1000000000L;
     }
     while (r->hdr->used == 0 && !r->hdr->closed) {
-      if (pthread_cond_timedwait(&r->hdr->not_empty, &r->hdr->mu, &ts) != 0) {
+      int rc = timedwait_robust(r->hdr, &r->hdr->not_empty, &ts);
+      if (rc != 0) {
         if (r->hdr->used == 0) {
           pthread_mutex_unlock(&r->hdr->mu);
           return -2;
@@ -192,11 +239,18 @@ static int64_t pop_impl(Ring* r, uint8_t* buf, uint64_t cap, uint64_t* required,
 
 void shm_ring_close(void* h) {
   auto* r = static_cast<Ring*>(h);
-  pthread_mutex_lock(&r->hdr->mu);
+  lock_robust(r->hdr);
   r->hdr->closed = 1;
   pthread_cond_broadcast(&r->hdr->not_empty);
   pthread_cond_broadcast(&r->hdr->not_full);
   pthread_mutex_unlock(&r->hdr->mu);
+}
+
+// Test hook: grab the ring mutex and never release it, so a test can kill the
+// process and verify the robust-mutex recovery path in the surviving reader.
+void shm_ring_debug_lock(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  lock_robust(r->hdr);
 }
 
 void shm_ring_destroy(void* h) {

@@ -100,13 +100,17 @@ void serve_conn(Server* s, int fd) {
         reply(fd, 0, {});
         break;
       }
-      case 1: {  // GET
-        std::lock_guard<std::mutex> lk(s->mu);
-        auto it = s->kv.find(key);
-        if (it == s->kv.end())
-          reply(fd, 1, {});
-        else
-          reply(fd, 0, it->second);
+      case 1: {  // GET — copy under the lock, reply outside it so a client
+                 // that stops draining its socket can't stall other ranks
+        bool found;
+        std::vector<uint8_t> out;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->kv.find(key);
+          found = it != s->kv.end();
+          if (found) out = it->second;
+        }
+        reply(fd, found ? 0 : 1, out);
         break;
       }
       case 2: {  // ADD: value = i64 delta; returns new value as i64
@@ -132,19 +136,27 @@ void serve_conn(Server* s, int fd) {
       case 3: {  // WAIT: value = i64 timeout_ms
         int64_t timeout_ms = 0;
         if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
-        std::unique_lock<std::mutex> lk(s->mu);
-        bool ok = s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-          return s->kv.count(key) > 0 || s->stop.load();
-        });
-        if (ok && s->kv.count(key))
-          reply(fd, 0, s->kv[key]);
+        bool found;
+        std::vector<uint8_t> out;
+        {
+          std::unique_lock<std::mutex> lk(s->mu);
+          bool ok = s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+            return s->kv.count(key) > 0 || s->stop.load();
+          });
+          found = ok && s->kv.count(key);
+          if (found) out = s->kv[key];
+        }
+        if (found)
+          reply(fd, 0, out);
         else
           reply(fd, 1, {});  // timeout — the comm-watchdog signal
         break;
       }
       case 4: {  // DELETE
-        std::lock_guard<std::mutex> lk(s->mu);
-        s->kv.erase(key);
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv.erase(key);
+        }
         reply(fd, 0, {});
         break;
       }

@@ -24,6 +24,7 @@ class Engine:
         self._train_step = None
         self._eval_fn = None
         self._pred_fn = None
+        self._example_specs = None  # first-seen input (shape, dtype)s, for export
         self.history = {"loss": []}
 
     # ----------------------------------------------------------------- build
@@ -54,6 +55,10 @@ class Engine:
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
                 inputs, labels = self._split_batch(batch, train_sample_split)
+                if self._example_specs is None:
+                    # keep the FIRST batch's shapes: a ragged final batch
+                    # would pin the exported model to its smaller batch size
+                    self._record_specs(inputs)
                 if len(labels) > 1:
                     raise NotImplementedError(
                         "Engine.fit: the compiled train step takes one label "
@@ -90,6 +95,8 @@ class Engine:
                 if steps is not None and step >= steps:
                     break
                 inputs, labels = self._split_batch(batch, valid_sample_split)
+                if self._example_specs is None:
+                    self._record_specs(inputs)
                 l = self._eval_fn(*inputs, *labels) if self._loss is not None                     else self._eval_fn(*inputs)
                 losses.append(float(np.asarray(l.numpy() if hasattr(l, "numpy") else l)))
                 if self._metrics and labels:
@@ -128,6 +135,8 @@ class Engine:
                 if steps is not None and step >= steps:
                     break
                 inputs, _ = self._split_batch(batch, test_sample_split)
+                if self._example_specs is None:
+                    self._record_specs(inputs)
                 outs.append(self._pred_fn(*inputs))
         finally:
             if was_training:
@@ -136,29 +145,89 @@ class Engine:
 
     # ------------------------------------------------------------- save/load
     def save(self, path, training=True):
+        """reference engine.py:2515 — training=True saves params (.pdparams)
+        plus optimizer state (.pdopt, the hapi/Model.save layout so either
+        loader can read the checkpoint); training=False exports the inference
+        model through jit.save using the last-seen input shapes."""
         import os
 
         import paddle_tpu as paddle
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        blob = {"model": self._model.state_dict()}
-        if training and self._optimizer is not None:
-            blob["optimizer"] = self._optimizer.state_dict()
-        paddle.save(blob, path + ".pdparams")
+        if not training:
+            if self._example_specs is None:
+                raise RuntimeError(
+                    "Engine.save(training=False) exports an inference model "
+                    "and needs recorded input shapes; run fit/evaluate/"
+                    "predict first"
+                )
+            from paddle_tpu.static import InputSpec
+
+            specs = [InputSpec(shape=shape, dtype=dtype)
+                     for shape, dtype in self._example_specs]
+            paddle.jit.save(self._model, path, input_spec=specs)
+            return
+        paddle.save(self._model.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, strict=True, load_optimizer=True):
+        import os
+
         import paddle_tpu as paddle
 
-        blob = paddle.load(path + ".pdparams")
+        state = paddle.load(path + ".pdparams")
+        if isinstance(state, dict) and set(state) == {"params", "buffers"}:
+            raise ValueError(
+                f"Engine.load: {path}.pdparams is an inference export "
+                "(written by save(training=False) / jit.save); load it with "
+                "paddle.jit.load, or save a training checkpoint with "
+                "save(training=True)"
+            )
+        if isinstance(state, dict) and "model" in state and set(state) <= {
+                "model", "optimizer"}:
+            # round-1 combined layout, still readable
+            opt_state = state.get("optimizer")
+            state = state["model"]
+        else:
+            opt_state = None
         if strict:
-            have = {n for n, _ in self._model.named_parameters()} | {
-                n for n, _ in getattr(self._model, "named_buffers", lambda: [])()}
-            missing = [k for k in have if k not in blob["model"]]
+            have = dict(self._model.named_parameters())
+            for n, b in getattr(self._model, "named_buffers", lambda: [])():
+                have.setdefault(n, b)
+            missing = sorted(set(have) - set(state))
+            unexpected = sorted(set(state) - set(have))
+            bad_shape = [
+                k for k in set(have) & set(state)
+                if list(have[k].shape) != list(state[k].shape)
+            ]
+            problems = []
             if missing:
-                raise ValueError(f"Engine.load(strict=True): missing keys {missing}")
-        self._model.set_state_dict(blob["model"])
-        if load_optimizer and "optimizer" in blob and self._optimizer is not None:
-            self._optimizer.set_state_dict(blob["optimizer"])
+                problems.append(f"missing keys {missing}")
+            if unexpected:
+                problems.append(f"unexpected keys {unexpected}")
+            if bad_shape:
+                problems.append(
+                    "shape mismatch for "
+                    + ", ".join(
+                        f"{k} (model {list(have[k].shape)} vs checkpoint "
+                        f"{list(state[k].shape)})" for k in bad_shape
+                    )
+                )
+            if problems:
+                raise ValueError(
+                    "Engine.load(strict=True): " + "; ".join(problems))
+        self._model.set_state_dict(state)
+        if load_optimizer and self._optimizer is not None:
+            opt_path = path + ".pdopt"
+            if opt_state is None and os.path.exists(opt_path):
+                opt_state = paddle.load(opt_path)
+            if opt_state is not None:
+                self._optimizer.set_state_dict(opt_state)
+
+    def _record_specs(self, inputs):
+        self._example_specs = [
+            (list(x.shape), str(x.dtype)) for x in inputs]
 
     # ------------------------------------------------------------- utilities
     def _as_loader(self, data, batch_size, collate_fn):

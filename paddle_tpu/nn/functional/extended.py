@@ -500,24 +500,35 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
 
+        zeros_pad = padding_mode != "border"
+
+        def tap(img, yi, xi):
+            # one gather with clipped indices; out-of-bounds taps are zeroed
+            # individually so a footprint straddling the border still blends
+            # its in-bounds corners (instead of zeroing the whole sample)
+            v = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+            if zeros_pad:
+                ok = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+                v = v * ok
+            return v
+
         def sample(img, yy, xx):
-            valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
-            if padding_mode == "border":
-                valid = jnp.ones_like(valid)
-            yy = jnp.clip(yy, 0, h - 1)
-            xx = jnp.clip(xx, 0, w - 1)
             if mode == "nearest":
-                v = img[:, jnp.round(yy).astype(jnp.int32), jnp.round(xx).astype(jnp.int32)]
-                return v * valid
+                return tap(img, jnp.round(yy).astype(jnp.int32),
+                           jnp.round(xx).astype(jnp.int32))
+            if padding_mode == "border":
+                yy = jnp.clip(yy, 0, h - 1)
+                xx = jnp.clip(xx, 0, w - 1)
             y0 = jnp.floor(yy).astype(jnp.int32)
             x0 = jnp.floor(xx).astype(jnp.int32)
-            y1 = jnp.minimum(y0 + 1, h - 1)
-            x1 = jnp.minimum(x0 + 1, w - 1)
+            y1 = y0 + 1
+            x1 = x0 + 1
             wy = yy - y0
             wx = xx - x0
-            v = (img[:, y0, x0] * (1 - wy) * (1 - wx) + img[:, y0, x1] * (1 - wy) * wx
-                 + img[:, y1, x0] * wy * (1 - wx) + img[:, y1, x1] * wy * wx)
-            return v * valid
+            return (tap(img, y0, x0) * (1 - wy) * (1 - wx)
+                    + tap(img, y0, x1) * (1 - wy) * wx
+                    + tap(img, y1, x0) * wy * (1 - wx)
+                    + tap(img, y1, x1) * wy * wx)
 
         return jax.vmap(lambda img, yy, xx: sample(img, yy.reshape(-1), xx.reshape(-1))
                         .reshape(c, *yy.shape))(a, fy, fx)

@@ -431,7 +431,51 @@ class TestAutoParallelEngine:
         assert len(preds) == 4 and list(preds[0].shape) == [8, 1]
         path = tempfile.mkdtemp() + "/ckpt"
         engine.save(path)
+        # hapi layout: params-only .pdparams + separate .pdopt, so either
+        # loader reads the checkpoint
+        import os
+
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        assert "model" not in paddle.load(path + ".pdparams")
         w0 = net.fc.weight.numpy().copy()
         net.fc.weight._data = net.fc.weight.data * 0
         engine.load(path)
         np.testing.assert_allclose(net.fc.weight.numpy(), w0)
+
+    def test_save_inference_and_strict_load(self):
+        import tempfile
+
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet import auto
+
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        engine = auto.Engine(net, loss=nn.MSELoss(), optimizer=opt)
+        X = np.random.rand(8, 4).astype("float32")
+        Y = np.zeros((8, 2), "float32")
+        engine.fit([(paddle.to_tensor(X), paddle.to_tensor(Y))], epochs=1,
+                   verbose=0)
+        d = tempfile.mkdtemp()
+        # training=False routes through the inference-model export
+        engine.save(d + "/infer", training=False)
+        import os
+
+        assert os.path.exists(d + "/infer.pdmodel.json")
+        assert os.path.exists(d + "/infer.stablehlo")
+
+        # strict load rejects unexpected keys and shape mismatches
+        state = net.state_dict()
+        state["ghost"] = paddle.to_tensor(np.zeros(3, "float32"))
+        paddle.save(state, d + "/bad.pdparams")
+        with pytest.raises(ValueError, match="unexpected"):
+            engine.load(d + "/bad")
+        state2 = {k: v for k, v in net.state_dict().items()}
+        state2["weight"] = paddle.to_tensor(np.zeros((4, 3), "float32"))
+        paddle.save(state2, d + "/bad2.pdparams")
+        with pytest.raises(ValueError, match="shape mismatch"):
+            engine.load(d + "/bad2")

@@ -187,3 +187,32 @@ w.close()
                 r.pop()
         finally:
             r.destroy()
+
+    def test_robust_mutex_survives_dead_lock_holder(self):
+        """A worker killed while holding the ring mutex must not hang the
+        parent: the robust mutex surfaces EOWNERDEAD and pop recovers."""
+        import ctypes
+        import subprocess
+        import sys
+
+        name = f"/pt_robust_{os.getpid()}"
+        r = ShmRing(name, capacity=1 << 16, create=True)
+        r.push(b"survivor")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = f"""
+import os, sys, ctypes
+sys.path.insert(0, {repo!r})
+from paddle_tpu.core.native import ShmRing
+w = ShmRing({name!r}, create=False)
+w._lib.shm_ring_debug_lock.argtypes = [ctypes.c_void_p]
+w._lib.shm_ring_debug_lock(w._h)  # die holding the lock
+os._exit(0)
+"""
+        try:
+            p = subprocess.Popen([sys.executable, "-c", script])
+            assert p.wait(timeout=60) == 0
+            # without PTHREAD_MUTEX_ROBUST this blocks forever inside
+            # pthread_mutex_lock, before the pop timeout can apply
+            assert r.pop(timeout_ms=5000) == b"survivor"
+        finally:
+            r.destroy()
