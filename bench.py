@@ -2,10 +2,15 @@
 
 Measures the flagship Llama-style causal-LM training step (fwd+bwd+AdamW fused
 into one XLA program via paddle_tpu.static.functionalize) in bf16 on the
-available chip, and reports tokens/sec.  The reference publishes no absolute
-numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first value
-this harness ever recorded on this hardware (bench_baseline.json, committed
-once measured) — i.e. it tracks our own progress round over round.
+available chip: a ~0.95B-parameter model at batch 8 x seq 2048 with per-layer
+recompute and the Pallas flash-attention forward+backward kernels.
+
+Reports tokens/sec and **MFU** (model FLOPs utilisation: analytic train FLOPs
+per token x tokens/sec / peak chip FLOPs).  The reference publishes no absolute
+numbers (BASELINE.md), so ``vs_baseline`` is the ratio of achieved MFU against
+the first MFU this harness ever recorded on this hardware
+(bench_baseline.json) — i.e. it tracks our own progress round over round in a
+config-independent unit.
 """
 from __future__ import annotations
 
@@ -15,6 +20,26 @@ import time
 
 import numpy as np
 
+# bf16 peak by chip generation (the driver runs on one real chip)
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _peak_tflops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in _PEAK_TFLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return 197.0  # default: v5e
+
 
 def main():
     import paddle_tpu as paddle
@@ -23,12 +48,13 @@ def main():
     from paddle_tpu.static.functionalize import build_train_step
 
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
-        max_position_embeddings=1024, dtype="bfloat16",
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=2048, dtype="bfloat16", recompute=True,
     )
-    batch, seq = 8, 1024
+    batch, seq = 8, 2048
     model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
                 weight_decay=0.01)
     step = build_train_step(model, None, opt)
@@ -44,13 +70,20 @@ def main():
     step(ids, labels).numpy()  # compile + warm up
     step(ids, labels).numpy()
 
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, labels)
-    loss.numpy()  # sync
+    loss.numpy()  # sync (only a device->host readback truly syncs over axon)
     dt = (time.perf_counter() - t0) / iters
     tokens_per_sec = batch * seq / dt
+
+    # analytic model FLOPs (6N per token for the matmuls + causal attention);
+    # remat recompute FLOPs are deliberately NOT counted — MFU is model FLOPs
+    flops_per_token = (6 * n_params
+                       + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq)
+    achieved_tflops = flops_per_token * tokens_per_sec / 1e12
+    mfu = achieved_tflops / _peak_tflops()
 
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
     vs = 1.0
@@ -58,16 +91,24 @@ def main():
         try:
             with open(baseline_path) as f:
                 base = json.load(f)
-            if base.get("value"):
-                vs = tokens_per_sec / float(base["value"])
+            if base.get("mfu"):
+                vs = mfu / float(base["mfu"])
+            elif base.get("value"):  # round-1 file: tokens/s of the old config
+                # old config: 168.3M params, seq 1024 -> 1.11e9 FLOPs/token
+                base_tflops = 1.11e9 * float(base["value"]) / 1e12
+                vs = achieved_tflops / base_tflops
         except Exception:
             pass
 
     print(json.dumps({
-        "metric": "llama_1b_slice_train_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
+        "metric": "llama_1b_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu",
         "vs_baseline": round(vs, 3),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "achieved_tflops": round(achieved_tflops, 1),
+        "params_b": round(n_params / 1e9, 3),
+        "step_ms": round(dt * 1000, 1),
     }))
 
 

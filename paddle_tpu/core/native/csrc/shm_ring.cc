@@ -47,7 +47,11 @@ struct Ring {
 void recover_after_owner_death(RingHeader* h) {
   pthread_mutex_consistent(&h->mu);
   if (h->used > h->capacity || h->head - h->tail != h->used) {
+    // also reset the counters: pop treats closed+empty as EOF, so leaving a
+    // torn `used` nonzero would let it read garbage records (and underflow
+    // `used`) before noticing the poison
     h->closed = 1;
+    h->head = h->tail = h->used = 0;
     pthread_cond_broadcast(&h->not_empty);
     pthread_cond_broadcast(&h->not_full);
   }

@@ -165,7 +165,15 @@ class Engine:
 
             specs = [InputSpec(shape=shape, dtype=dtype)
                      for shape, dtype in self._example_specs]
-            paddle.jit.save(self._model, path, input_spec=specs)
+            # trace in eval mode: the exported graph must not bake in
+            # dropout masking / batch-stats normalization
+            was_training = getattr(self._model, "training", False)
+            self._model.eval()
+            try:
+                paddle.jit.save(self._model, path, input_spec=specs)
+            finally:
+                if was_training:
+                    self._model.train()
             return
         paddle.save(self._model.state_dict(), path + ".pdparams")
         if self._optimizer is not None:

@@ -10,9 +10,11 @@ third_party/flashattn (CUDA).  TPU-native design:
   blocks in pure jnp.  It is differentiable, memory-efficient (never
   materializes the [Lq, Lk] score matrix), works on any backend, and is the
   building block ring attention rotates over the mesh (ops/ring_attention.py).
-* ``flash_attention_blhd`` — custom_vjp wrapper: Pallas forward, backward via
-  the vjp of ``blockwise_attention`` (recompute — the flashattn backward
-  strategy, traded for FLOPs exactly as jax.checkpoint would).
+* ``_flash_bwd_pallas`` — the standard two-pass flash backward as Pallas
+  kernels (dk/dv pass over k blocks, dq pass over q blocks) consuming the
+  forward's log-sum-exp rows; fp32 accumulation, no [Lq, Lk] tensor in HBM.
+* ``flash_attention_blhd`` — custom_vjp wrapper: Pallas forward, Pallas
+  backward.
 
 Layout is Paddle's flash-attention layout [batch, seq, heads, head_dim].
 """
@@ -28,11 +30,13 @@ _NEG_INF = -1e30
 
 
 # --------------------------------------------------------------------------- pallas fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float):
     """One (batch*head, q-block) program: online softmax over k blocks.
 
-    q_ref [1, block_q, D]; k_ref/v_ref [1, Lk, D]; o_ref [1, block_q, D].
+    q_ref [1, block_q, D]; k_ref/v_ref [1, Lk, D]; o_ref [1, block_q, D];
+    lse_ref [1, 8, block_q] — log-sum-exp rows, replicated across the 8
+    sublanes so the stats tensor tiles legally on TPU; consumed by backward.
     """
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -77,7 +81,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     # exp(-inf)=0 so the result is identical
     acc, m, l = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks), body,
                                   init, unroll=num_k_blocks <= 8)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (8, block_q))
 
 
 def _pick_block(n: int, preferred: int) -> int:
@@ -89,7 +95,8 @@ def _pick_block(n: int, preferred: int) -> int:
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
 def _flash_fwd_pallas(q, k, v, causal=False, scale=None, interpret=False):
-    """[B, L, H, D] in/out.  Grid: (B*H_kv-expanded, q blocks)."""
+    """[B, L, H, D] in/out; also returns lse [B*H, 8, Lq] (sublane-replicated
+    fp32 log-sum-exp rows) for the backward kernels."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
@@ -100,7 +107,7 @@ def _flash_fwd_pallas(q, k, v, causal=False, scale=None, interpret=False):
     block_q = _pick_block(lq, 512)
     block_k = _pick_block(lk, 512)
     grid = (b * h, lq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, causal=causal, scale=scale
         ),
@@ -113,11 +120,203 @@ def _flash_fwd_pallas(q, k, v, causal=False, scale=None, interpret=False):
             pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
             pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, i: (bh, i * 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, lq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2), lse
+
+
+# --------------------------------------------------------------------------- pallas bwd
+# Standard flash-attention backward (the public two-pass formulation): with the
+# forward's log-sum-exp rows the softmax is reconstructed per tile as
+# p = exp(s - lse), then
+#   dv = pᵀ·do,  dp = do·vᵀ,  ds = p ∘ (dp - delta) · scale,
+#   dk = dsᵀ·q,  dq = Σ ds·k,      delta = rowsum(do ∘ o).
+# Pass 1 (grid over k blocks) accumulates dk/dv with q/do streamed; pass 2
+# (grid over q blocks) accumulates dq with k/v streamed.  All accumulation in
+# fp32; no [Lq, Lk] tensor ever hits HBM — this replaces the recompute-vjp
+# fallback whose stacked fp32 temps dominated the train-step footprint.
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, causal: bool,
+                    scale: float):
+    """One (batch*head, k-block) program: dk/dv for this k block.
+
+    q_ref/do_ref [1, Lq, D]; k_ref/v_ref [1, block_k, D];
+    lse_ref/delta_ref [1, 8, Lq] (sublane-replicated rows);
+    dk_ref/dv_ref [1, block_k, D].
+    """
+    block_k = k_ref.shape[1]
+    head_dim = k_ref.shape[2]
+    lq = q_ref.shape[1]
+    num_q_blocks = lq // block_q
+    ki = pl.program_id(1)
+
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]       # [block_q, D]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]   # [block_q]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                           # [block_q, block_k]
+        if causal:
+            q_idx = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])                       # [block_q, block_k]
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                   # [block_q, block_k]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    init = (
+        jnp.zeros((block_k, head_dim), jnp.float32),
+        jnp.zeros((block_k, head_dim), jnp.float32),
+    )
+    dk, dv = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_q_blocks), body,
+                               init, unroll=num_q_blocks <= 8)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_k: int, causal: bool, scale: float):
+    """One (batch*head, q-block) program: dq for this q block.
+
+    q_ref/do_ref/dq_ref [1, block_q, D]; k_ref/v_ref [1, Lk, D];
+    lse_ref/delta_ref [1, 8, block_q] (sublane-replicated rows).
+    """
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    lk = k_ref.shape[1]
+    num_k_blocks = lk // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(num_k_blocks), body,
+        jnp.zeros((block_q, head_dim), jnp.float32), unroll=num_k_blocks <= 8
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+def _flash_bwd_pallas(q, k, v, out, lse, do, causal=False, scale=None,
+                      interpret=False):
+    """[B, L, H, D] in/out; lse [B*H, 8, Lq] from the forward kernel."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    qh = jnp.swapaxes(q, 1, 2).reshape(b * h, lq, d)
+    kh = jnp.swapaxes(k, 1, 2).reshape(b * h, lk, d)
+    vh = jnp.swapaxes(v, 1, 2).reshape(b * h, lk, d)
+    oh = jnp.swapaxes(out, 1, 2).reshape(b * h, lq, d)
+    doh = jnp.swapaxes(do, 1, 2).reshape(b * h, lq, d)
+    # delta = rowsum(do ∘ o): one cheap elementwise pass, fused by XLA;
+    # replicated over 8 sublanes to match the lse tiling
+    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, lq))
+    block_q = _pick_block(lq, 512)
+    block_k = _pick_block(lk, 512)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
+        ),
+        grid=(b * h, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, lq, d), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, lq, d), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, 8, lq), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, 8, lq), lambda bh, i: (bh, i * 0, i * 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, i * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, lk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(b * h, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, i: (bh, i * 0, i * 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, i: (bh, i * 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, i: (bh, i * 0, i)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, i * 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         interpret=interpret,
-    )(qh, kh, vh)
-    return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)
+    )(qh, kh, vh, doh, lse, delta)
+
+    unflat = lambda x, l: jnp.swapaxes(x.reshape(b, h, l, d), 1, 2)
+    return unflat(dq, lq), unflat(dk, lk), unflat(dv, lk)
 
 
 # ------------------------------------------------------------------- blockwise (jnp)
@@ -204,20 +403,17 @@ def available(q_shape) -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention_blhd(q, k, v, causal=False, scale=None):
     """Flash attention, [batch, seq, heads, head_dim]."""
-    return _flash_fwd_pallas(q, k, v, causal=causal, scale=scale)
+    return _flash_fwd_pallas(q, k, v, causal=causal, scale=scale)[0]
 
 
 def _fa_fwd(q, k, v, causal, scale):
-    return _flash_fwd_pallas(q, k, v, causal=causal, scale=scale), (q, k, v)
+    out, lse = _flash_fwd_pallas(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal=causal, scale=scale)
 
 
 flash_attention_blhd.defvjp(_fa_fwd, _fa_bwd)

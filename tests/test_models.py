@@ -103,10 +103,32 @@ class TestFlashAttention:
 
         q, k, v = self._qkv(L=256, D=128)
         for causal in (False, True):
-            out = _flash_fwd_pallas(q, k, v, causal=causal, interpret=True)
+            out, lse = _flash_fwd_pallas(q, k, v, causal=causal, interpret=True)
             ref = self._dense(q, k, v, causal)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        rtol=2e-5, atol=2e-5)
+
+    def test_pallas_bwd_matches_dense_grads(self):
+        from paddle_tpu.ops.flash_attention import (
+            _flash_bwd_pallas, _flash_fwd_pallas)
+
+        q, k, v = self._qkv(L=256, D=128)
+        rng = np.random.default_rng(7)
+        for causal in (False, True):
+            do = jnp.asarray(
+                rng.standard_normal(q.shape).astype(np.float32))
+
+            def f_dense(q_, k_, v_, _c=causal):
+                return jnp.vdot(self._dense(q_, k_, v_, _c), do)
+
+            gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+            out, lse = _flash_fwd_pallas(q, k, v, causal=causal,
+                                         interpret=True)
+            gp = _flash_bwd_pallas(q, k, v, out, lse, do, causal=causal,
+                                   interpret=True)
+            for a, b in zip(gp, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=2e-4)
 
     @staticmethod
     def _dense(q, k, v, causal):
