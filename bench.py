@@ -78,10 +78,11 @@ def main():
     dt = (time.perf_counter() - t0) / iters
     tokens_per_sec = batch * seq / dt
 
-    # analytic model FLOPs (6N per token for the matmuls + causal attention);
-    # remat recompute FLOPs are deliberately NOT counted — MFU is model FLOPs
+    # analytic model FLOPs: 6N per token for the matmuls + causal attention
+    # (12*L*h*seq full-attention halved for the causal triangle); remat
+    # recompute FLOPs are deliberately NOT counted — MFU is model FLOPs
     flops_per_token = (6 * n_params
-                       + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq)
+                       + 6 * cfg.num_hidden_layers * cfg.hidden_size * seq)
     achieved_tflops = flops_per_token * tokens_per_sec / 1e12
     mfu = achieved_tflops / _peak_tflops()
 
@@ -94,8 +95,8 @@ def main():
             if base.get("mfu"):
                 vs = mfu / float(base["mfu"])
             elif base.get("value"):  # round-1 file: tokens/s of the old config
-                # old config: 168.3M params, seq 1024 -> 1.11e9 FLOPs/token
-                base_tflops = 1.11e9 * float(base["value"]) / 1e12
+                # old config: 168.3M params, seq 1024 -> 1.06e9 FLOPs/token
+                base_tflops = 1.06e9 * float(base["value"]) / 1e12
                 vs = achieved_tflops / base_tflops
         except Exception:
             pass

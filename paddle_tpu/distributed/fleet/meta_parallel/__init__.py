@@ -48,5 +48,40 @@ class ShardingParallel(_PassthroughParallel):
 
 
 class SegmentParallel(_PassthroughParallel):
-    """meta_parallel/segment_parallel.py:26 — inputs are sharded on the sequence dim
-    over the sep axis by the caller (see distributed.sep_utils)."""
+    """meta_parallel/segment_parallel.py:26 — context parallelism over the sep
+    axis.  The reference broadcasts params per rank; under SPMD params are one
+    replicated array already, so the wrapper's job is the *input* layout: lay
+    each batch-first tensor argument's sequence dim (dim 1) over "sep" so the
+    model's attention (ring attention when the model enables ``sep_axis``, see
+    ops/ring_attention.py) runs on sequence shards."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis=1, **kw):
+        super().__init__(layers, hcg, strategy, **kw)
+        self._seq_axis = seq_axis
+
+    def forward(self, *args, **kwargs):
+        from paddle_tpu.distributed.sep_utils import shard_sequence
+        from paddle_tpu.tensor.tensor import Tensor
+
+        def maybe_shard(a):
+            # only tensors whose dim `seq_axis` is actually divisible by the
+            # sep degree (e.g. skips [b, heads, Lq, Lk] masks with few heads)
+            if not (isinstance(a, Tensor) and a.ndim > self._seq_axis):
+                return a
+            mesh = self._sep_mesh()
+            if mesh is None or a.shape[self._seq_axis] % mesh.shape["sep"]:
+                return a
+            return shard_sequence(a, axis=self._seq_axis)
+
+        args = [maybe_shard(a) for a in args]
+        kwargs = {k: maybe_shard(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+    @staticmethod
+    def _sep_mesh():
+        from paddle_tpu.distributed.fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None or "sep" not in hcg.jax_mesh.axis_names:
+            return None
+        return hcg.jax_mesh

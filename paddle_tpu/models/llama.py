@@ -49,6 +49,11 @@ class LlamaConfig:
     use_flash_attention: bool = True
     sep_axis: str | None = None  # mesh axis for ring-attention context parallel
     recompute: bool = False
+    # Megatron-SP over the fleet "mp" axis: projections become Column/Row
+    # SequenceParallelLinear (distributed/sep_utils.py) and the residual
+    # stream between blocks stays sequence-sharded (requires
+    # fleet.init(mp_degree>1) before model construction)
+    sequence_parallel: bool = False
 
     # tiny preset used by tests / dryrun
     @staticmethod
@@ -82,6 +87,17 @@ def _apply_rope(q, k, theta, position_offset=0):
     return q * cos + rot_half(q) * sin, k * cos + rot_half(k) * sin
 
 
+def _sp_linears():
+    from paddle_tpu.distributed.sep_utils import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+
+    col = lambda i, o: ColumnSequenceParallelLinear(
+        i, o, has_bias=False, gather_output=False, seq_axis=1)
+    row = lambda i, o: RowSequenceParallelLinear(
+        i, o, has_bias=False, input_is_parallel=True, seq_axis=1)
+    return col, row
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -89,10 +105,17 @@ class LlamaAttention(Layer):
         h, nh, nkv = config.hidden_size, config.num_attention_heads, \
             config.num_key_value_heads
         self.head_dim = h // nh
-        self.q_proj = Linear(h, nh * self.head_dim, bias_attr=False)
-        self.k_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
-        self.v_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
-        self.o_proj = Linear(nh * self.head_dim, h, bias_attr=False)
+        if config.sequence_parallel:
+            col, row = _sp_linears()
+            self.q_proj = col(h, nh * self.head_dim)
+            self.k_proj = col(h, nkv * self.head_dim)
+            self.v_proj = col(h, nkv * self.head_dim)
+            self.o_proj = row(nh * self.head_dim, h)
+        else:
+            self.q_proj = Linear(h, nh * self.head_dim, bias_attr=False)
+            self.k_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
+            self.v_proj = Linear(h, nkv * self.head_dim, bias_attr=False)
+            self.o_proj = Linear(nh * self.head_dim, h, bias_attr=False)
 
     def forward(self, hidden_states, attn_mask=None, cache=None,
                 position_offset=0):
@@ -149,9 +172,15 @@ class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         h, i = config.hidden_size, config.intermediate_size
-        self.gate_proj = Linear(h, i, bias_attr=False)
-        self.up_proj = Linear(h, i, bias_attr=False)
-        self.down_proj = Linear(i, h, bias_attr=False)
+        if config.sequence_parallel:
+            col, row = _sp_linears()
+            self.gate_proj = col(h, i)
+            self.up_proj = col(h, i)
+            self.down_proj = row(i, h)
+        else:
+            self.gate_proj = Linear(h, i, bias_attr=False)
+            self.up_proj = Linear(h, i, bias_attr=False)
+            self.down_proj = Linear(i, h, bias_attr=False)
 
     def forward(self, x):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
@@ -200,6 +229,15 @@ class LlamaModel(Layer):
     def forward(self, input_ids, attn_mask=None, caches=None,
                 position_offset=0):
         h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            if caches is not None:
+                raise NotImplementedError(
+                    "sequence_parallel training does not support KV caches; "
+                    "build the model with sequence_parallel=False for decode"
+                )
+            from paddle_tpu.distributed.sep_utils import ScatterOp
+
+            h = ScatterOp.apply(h, axis=1)  # residual stream seq-sharded
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             layer_fn = layer
@@ -213,6 +251,10 @@ class LlamaModel(Layer):
             else:
                 h = layer_fn(h, attn_mask)
         h = self.norm(h)
+        if self.config.sequence_parallel:
+            from paddle_tpu.distributed.sep_utils import GatherOp
+
+            h = GatherOp.apply(h, axis=1)  # full seq for the LM head
         if caches is not None:
             return h, new_caches
         return h
