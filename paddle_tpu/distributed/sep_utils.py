@@ -133,19 +133,15 @@ def all_gather(input, axis=0):
 
 
 def reduce_scatter(input, axis=0):
-    """Per-rank partials [s, ...] -> summed seq shards (reference :70).  In
-    the global view each mp shard holds an identical copy, so this sums n
-    copies and scatters — matching the reference's per-rank semantics.  Inside
-    the SP linears the partial summands are produced per shard by the local
-    matmul, so there it is the true Megatron reduce-scatter."""
-    nd = input.ndim
-
-    def body(xs):
-        return jax.lax.psum_scatter(
-            xs, _AXIS, scatter_dimension=axis, tiled=True)
-
-    f = _smap(body, P(*[None] * nd), _seq_spec(nd, _AXIS, dim=axis))
-    return _apply("sp_reduce_scatter", f, input)
+    """Reference :70 takes per-rank *partial sums* and returns summed seq
+    shards.  On this global-view runtime a partial sum never exists as an
+    array — the value handed in is already the true global tensor — so the
+    faithful op is the relayout (slice per shard); the actual reduce-scatter
+    collective lives inside the SP linears' shard_map bodies
+    (``lax.psum_scatter`` over the per-shard matmul partials), where partials
+    are real.  Summing n identical copies here instead would scale values —
+    and, used as a PyLayer backward, gradients — by the mp degree."""
+    return scatter(input, axis=axis)
 
 
 class ScatterOp(PyLayer):
@@ -175,8 +171,11 @@ class GatherOp(PyLayer):
 
 
 class AllGatherOp(PyLayer):
-    """fwd all-gather / bwd reduce-scatter (reference :110) — the input side
-    of a column SP linear."""
+    """Reference :110: fwd all-gather / bwd reduce-scatter — the input side of
+    a column SP linear.  On the global tape the cotangent arriving here is the
+    complete global gradient (not a per-rank partial), so the backward is the
+    relayout to seq shards; see ``reduce_scatter`` for why the collective form
+    would scale grads by the mp degree."""
 
     @staticmethod
     def forward(ctx, input):
@@ -188,8 +187,8 @@ class AllGatherOp(PyLayer):
 
 
 class ReduceScatterOp(PyLayer):
-    """fwd reduce-scatter / bwd all-gather (reference :126) — the output side
-    of a row SP linear."""
+    """Reference :126: fwd reduce-scatter / bwd all-gather — the output side
+    of a row SP linear.  Same global-view adaptation as ``reduce_scatter``."""
 
     @staticmethod
     def forward(ctx, input):

@@ -4,12 +4,18 @@ stage-1/2 (GroupShardedOptimizerStage2/GroupShardedStage2) and stage-3
 (GroupShardedStage3) in fleet/meta_parallel/sharding/.
 
 TPU-native re-design (SURVEY.md §7.5): ZeRO is a *layout choice*, not a runtime.
-  stage 1 — optimizer states laid out sharded over the dp/sharding axis;
-  stage 2 — same (gradients in XLA are temporaries; reduce-scatter falls out of GSPMD
-            when the consuming update is sharded);
+  stage 1 — optimizer states laid out sharded over the dp/sharding axis (both the
+            eager accumulators and the jitted TrainStep's functional states);
+  stage 2 — gradients additionally constrained to the same sharded layout at the
+            point the update consumes them (static/functionalize.py), so the
+            update runs at shard shape and only grad *shards* stay live.  The
+            grad reduction then lowers to all-reduce-then-slice on backends
+            without a reduce-scatter combiner and to a single reduce-scatter
+            where XLA has one (TPU); tests assert the pattern.
   stage 3 — parameters themselves laid out sharded; XLA all-gathers them just-in-time
             in forward/backward, which IS the stage-3 choreography the reference
-            hand-schedules with broadcasts + release hooks.
+            hand-schedules with broadcasts + release hooks; the train step
+            re-constrains updated params to keep them sharded across steps.
 """
 from __future__ import annotations
 
@@ -19,7 +25,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.tensor.tensor import Tensor
 
-__all__ = ["group_sharded_parallel", "save_group_sharded_model", "shard_leading_dim"]
+__all__ = [
+    "group_sharded_parallel", "save_group_sharded_model", "shard_leading_dim",
+    "leading_dim_spec",
+]
 
 
 def _sharding_axis(mesh):
@@ -29,16 +38,39 @@ def _sharding_axis(mesh):
     return mesh.axis_names[0]
 
 
-def shard_leading_dim(arr: jax.Array, mesh, axis_name) -> jax.Array:
-    """Lay out ``arr`` sharded on its first divisible dim over ``axis_name`` (replicated
-    if nothing divides) — the accumulator/param layout primitive for every ZeRO stage."""
+def leading_dim_spec(shape, mesh, axis_name, base=None) -> P:
+    """PartitionSpec adding ``axis_name`` on the first *unsharded* dim the
+    axis degree divides — the ZeRO layout rule.  ``base`` is an existing spec
+    (e.g. a TP layout over "mp") which is COMPOSED with, never overwritten:
+    clobbering it would force-replicate TP-sharded tensors over mp, inflating
+    the very memory ZeRO is meant to shard.  Returns ``base`` unchanged when
+    the axis is already placed or nothing divides."""
+    entries = list(base) if base is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    placed = {
+        nm for e in entries if e
+        for nm in (e if isinstance(e, tuple) else (e,))
+    }
+    if axis_name in placed:
+        return P(*entries)
     n = mesh.shape[axis_name]
-    for d, size in enumerate(arr.shape):
-        if size % n == 0 and size > 0:
-            spec = [None] * arr.ndim
-            spec[d] = axis_name
-            return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
-    return jax.device_put(arr, NamedSharding(mesh, P(*[None] * arr.ndim)))
+    for d, size in enumerate(shape):
+        if entries[d] is None and size % n == 0 and size > 0:
+            entries[d] = axis_name
+            break
+    return P(*entries)
+
+
+def shard_leading_dim(arr: jax.Array, mesh, axis_name, base=None) -> jax.Array:
+    """Lay out ``arr`` per ``leading_dim_spec`` — the accumulator/param layout
+    primitive for every ZeRO stage."""
+    if base is None:
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.shape == mesh.shape:
+            base = sh.spec
+    return jax.device_put(
+        arr,
+        NamedSharding(mesh, leading_dim_spec(arr.shape, mesh, axis_name, base)))
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
@@ -66,7 +98,8 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
             mesh = world_mesh()
             axis = "world"
 
-    # stage >= 1: optimizer accumulators sharded.
+    # stage >= 1: optimizer accumulators sharded — on the eager path and in
+    # the functional states that build_train_step passes through jit.
     orig_init = optimizer._init_accumulator
 
     def _init(name, param):
@@ -77,6 +110,29 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         return st
 
     optimizer._init_accumulator = _init
+
+    orig_func_init = optimizer.functional_init_states
+
+    def _func_init(params):
+        states = orig_func_init(params)
+
+        def base(k):
+            sh = getattr(params.get(k), "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh.shape == mesh.shape:
+                return sh.spec  # compose with the param's TP layout
+            return None
+
+        return {
+            n: {
+                k: shard_leading_dim(v, mesh, axis, base=base(k))
+                if getattr(v, "ndim", 0) > 0 else v
+                for k, v in d.items()
+            }
+            for n, d in states.items()
+        }
+
+    optimizer.functional_init_states = _func_init
+    optimizer._gs_mesh, optimizer._gs_axis = mesh, axis
 
     # stage 3: parameters sharded too.
     if stage >= 3:

@@ -33,6 +33,15 @@ class TrainStep:
         self._optimizer = optimizer
         self._recompute = recompute
         self._params, self._buffers = network.functional_state()
+        # initial param layouts (TP etc.) — ZeRO constraints compose with
+        # these instead of clobbering them
+        from jax.sharding import NamedSharding as _NS
+
+        self._param_specs = {
+            k: a.sharding.spec
+            for k, a in self._params.items()
+            if isinstance(getattr(a, "sharding", None), _NS)
+        }
         self._states = (
             optimizer.functional_init_states(self._params)
             if optimizer is not None
@@ -70,12 +79,41 @@ class TrainStep:
             scale = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
             grads = {k: (g * scale.astype(g.dtype)) for k, g in grads.items()}
 
+        # ZeRO stage-2: constrain each grad to the accumulators' sharded
+        # layout at the point the update consumes it — the update then runs
+        # at shard shape (only grad shards stay live) and XLA lowers the grad
+        # reduction to reduce-scatter where its combiner exists (TPU), or
+        # all-reduce + slice elsewhere.  distributed/sharding/__init__.py.
+        gs_level = getattr(optimizer, "_group_sharded_level", 0)
+
+        def zero_constrain(tree):
+            from jax.sharding import NamedSharding
+
+            from paddle_tpu.distributed.sharding import leading_dim_spec
+
+            mesh, axis = optimizer._gs_mesh, optimizer._gs_axis
+            return {
+                k: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, leading_dim_spec(
+                        v.shape, mesh, axis, base=self._param_specs.get(k))))
+                for k, v in tree.items()
+            }
+
+        if gs_level >= 2 and getattr(optimizer, "_gs_mesh", None) is not None:
+            grads = zero_constrain(grads)
+
         prev = optimizer._global_step
         optimizer._global_step = step  # bias-correction uses the traced step counter
         try:
             new_params, new_states = optimizer.functional_update(params, grads, states, lr)
         finally:
             optimizer._global_step = prev
+
+        # ZeRO stage-3: keep updated params sharded across steps (without the
+        # constraint XLA may choose replicated outputs, silently reverting the
+        # parameter layout stage 3 is about)
+        if gs_level >= 3 and getattr(optimizer, "_gs_mesh", None) is not None:
+            new_params = zero_constrain(new_params)
         return lval, new_params, new_states
 
     def __call__(self, *datas):
@@ -86,6 +124,23 @@ class TrainStep:
         lval, self._params, self._states = self._jitted(
             self._params, self._buffers, self._states, lr, step, *arrs
         )
+        # FLAGS_check_nan_inf on the fused path: one loss readback per step
+        # (per-op checking is impossible inside a compiled program; a
+        # non-finite loss is the canonical divergence signal the reference's
+        # nan_inf_utils surfaces).  No overhead when the flag is unset.
+        from paddle_tpu.autograd.engine import _nan_check_enabled
+
+        if _nan_check_enabled():
+            import numpy as _np
+
+            lv = _np.asarray(lval)
+            if not _np.all(_np.isfinite(lv)):
+                raise RuntimeError(
+                    f"[check_nan_inf] op=train_step: non-finite loss {lv} at "
+                    f"global step {self._step_count} — enable "
+                    "amp.debugging.enable_tensor_checker() and run eagerly "
+                    "to localize the producing op"
+                )
         for n, p in self._network.named_parameters():
             if n in self._params:
                 p._data = self._params[n]  # pointer swap, no device copy

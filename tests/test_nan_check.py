@@ -1,0 +1,88 @@
+"""FLAGS_check_nan_inf wiring (reference paddle/fluid/eager/nan_inf_utils.cc:
+per-op output checking behind the flag, with checker-config op lists, plus the
+fused-train-step loss check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture
+def nan_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False,
+                      "FLAGS_check_nan_inf_level": 0})
+
+
+class TestEagerNanCheck:
+    def test_off_by_default_no_raise(self):
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        y = x / x  # 0/0 -> nan, but the flag is off
+        assert np.isnan(y.numpy()).any()
+
+    def test_raises_with_op_name(self, nan_flag):
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(RuntimeError, match=r"\[check_nan_inf\] op=divide"):
+            _ = x / x
+
+    def test_inf_detected(self, nan_flag):
+        x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        z = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        with pytest.raises(RuntimeError, match="1 inf"):
+            _ = x / z
+
+    def test_warn_level(self, nan_flag):
+        paddle.set_flags({"FLAGS_check_nan_inf_level": 1})
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.warns(UserWarning, match="check_nan_inf"):
+            y = x / x
+        assert np.isnan(y.numpy()).any()
+
+    def test_checker_config_op_lists(self, nan_flag):
+        from paddle_tpu.amp.debugging import (
+            TensorCheckerConfig, disable_tensor_checker, enable_tensor_checker,
+        )
+
+        cfg = TensorCheckerConfig(enable=True, skipped_op_list=["divide"])
+        enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            y = x / x  # div skipped -> no raise
+            assert np.isnan(y.numpy()).any()
+        finally:
+            disable_tensor_checker()
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_grad_path_checked(self, nan_flag):
+        # forward is finite; the nan appears in an op applied to the output
+        x = paddle.to_tensor(np.array([-1.0, 4.0], np.float32))
+        with pytest.raises(RuntimeError, match=r"op=sqrt"):
+            _ = paddle.sqrt(x)  # sqrt(-1) = nan
+
+
+class TestTrainStepNanCheck:
+    def test_fused_step_raises_on_nonfinite_loss(self, nan_flag):
+        from paddle_tpu.static.functionalize import build_train_step
+
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = build_train_step(net, nn.MSELoss(), opt)
+        X = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        bad = paddle.to_tensor(np.full((4, 1), np.nan, np.float32))
+        with pytest.raises(RuntimeError, match="non-finite loss"):
+            step(X, bad)
+
+    def test_fused_step_no_overhead_when_off(self):
+        from paddle_tpu.static.functionalize import build_train_step
+
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = build_train_step(net, nn.MSELoss(), opt)
+        X = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        bad = paddle.to_tensor(np.full((4, 1), np.nan, np.float32))
+        loss = step(X, bad)  # flag off: no readback, no raise
+        assert np.isnan(float(loss.numpy()))
