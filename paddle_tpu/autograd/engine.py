@@ -441,6 +441,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=Fa
             in_grads = apply(f"{node.name}_grad", lambda c: node.vjp_fn(c), cot)
         else:
             in_grads = node.vjp_fn(cot)
+        if _nan_check_enabled():
+            # grad kernels are checked like forward ops (reference
+            # nan_inf_utils covers the generated grad ad_funcs too)
+            _check_op_outputs(f"{node.name}_grad", in_grads)
 
         for inp, g in zip(node.inputs, in_grads):
             if g is None:

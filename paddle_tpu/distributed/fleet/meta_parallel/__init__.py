@@ -11,13 +11,13 @@ from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (  # noqa: F401
     LayerDesc, PipelineLayer, SharedLayerDesc,
 )
 from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (  # noqa: F401
-    PipelineParallel, pipeline_apply, stack_stage_params,
+    PipelineParallel, pipeline_apply, pipeline_train_1f1b, stack_stage_params,
 )
 
 __all__ = [
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-    "pipeline_apply", "stack_stage_params", "TensorParallel", "ShardingParallel",
-    "SegmentParallel",
+    "pipeline_apply", "pipeline_train_1f1b", "stack_stage_params",
+    "TensorParallel", "ShardingParallel", "SegmentParallel",
 ]
 
 

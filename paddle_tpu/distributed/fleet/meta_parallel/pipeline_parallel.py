@@ -4,15 +4,26 @@ Reference: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py â€
 ``PipelineParallel.train_batch``:820, ``forward_backward_pipeline`` (1F1B):575, with p2p
 isend/irecv (pp_utils/p2p_communication.py).
 
-TPU-native re-design: XLA has no rooted p2p runtime; instead the schedule is a *compiled
-program* â€” ``pipeline_apply`` runs the microbatch loop as ``lax.scan`` under a
-partial-manual ``shard_map`` over the "pp" mesh axis, moving activations between stages
-with ``lax.ppermute`` (ICI neighbor hops).  Reverse-mode AD of that scan yields the
-backward pipeline automatically, so fwd+bwd together realize a fill-drain (GPipe)
-schedule; with XLA's latency-hiding scheduler overlapping the ppermute with compute this
-plays the role of the reference's six hand-written schedules.  The eager
-``PipelineParallel`` wrapper keeps the reference's train_batch API (microbatch loop +
-grad accumulation) for dygraph parity."""
+TPU-native re-design: XLA has no rooted p2p runtime; the schedule is a *compiled
+program* over the "pp" mesh axis:
+
+* ``pipeline_apply`` â€” inference/forward pipelining: microbatch loop as
+  ``lax.scan`` under shard_map with ``lax.ppermute`` hops; AD of it gives
+  fill-drain (GPipe) training with O(M) per-stage activations.
+* ``pipeline_train_1f1b`` â€” the TRAINING pipeline: forward and backward are
+  written explicitly in one scan (activations ppermute up, cotangents
+  ppermute down each tick), bounding per-stage live activations by a
+  min(M, 2S-1) ring â€” the 1F1B peak-memory property, verified against
+  GPipe-AD in tests/test_pipeline_schedules.py via memory_analysis().
+* ``PipelineParallel._run_schedule`` â€” the eager executor: consumes the
+  per-stage instruction streams from schedules.py (FThenB/1F1B/Eager1F1B/
+  VPP/ZBH1) with true stage partitioning over the (segment, microbatch)-keyed
+  p2p mailbox, including ZBH1's real B/W split (activation-grad pass, then a
+  deferred weight-grad pass).  Note: a compiled lockstep-SPMD pipeline cannot
+  benefit from the zero-bubble split â€” every tick executes the same masked
+  program on every stage, so W work cannot fill idle slots that are already
+  paid for â€” which is why ZBH1 lives on the eager per-stage path while the
+  compiled path targets the 1F1B memory/throughput point."""
 from __future__ import annotations
 
 import jax
@@ -22,7 +33,8 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import PipelineLayer
 
-__all__ = ["pipeline_apply", "PipelineParallel", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b", "PipelineParallel",
+           "stack_stage_params"]
 
 
 def pipeline_apply(stage_fn, stacked_params, x, num_microbatches, mesh, axis="pp"):
@@ -71,7 +83,7 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches, mesh, axis="pp
         mesh=mesh,
         in_specs=(pspecs, P(*(None,) * len(mb_shape))),
         out_specs=P(axis, *(None,) * len(mb_shape)),
-        axis_names={axis},
+        check_vma=False,
     )(stacked_params, x.reshape(mb_shape))
     return out[-1].reshape((B,) + tuple(x.shape[1:]))
 
@@ -79,6 +91,117 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches, mesh, axis="pp
 def stack_stage_params(per_stage_params):
     """Stack S same-structure per-stage pytrees on a new leading stage axis."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, labels,
+                        num_microbatches, mesh, axis="pp"):
+    """Compiled 1F1B training step: forward AND backward written explicitly in
+    ONE ``lax.scan``, so per-stage live activations are bounded by the ring
+    buffer ``W = min(M, 2S-1)`` â€” O(S), independent of the microbatch count â€”
+    which is the 1F1B peak-memory property (reference
+    meta_parallel/pipeline_parallel.py:575 forward_backward_pipeline).
+
+    Differentiating ``pipeline_apply`` instead gives fill-drain (GPipe)
+    semantics: the scan's AD stores every tick's residuals, O(M) per stage.
+    Here tick ``t`` at stage ``s`` runs F for microbatch ``t - s`` and B for
+    microbatch ``t - (2(S-1) - s)`` (recomputing the stage forward from the
+    saved input â€” the jax.checkpoint trade), with activations ppermuted up and
+    cotangents ppermuted down each tick.  The last stage's B consumes the
+    dLoss/dy of the F it ran the same tick, which is exactly the 1F1B
+    steady-state.
+
+    Returns ``(mean_loss, stacked_grads)`` with grads laid out like
+    ``stacked_params`` (P(axis, ...)), ready for a stage-sharded optimizer.
+
+    stage_fn: (params_one_stage, activation[mb, ...]) -> activation[mb, ...]
+    loss_fn:  (activation[mb, ...], label[mb, ...]) -> scalar
+    """
+    S = mesh.shape[axis]
+    M = int(num_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    mb = B // M
+    x_mb = x.reshape((M, mb) + tuple(x.shape[1:]))
+    lbl_mb = labels.reshape((M, mb) + tuple(labels.shape[1:]))
+    W = min(M, 2 * S - 1)  # ring slots: stage-0 residency is 2(S-1)+1 ticks
+    T = M + 2 * (S - 1)
+
+    def body(params, xs, ls):
+        p = jax.tree_util.tree_map(lambda a: a[0], params)
+        s = jax.lax.axis_index(axis)
+        is_last = s == S - 1
+        zero_act = jnp.zeros_like(xs[0])
+        fwd0 = jax.lax.pcast(zero_act, (axis,), to="varying")
+        bwd0 = jax.lax.pcast(zero_act, (axis,), to="varying")
+        buf0 = jax.lax.pcast(
+            jnp.zeros((W,) + xs.shape[1:], xs.dtype), (axis,), to="varying")
+        gacc0 = jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(
+                jnp.zeros(a.shape[1:], jnp.float32), (axis,), to="varying"),
+            params)
+        lacc0 = jax.lax.pcast(jnp.float32(0.0), (axis,), to="varying")
+
+        def tick(carry, t):
+            fwd_in, bwd_in, act_buf, gacc, lacc = carry
+            # ---- forward: microbatch t - s
+            m_f = t - s
+            act_f = jnp.logical_and(m_f >= 0, m_f < M)
+            mf_c = jnp.clip(m_f, 0, M - 1)
+            inp = jnp.where(s == 0, xs[mf_c], fwd_in)
+            slot_f = mf_c % W
+            old = jax.lax.dynamic_index_in_dim(act_buf, slot_f, 0,
+                                               keepdims=False)
+            act_buf = jax.lax.dynamic_update_index_in_dim(
+                act_buf, jnp.where(act_f, inp, old), slot_f, 0)
+            y = stage_fn(p, inp)
+            # last stage: per-microbatch loss and its cotangent
+            loss_m, dy = jax.value_and_grad(
+                lambda yy: loss_fn(yy, ls[mf_c]))(y)
+            lacc = lacc + jnp.where(jnp.logical_and(act_f, is_last),
+                                    loss_m.astype(jnp.float32), 0.0)
+            # ---- backward: microbatch t - (2(S-1) - s), recompute-vjp
+            m_b = t - (2 * (S - 1) - s)
+            act_b = jnp.logical_and(m_b >= 0, m_b < M)
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(act_buf, mb_c % W, 0,
+                                                   keepdims=False)
+            cot = jnp.where(is_last, dy, bwd_in).astype(y.dtype)
+            _, vjp = jax.vjp(stage_fn, p, x_saved)
+            dp, dx = vjp(cot)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(act_b, g.astype(jnp.float32), 0.0),
+                gacc, dp)
+            # ---- neighbor hops: activations up, cotangents down
+            fwd_out = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(S - 1)])
+            bwd_out = jax.lax.ppermute(
+                dx, axis, [(i, i - 1) for i in range(1, S)])
+            return (fwd_out, bwd_out, act_buf, gacc, lacc), None
+
+        (_, _, _, gacc, lacc), _ = jax.lax.scan(
+            tick, (fwd0, bwd0, buf0, gacc0, lacc0),
+            jnp.arange(T, dtype=jnp.int32))
+        grads = jax.tree_util.tree_map(lambda g: g[None], gacc)
+        return lacc[None], grads
+
+    pspecs = jax.tree_util.tree_map(
+        lambda a: P(*((axis,) + (None,) * (a.ndim - 1))), stacked_params
+    )
+    gspecs = pspecs
+    loss_s, grads = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, P(*(None,) * x_mb.ndim),
+                  P(*(None,) * lbl_mb.ndim)),
+        out_specs=(P(axis), gspecs),
+        check_vma=False,
+    )(stacked_params, x_mb, lbl_mb)
+    mean_loss = loss_s[-1] / M
+    # grads of the MEAN loss (accumulation summed per-microbatch cotangents)
+    grads = jax.tree_util.tree_map(
+        lambda g, a: (g / M).astype(a.dtype), grads, stacked_params)
+    return mean_loss, grads
 
 
 class PipelineParallel(Layer):
@@ -100,30 +223,160 @@ class PipelineParallel(Layer):
     def forward(self, x):
         return self._layers(x)
 
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        import paddle_tpu as paddle
+    # ------------------------------------------------------ scheduled executor
+    def _segments(self, num_chunks):
+        """Split run_function into S*num_chunks parts; segment g holds chunk
+        g // S of stage g % S (chunk-major placement, reference pp_layers
+        interleave)."""
+        entries = self._layers.run_function
+        G = self._layers.num_stages * num_chunks
+        n = len(entries)
+        base, extra = divmod(n, G)
+        bounds = [0]
+        for i in range(G):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return [entries[bounds[g]:bounds[g + 1]] for g in range(G)]
 
-        inputs, labels = data
+    def _run_schedule(self, inputs, labels, schedule="1F1B", num_chunks=1,
+                      scaler=None):
+        """Execute the per-stage instruction streams from schedules.py with
+        true stage partitioning: each F/B/W runs ONLY that stage's segment,
+        activations/cotangents move through the (segment, microbatch)-keyed
+        p2p mailbox, and ZBH1's W ops are the deferred weight-grad passes.
+        Ticks round-robin the stages; an instruction whose input has not
+        arrived blocks its stage until the producer has run â€” the actual
+        dataflow the reference's forward_backward_pipeline hand-schedules
+        (pipeline_parallel.py:575, pipeline_zero_bubble.py ZBH1)."""
+        from paddle_tpu.autograd import engine as _engine
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import (
+            get_schedule,
+        )
+
+        layer = self._layers
+        S = layer.num_stages
+        G = S * num_chunks
         M = max(self.accumulate_steps, 1)
         B = inputs.shape[0]
         if B % M:
             raise ValueError(
-                f"batch size {B} must be divisible by accumulate_steps {M}"
-            )
-        step = max(B // M, 1)
+                f"batch size {B} must be divisible by accumulate_steps {M}")
+        mb = B // M
+        segs = self._segments(num_chunks)
+
+        def seg_forward(g, x):
+            for fn, fwd in segs[g]:
+                x = fwd(fn, x) if fwd is not None else fn(x)
+            return x
+
+        p2p.reset_mailbox()  # drop stale entries from an aborted prior run
+        streams = {
+            s: list(get_schedule(schedule)(s, S, M, num_chunks))
+            for s in range(S)
+        }
+        ptrs = {s: 0 for s in range(S)}
+        saved = {}       # (g, m) -> (inp, out_or_loss)
+        pending_w = {}   # (g, m) -> (src, cot) for the deferred W pass
+        trace = []       # executed (stage, op, m, chunk) â€” asserted by tests
         total = None
-        optimizer.clear_grad()
-        for i in range(0, B, step):
-            x_mb = inputs[i : i + step]
-            y_mb = labels[i : i + step]
-            out = self._layers(x_mb)
-            loss = self._layers._loss_fn(out, y_mb)
-            scaled = loss / M if M > 1 else loss
-            if scaler is not None:
-                scaler.scale(scaled).backward()
+        stall = 0
+        while any(ptrs[s] < len(streams[s]) for s in range(S)):
+            progressed = False
+            for s in range(S):
+                if ptrs[s] >= len(streams[s]):
+                    continue
+                op, m, c = streams[s][ptrs[s]]
+                g = c * S + s
+                if op == "F":
+                    if g == 0:
+                        inp = inputs[m * mb:(m + 1) * mb]
+                    else:
+                        inp = p2p.recv_forward_mb(g, m)
+                        if inp is None:
+                            continue  # producer has not run yet
+                    inp = inp.detach()
+                    inp.stop_gradient = False
+                    out = seg_forward(g, inp)
+                    if g == G - 1:
+                        loss = layer._loss_fn(out, labels[m * mb:(m + 1) * mb])
+                        loss = loss / M
+                        total = loss.detach() if total is None \
+                            else total + loss.detach()
+                        if scaler is not None:
+                            loss = scaler.scale(loss)
+                        saved[(g, m)] = (inp, loss)
+                    else:
+                        p2p.send_forward_mb(out, g, m)
+                        saved[(g, m)] = (inp, out)
+                elif op == "B":
+                    if g == G - 1:
+                        inp, src = saved[(g, m)]
+                        cot = None
+                    else:
+                        cot = p2p.recv_backward_mb(g, m)
+                        if cot is None:
+                            continue
+                        inp, src = saved[(g, m)]
+                    gouts = None if cot is None else [cot]
+                    if schedule == "ZBH1":
+                        # B/W split in ONE backward walk: dx plus the stage's
+                        # param grads are captured together, but the param
+                        # grads are only APPLIED by the deferred W op â€” the
+                        # zero-bubble accumulation order without paying the
+                        # tape walk twice
+                        sparams = [
+                            pp_ for fn, _ in segs[g]
+                            if isinstance(fn, Layer)
+                            for pp_ in fn.parameters()
+                            if not pp_.stop_gradient
+                        ]
+                        res = _engine.grad([src], [inp] + sparams,
+                                           grad_outputs=gouts,
+                                           retain_graph=False,
+                                           allow_unused=True)
+                        dx, pgrads = res[0], res[1:]
+                        pending_w[(g, m)] = (sparams, pgrads)
+                    else:
+                        src.backward(cot, retain_graph=False)
+                        dx = inp.grad
+                    if g > 0 and dx is not None:
+                        p2p.send_backward_mb(dx, g, m)
+                    saved.pop((g, m), None)
+                elif op == "W":
+                    sparams, pgrads = pending_w.pop((g, m))
+                    for pp_, gr in zip(sparams, pgrads):
+                        if gr is None:
+                            continue
+                        pp_.grad = gr if pp_.grad is None \
+                            else pp_.grad + gr
+                else:  # pragma: no cover - schedule streams only emit F/B/W
+                    raise ValueError(f"unknown pipeline op {op!r}")
+                trace.append((s, op, m, c))
+                ptrs[s] += 1
+                progressed = True
+            if not progressed:
+                stall += 1
+                if stall > G * M + 8:
+                    raise RuntimeError(
+                        f"pipeline schedule {schedule} deadlocked; "
+                        f"pointers {ptrs}")
             else:
-                scaled.backward()
-            total = loss.detach() if total is None else total + loss.detach()
+                stall = 0
+        self._last_schedule_trace = trace
+        return total
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        cfg = getattr(self._strategy, "pipeline_configs", None) or {}
+        num_chunks = getattr(self, "num_model_chunks", 1)
+        # interleaved chunks need the chunk-aware stream
+        schedule = "VPP" if num_chunks > 1 else cfg.get("schedule_mode", "1F1B")
+        inputs, labels = data
+        optimizer.clear_grad()
+        total = self._run_schedule(
+            inputs, labels, schedule=schedule, num_chunks=num_chunks,
+            scaler=scaler)
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -132,7 +385,7 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
         optimizer.clear_grad()
-        return total / (B // step if B >= step else 1)
+        return total
 
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data

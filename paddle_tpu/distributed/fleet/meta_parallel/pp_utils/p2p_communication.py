@@ -54,6 +54,34 @@ def recv_backward(pp_last_stage=None, shape=None, dtype=None):
     return _STAGE_BOX.pop(("bwd", rank), None)
 
 
+# --- microbatch-addressed mailbox used by the scheduled executor
+# (PipelineParallel._run_schedule): pipeline messages are (segment, microbatch)
+# addressed so interleaved (VPP) chunks and out-of-order 1F1B ticks never
+# collide.  ``seg`` is the GLOBAL segment index (chunk * num_stages + stage).
+
+
+def reset_mailbox():
+    """Drop all in-flight entries — called at schedule start so an aborted
+    run's stale activations can never be consumed by the next one."""
+    _STAGE_BOX.clear()
+
+
+def send_forward_mb(tensor, seg, micro_batch_id):
+    _STAGE_BOX[("fwd", seg + 1, micro_batch_id)] = tensor.detach()
+
+
+def recv_forward_mb(seg, micro_batch_id):
+    return _STAGE_BOX.pop(("fwd", seg, micro_batch_id), None)
+
+
+def send_backward_mb(tensor, seg, micro_batch_id):
+    _STAGE_BOX[("bwd", seg - 1, micro_batch_id)] = tensor.detach()
+
+
+def recv_backward_mb(seg, micro_batch_id):
+    return _STAGE_BOX.pop(("bwd", seg, micro_batch_id), None)
+
+
 def send_forward_recv_backward(output_tensor, pp_last_stage=None, shape=None, dtype=None):
     send_forward(output_tensor, pp_last_stage)
     return recv_backward(pp_last_stage, shape=shape, dtype=dtype)

@@ -61,6 +61,15 @@ class TestEagerNanCheck:
         with pytest.raises(RuntimeError, match=r"op=sqrt"):
             _ = paddle.sqrt(x)  # sqrt(-1) = nan
 
+    def test_backward_outputs_checked(self, nan_flag):
+        # forward is finite (sqrt(0)=0) but the grad kernel produces inf
+        # (0.5/sqrt(0)); run_backward must check vjp outputs too
+        x = paddle.Tensor(np.array([0.0, 4.0], np.float32),
+                          stop_gradient=False)
+        y = paddle.sqrt(x)
+        with pytest.raises(RuntimeError, match=r"op=sqrt_grad"):
+            y.sum().backward()
+
 
 class TestTrainStepNanCheck:
     def test_fused_step_raises_on_nonfinite_loss(self, nan_flag):
