@@ -4,7 +4,11 @@ detection, host-list rewrite and relaunch).
 
 TPU-native: the registry rides the native TCPStore (core/native) instead of
 etcd; nodes heartbeat `node:<host>` keys, the manager watches the alive set and
-flags scale events.  Recovery remains checkpoint-based resume (SURVEY.md §5.3)."""
+flags scale events.  Recovery remains checkpoint-based resume (SURVEY.md §5.3);
+the actual kill-and-relaunch machinery is the launcher controller
+(distributed/launch/controllers/collective.py) — tests/test_launch.py
+SIGKILLs a worker mid-training and observes peer relaunch + store
+re-rendezvous + checkpoint resume."""
 from __future__ import annotations
 
 import enum

@@ -1,0 +1,5 @@
+import sys
+
+from paddle_tpu.distributed.launch.main import launch
+
+sys.exit(launch())

@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.launch.controllers.collective import (  # noqa: F401
+    CollectiveController,
+)

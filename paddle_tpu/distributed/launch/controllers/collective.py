@@ -1,0 +1,159 @@
+"""Multi-process collective launcher controller.
+
+Reference: python/paddle/distributed/launch/controllers/collective.py:280
+(CollectiveElasticController) + controller.py process management: spawn
+``nproc_per_node`` worker processes with the trainer env contract, host the
+master TCPStore for rendezvous, watch the pod, and on a worker failure
+relaunch the whole peer group (fault-tolerance level 1: peer restart +
+checkpoint resume) up to ``max_restarts`` times.
+
+TPU-native notes: on real TPU pods the platform runtime starts one process per
+host, so ``nproc_per_node`` here is mostly the CPU/test/multi-host-controller
+path — but the env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT / PADDLE_MASTER) is the
+same one parallel_env.init_parallel_env consumes everywhere.  The rendezvous
+store is the native C++ TCPStore (core/native/csrc/tcp_store.cc)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class CollectiveController:
+    def __init__(self, script, script_args=None, nproc_per_node=1, nnodes=1,
+                 node_rank=0, master=None, job_id="default", log_dir=None,
+                 max_restarts=0, env=None):
+        self.script = script
+        self.script_args = list(script_args or [])
+        self.nproc = int(nproc_per_node)
+        self.nnodes = int(nnodes)
+        self.node_rank = int(node_rank)
+        self.master = master
+        self.job_id = job_id
+        self.log_dir = log_dir
+        self.max_restarts = int(max_restarts)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.procs = []
+        self.restart_count = 0
+        self._server = None
+        self._log_files = []
+
+    # ------------------------------------------------------------- rendezvous
+    def _ensure_master(self):
+        """Node 0 hosts the TCPStore; everyone learns host:port."""
+        if self.master:
+            host, port = self.master.rsplit(":", 1)
+            if self.node_rank == 0 and not self._server:
+                from paddle_tpu.core.native import TCPStoreServer
+
+                self._server = TCPStoreServer(port=int(port))
+            return host, int(port)
+        if self.nnodes > 1:
+            raise ValueError(
+                "--master host:port is required when nnodes > 1 — without it "
+                "each node would self-host its own rendezvous store and the "
+                "job would hang waiting for peers that can never arrive"
+            )
+        from paddle_tpu.core.native import TCPStoreServer
+
+        self._server = TCPStoreServer(port=0)
+        return "127.0.0.1", self._server.port
+
+    # ---------------------------------------------------------------- workers
+    def _worker_env(self, local_rank, host, port):
+        world = self.nproc * self.nnodes
+        rank = self.node_rank * self.nproc + local_rank
+        endpoints = ",".join(
+            f"{host}:{port + 1 + r}" for r in range(world)
+        )
+        env = dict(self.base_env)
+        env.update({
+            # port map: TCPStore rendezvous on `port`, worker endpoints on
+            # port+1..port+world, jax coordinator on port+world+1 (it must
+            # not collide with the store the launcher itself holds)
+            "PADDLE_MASTER": f"{host}:{port}",
+            "MASTER_ADDR": host,
+            "MASTER_PORT": str(port + world + 1),
+            "PADDLE_COORDINATOR": f"{host}:{port + world + 1}",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_NNODES": str(self.nnodes),
+            "PADDLE_JOB_ID": str(self.job_id),
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{port + 1 + rank}",
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_RESTART_COUNT": str(self.restart_count),
+            "FLAGS_selected_devices": str(local_rank),
+        })
+        return env
+
+    def _spawn_all(self, host, port):
+        self.procs = []
+        for lr in range(self.nproc):
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                rank = self.node_rank * self.nproc + lr
+                f = open(os.path.join(self.log_dir, f"workerlog.{rank}"), "ab")
+                self._log_files.append(f)
+                out = err = f
+            else:
+                out = err = None
+            p = subprocess.Popen(
+                [sys.executable, "-u", self.script] + self.script_args,
+                env=self._worker_env(lr, host, port),
+                stdout=out, stderr=err,
+            )
+            self.procs.append(p)
+
+    def _kill_all(self, sig=signal.SIGTERM, grace=5.0):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except ProcessLookupError:  # pragma: no cover
+                    pass
+        deadline = time.time() + grace
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    # -------------------------------------------------------------------- run
+    def run(self, poll_interval=0.2):
+        """Spawn, watch, restart-on-failure (the reference controller's
+        watch() loop: CollectiveElasticController.run + pod watcher)."""
+        host, port = self._ensure_master()
+        self._spawn_all(host, port)
+        try:
+            while True:
+                states = [p.poll() for p in self.procs]
+                if all(s == 0 for s in states):
+                    return 0
+                failed = [
+                    (i, s) for i, s in enumerate(states)
+                    if s is not None and s != 0
+                ]
+                if failed:
+                    if self.restart_count < self.max_restarts:
+                        self.restart_count += 1
+                        self._kill_all()
+                        self._spawn_all(host, port)
+                    else:
+                        self._kill_all()
+                        return failed[0][1]
+                time.sleep(poll_interval)
+        finally:
+            self._kill_all()
+            for f in self._log_files:
+                try:
+                    f.close()
+                except OSError:  # pragma: no cover
+                    pass
+            if self._server is not None:
+                self._server.stop()
+                self._server = None

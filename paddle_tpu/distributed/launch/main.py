@@ -1,41 +1,51 @@
 """Launcher (reference: python/paddle/distributed/launch/main.py:23).
 
-On TPU pods the runtime (GKE/queued-resources) starts one process per host and exports
-the coordinator env; this launcher therefore only normalizes env and execs the training
-script — the reference's process-manager/rendezvous duties live in
-``jax.distributed.initialize`` (parallel_env.init_parallel_env)."""
+Two modes:
+
+* ``--nproc_per_node N`` (or ``PADDLE_NPROC_PER_NODE``): real process manager —
+  spawns N workers with the trainer env contract, hosts the master TCPStore
+  rendezvous, watches the pod and peer-relaunches on failure
+  (``--max_restarts``); see controllers/collective.py.
+* otherwise (TPU pods): the platform runtime (GKE/queued-resources) already
+  starts one process per host and exports the coordinator env, so the launcher
+  normalizes env and execs the training script in-process — the reference's
+  rendezvous duties live in jax.distributed.initialize
+  (parallel_env.init_parallel_env).
+"""
 from __future__ import annotations
 
 import os
 import runpy
 import sys
 
+_ENV_FLAGS = {
+    "--master": "PADDLE_MASTER",
+    "--nnodes": "PADDLE_NNODES",
+    "--rank": "PADDLE_TRAINER_ID",
+    "--job_id": "PADDLE_JOB_ID",
+}
+_KNOWN_FLAGS = set(_ENV_FLAGS) | {
+    "--nproc_per_node", "--devices", "--log_dir", "--ips", "--gpus", "--xpus",
+    "--run_mode", "--max_restarts", "--elastic_level",
+}
 
-def launch():
-    argv = sys.argv[1:]
-    # strip `--key value` launcher options the TPU runtime makes irrelevant, keep env
-    # overrides of the reference's contract working.
-    script = None
-    script_args = []
+
+def _parse(argv):
+    opts, script, script_args = {}, None, []
     i = 0
-    known_flags = {"--nnodes", "--nproc_per_node", "--master", "--rank", "--devices",
-                   "--job_id", "--log_dir", "--ips", "--gpus", "--xpus", "--run_mode"}
     while i < len(argv):
         a = argv[i]
         if script is None and a.startswith("--"):
             key = a.split("=")[0]
-            if key in known_flags:
-                if "=" not in a and i + 1 < len(argv):
+            if key in _KNOWN_FLAGS:
+                if "=" in a:
+                    val = a.split("=", 1)[1]
+                elif i + 1 < len(argv):
                     val = argv[i + 1]
                     i += 1
                 else:
-                    val = a.split("=", 1)[1] if "=" in a else ""
-                if key == "--master":
-                    os.environ.setdefault("PADDLE_MASTER", val)
-                elif key == "--nnodes":
-                    os.environ.setdefault("PADDLE_NNODES", val)
-                elif key == "--rank":
-                    os.environ.setdefault("PADDLE_TRAINER_ID", val)
+                    val = ""
+                opts[key] = val
             i += 1
             continue
         if script is None:
@@ -43,9 +53,42 @@ def launch():
         else:
             script_args.append(a)
         i += 1
+    return opts, script, script_args
+
+
+def launch():
+    opts, script, script_args = _parse(sys.argv[1:])
     if script is None:
-        print("usage: python -m paddle_tpu.distributed.launch [options] script.py ...")
+        print("usage: python -m paddle_tpu.distributed.launch "
+              "[--nproc_per_node N] [--master host:port] [--nnodes N] "
+              "[--rank R] [--log_dir DIR] [--max_restarts K] script.py ...")
         return 1
+    for flag, env in _ENV_FLAGS.items():
+        if flag in opts:
+            os.environ.setdefault(env, opts[flag])
+
+    nproc = opts.get("--nproc_per_node") or os.environ.get(
+        "PADDLE_NPROC_PER_NODE")
+    if nproc and int(nproc) >= 1:
+        from paddle_tpu.distributed.launch.controllers import (
+            CollectiveController,
+        )
+
+        ctl = CollectiveController(
+            script, script_args,
+            nproc_per_node=int(nproc),
+            nnodes=int(opts.get("--nnodes",
+                                os.environ.get("PADDLE_NNODES", 1))),
+            node_rank=int(opts.get("--rank",
+                                   os.environ.get("PADDLE_TRAINER_ID", 0))),
+            master=opts.get("--master") or os.environ.get("PADDLE_MASTER"),
+            job_id=opts.get("--job_id",
+                            os.environ.get("PADDLE_JOB_ID", "default")),
+            log_dir=opts.get("--log_dir"),
+            max_restarts=int(opts.get("--max_restarts", 0)),
+        )
+        return ctl.run()
+
     sys.argv = [script] + script_args
     runpy.run_path(script, run_name="__main__")
     return 0

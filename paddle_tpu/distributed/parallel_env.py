@@ -50,7 +50,12 @@ def init_parallel_env():
     """
     if _WORLD["initialized"]:
         return ParallelEnv()
-    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    # the jax coordinator must NOT share the TCPStore's port (the launcher
+    # holds that); prefer the dedicated PADDLE_COORDINATOR, then
+    # MASTER_ADDR:MASTER_PORT, then PADDLE_MASTER
+    master = (os.environ.get("PADDLE_COORDINATOR")
+              or os.environ.get("MASTER_ADDR")
+              or os.environ.get("PADDLE_MASTER"))
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
     if master and nnodes > 1 and jax.process_count() == 1:
         port = os.environ.get("MASTER_PORT")

@@ -1,0 +1,142 @@
+"""Real multi-process launcher (VERDICT r1 item 5).
+
+Reference: python/paddle/distributed/launch/main.py:23 +
+controllers/collective.py:280 — spawn N workers with the trainer env
+contract, master TCPStore rendezvous, pod watch, peer relaunch on failure.
+
+The recovery test SIGKILLs one worker mid-training and observes the
+controller relaunch the whole peer group, which re-rendezvouses through the
+store and resumes from checkpoint (fleet/elastic/manager.py:125 fault
+tolerance level 1)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import json, os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+workdir = {workdir!r}
+
+# rendezvous through the master TCPStore (the launcher hosts it)
+from paddle_tpu.core.native import TCPStore
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+store = TCPStore(host, int(port))
+store.add(f"rdv_{{restart}}", 1)
+deadline = time.time() + 30
+while int(store.add(f"rdv_{{restart}}", 0)) < world:
+    if time.time() > deadline:
+        raise SystemExit(f"rank {{rank}}: rendezvous timeout")
+    time.sleep(0.01)
+with open(os.path.join(workdir, f"rdv_{{rank}}_{{restart}}"), "w") as f:
+    f.write("ok")
+
+ckpt = os.path.join(workdir, f"ckpt_{{rank}}.npz")
+start, w = 0, 0.0
+if os.path.exists(ckpt):
+    blob = np.load(ckpt)
+    start, w = int(blob["step"]), float(blob["w"])
+
+TOTAL = 10
+for step in range(start, TOTAL):
+    w += 1.0  # the training step
+    tmp = ckpt + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, step=step + 1, w=w)
+    os.replace(tmp, ckpt)  # atomic: a SIGTERM mid-save can't corrupt resume
+    if rank == 1 and restart == 0 and step == 4:
+        os.kill(os.getpid(), signal.SIGKILL)  # die mid-training
+    time.sleep(0.02)
+
+with open(os.path.join(workdir, f"done_{{rank}}_{{restart}}"), "w") as f:
+    f.write(json.dumps({{"w": w, "step": TOTAL}}))
+"""
+
+
+def _run_launcher(workdir, script, nproc=2, max_restarts=1, timeout=120):
+    log_dir = os.path.join(workdir, "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         f"--nproc_per_node={nproc}", f"--max_restarts={max_restarts}",
+         "--log_dir", log_dir, "--job_id", "testjob", script],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    return proc, log_dir
+
+
+def test_launcher_spawns_env_contract(tmp_path):
+    """N workers run with correct rank/world/endpoints env."""
+    script = tmp_path / "probe.py"
+    script.write_text(f"""
+import json, os, sys
+sys.path.insert(0, {REPO!r})
+rank = os.environ["PADDLE_TRAINER_ID"]
+info = {{k: os.environ[k] for k in (
+    "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_LOCAL_RANK",
+    "PADDLE_MASTER", "PADDLE_CURRENT_ENDPOINT", "PADDLE_TRAINER_ENDPOINTS",
+    "PADDLE_JOB_ID")}}
+with open({str(tmp_path)!r} + f"/env_{{rank}}.json", "w") as f:
+    json.dump(info, f)
+""")
+    proc, _ = _run_launcher(str(tmp_path), str(script), nproc=3,
+                            max_restarts=0)
+    assert proc.returncode == 0, proc.stderr
+    infos = []
+    for r in range(3):
+        with open(tmp_path / f"env_{r}.json") as f:
+            import json
+
+            infos.append(json.load(f))
+    assert [i["PADDLE_TRAINER_ID"] for i in infos] == ["0", "1", "2"]
+    assert all(i["PADDLE_TRAINERS_NUM"] == "3" for i in infos)
+    assert all(i["PADDLE_JOB_ID"] == "testjob" for i in infos)
+    eps = infos[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 3
+    assert infos[1]["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+
+
+def test_kill_and_recover(tmp_path):
+    """SIGKILL one worker mid-training: the controller peer-relaunches, the
+    group re-rendezvouses through the TCPStore, and training resumes from
+    checkpoint to completion."""
+    workdir = str(tmp_path)
+    script = tmp_path / "train.py"
+    script.write_text(_WORKER.format(repo=REPO, workdir=workdir))
+    proc, log_dir = _run_launcher(workdir, str(script), nproc=2,
+                                  max_restarts=1)
+    assert proc.returncode == 0, proc.stderr
+
+    # both generations rendezvoused
+    for r in range(2):
+        assert os.path.exists(tmp_path / f"rdv_{r}_0")
+        assert os.path.exists(tmp_path / f"rdv_{r}_1")
+    # generation 0 died before finishing; generation 1 completed
+    assert not os.path.exists(tmp_path / "done_1_0")
+    for r in range(2):
+        assert os.path.exists(tmp_path / f"done_{r}_1")
+    # resumed from checkpoint: every rank reached exactly TOTAL steps
+    for r in range(2):
+        blob = np.load(tmp_path / f"ckpt_{r}.npz")
+        assert int(blob["step"]) == 10
+        assert float(blob["w"]) == 10.0
+    # per-rank worker logs were written
+    assert os.path.exists(os.path.join(log_dir, "workerlog.0"))
+    assert os.path.exists(os.path.join(log_dir, "workerlog.1"))
+
+
+def test_no_restart_budget_propagates_failure(tmp_path):
+    """With max_restarts=0 a failing worker fails the launch."""
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    proc, _ = _run_launcher(str(tmp_path), str(script), nproc=2,
+                            max_restarts=0)
+    assert proc.returncode == 3
