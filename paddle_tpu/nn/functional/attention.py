@@ -89,8 +89,54 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def flash_attn_unpadded(*a, **k):  # pragma: no cover - varlen path
-    raise NotImplementedError("varlen flash attention not yet implemented on TPU")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention over PACKED sequences (reference
+    python/paddle/nn/functional/flash_attention.py flash_attn_unpadded over
+    flash_attn_varlen_fwd).
+
+    q/k/v: [total_tokens, num_heads, head_dim]; cu_seqlens_*: [batch+1] int32
+    prefix sums of sequence lengths.  TPU-native: tokens are tagged with their
+    sequence index (searchsorted over the prefix sums) and attention runs as
+    one segment-masked blockwise pass — no [total, total] score matrix, no
+    unpacking; cross-sequence pairs are masked inside the online softmax, and
+    ``causal`` composes with the segment mask to give per-sequence causality
+    (positions are monotone inside each packed sequence).
+
+    ``causal`` assumes self-attention lengths (cu_seqlens_q == cu_seqlens_k),
+    the reference's primary varlen mode.  Returns (out, softmax) with softmax
+    None, like the reference's return_softmax=False path."""
+    from paddle_tpu.ops.flash_attention import blockwise_attention
+
+    q, k, v = _t(query), _t(key), _t(value)
+    cu_q, cu_k = _t(cu_seqlens_q), _t(cu_seqlens_k)
+    if dropout > 0.0 and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: dropout inside the varlen kernel is not "
+            "supported; apply dropout outside attention"
+        )
+
+    def f(qa, ka, va, cuq, cuk):
+        total_q, total_k = qa.shape[0], ka.shape[0]
+        seg_q = jnp.searchsorted(
+            cuq[1:].astype(jnp.int32), jnp.arange(total_q, dtype=jnp.int32),
+            side="right").astype(jnp.int32)
+        seg_k = jnp.searchsorted(
+            cuk[1:].astype(jnp.int32), jnp.arange(total_k, dtype=jnp.int32),
+            side="right").astype(jnp.int32)
+        # global causal ∧ same-segment == per-sequence causal: packed
+        # positions are monotone inside each sequence, so the blockwise
+        # kernel's global index comparison is exactly per-sequence order
+        out = blockwise_attention(
+            qa[None], ka[None], va[None], causal=causal, scale=scale,
+            q_segments=seg_q[None], k_segments=seg_k[None])
+        return out[0]
+
+    out = apply("flash_attn_unpadded", f, q, k, v, cu_q, cu_k)
+    return out, None
 
 
 def sparse_attention(query, key, value, sparse_csr_offset=None,

@@ -639,9 +639,20 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
 
 
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q=None, cu_seqlens_k=None,
-                                max_seqlen_q=None, max_seqlen_k=None, scale=None,
-                                dropout=0.0, causal=False, **kw):
-    raise NotImplementedError(
-        "varlen flash attention: pad to max_seqlen and use flash_attn_qkvpacked "
-        "(XLA requires static shapes; ragged batches should be bucketed)"
-    )
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, training=True, **kw):
+    """Varlen packed-QKV flash attention (reference
+    flash_attn_varlen_qkvpacked): qkv [total_tokens, 3, H, D] + cu_seqlens —
+    delegates to the segment-masked varlen path (attention.py
+    flash_attn_unpadded)."""
+    from paddle_tpu.nn.functional.attention import flash_attn_unpadded
+
+    def split(a):
+        return a[:, 0], a[:, 1], a[:, 2]
+
+    q, k, v = apply("split_qkv_packed_varlen", split, _t(qkv))
+    return flash_attn_unpadded(
+        q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q=max_seqlen_q,
+        max_seqlen_k=max_seqlen_k, scale=scale, dropout=dropout,
+        causal=causal, return_softmax=return_softmax, training=training)

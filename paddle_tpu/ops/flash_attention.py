@@ -324,13 +324,17 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal=False, scale=None,
 # ------------------------------------------------------------------- blockwise (jnp)
 def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
                         q_offset=0, k_offset=0, carry_in=None,
-                        return_carry=False):
+                        return_carry=False, q_segments=None, k_segments=None):
     """Memory-efficient attention as a scan over k/v blocks ([B, L, H, D]).
 
     ``q_offset``/``k_offset`` shift query/key positions to their global indices
     (ring attention passes each rotating shard's offset); ``carry_in``/
     ``return_carry`` expose the online-softmax state (acc, m, l) so callers can
-    stitch multiple k/v shards together.
+    stitch multiple k/v shards together.  ``q_segments``/``k_segments``
+    ([B, Lq] / [B, Lk] int arrays) restrict attention to same-segment pairs —
+    the varlen/packed-sequence masking (flash_attn_unpadded, padding masks):
+    tokens never attend across segment boundaries, and rows whose segment id
+    is negative (padding) produce zeros.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -344,9 +348,13 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
     vb = vt.reshape(b, h, nblocks, block_k, d)
     q_idx = q_offset + jnp.arange(lq)
 
+    kseg_b = (None if k_segments is None
+              else jnp.asarray(k_segments).reshape(b, nblocks, block_k))
+    qseg = None if q_segments is None else jnp.asarray(q_segments)
+
     def step(carry, blk):
         acc, m, l = carry
-        kblk, vblk, kb_idx = blk
+        kblk, vblk, kb_idx, kseg = blk
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", qt, kblk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
@@ -355,6 +363,9 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
             k_idx = k_offset + kb_idx * block_k + jnp.arange(block_k)
             mask = q_idx[:, None] >= k_idx[None, :]
             s = jnp.where(mask[None, None], s, _NEG_INF)
+        if kseg is not None:
+            seg_mask = qseg[:, :, None] == kseg[:, None, :]  # [B, Lq, block_k]
+            s = jnp.where(seg_mask[:, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
@@ -376,12 +387,15 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
         jnp.moveaxis(kb, 2, 0),  # [nblocks, B, H, block_k, D]
         jnp.moveaxis(vb, 2, 0),
         jnp.arange(nblocks),
+        None if kseg_b is None else jnp.moveaxis(kseg_b, 1, 0),
     )
     carry, _ = jax.lax.scan(step, carry, blocks)
     if return_carry:
         return carry
     acc, m, l = carry
     out = acc / jnp.maximum(l, 1e-30)[..., None]
+    if qseg is not None:
+        out = jnp.where((qseg >= 0)[:, None, :, None], out, 0.0)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 

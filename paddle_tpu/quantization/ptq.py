@@ -1,8 +1,9 @@
 """PTQ (reference python/paddle/quantization/ptq.py): insert observers, run
-calibration data, then convert observed stats into quant params."""
+calibration data, then convert observed stats into layers that execute
+low-precision math (quantized_layers)."""
 from __future__ import annotations
 
-from paddle_tpu.quantization.qat import QuantedWrapper, _QUANTABLE, _convert
+from paddle_tpu.quantization.qat import _convert, _materialize
 
 
 class PTQ:
@@ -10,14 +11,11 @@ class PTQ:
         self._config = config
 
     def quantize(self, model, inplace=False):
+        """Insert observers: run calibration batches through the result."""
         return _convert(model, self._config)
 
     def convert(self, model, inplace=False):
-        """After calibration: freeze observer scales (kept as attributes)."""
-        for _, sub in model.named_sublayers():
-            if isinstance(sub, QuantedWrapper):
-                if sub.activation_quanter is not None and hasattr(sub.activation_quanter, "scales"):
-                    sub._act_scale = sub.activation_quanter.scales()
-                if sub.weight_quanter is not None and hasattr(sub.weight_quanter, "scales"):
-                    sub._w_scale = sub.weight_quanter.scales()
-        return model
+        """After calibration: replace each observed layer with its int8
+        execution form (QuantizedLinear / QuantizedConv2D) built from the
+        observed scales."""
+        return _materialize(model)

@@ -48,7 +48,36 @@ class QAT:
         return _convert(model, self._config)
 
     def convert(self, model, inplace=False):
-        return model
+        """Freeze the learned fake-quant scales into int8 execution layers."""
+        return _materialize(model)
+
+
+def _materialize(model):
+    """Swap every QuantedWrapper for its quantized execution layer using the
+    scales its quanters/observers learned.  Wrappers without both scales are
+    left in fake-quant form (nothing to execute in int8)."""
+    from paddle_tpu.quantization.quantized_layers import (
+        QuantizedConv2D, QuantizedLinear,
+    )
+
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if "." in name:
+            continue
+        if isinstance(sub, QuantedWrapper):
+            wq, aq = sub.weight_quanter, sub.activation_quanter
+            if wq is None or aq is None:
+                continue
+            inner = sub._inner
+            if isinstance(inner, Linear):
+                q = QuantizedLinear(inner, wq.scales(), aq.scales())
+            elif isinstance(inner, Conv2D):
+                q = QuantizedConv2D(inner, wq.scales(), aq.scales())
+            else:  # pragma: no cover - _QUANTABLE gate upstream
+                continue
+            setattr(model, name, q)
+        else:
+            _materialize(sub)
+    return model
 
 
 def _convert(model, config, prefix=""):

@@ -39,7 +39,10 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
         return apply("fake_quant_absmax", fake_quant, x)
 
     def scales(self):
-        return Tensor(jnp.asarray(self._scale, jnp.float32))
+        # step-size convention (absmax / qmax), matching observers.scales()
+        # so convert() can treat every scales() as the int8 grid step
+        qmax = 2 ** (self._bit_length - 1) - 1
+        return Tensor(jnp.asarray(self._scale / qmax, jnp.float32))
 
     def zero_points(self):
         return Tensor(jnp.zeros((), jnp.float32))

@@ -1,0 +1,116 @@
+"""Quantized inference layers — the EXECUTION half of PTQ/QAT.
+
+Reference: python/paddle/quantization (convert pipeline) +
+static/quantization quantized op kernels: after calibration/QAT the convert
+step replaces each observed layer with one that really runs low-precision
+math.
+
+TPU-native: the int8 matmul rides ``lax.dot_general`` with int8 operands and
+an int32 ``preferred_element_type`` — the MXU's native int8 path — then one
+fused dequant-scale + bias.  Convolution quantizes values to the int8 grid but
+accumulates through the fp32 conv kernel (XLA's TPU conv lowering is
+float-typed; the arithmetic is exact because products of ints ≤ 127² are
+representable in fp32), which is the documented "simulated int8" conv the
+reference's onnx-style converters also emit for backends without an int8
+conv."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.engine import apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["QuantizedLinear", "QuantizedConv2D", "quantize_to_int8"]
+
+
+def _as_scale(s, default=1.0):
+    if s is None:
+        return float(default)
+    if isinstance(s, Tensor):
+        s = s.data
+    return float(jnp.asarray(s))
+
+
+def quantize_to_int8(w, scale):
+    """value -> int8 grid: q = clip(round(w / scale), -127, 127)."""
+    arr = w.data if isinstance(w, Tensor) else jnp.asarray(w)
+    q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8)
+
+
+class QuantizedLinear(Layer):
+    """y = (q_x · q_w) * (s_x * s_w) + b with an int8×int8→int32 dot."""
+
+    def __init__(self, linear, w_scale, act_scale):
+        super().__init__()
+        self._w_scale = _as_scale(w_scale)
+        self._act_scale = _as_scale(act_scale)
+        self.weight_int8 = Tensor(
+            quantize_to_int8(linear.weight, self._w_scale))
+        self.bias = getattr(linear, "bias", None)
+        self._in_features = linear.weight.shape[0]
+        self._out_features = linear.weight.shape[1]
+
+    def forward(self, x):
+        sx, sw = self._act_scale, self._w_scale
+        qw = self.weight_int8
+        bias = self.bias
+
+        def f(a, qw_, *b):
+            qa = jnp.clip(jnp.round(a.astype(jnp.float32) / sx), -127, 127
+                          ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qa, qw_, (((qa.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            out = acc.astype(jnp.float32) * (sx * sw)
+            if b:
+                out = out + b[0].astype(jnp.float32)
+            return out.astype(a.dtype)
+
+        args = [x, self.weight_int8] + ([bias] if bias is not None else [])
+        return apply("quantized_linear", f, *args)
+
+
+class QuantizedConv2D(Layer):
+    """Conv on the int8 value grid (fp32 accumulation, exact for int8
+    products), dequantized with s_x * s_w."""
+
+    def __init__(self, conv, w_scale, act_scale):
+        super().__init__()
+        self._w_scale = _as_scale(w_scale)
+        self._act_scale = _as_scale(act_scale)
+        self.weight_int8 = Tensor(quantize_to_int8(conv.weight, self._w_scale))
+        self.bias = getattr(conv, "bias", None)
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = getattr(conv, "_dilation", 1)
+        self._groups = getattr(conv, "_groups", 1)
+        self._data_format = getattr(conv, "_data_format", "NCHW")
+
+    def forward(self, x):
+        sx, sw = self._act_scale, self._w_scale
+        stride, padding = self._stride, self._padding
+        dilation, groups = self._dilation, self._groups
+        data_format = self._data_format
+        bias = self.bias
+
+        def f(a, qw_, *b):
+            qa = jnp.clip(jnp.round(a.astype(jnp.float32) / sx), -127, 127)
+            acc = F.conv2d(
+                Tensor(qa), Tensor(qw_.astype(jnp.float32)),
+                bias=None, stride=stride, padding=padding,
+                dilation=dilation, groups=groups, data_format=data_format,
+            ).data
+            out = acc * (sx * sw)
+            if b:
+                cshape = ((1, -1, 1, 1) if data_format == "NCHW"
+                          else (1, 1, 1, -1))
+                out = out + b[0].reshape(cshape).astype(jnp.float32)
+            return out.astype(a.dtype)
+
+        args = [x, self.weight_int8] + ([bias] if bias is not None else [])
+        return apply("quantized_conv2d", f, *args)

@@ -83,9 +83,15 @@ class TestQuantization:
         m = PTQ(cfg).quantize(self._model())
         for _ in range(3):
             m(paddle.to_tensor(np.random.rand(4, 8).astype("float32")))
-        m = PTQ(cfg).convert(m)
+        # scales are observable before conversion...
         scale = m.fc1.activation_quanter.scales()
         assert float(scale.numpy()) > 0
+        # ...and convert() swaps the wrapper for the int8 execution layer
+        m = PTQ(cfg).convert(m)
+        from paddle_tpu.quantization.quantized_layers import QuantizedLinear
+
+        assert isinstance(m.fc1, QuantizedLinear)
+        assert m.fc1._act_scale > 0
 
 
 class TestRegularizer:

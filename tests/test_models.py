@@ -219,3 +219,69 @@ class TestMultiChip:
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)
+
+
+class TestVarlenFlashAttention:
+    """flash_attn_unpadded over packed sequences (VERDICT r1 item 9): OpTest
+    vs per-sequence naive attention, fwd and grads."""
+
+    @staticmethod
+    def _naive(q, k, v, causal):
+        d = q.shape[-1]
+        qt, kt, vt = (jnp.swapaxes(x[None], 1, 2) for x in (q, k, v))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+        if causal:
+            L = s.shape[-1]
+            s = jnp.where(jnp.tril(jnp.ones((L, L), bool)), s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)[0]
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_per_sequence_naive(self, causal):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        lens = [7, 13, 4]
+        total, H, D = sum(lens), 2, 16
+        q = rng.standard_normal((total, H, D)).astype(np.float32)
+        k = rng.standard_normal((total, H, D)).astype(np.float32)
+        v = rng.standard_normal((total, H, D)).astype(np.float32)
+        cu = np.cumsum([0] + lens).astype(np.int32)
+
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu),
+            max_seqlen_q=max(lens), max_seqlen_k=max(lens), causal=causal)
+        got = out.numpy()
+        for i in range(len(lens)):
+            a, b = cu[i], cu[i + 1]
+            ref = np.asarray(self._naive(
+                jnp.asarray(q[a:b]), jnp.asarray(k[a:b]), jnp.asarray(v[a:b]),
+                causal))
+            np.testing.assert_allclose(got[a:b], ref, rtol=2e-4, atol=2e-5,
+                                       err_msg=f"sequence {i}")
+
+    def test_gradients_flow_and_stay_in_segment(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(1)
+        lens = [6, 10]
+        total, H, D = sum(lens), 2, 8
+        qv = rng.standard_normal((total, H, D)).astype(np.float32)
+        kv = rng.standard_normal((total, H, D)).astype(np.float32)
+        vv = rng.standard_normal((total, H, D)).astype(np.float32)
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        q = paddle.Tensor(qv, stop_gradient=False)
+        k = paddle.Tensor(kv, stop_gradient=False)
+        v = paddle.Tensor(vv, stop_gradient=False)
+        out, _ = F.flash_attn_unpadded(
+            q, k, v, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            causal=True)
+        # loss over ONLY the first sequence -> second sequence's k/v get
+        # exactly zero grad (no cross-sequence leakage)
+        out[:6].sum().backward()
+        gk = k.grad.numpy()
+        assert np.abs(gk[:6]).max() > 0
+        np.testing.assert_allclose(gk[6:], 0.0, atol=1e-7)
