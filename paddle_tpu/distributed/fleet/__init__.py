@@ -96,7 +96,39 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy=None):
     """Reference fleet.py distributed_optimizer → HybridParallelOptimizer (a grad-clip
     + sharding aware wrapper).  Global-array grads are already fully reduced, so the
-    hybrid concerns reduce to clip-then-step."""
+    hybrid concerns reduce to clip-then-step — plus the comm meta-optimizers
+    the strategy enables (DGC / LocalSGD / fp16-allreduce, reference
+    fleet/meta_optimizers/)."""
+    if strategy is None:
+        strategy = _state["strategy"]
+    if strategy is not None:
+        from paddle_tpu.distributed.fleet import meta_optimizers as _mo
+        from paddle_tpu.optimizer.optimizers import Momentum
+
+        if getattr(strategy, "dgc", False) and isinstance(optimizer, Momentum) \
+                and not isinstance(optimizer, _mo.DGCMomentumOptimizer):
+            cfg = getattr(strategy, "dgc_configs", None)
+            optimizer = _mo.DGCMomentumOptimizer(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                rampup_begin_step=getattr(cfg, "rampup_begin_step", 0),
+                rampup_step=getattr(cfg, "rampup_step", 1),
+                sparsity=getattr(cfg, "sparsity", [0.999]),
+                parameters=optimizer._parameter_list,
+                use_nesterov=optimizer._use_nesterov,
+                grad_clip=optimizer._grad_clip,
+                weight_decay=getattr(optimizer, "_weight_decay", None),
+                rescale_grad=getattr(optimizer, "_rescale", 1.0),
+            )
+        if getattr(strategy, "fp16_allreduce", False):
+            optimizer = _mo.FP16AllReduceOptimizer(optimizer)
+        if getattr(strategy, "localsgd", False):
+            cfg = getattr(strategy, "localsgd_configs", None)
+            optimizer = _mo.LocalSGDOptimizer(
+                optimizer,
+                k_steps=getattr(cfg, "k_steps", 1),
+                begin_step=getattr(cfg, "begin_step", 1),
+            )
     return optimizer
 
 

@@ -50,9 +50,13 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = _SubConfig(k_steps=1, avg=True)
         self.dgc = False
+        self.dgc_configs = _SubConfig(rampup_begin_step=0, rampup_step=1,
+                                      sparsity=[0.999])
         self.lamb = False
         self.lars = False
         self.localsgd = False
+        self.localsgd_configs = _SubConfig(k_steps=1, begin_step=1)
+        self.fp16_allreduce = False
         self.heter_ccl_mode = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True

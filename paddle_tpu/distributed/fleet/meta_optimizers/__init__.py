@@ -1,0 +1,14 @@
+from paddle_tpu.distributed.fleet.meta_optimizers.dgc_optimizer import (  # noqa: F401
+    DGCMomentumOptimizer,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers.localsgd_optimizer import (  # noqa: F401
+    LocalSGDOptimizer, average_parameters,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers.fp16_allreduce_optimizer import (  # noqa: F401
+    FP16AllReduceOptimizer,
+)
+
+__all__ = [
+    "DGCMomentumOptimizer", "LocalSGDOptimizer", "average_parameters",
+    "FP16AllReduceOptimizer",
+]

@@ -25,7 +25,8 @@ def _srv_push_sparse(name, ids, grads):
 
 
 def _srv_table_size(name):
-    return _SERVER_TABLES[name].size()
+    t = _SERVER_TABLES.get(name)
+    return t.size() if t is not None else 0  # dense tables live on server 0
 
 
 def _srv_save(name, path):
@@ -35,6 +36,20 @@ def _srv_save(name, path):
 
 def _srv_load(name, path):
     _SERVER_TABLES[name].load(path)
+    return True
+
+
+def _srv_create_dense(name, shape, lr):
+    _SERVER_TABLES[name] = DenseTable(shape, lr=lr)
+    return True
+
+
+def _srv_pull_dense(name):
+    return _SERVER_TABLES[name].pull()
+
+
+def _srv_push_dense(name, grad):
+    _SERVER_TABLES[name].push(grad)
     return True
 
 
@@ -53,37 +68,121 @@ class PsServer:
 
 
 class PsWorker:
-    """Worker role: rpc client with pull/push API (BrpcPsClient analog)."""
+    """Worker role: rpc client with pull/push API (BrpcPsClient analog).
+
+    ``servers`` may be one name or a list: sparse tables shard rows by
+    ``id % n_servers`` (the reference's table-shard routing), dense tables
+    live on server 0.  ``push_*_async`` returns futures — the async-training
+    path where the trainer does not block on the update round trip."""
 
     def __init__(self, server_name="ps0"):
         from paddle_tpu.distributed import rpc
 
-        self.server = server_name
+        self.servers = (list(server_name)
+                        if isinstance(server_name, (list, tuple))
+                        else [server_name])
+        self.server = self.servers[0]
         self._rpc = rpc
 
+    def _shard(self, ids):
+        n = len(self.servers)
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        return ids % n
+
     def create_sparse_table(self, name, dim, accessor="sgd", **kwargs):
-        return self._rpc.rpc_sync(self.server, _srv_create_sparse,
-                                  args=(name, dim, accessor, kwargs))
+        return [
+            self._rpc.rpc_sync(srv, _srv_create_sparse,
+                               args=(name, dim, accessor, kwargs))
+            for srv in self.servers
+        ]
 
     def pull_sparse(self, name, ids):
-        return self._rpc.rpc_sync(self.server, _srv_pull_sparse, args=(name, np.asarray(ids)))
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if len(self.servers) == 1 or len(ids) == 0:
+            return self._rpc.rpc_sync(self.server, _srv_pull_sparse,
+                                      args=(name, ids))
+        owner = self._shard(ids)
+        futs = []
+        for si, srv in enumerate(self.servers):  # scatter pulls in parallel
+            sel = np.nonzero(owner == si)[0]
+            if len(sel):
+                futs.append((sel, self._rpc.rpc_async(
+                    srv, _srv_pull_sparse, args=(name, ids[sel]))))
+        rows = None
+        for sel, f in futs:
+            part = f.result()
+            if rows is None:
+                rows = np.empty((len(ids), part.shape[1]), np.float32)
+            rows[sel] = part
+        return rows
 
     def push_sparse(self, name, ids, grads):
-        return self._rpc.rpc_sync(self.server, _srv_push_sparse,
-                                  args=(name, np.asarray(ids), np.asarray(grads)))
+        for f in self._push_sparse_futs(name, ids, grads):
+            f.result()
+        return True
 
     def push_sparse_async(self, name, ids, grads):
-        return self._rpc.rpc_async(self.server, _srv_push_sparse,
-                                   args=(name, np.asarray(ids), np.asarray(grads)))
+        """Always a list of futures (one per contacted server)."""
+        return self._push_sparse_futs(name, ids, grads)
+
+    def _push_sparse_futs(self, name, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        if len(self.servers) == 1:
+            return [self._rpc.rpc_async(self.server, _srv_push_sparse,
+                                        args=(name, ids, grads))]
+        owner = self._shard(ids)
+        futs = []
+        for si, srv in enumerate(self.servers):
+            sel = np.nonzero(owner == si)[0]
+            if len(sel):
+                futs.append(self._rpc.rpc_async(
+                    srv, _srv_push_sparse, args=(name, ids[sel], grads[sel])))
+        return futs
+
+    # ------------------------------------------------------------- dense side
+    def create_dense_table(self, name, shape, lr=0.05):
+        return self._rpc.rpc_sync(self.server, _srv_create_dense,
+                                  args=(name, shape, lr))
+
+    def pull_dense(self, name):
+        return self._rpc.rpc_sync(self.server, _srv_pull_dense, args=(name,))
+
+    def push_dense(self, name, grad):
+        return self._rpc.rpc_sync(self.server, _srv_push_dense,
+                                  args=(name, np.asarray(grad, np.float32)))
+
+    def push_dense_async(self, name, grad):
+        return self._rpc.rpc_async(self.server, _srv_push_dense,
+                                   args=(name, np.asarray(grad, np.float32)))
 
     def table_size(self, name):
-        return self._rpc.rpc_sync(self.server, _srv_table_size, args=(name,))
+        return sum(
+            self._rpc.rpc_sync(srv, _srv_table_size, args=(name,))
+            for srv in self.servers
+        )
 
     def save(self, name, path):
-        return self._rpc.rpc_sync(self.server, _srv_save, args=(name, path))
+        """Sparse shards live on EVERY server: each saves its own
+        ``path.shard{i}`` file (single-server keeps the bare path)."""
+        if len(self.servers) == 1:
+            return self._rpc.rpc_sync(self.server, _srv_save,
+                                      args=(name, path))
+        return [
+            self._rpc.rpc_sync(srv, _srv_save,
+                               args=(name, f"{path}.shard{si}"))
+            for si, srv in enumerate(self.servers)
+        ]
 
     def load(self, name, path):
-        return self._rpc.rpc_sync(self.server, _srv_load, args=(name, path))
+        if len(self.servers) == 1:
+            return self._rpc.rpc_sync(self.server, _srv_load,
+                                      args=(name, path))
+        return [
+            self._rpc.rpc_sync(srv, _srv_load,
+                               args=(name, f"{path}.shard{si}"))
+            for si, srv in enumerate(self.servers)
+        ]
 
 
 class TheOnePSRuntime:

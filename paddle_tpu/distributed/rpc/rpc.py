@@ -3,7 +3,11 @@ the C++ brpc agent paddle/fluid/distributed/rpc/).
 
 TPU-native runtime is single-controller, so cross-worker RPC degenerates to
 local execution in 1-process mode; multi-process mode serves requests over a
-TCP socket server thread (the brpc analog, stdlib-only)."""
+TCP socket server thread (the brpc analog, stdlib-only).  Worker discovery is
+cross-process: when ``PADDLE_MASTER`` points at the native TCPStore
+(core/native), ``init_rpc`` publishes this worker's (name, rank, ip, port)
+there and ``rpc_sync``/``get_worker_info`` resolve unknown names through it —
+the gethostbyname+master rendezvous of the reference's brpc agent."""
 from __future__ import annotations
 
 import pickle
@@ -15,7 +19,24 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
-_STATE = {"workers": {}, "current": None, "server": None, "pool": None}
+_STATE = {"workers": {}, "current": None, "server": None, "pool": None,
+          "store": None}
+
+
+def _registry_store():
+    """TCPStore client for cross-process worker discovery (PADDLE_MASTER)."""
+    if _STATE["store"] is not None:
+        return _STATE["store"]
+    import os
+
+    master = os.environ.get("PADDLE_MASTER")
+    if not master:
+        return None
+    from paddle_tpu.core.native import TCPStore
+
+    host, port = master.rsplit(":", 1)
+    _STATE["store"] = TCPStore(host, int(port))
+    return _STATE["store"]
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -35,23 +56,51 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
     world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-    # serve on an ephemeral port
-    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Handler)
+    master = master_endpoint or os.environ.get("PADDLE_MASTER")
+    # cross-host: bind all interfaces and advertise the IP the master route
+    # uses (the gethostbyname analog); single host stays on loopback
+    host_ip = "127.0.0.1"
+    bind = "127.0.0.1"
+    if master:
+        try:
+            mhost, mport = master.rsplit(":", 1)
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+                probe.connect((mhost, int(mport)))
+                host_ip = probe.getsockname()[0]
+            bind = "0.0.0.0"
+        except OSError:
+            pass
+    srv = socketserver.ThreadingTCPServer((bind, 0), _Handler)
     srv.daemon_threads = True
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
-    info = WorkerInfo(name, rank, "127.0.0.1", srv.server_address[1])
+    info = WorkerInfo(name, rank, host_ip, srv.server_address[1])
     _STATE["workers"][name] = info
     _STATE["current"] = info
     _STATE["server"] = srv
     _STATE["pool"] = ThreadPoolExecutor(max_workers=8)
+    store = _registry_store()
+    if store is not None:
+        store.set(f"rpc_worker:{name}", pickle.dumps(tuple(info)))
     return info
 
 
-def _call(to, fn, args, kwargs):
+def _resolve(to, timeout_ms=30000):
     info = _STATE["workers"].get(to)
-    if info is None:
-        raise RuntimeError(f"unknown rpc worker {to}")
+    if info is not None:
+        return info
+    store = _registry_store()
+    if store is not None:
+        blob = store.wait(f"rpc_worker:{to}", timeout_ms=timeout_ms)
+        if blob:
+            info = WorkerInfo(*pickle.loads(blob))
+            _STATE["workers"][to] = info
+            return info
+    raise RuntimeError(f"unknown rpc worker {to}")
+
+
+def _call(to, fn, args, kwargs):
+    info = _resolve(to)
     with socket.create_connection((info.ip, info.port)) as s:
         f = s.makefile("rwb")
         pickle.dump((fn, args or (), kwargs or {}), f)
@@ -74,6 +123,13 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
 
 
 def shutdown():
+    cur, store = _STATE["current"], _STATE["store"]
+    if cur is not None and store is not None:
+        try:  # drop the stale endpoint so peers get 'unknown worker', not a
+              # connection to a dead port
+            store.delete(f"rpc_worker:{cur.name}")
+        except Exception:  # pragma: no cover - store may already be down
+            pass
     if _STATE["server"] is not None:
         _STATE["server"].shutdown()
         _STATE["server"] = None
@@ -82,10 +138,11 @@ def shutdown():
         _STATE["pool"] = None
     _STATE["workers"].clear()
     _STATE["current"] = None
+    _STATE["store"] = None
 
 
 def get_worker_info(name):
-    return _STATE["workers"][name]
+    return _resolve(name)
 
 
 def get_all_worker_infos():

@@ -121,12 +121,16 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
     def f(qa, ka, va, cuq, cuk):
         total_q, total_k = qa.shape[0], ka.shape[0]
+        pos_q = jnp.arange(total_q, dtype=jnp.int32)
+        pos_k = jnp.arange(total_k, dtype=jnp.int32)
         seg_q = jnp.searchsorted(
-            cuq[1:].astype(jnp.int32), jnp.arange(total_q, dtype=jnp.int32),
-            side="right").astype(jnp.int32)
+            cuq[1:].astype(jnp.int32), pos_q, side="right").astype(jnp.int32)
         seg_k = jnp.searchsorted(
-            cuk[1:].astype(jnp.int32), jnp.arange(total_k, dtype=jnp.int32),
-            side="right").astype(jnp.int32)
+            cuk[1:].astype(jnp.int32), pos_k, side="right").astype(jnp.int32)
+        # tokens past cu[-1] (static-shape pad tail) are no one's: tag q with
+        # -1 (output zeroed) and k with -2 (matches nothing, grads stay zero)
+        seg_q = jnp.where(pos_q < cuq[-1].astype(jnp.int32), seg_q, -1)
+        seg_k = jnp.where(pos_k < cuk[-1].astype(jnp.int32), seg_k, -2)
         # global causal ∧ same-segment == per-sequence causal: packed
         # positions are monotone inside each sequence, so the blockwise
         # kernel's global index comparison is exactly per-sequence order

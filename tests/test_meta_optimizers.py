@@ -1,0 +1,169 @@
+"""Comm meta-optimizers (VERDICT r1 item 10): DGC top-k sparsification with
+error feedback + momentum correction, LocalSGD periodic averaging, fp16(bf16)
+allreduce compression.  Reference fleet/meta_optimizers/dgc_optimizer.py,
+localsgd_optimizer.py, fp16_allreduce_optimizer.py."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentumOptimizer, FP16AllReduceOptimizer, LocalSGDOptimizer,
+    average_parameters,
+)
+
+D = 16
+
+
+def _problem(seed=0, n=64):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, D).astype(np.float32)
+    w_true = rng.randn(D, 1).astype(np.float32)
+    Y = X @ w_true + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return X, Y
+
+
+def _train(opt_factory, steps=120, seed=5):
+    X, Y = _problem()
+    paddle.seed(seed)
+    model = nn.Linear(D, 1)
+    opt = opt_factory(model)
+    loss_fn = nn.MSELoss()
+    xb, yb = paddle.to_tensor(X), paddle.to_tensor(Y)
+    for _ in range(steps):
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy())
+
+
+class TestDGC:
+    def test_convergence_parity_with_momentum(self):
+        base = _train(lambda m: paddle.optimizer.Momentum(
+            learning_rate=0.02, momentum=0.9, parameters=m.parameters()))
+        dgc = _train(lambda m: DGCMomentumOptimizer(
+            learning_rate=0.02, momentum=0.9, sparsity=[0.9],
+            rampup_begin_step=0, parameters=m.parameters()))
+        assert dgc < max(base * 3, 0.01), (base, dgc)
+
+    def test_sparsification_and_error_feedback(self):
+        """Each step applies only top-k entries; the rest accumulates in the
+        residual and is applied later — no gradient mass is lost."""
+        paddle.seed(0)
+        lin = nn.Linear(D, 1, bias_attr=False)
+        opt = DGCMomentumOptimizer(
+            learning_rate=1.0, momentum=0.0, sparsity=[0.75],
+            rampup_begin_step=0, parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        g = np.arange(1, D + 1, dtype=np.float32).reshape(D, 1)
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        delta1 = w0 - lin.weight.numpy()
+        # top 25% of 16 entries = 4 applied, 12 zeros
+        applied = (np.abs(delta1) > 1e-8).sum()
+        assert applied == 4, delta1.ravel()
+        # the largest entries moved first
+        assert np.abs(delta1[-4:]).min() > 0
+        # error feedback: residual holds the unapplied mass
+        v = opt._accumulators["dgc_v"][id(lin.weight)]
+        np.testing.assert_allclose(np.asarray(v).ravel()[:12],
+                                   g.ravel()[:12], rtol=1e-6)
+        # feeding zero grads eventually drains the residual into the params
+        for _ in range(6):
+            lin.weight.grad = paddle.to_tensor(np.zeros_like(g))
+            opt.step()
+        total_delta = w0 - lin.weight.numpy()
+        np.testing.assert_allclose(total_delta, g, rtol=1e-5, atol=1e-6)
+
+    def test_rampup_behaves_as_momentum(self):
+        paddle.seed(0)
+        lin = nn.Linear(D, 1, bias_attr=False)
+        opt = DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, sparsity=[0.999],
+            rampup_begin_step=100, parameters=lin.parameters())
+        g = np.ones((D, 1), np.float32)
+        lin.weight.grad = paddle.to_tensor(g)
+        w0 = lin.weight.numpy().copy()
+        opt.step()  # step < rampup_begin: dense momentum update
+        delta = w0 - lin.weight.numpy()
+        np.testing.assert_allclose(delta, 0.1 * g, rtol=1e-5)
+
+
+class TestLocalSGD:
+    def test_stacked_replicas_converge_with_periodic_sync(self):
+        """The real LocalSGD semantics on the SPMD runtime: n replicas as a
+        stacked leading axis take k local steps on disjoint data shards, then
+        average_parameters syncs them; the run converges and the replicas are
+        bit-identical right after each sync."""
+        n_rep, k = 4, 5
+        X, Y = _problem(n=64)
+        Xs = X.reshape(n_rep, -1, D)
+        Ys = Y.reshape(n_rep, -1, 1)
+        w = jnp.zeros((n_rep, D, 1))
+
+        def local_step(w, x, y, lr=0.05):
+            def loss(w1, x1, y1):
+                return jnp.mean((x1 @ w1 - y1) ** 2)
+
+            g = jax.vmap(jax.grad(loss))(w, x, y)  # no cross-replica comm
+            return w - lr * g
+
+        for it in range(30):
+            for _ in range(k):
+                w = local_step(w, jnp.asarray(Xs), jnp.asarray(Ys))
+            w = average_parameters(w)
+            np.testing.assert_allclose(np.asarray(w[0]), np.asarray(w[1]),
+                                       rtol=1e-6)
+        final = float(np.mean((X @ np.asarray(w[0]) - Y) ** 2))
+        assert final < 0.01, final
+
+    def test_wrapper_counts_and_syncs(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(1)
+        model = nn.Linear(D, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=model.parameters())
+        synced = []
+        opt = LocalSGDOptimizer(inner, k_steps=3,
+                                sync_fn=lambda ps: synced.append(len(ps)))
+        X, Y = _problem()
+        loss_fn = nn.MSELoss()
+        for _ in range(7):
+            loss = loss_fn(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert len(synced) == 2  # steps 3 and 6
+
+    def test_strategy_wiring(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.dgc = True
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 4}
+        strategy.fp16_allreduce = True
+        fleet.init(is_collective=True, strategy=strategy)
+        model = nn.Linear(D, 1)
+        mom = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                        parameters=model.parameters())
+        opt = fleet.distributed_optimizer(mom, strategy)
+        assert isinstance(opt, LocalSGDOptimizer)
+        assert opt.k_steps == 4
+        assert isinstance(opt._inner, FP16AllReduceOptimizer)
+        assert isinstance(opt._inner._inner, DGCMomentumOptimizer)
+
+
+class TestFP16AllReduce:
+    def test_convergence_parity(self):
+        base = _train(lambda m: paddle.optimizer.SGD(
+            learning_rate=0.05, parameters=m.parameters()))
+        comp = _train(lambda m: FP16AllReduceOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=m.parameters())))
+        assert comp < max(base * 3, 0.01), (base, comp)

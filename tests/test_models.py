@@ -285,3 +285,22 @@ class TestVarlenFlashAttention:
         gk = k.grad.numpy()
         assert np.abs(gk[:6]).max() > 0
         np.testing.assert_allclose(gk[6:], 0.0, atol=1e-7)
+
+    def test_pad_tail_is_inert(self):
+        """Static-shape packed buffers with total > cu[-1]: pad rows output
+        zero and pad k/v receive zero grads."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(2)
+        lens = [5, 7]
+        pad, H, D = 4, 2, 8
+        tot = sum(lens) + pad
+        qv = rng.standard_normal((tot, H, D)).astype(np.float32)
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        q = paddle.Tensor(qv, stop_gradient=False)
+        out, _ = F.flash_attn_unpadded(
+            q, q, q, paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True)
+        np.testing.assert_allclose(out.numpy()[sum(lens):], 0.0, atol=1e-7)
+        out.sum().backward()
+        np.testing.assert_allclose(q.grad.numpy()[sum(lens):], 0.0, atol=1e-7)

@@ -1,0 +1,120 @@
+"""Parameter server over a real transport (VERDICT r1 item 8): a separate
+server PROCESS owns the tables and serves pull/push over sockets, discovered
+through the native TCPStore; the trainer process trains DistributedEmbedding
+through the service.  Reference the_one_ps.py + ps/service/brpc_ps_client.h."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SERVER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps.the_one_ps import PsServer
+from paddle_tpu.core.native import TCPStore
+
+rpc.init_rpc({name!r})          # publishes (name, ip, port) to PADDLE_MASTER
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+store = TCPStore(host, int(port))
+store.set({ready_key!r}, b"up")
+store.wait("ps_shutdown", timeout_ms=120000)   # serve until told to stop
+"""
+
+
+@pytest.fixture
+def ps_cluster():
+    """TCPStore + two PS server processes; yields (store, env)."""
+    from paddle_tpu.core.native import TCPStore, TCPStoreServer
+
+    srv = TCPStoreServer(port=0)
+    master = f"127.0.0.1:{srv.port}"
+    env = {**os.environ, "PADDLE_MASTER": master, "PYTHONPATH": REPO}
+    procs = []
+    for name in ("ps0", "ps1"):
+        script = _SERVER.format(repo=REPO, name=name,
+                                ready_key=f"ready:{name}")
+        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env))
+    store = TCPStore("127.0.0.1", srv.port)
+    for name in ("ps0", "ps1"):
+        store.wait(f"ready:{name}", timeout_ms=60000)
+    old_master = os.environ.get("PADDLE_MASTER")
+    os.environ["PADDLE_MASTER"] = master
+    try:
+        yield store
+    finally:
+        store.set("ps_shutdown", b"1")
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if old_master is None:
+            os.environ.pop("PADDLE_MASTER", None)
+        else:
+            os.environ["PADDLE_MASTER"] = old_master
+        from paddle_tpu.distributed import rpc
+
+        rpc.shutdown()
+        srv.stop()
+
+
+def test_train_distributed_embedding_through_service(ps_cluster):
+    """Sparse rows live in the server processes; the trainer pulls them, runs
+    the dense model locally, pushes sparse grads back — loss must fall and the
+    rows must be sharded across both servers."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import DistributedEmbedding, PsWorker
+
+    rpc.init_rpc("trainer0")
+    worker = PsWorker(["ps0", "ps1"])
+
+    dim, vocab = 8, 40
+    emb = DistributedEmbedding(worker, "embed", dim, accessor="sgd", lr=0.2)
+    head = nn.Linear(dim, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=head.parameters())
+    loss_fn = nn.MSELoss()
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, vocab, (16, 4)).astype(np.int64)
+    target = (ids_np.sum(1, keepdims=True) / (4 * vocab)).astype(np.float32)
+
+    losses = []
+    for _ in range(40):
+        ids = paddle.to_tensor(ids_np)
+        feats = emb(ids)                       # pull over the wire
+        pooled = feats.sum(axis=1)
+        loss = loss_fn(head(pooled), paddle.to_tensor(target))
+        loss.backward()                        # push hook fires over the wire
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    # the table is really sharded across the two server processes
+    total = worker.table_size("embed")
+    assert total == len(np.unique(ids_np))
+    from paddle_tpu.distributed.ps.the_one_ps import _srv_table_size
+
+    per_server = [
+        rpc.rpc_sync(srv, _srv_table_size, args=("embed",))
+        for srv in ("ps0", "ps1")
+    ]
+    assert all(n > 0 for n in per_server), per_server
+    assert sum(per_server) == total
+
+    # async dense tables over the same service
+    worker.create_dense_table("dense_w", (dim, 1), lr=0.1)
+    w0 = worker.pull_dense("dense_w")
+    fut = worker.push_dense_async("dense_w", np.ones((dim, 1), np.float32))
+    fut.result()
+    w1 = worker.pull_dense("dense_w")
+    np.testing.assert_allclose(w1, w0 - 0.1, rtol=1e-6)
