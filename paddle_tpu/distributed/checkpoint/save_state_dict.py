@@ -27,7 +27,9 @@ def _tensor_shards(arr):
     import jax
 
     if not isinstance(arr, jax.Array) or not hasattr(arr, "addressable_shards"):
-        a = np.asarray(arr)
+        # copy: np.asarray is a no-copy passthrough for numpy inputs, and the
+        # async writer thread must never alias the caller's mutable buffer
+        a = np.array(arr, copy=True)
         yield tuple((0, s) for s in a.shape), a
         return
     seen = set()
@@ -44,12 +46,35 @@ def _tensor_shards(arr):
         yield norm, np.asarray(shard.data)
 
 
+_ASYNC = {"executor": None, "last": None}
+
+
+def _write_blocks(path, meta, blocks):
+    for fname, block in blocks:
+        # bfloat16 & friends: store as raw uint16/uint8 view + dtype tag
+        if block.dtype.kind not in "biufc":
+            np.save(os.path.join(path, fname),
+                    block.view(np.uint8 if block.dtype.itemsize == 1
+                               else np.uint16))
+        else:
+            np.save(os.path.join(path, fname), block)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
+    """``async_save=True`` (reference save_state_dict:145 async path):
+    device→host snapshots are taken synchronously — so the caller may keep
+    training and mutating (donated) buffers immediately — and the file writes
+    run on a background thread.  Returns the Future; ``wait_async_save()``
+    blocks on the most recent one.  Successive async saves serialize on one
+    writer thread, so checkpoints never interleave."""
     from paddle_tpu.tensor.tensor import Tensor
 
     os.makedirs(path, exist_ok=True)
     meta = {}
+    blocks = []
     n_files = 0
     for name, value in state_dict.items():
         arr = value.data if isinstance(value, Tensor) else value
@@ -59,16 +84,27 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         for norm_idx, block in _tensor_shards(arr):
             fname = f"shard_{n_files}.npy"
             n_files += 1
-            # bfloat16 & friends: store as raw uint16/uint8 view + dtype tag
-            if block.dtype.kind not in "biufc":
-                np.save(os.path.join(path, fname),
-                        block.view(np.uint8 if block.dtype.itemsize == 1
-                                   else np.uint16))
-            else:
-                np.save(os.path.join(path, fname), block)
+            blocks.append((fname, block))  # host copy, safe from mutation
             entry["shards"].append(
                 {"index": [list(p) for p in norm_idx], "file": fname}
             )
         meta[name] = entry
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+
+    if not async_save:
+        _write_blocks(path, meta, blocks)
+        return None
+    from concurrent.futures import ThreadPoolExecutor
+
+    if _ASYNC["executor"] is None:
+        _ASYNC["executor"] = ThreadPoolExecutor(max_workers=1)
+    fut = _ASYNC["executor"].submit(_write_blocks, path, meta, blocks)
+    _ASYNC["last"] = fut
+    return fut
+
+
+def wait_async_save():
+    """Block until the most recent async checkpoint has fully landed."""
+    fut = _ASYNC["last"]
+    if fut is not None:
+        fut.result()
+        _ASYNC["last"] = None

@@ -120,6 +120,15 @@ def distributed_optimizer(optimizer, strategy=None):
                 weight_decay=getattr(optimizer, "_weight_decay", None),
                 rescale_grad=getattr(optimizer, "_rescale", 1.0),
             )
+        if getattr(strategy, "lamb", False):
+            from paddle_tpu.optimizer.optimizers import Lamb
+
+            if not isinstance(optimizer, Lamb):
+                optimizer = Lamb(
+                    learning_rate=optimizer._learning_rate,
+                    parameters=optimizer._parameter_list,
+                    grad_clip=optimizer._grad_clip,
+                )
         if getattr(strategy, "fp16_allreduce", False):
             optimizer = _mo.FP16AllReduceOptimizer(optimizer)
         if getattr(strategy, "localsgd", False):

@@ -66,7 +66,29 @@ class DistributedStrategy:
         self.a_sync = False
         self.a_sync_configs = _SubConfig(k_steps=-1)
 
+    # knobs the TPU runtime implements or deliberately delegates; enabling
+    # anything in _UNIMPLEMENTED warns instead of silently no-opping
+    _UNIMPLEMENTED = {
+        "lars": "LARS is not implemented; use optimizer-level Lamb or SGD",
+        "heter_ccl_mode": "heterogeneous NCCL/Gloo mode has no TPU analog",
+        "a_sync": "geo/async PS training is not implemented; the PS service "
+                  "(distributed.ps) supports push_sparse_async instead",
+    }
+    _DELEGATED = {
+        # accepted silently: XLA owns these concerns on TPU
+        "fuse_all_reduce_ops", "fuse_grad_size_in_MB", "nccl_comm_num",
+        "find_unused_parameters",
+    }
+
     def __setattr__(self, key, value):
+        if value is True and key in self._UNIMPLEMENTED:
+            import warnings
+
+            warnings.warn(
+                f"DistributedStrategy.{key} is accepted for API parity but "
+                f"NOT implemented on this runtime: {self._UNIMPLEMENTED[key]}",
+                stacklevel=2,
+            )
         if key == "hybrid_configs" and isinstance(value, dict) and not isinstance(value, _SubConfig):
             merged = _SubConfig({k: (dict(v) if isinstance(v, dict) else
                                      (list(v) if isinstance(v, list) else v))

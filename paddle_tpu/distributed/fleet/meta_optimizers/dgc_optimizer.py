@@ -24,8 +24,10 @@ __all__ = ["DGCMomentumOptimizer"]
 
 
 class DGCMomentumOptimizer(Momentum):
-    # reference accumulator names: _dgc_u_ (velocity), _dgc_v_ (residual)
-    _accum_names = ("velocity", "dgc_u", "dgc_v")
+    # reference accumulator names: _dgc_u_ (velocity), _dgc_v_ (residual);
+    # dgc_u IS the velocity throughout (rampup included) so momentum carries
+    # across the rampup boundary exactly as in the reference
+    _accum_names = ("dgc_u", "dgc_v")
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
@@ -41,26 +43,32 @@ class DGCMomentumOptimizer(Momentum):
         self._sparsity = tuple(sparsity) if isinstance(
             sparsity, (list, tuple)) else (float(sparsity),)
 
-    def _current_sparsity(self, step):
+    def _current_sparsity(self, steps_into_rampup):
         """Reference rampup: walk the sparsity schedule one entry per
         rampup_step steps after rampup begins, clamping at the last."""
-        idx = min(
-            max(int(step) - self._rampup_begin_step, 0) // self._rampup_step,
-            len(self._sparsity) - 1,
-        )
+        idx = min(steps_into_rampup // self._rampup_step,
+                  len(self._sparsity) - 1)
         return float(self._sparsity[idx])
 
     def _update(self, p, g, state, lr):
-        step = int(self._global_step)
-        if step < self._rampup_begin_step or g.ndim == 0:
-            new_p, st = super()._update(p, g, state, lr)
-            st.setdefault("dgc_u", state["dgc_u"])
-            st.setdefault("dgc_v", state["dgc_v"])
-            return new_p, st
+        # _global_step is incremented before _update: the k-th call sees k
+        steps_done = int(self._global_step) - 1
+        if steps_done < self._rampup_begin_step or g.ndim == 0:
+            # dense momentum THROUGH the dgc_u velocity, so rampup momentum
+            # carries into the compressed phase
+            g = g * self._rescale
+            u = self._momentum * state["dgc_u"] + g
+            if self._use_nesterov:
+                upd = g + self._momentum * u
+            else:
+                upd = u
+            return (p.data - lr * upd.astype(p.data.dtype),
+                    {"dgc_u": u, "dgc_v": state["dgc_v"]})
 
         g = g * self._rescale
         m = self._momentum
-        sparsity = self._current_sparsity(step)
+        sparsity = self._current_sparsity(
+            steps_done - self._rampup_begin_step)
         n = g.size
         k = max(int(round(n * (1.0 - sparsity))), 1)
 
@@ -81,5 +89,4 @@ class DGCMomentumOptimizer(Momentum):
         else:
             upd = encoded
         new_p = p.data - lr * upd.astype(p.data.dtype)
-        return new_p, {"velocity": state["velocity"],
-                       "dgc_u": u_new, "dgc_v": v_new}
+        return new_p, {"dgc_u": u_new, "dgc_v": v_new}

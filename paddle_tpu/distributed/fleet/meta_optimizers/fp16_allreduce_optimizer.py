@@ -12,6 +12,8 @@ because the sum of bf16-rounded terms is what the reference's fp16 allreduce
 produces."""
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 
 __all__ = ["FP16AllReduceOptimizer"]
@@ -21,13 +23,31 @@ class FP16AllReduceOptimizer:
     def __init__(self, inner, dtype="bfloat16"):
         self._inner = inner
         self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
-        inner_update = inner._update
-
-        def compressed_update(p, g, state, lr):
-            g16 = g.astype(self._dtype).astype(g.dtype)
-            return inner_update(p, g16, state, lr)
-
-        inner._update = compressed_update
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+    @contextlib.contextmanager
+    def _compressed(self):
+        """Swap the inner update for its grad-compressed form only for the
+        duration of this wrapper's call — constructing (or discarding) the
+        wrapper never mutates the wrapped optimizer."""
+        inner, dt = self._inner, self._dtype
+        orig = inner._update
+
+        def compressed_update(p, g, state, lr):
+            return orig(p, g.astype(dt).astype(g.dtype), state, lr)
+
+        inner._update = compressed_update
+        try:
+            yield
+        finally:
+            inner._update = orig
+
+    def step(self):
+        with self._compressed():
+            self._inner.step()
+
+    def functional_update(self, params, grads, states, lr):
+        with self._compressed():
+            return self._inner.functional_update(params, grads, states, lr)

@@ -30,12 +30,18 @@ def _srv_table_size(name):
 
 
 def _srv_save(name, path):
-    _SERVER_TABLES[name].save(path)
+    t = _SERVER_TABLES.get(name)
+    if t is None:  # dense tables live on server 0 only
+        return False
+    t.save(path)
     return True
 
 
 def _srv_load(name, path):
-    _SERVER_TABLES[name].load(path)
+    t = _SERVER_TABLES.get(name)
+    if t is None:
+        return False
+    t.load(path)
     return True
 
 
@@ -127,6 +133,8 @@ class PsWorker:
 
     def _push_sparse_futs(self, name, ids, grads):
         ids = np.asarray(ids, np.int64).reshape(-1)
+        if len(ids) == 0:
+            return []
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         if len(self.servers) == 1:
             return [self._rpc.rpc_async(self.server, _srv_push_sparse,

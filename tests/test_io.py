@@ -228,6 +228,27 @@ class TestDistributedCheckpoint:
         files = [f for f in os.listdir(path) if f.endswith(".npy")]
         assert len(files) == 1  # 8 replicated device shards -> 1 file
 
+    def test_async_save_snapshots_before_mutation(self, tmp_path):
+        """async_save returns a future and the checkpoint reflects the values
+        AT CALL TIME even if the arrays are immediately overwritten."""
+        import numpy as np
+
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict, save_state_dict, wait_async_save,
+        )
+
+        w = paddle.to_tensor(np.full((16, 16), 7.0, np.float32))
+        path = str(tmp_path / "actkpt")
+        fut = save_state_dict({"w": w}, path, async_save=True)
+        # mutate right away: the snapshot must not see this
+        w._data = w.data * 0
+        assert fut is not None
+        wait_async_save()
+        dst = paddle.to_tensor(np.zeros((16, 16), np.float32))
+        load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(dst.numpy(),
+                                      np.full((16, 16), 7.0, np.float32))
+
 
 class TestProcessWorkers:
     """Multiprocess DataLoader over the native shm ring (reference
