@@ -134,6 +134,17 @@ class Predictor:
                 self._exported = _jexport.deserialize(bytearray(f.read()))
         self._inputs = {f"x{i}": None for i in range(len(self._specs))}
         self._outputs = {}
+        # the analysis/optimization step: with ir_optim on (default) the
+        # deserialized computation is wrapped in jax.jit, so repeated run()
+        # calls hit one compiled executable (XLA is the pass pipeline);
+        # switching it off executes the artifact unoptimized per call —
+        # the reference's switch_ir_optim semantics at the StableHLO level
+        self._call = None
+        if self._exported is not None:
+            import jax as _jax
+
+            call = self._exported.call
+            self._call = _jax.jit(call) if config._ir_optim else call
 
     def get_input_names(self):
         return list(self._inputs.keys())
@@ -154,7 +165,7 @@ class Predictor:
             arrs = [self._inputs[k] for k in self.get_input_names()]
         if self._exported is None:
             raise RuntimeError("no executable artifact (.jaxexport) next to the model")
-        out = self._exported.call(*[jnp.asarray(a) for a in arrs])
+        out = self._call(*[jnp.asarray(a) for a in arrs])
         leaves = out if isinstance(out, (list, tuple)) else [out]
         self._outputs.clear()
         res = []
