@@ -80,3 +80,201 @@ def _mean(x, axis=None, keepdim=False):
         return total / cnt
 
     return apply("decomp_mean", f, x)
+
+
+@register_decomp("rsqrt")
+def _rsqrt(x):
+    return apply("decomp_rsqrt", lambda a: 1.0 / jnp.sqrt(a), x)
+
+
+@register_decomp("pow")
+def _pow(x, y):
+    """Integer exponents via repeated squaring (exact, sign-correct);
+    non-integer via exp(y·log|a|) with the nan domain the real op has —
+    the reference rules.py pow decomposition's case split."""
+    def static_int(a, n):
+        if n == 0:
+            return jnp.ones_like(a)
+        result = jnp.ones_like(a)
+        base, e = a, abs(n)
+        while e:
+            if e & 1:
+                result = result * base
+            base, e = base * base, e >> 1
+        return result if n > 0 else 1.0 / result
+
+    def traced(a, b):
+        # sign-corrected |a|^b for integer-valued b; the real op's nan
+        # domain (negative base, fractional exponent) otherwise
+        mag = jnp.exp(b * jnp.log(jnp.abs(a)))
+        odd = jnp.mod(b, 2.0) != 0.0
+        signed = jnp.where((a < 0) & odd, -mag, mag)
+        int_exp = jnp.floor(b) == b
+        zero_base = jnp.where(b == 0.0, jnp.ones_like(a),
+                              jnp.where(b > 0, jnp.zeros_like(a),
+                                        jnp.full_like(a, jnp.inf)))
+        res = jnp.where(int_exp, signed, jnp.exp(b * jnp.log(a)))
+        return jnp.where(a == 0, zero_base, res)
+
+    if isinstance(y, Tensor):
+        return apply("decomp_pow", traced, x, y)
+    if float(y) == int(float(y)):
+        return apply("decomp_pow",
+                     lambda a: static_int(a, int(float(y))), x)
+    return apply("decomp_pow", lambda a: jnp.exp(float(y) * jnp.log(a)), x)
+
+
+@register_decomp("sigmoid")
+def _sigmoid(x):
+    return apply("decomp_sigmoid", lambda a: 1.0 / (1.0 + jnp.exp(-a)), x)
+
+
+@register_decomp("silu")
+def _silu(x):
+    return apply("decomp_silu", lambda a: a / (1.0 + jnp.exp(-a)), x)
+
+
+@register_decomp("swiglu")
+def _swiglu(x, y=None):
+    def f(a, *rest):
+        if rest:
+            g, u = a, rest[0]
+        else:
+            g, u = jnp.split(a, 2, axis=-1)
+        return (g / (1.0 + jnp.exp(-g))) * u
+
+    args = [x] + ([y] if y is not None else [])
+    return apply("decomp_swiglu", f, *args)
+
+
+@register_decomp("relu6")
+def _relu6(x):
+    return apply("decomp_relu6",
+                 lambda a: jnp.minimum(jnp.maximum(a, 0.0), 6.0), x)
+
+
+@register_decomp("hardswish")
+def _hardswish(x):
+    return apply(
+        "decomp_hardswish",
+        lambda a: a * jnp.minimum(jnp.maximum(a + 3.0, 0.0), 6.0) / 6.0, x)
+
+
+@register_decomp("softsign")
+def _softsign(x):
+    return apply("decomp_softsign", lambda a: a / (1.0 + jnp.abs(a)), x)
+
+
+@register_decomp("rms_norm")
+def _rms_norm(x, weight=None, epsilon=1e-6):
+    def f(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), -1, keepdims=True)
+        out = (a.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(a.dtype)
+        return out * w[0] if w else out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return apply("decomp_rms_norm", f, *args)
+
+
+@register_decomp("batch_norm")
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                epsilon=1e-5, data_format="NCHW"):
+    """Inference-mode batch norm from primitives (reference rules.py
+    batch_norm composite; training-mode statistics live in nn.BatchNorm)."""
+    def f(a, mean, var, *wb):
+        shape = [1, -1] + [1] * (a.ndim - 2) if data_format == "NCHW" \
+            else [1] * (a.ndim - 1) + [-1]
+        out = (a - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, running_mean, running_var] + [
+        t for t in (weight, bias) if t is not None]
+    return apply("decomp_batch_norm", f, *args)
+
+
+@register_decomp("instance_norm")
+def _instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = a.mean(axes, keepdims=True)
+        var = ((a - mean) ** 2).mean(axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("decomp_instance_norm", f, *args)
+
+
+@register_decomp("group_norm")
+def _group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = a.reshape((n, num_groups, c // num_groups) + a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = g.mean(axes, keepdims=True)
+        var = ((g - mean) ** 2).mean(axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("decomp_group_norm", f, *args)
+
+
+@register_decomp("bmm")
+def _bmm(x, y):
+    return apply("decomp_bmm",
+                 lambda a, b: jnp.einsum("bij,bjk->bik", a, b), x, y)
+
+
+@register_decomp("huber_loss")
+def _huber_loss(x, label, delta=1.0):
+    def f(a, t):
+        d = a - t
+        ad = jnp.abs(d)
+        return jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * (ad - 0.5 * delta))
+
+    return apply("decomp_huber_loss", f, x, label)
+
+
+@register_decomp("squared_l2_norm")
+def _squared_l2_norm(x):
+    return apply("decomp_squared_l2_norm",
+                 lambda a: jnp.sum(jnp.square(a)).reshape(1), x)
+
+
+@register_decomp("stack")
+def _stack(xs, axis=0):
+    return apply("decomp_stack",
+                 lambda *arrs: jnp.concatenate(
+                     [jnp.expand_dims(a, axis) for a in arrs], axis), *xs)
+
+
+@register_decomp("flatten")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    def f(a):
+        stop = stop_axis % a.ndim
+        shape = (a.shape[:start_axis] + (-1,) + a.shape[stop + 1:])
+        return a.reshape(shape)
+
+    return apply("decomp_flatten", f, x)
