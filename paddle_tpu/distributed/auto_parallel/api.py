@@ -290,11 +290,16 @@ class DistModel:
         return self.network.set_state_dict(state_dict)
 
     def _build_train_fn(self):
-        from paddle_tpu.static.functionalize import build_train_step
+        from paddle_tpu.static.functionalize import (
+            amp_args_from_strategy,
+            build_train_step,
+        )
 
+        amp_level, amp_dtype = amp_args_from_strategy(self._strategy)
         self._train_fn = build_train_step(
             self.network, self._loss, self._optimizer,
             recompute=self._strategy.recompute.enable,
+            amp_level=amp_level, amp_dtype=amp_dtype,
         )
         return self._train_fn
 

@@ -32,10 +32,14 @@ class Engine:
         from paddle_tpu.static.functionalize import build_eval_fn, build_train_step
 
         if mode == "train" and self._train_step is None:
+            from paddle_tpu.static.functionalize import amp_args_from_strategy
+
             recompute = bool(getattr(getattr(self._strategy, "recompute", None),
                                      "enable", False))
+            amp_level, amp_dtype = amp_args_from_strategy(self._strategy)
             self._train_step = build_train_step(
-                self._model, self._loss, self._optimizer, recompute=recompute)
+                self._model, self._loss, self._optimizer, recompute=recompute,
+                amp_level=amp_level, amp_dtype=amp_dtype)
         elif mode == "eval" and self._eval_fn is None:
             self._eval_fn = build_eval_fn(self._model, self._loss)
         elif mode == "predict" and self._pred_fn is None:

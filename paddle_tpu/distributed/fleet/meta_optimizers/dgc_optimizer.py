@@ -16,6 +16,7 @@ in the residual.  Before ``rampup_begin_step`` it behaves as plain momentum,
 matching the reference's rampup."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.optimizer.optimizers import Momentum
@@ -77,15 +78,20 @@ class DGCMomentumOptimizer(Momentum):
         # error feedback: residual carries everything not yet communicated
         v = state["dgc_v"] + u
 
+        # strict top-k (lax.top_k indices): exactly k entries communicated
+        # even when |v| has ties at the threshold
         flat = v.reshape(-1)
-        thresh = jnp.sort(jnp.abs(flat))[n - k]
-        mask = (jnp.abs(v) >= thresh).astype(v.dtype)
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        mask = jnp.zeros_like(flat).at[idx].set(1.0).reshape(v.shape)
         encoded = v * mask          # what the allreduce would carry
         v_new = v * (1.0 - mask)    # the residual stays local
         u_new = u * (1.0 - mask)    # masked velocity (reference dgc_op)
 
         if self._use_nesterov:
-            upd = encoded + m * encoded
+            # dense nesterov is g + m*u; the compressed analog adds the
+            # momentum lookahead from the velocity at the communicated
+            # coordinates (encoded already folds the accumulated g-mass)
+            upd = encoded + m * (u * mask)
         else:
             upd = encoded
         new_p = p.data - lr * upd.astype(p.data.dtype)

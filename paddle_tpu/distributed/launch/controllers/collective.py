@@ -62,13 +62,48 @@ class CollectiveController:
         self._server = TCPStoreServer(port=0)
         return "127.0.0.1", self._server.port
 
+    def _node_hosts(self, host, port):
+        """Per-node reachable host for every node's worker endpoints.
+
+        Single-node keeps the master host.  Multi-node: each controller
+        derives its own reachable IP (UDP-connect probe toward the master,
+        no packet sent) and publishes it through the rendezvous store, so
+        PADDLE_TRAINER_ENDPOINTS/PADDLE_CURRENT_ENDPOINT carry real
+        addresses instead of endpoints fabricated on the master host."""
+        if self.nnodes == 1:
+            return [host]
+        import socket
+
+        from paddle_tpu.core.native import TCPStore
+
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            probe.connect((host, port))
+            self_host = probe.getsockname()[0]
+        store = TCPStore(host, port)
+        store.set(f"launch/{self.job_id}/node/{self.node_rank}/host", self_host)
+        hosts = []
+        for n in range(self.nnodes):
+            try:
+                hosts.append(
+                    store.wait(f"launch/{self.job_id}/node/{n}/host",
+                               timeout_ms=300_000).decode())
+            except TimeoutError:
+                raise RuntimeError(
+                    f"launch rendezvous: node {n} of {self.nnodes} never "
+                    f"joined within 300s (job {self.job_id}, master "
+                    f"{host}:{port}) — check that every node was started "
+                    "with the same --master and --nnodes"
+                ) from None
+        return hosts
+
     # ---------------------------------------------------------------- workers
-    def _worker_env(self, local_rank, host, port):
+    def _worker_env(self, local_rank, host, port, node_hosts):
         world = self.nproc * self.nnodes
         rank = self.node_rank * self.nproc + local_rank
         endpoints = ",".join(
-            f"{host}:{port + 1 + r}" for r in range(world)
+            f"{node_hosts[r // self.nproc]}:{port + 1 + r}" for r in range(world)
         )
+        self_host = node_hosts[self.node_rank]
         env = dict(self.base_env)
         env.update({
             # port map: TCPStore rendezvous on `port`, worker endpoints on
@@ -83,14 +118,14 @@ class CollectiveController:
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_NNODES": str(self.nnodes),
             "PADDLE_JOB_ID": str(self.job_id),
-            "PADDLE_CURRENT_ENDPOINT": f"{host}:{port + 1 + rank}",
+            "PADDLE_CURRENT_ENDPOINT": f"{self_host}:{port + 1 + rank}",
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_RESTART_COUNT": str(self.restart_count),
             "FLAGS_selected_devices": str(local_rank),
         })
         return env
 
-    def _spawn_all(self, host, port):
+    def _spawn_all(self, host, port, node_hosts):
         self.procs = []
         for lr in range(self.nproc):
             if self.log_dir:
@@ -103,7 +138,7 @@ class CollectiveController:
                 out = err = None
             p = subprocess.Popen(
                 [sys.executable, "-u", self.script] + self.script_args,
-                env=self._worker_env(lr, host, port),
+                env=self._worker_env(lr, host, port, node_hosts),
                 stdout=out, stderr=err,
             )
             self.procs.append(p)
@@ -128,7 +163,8 @@ class CollectiveController:
         """Spawn, watch, restart-on-failure (the reference controller's
         watch() loop: CollectiveElasticController.run + pod watcher)."""
         host, port = self._ensure_master()
-        self._spawn_all(host, port)
+        node_hosts = self._node_hosts(host, port)
+        self._spawn_all(host, port, node_hosts)
         try:
             while True:
                 states = [p.poll() for p in self.procs]
@@ -142,7 +178,7 @@ class CollectiveController:
                     if self.restart_count < self.max_restarts:
                         self.restart_count += 1
                         self._kill_all()
-                        self._spawn_all(host, port)
+                        self._spawn_all(host, port, node_hosts)
                     else:
                         self._kill_all()
                         return failed[0][1]

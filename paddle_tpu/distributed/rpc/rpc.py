@@ -7,7 +7,14 @@ TCP socket server thread (the brpc analog, stdlib-only).  Worker discovery is
 cross-process: when ``PADDLE_MASTER`` points at the native TCPStore
 (core/native), ``init_rpc`` publishes this worker's (name, rank, ip, port)
 there and ``rpc_sync``/``get_worker_info`` resolve unknown names through it —
-the gethostbyname+master rendezvous of the reference's brpc agent."""
+the gethostbyname+master rendezvous of the reference's brpc agent.
+
+Trust boundary: requests are pickled callables, i.e. code execution by
+design (same model as the reference's brpc agent, which assumes a private
+cluster network).  Mitigations here: the server binds only the advertised
+interface (loopback without PADDLE_MASTER), and in cross-process mode every
+request must present a per-job token distributed through the TCPStore before
+anything is unpickled.  Do NOT expose the port beyond the job's network."""
 from __future__ import annotations
 
 import pickle
@@ -20,16 +27,17 @@ from concurrent.futures import Future, ThreadPoolExecutor
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
 _STATE = {"workers": {}, "current": None, "server": None, "pool": None,
-          "store": None}
+          "store": None, "token": ""}
 
 
-def _registry_store():
-    """TCPStore client for cross-process worker discovery (PADDLE_MASTER)."""
+def _registry_store(master=None):
+    """TCPStore client for cross-process worker discovery (the
+    ``master_endpoint`` argument, falling back to ``PADDLE_MASTER``)."""
     if _STATE["store"] is not None:
         return _STATE["store"]
     import os
 
-    master = os.environ.get("PADDLE_MASTER")
+    master = master or os.environ.get("PADDLE_MASTER")
     if not master:
         return None
     from paddle_tpu.core.native import TCPStore
@@ -41,6 +49,14 @@ def _registry_store():
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
+        # authenticate BEFORE unpickling: the first line is the job token
+        # (empty in local/loopback mode)
+        import hmac
+
+        expected = _STATE.get("token") or ""
+        supplied = self.rfile.readline().strip().decode("utf-8", "replace")
+        if expected and not hmac.compare_digest(supplied, expected):
+            return  # drop unauthenticated connections silently
         data = pickle.load(self.rfile)
         fn, args, kwargs = data
         try:
@@ -57,20 +73,33 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
     world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
     master = master_endpoint or os.environ.get("PADDLE_MASTER")
-    # cross-host: bind all interfaces and advertise the IP the master route
-    # uses (the gethostbyname analog); single host stays on loopback
+    # cross-host: bind + advertise the IP the master route uses (the
+    # gethostbyname analog) — only that interface, not 0.0.0.0; single host
+    # stays on loopback
     host_ip = "127.0.0.1"
-    bind = "127.0.0.1"
     if master:
         try:
             mhost, mport = master.rsplit(":", 1)
             with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
                 probe.connect((mhost, int(mport)))
                 host_ip = probe.getsockname()[0]
-            bind = "0.0.0.0"
         except OSError:
             pass
-    srv = socketserver.ThreadingTCPServer((bind, 0), _Handler)
+    # per-job auth token, agreed through the store BEFORE the server accepts
+    # connections (rank 0 mints it, everyone else waits for it)
+    store = _registry_store(master)
+    if store is not None:
+        # first initializer mints the token (atomic claim via add — ranks
+        # are not unique across ps/trainer roles), everyone else waits
+        if store.add("rpc:job_token_claim", 1) == 1:
+            import secrets
+
+            token = secrets.token_hex(16)
+            store.set("rpc:job_token", token)
+        else:
+            token = store.wait("rpc:job_token").decode()
+        _STATE["token"] = token
+    srv = socketserver.ThreadingTCPServer((host_ip, 0), _Handler)
     srv.daemon_threads = True
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -79,7 +108,6 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _STATE["current"] = info
     _STATE["server"] = srv
     _STATE["pool"] = ThreadPoolExecutor(max_workers=8)
-    store = _registry_store()
     if store is not None:
         store.set(f"rpc_worker:{name}", pickle.dumps(tuple(info)))
     return info
@@ -103,6 +131,7 @@ def _call(to, fn, args, kwargs):
     info = _resolve(to)
     with socket.create_connection((info.ip, info.port)) as s:
         f = s.makefile("rwb")
+        f.write((_STATE.get("token") or "").encode() + b"\n")
         pickle.dump((fn, args or (), kwargs or {}), f)
         f.flush()
         status, res = pickle.load(f)

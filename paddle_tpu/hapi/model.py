@@ -42,13 +42,31 @@ class Model:
         self._metrics = _to_list(metrics)
         self._train_step = None
         self._eval_fn = None
+        # reference Model.prepare amp_configs: "O1"/"O2" or a dict with
+        # level/dtype (+ GradScaler knobs the TPU bf16 path doesn't need)
+        self._amp_level, self._amp_dtype = None, "bfloat16"
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
+            else:
+                raise TypeError(
+                    "amp_configs must be a level string ('O1'/'O2') or a "
+                    f"dict, got {type(amp_configs).__name__}"
+                )
+            if self._amp_level == "O0":
+                self._amp_level = None
 
     def _ensure_train_step(self):
         if self._train_step is None:
             from paddle_tpu.static.functionalize import build_train_step
 
             self._train_step = build_train_step(
-                self.network, self._loss, self._optimizer
+                self.network, self._loss, self._optimizer,
+                amp_level=getattr(self, "_amp_level", None),
+                amp_dtype=getattr(self, "_amp_dtype", "bfloat16"),
             )
         return self._train_step
 

@@ -64,9 +64,6 @@ class Config:
     def disable_gpu(self):
         self._device = "cpu"
 
-    def enable_xpu(self, *a, **kw):
-        self._device = "xpu"
-
     def enable_custom_device(self, device_type, device_id=0):
         self._device, self._device_id = device_type, device_id
 
@@ -82,8 +79,46 @@ class Config:
     def set_cpu_math_library_num_threads(self, n):
         self._num_threads = n
 
+    # -- engine knobs with no TPU analog: warn, don't silently accept --------
+    # (same honesty standard as DistributedStrategy: a knob either works or
+    #  tells the user it does nothing here)
+    def _warn_unsupported(self, knob, why):
+        import warnings
+
+        warnings.warn(
+            f"Config.{knob} has no effect on the TPU backend ({why}); "
+            "XLA is the optimization pipeline here",
+            UserWarning, stacklevel=3,
+        )
+
     def enable_tensorrt_engine(self, *a, **kw):
-        pass  # TensorRT is CUDA-only; XLA compiles the graph on TPU
+        self._warn_unsupported("enable_tensorrt_engine", "TensorRT is CUDA-only")
+
+    def enable_tuned_tensorrt_dynamic_shape(self, *a, **kw):
+        self._warn_unsupported(
+            "enable_tuned_tensorrt_dynamic_shape", "TensorRT is CUDA-only")
+
+    def set_trt_dynamic_shape_info(self, *a, **kw):
+        self._warn_unsupported(
+            "set_trt_dynamic_shape_info", "TensorRT is CUDA-only")
+
+    def enable_mkldnn(self, *a, **kw):
+        self._warn_unsupported("enable_mkldnn", "oneDNN is a CPU library")
+
+    def enable_mkldnn_bfloat16(self, *a, **kw):
+        self._warn_unsupported("enable_mkldnn_bfloat16", "oneDNN is a CPU library")
+
+    def enable_mkldnn_int8(self, *a, **kw):
+        self._warn_unsupported("enable_mkldnn_int8", "oneDNN is a CPU library")
+
+    def enable_lite_engine(self, *a, **kw):
+        self._warn_unsupported("enable_lite_engine", "Paddle-Lite targets mobile")
+
+    def enable_xpu(self, *a, **kw):
+        self._warn_unsupported("enable_xpu", "Kunlun XPU runtime not present")
+
+    def exp_disable_tensorrt_ops(self, *a, **kw):
+        self._warn_unsupported("exp_disable_tensorrt_ops", "TensorRT is CUDA-only")
 
     def summary(self):
         return f"Config(model={self._model_path}, device={self._device})"

@@ -26,12 +26,22 @@ from paddle_tpu.tensor.tensor import Tensor
 __all__ = ["QuantizedLinear", "QuantizedConv2D", "quantize_to_int8"]
 
 
-def _as_scale(s, default=1.0):
+def _as_scale(s, default=1.0, allow_channelwise=False, what="scale"):
+    """Scalar scales come back as python floats; per-channel weight scales
+    (the common conv convention) as a 1-D fp32 array when allowed."""
     if s is None:
         return float(default)
     if isinstance(s, Tensor):
         s = s.data
-    return float(jnp.asarray(s))
+    arr = jnp.asarray(s)
+    if arr.size == 1:
+        return float(arr.reshape(()))
+    if not allow_channelwise:
+        raise NotImplementedError(
+            f"per-channel {what} is not supported (got shape "
+            f"{tuple(arr.shape)}); only weight scales may be per-channel"
+        )
+    return arr.reshape(-1).astype(jnp.float32)
 
 
 def quantize_to_int8(w, scale):
@@ -46,8 +56,18 @@ class QuantizedLinear(Layer):
 
     def __init__(self, linear, w_scale, act_scale):
         super().__init__()
-        self._w_scale = _as_scale(w_scale)
-        self._act_scale = _as_scale(act_scale)
+        # per-channel weight scale = one scale per OUTPUT feature (column of
+        # the (in, out) weight); broadcasts over the last dim in both
+        # quantize and dequantize
+        self._w_scale = _as_scale(w_scale, allow_channelwise=True,
+                                  what="weight scale")
+        self._act_scale = _as_scale(act_scale, what="activation scale")
+        ws = self._w_scale
+        if not isinstance(ws, float) and ws.shape[0] != linear.weight.shape[1]:
+            raise ValueError(
+                f"per-channel weight scale has {ws.shape[0]} entries but the "
+                f"layer has {linear.weight.shape[1]} output features"
+            )
         self.weight_int8 = Tensor(
             quantize_to_int8(linear.weight, self._w_scale))
         self.bias = getattr(linear, "bias", None)
@@ -81,9 +101,20 @@ class QuantizedConv2D(Layer):
 
     def __init__(self, conv, w_scale, act_scale):
         super().__init__()
-        self._w_scale = _as_scale(w_scale)
-        self._act_scale = _as_scale(act_scale)
-        self.weight_int8 = Tensor(quantize_to_int8(conv.weight, self._w_scale))
+        # per-channel weight scale = one scale per OUTPUT channel (dim 0 of
+        # the OIHW weight)
+        self._w_scale = _as_scale(w_scale, allow_channelwise=True,
+                                  what="weight scale")
+        self._act_scale = _as_scale(act_scale, what="activation scale")
+        ws = self._w_scale
+        if not isinstance(ws, float):
+            if ws.shape[0] != conv.weight.shape[0]:
+                raise ValueError(
+                    f"per-channel weight scale has {ws.shape[0]} entries but "
+                    f"the conv has {conv.weight.shape[0]} output channels"
+                )
+            ws = ws.reshape(-1, 1, 1, 1)  # OIHW broadcast
+        self.weight_int8 = Tensor(quantize_to_int8(conv.weight, ws))
         self.bias = getattr(conv, "bias", None)
         self._stride = conv._stride
         self._padding = conv._padding
@@ -105,7 +136,10 @@ class QuantizedConv2D(Layer):
                 bias=None, stride=stride, padding=padding,
                 dilation=dilation, groups=groups, data_format=data_format,
             ).data
-            out = acc * (sx * sw)
+            sw_b = sw if isinstance(sw, float) else (
+                sw.reshape(1, -1, 1, 1) if data_format == "NCHW"
+                else sw.reshape(1, 1, 1, -1))
+            out = acc * (sx * sw_b)
             if b:
                 cshape = ((1, -1, 1, 1) if data_format == "NCHW"
                           else (1, 1, 1, -1))

@@ -21,18 +21,51 @@ from paddle_tpu.tensor.tensor import Tensor
 __all__ = ["TrainStep", "build_train_step", "build_eval_fn"]
 
 
+class _ClipStub:
+    """Parameter stand-in handed to grad-clip callables inside the traced
+    step — carries the attributes clip implementations consult (need_clip,
+    plus name/shape/dtype for user subclasses that branch on them)."""
+
+    __slots__ = ("need_clip", "name", "shape", "dtype")
+
+    def __init__(self, need_clip, name="", shape=None, dtype=None):
+        self.need_clip = need_clip
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
 class TrainStep:
     """Callable ``step(*inputs, label) -> loss``.  Holds the functional state
     (params/buffers/accumulators) and keeps the Layer's Parameters pointed at the
     latest arrays after every step (reference users read ``layer.state_dict()``
     mid-training)."""
 
-    def __init__(self, network, loss_fn, optimizer, recompute=False, donate=True):
+    def __init__(self, network, loss_fn, optimizer, recompute=False, donate=True,
+                 amp_level=None, amp_dtype="bfloat16"):
         self._network = network
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._recompute = recompute
+        # amp_level "O1"/"O2" wraps the traced forward in amp.auto_cast — the
+        # per-op white/black-list casting at the apply() chokepoint happens at
+        # trace time, so the compiled program runs white-list matmuls in
+        # amp_dtype exactly like eager autocast.
+        self._amp_level = None if amp_level in (None, "O0") else amp_level
+        self._amp_dtype = amp_dtype
         self._params, self._buffers = network.functional_state()
+        # Mirror the eager optimizer's params_grads construction
+        # (optimizer.py:122): frozen params never enter clipping or updates.
+        self._trainable = {
+            n: (not getattr(p, "stop_gradient", False)
+                and getattr(p, "trainable", True))
+            for n, p in network.named_parameters()
+        }
+        self._clip_stubs = {
+            n: _ClipStub(bool(getattr(p, "need_clip", True)), name=n,
+                         shape=list(p.shape), dtype=p.dtype)
+            for n, p in network.named_parameters()
+        }
         # initial param layouts (TP etc.) — ZeRO constraints compose with
         # these instead of clobbering them
         from jax.sharding import NamedSharding as _NS
@@ -55,10 +88,20 @@ class TrainStep:
     def _step_fn(self, params, buffers, states, lr, step, *datas):
         network, loss_fn, optimizer = self._network, self._loss_fn, self._optimizer
 
+        import contextlib
+
+        if self._amp_level is not None:
+            from paddle_tpu.amp.auto_cast import auto_cast as _auto_cast
+
+            amp_ctx = lambda: _auto_cast(level=self._amp_level,
+                                         dtype=self._amp_dtype)
+        else:
+            amp_ctx = contextlib.nullcontext
+
         def loss_of(ps):
             # the eager tape is bypassed (no_grad): ops execute their jnp bodies
             # directly as traced ops; jax.value_and_grad supplies the gradients.
-            with _engine.no_grad():
+            with _engine.no_grad(), amp_ctx():
                 inputs = [Tensor(d) for d in datas]
                 if loss_fn is not None:
                     out = network.functional_call(ps, buffers, *inputs[:-1])
@@ -71,13 +114,27 @@ class TrainStep:
         fwd = jax.checkpoint(loss_of) if self._recompute else loss_of
         lval, grads = jax.value_and_grad(fwd)(params)
 
+        # Frozen params get None grads (functional_update passes them through
+        # untouched; XLA DCEs their backward computation) — same exclusion the
+        # eager path applies when building params_grads.
+        grads = {
+            k: (g if self._trainable.get(k, True) else None)
+            for k, g in grads.items()
+        }
+
+        # Grad clipping: run the clip object's OWN _dygraph_clip inside the
+        # trace (every built-in clip is pure jnp, hence traceable) so the
+        # compiled step has identical semantics to eager for ClipGradByValue
+        # (elementwise), ClipGradByNorm (per-tensor), ClipGradByGlobalNorm
+        # (one fused norm), and any user subclass — reference
+        # python/paddle/nn/clip.py applies the same objects on both paths.
         clip = getattr(optimizer, "_grad_clip", None)
-        if clip is not None and hasattr(clip, "clip_norm"):
-            gn = jnp.sqrt(
-                sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads.values())
-            )
-            scale = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
-            grads = {k: (g * scale.astype(g.dtype)) for k, g in grads.items()}
+        if clip is not None:
+            keys = [k for k, g in grads.items() if g is not None]
+            pgs = [(self._clip_stubs[k], Tensor(grads[k])) for k in keys]
+            clipped = clip(pgs)
+            for k, (_, t) in zip(keys, clipped):
+                grads[k] = t.data if isinstance(t, Tensor) else t
 
         # ZeRO stage-2: constrain each grad to the accumulators' sharded
         # layout at the point the update consumes it — the update then runs
@@ -93,9 +150,9 @@ class TrainStep:
 
             mesh, axis = optimizer._gs_mesh, optimizer._gs_axis
             return {
-                k: jax.lax.with_sharding_constraint(
+                k: (v if v is None else jax.lax.with_sharding_constraint(
                     v, NamedSharding(mesh, leading_dim_spec(
-                        v.shape, mesh, axis, base=self._param_specs.get(k))))
+                        v.shape, mesh, axis, base=self._param_specs.get(k)))))
                 for k, v in tree.items()
             }
 
@@ -153,8 +210,20 @@ class TrainStep:
         return {n: Tensor(a) for n, a in {**self._params, **self._buffers}.items()}
 
 
-def build_train_step(network, loss_fn, optimizer, recompute=False, donate=True):
-    return TrainStep(network, loss_fn, optimizer, recompute=recompute, donate=donate)
+def amp_args_from_strategy(strategy):
+    """(amp_level, amp_dtype) from an auto-parallel Strategy-style config bag
+    — the one place the amp knob is interpreted, shared by Engine, DistModel
+    and any other build_train_step caller."""
+    amp = getattr(strategy, "amp", None)
+    if not getattr(amp, "enable", False):
+        return None, "bfloat16"
+    return getattr(amp, "level", "O1") or "O1", getattr(amp, "dtype", "bfloat16")
+
+
+def build_train_step(network, loss_fn, optimizer, recompute=False, donate=True,
+                     amp_level=None, amp_dtype="bfloat16"):
+    return TrainStep(network, loss_fn, optimizer, recompute=recompute,
+                     donate=donate, amp_level=amp_level, amp_dtype=amp_dtype)
 
 
 def build_eval_fn(network, loss_fn=None):

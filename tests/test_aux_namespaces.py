@@ -322,3 +322,40 @@ class TestPasses:
         assert ctx.get_attr("sharding")["stage"] == 2
         with pytest.raises(ValueError):
             new_pass("not_a_pass")
+
+
+class TestInferenceConfigHonesty:
+    """Engine knobs with no TPU analog warn instead of silently no-opping."""
+
+    def test_unsupported_engine_knobs_warn(self):
+        import warnings
+
+        import paddle_tpu as paddle
+
+        cfg = paddle.inference.Config("m")
+        for knob, args in [
+            ("enable_tensorrt_engine", ()),
+            ("set_trt_dynamic_shape_info", ()),
+            ("enable_mkldnn", ()),
+            ("enable_mkldnn_bfloat16", ()),
+            ("enable_lite_engine", ()),
+            ("enable_xpu", ()),
+        ]:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                getattr(cfg, knob)(*args)
+            assert any("no effect on the TPU backend" in str(x.message)
+                       for x in w), knob
+
+    def test_supported_knobs_do_not_warn(self):
+        import warnings
+
+        import paddle_tpu as paddle
+
+        cfg = paddle.inference.Config("m")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            cfg.switch_ir_optim(False)
+            cfg.enable_memory_optim()
+            cfg.disable_gpu()
+        assert not w

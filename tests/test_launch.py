@@ -140,3 +140,81 @@ def test_no_restart_budget_propagates_failure(tmp_path):
     proc, _ = _run_launcher(str(tmp_path), str(script), nproc=2,
                             max_restarts=0)
     assert proc.returncode == 3
+
+
+def test_multinode_endpoints_use_per_node_hosts():
+    """ADVICE r2: endpoints for node_rank>0 were fabricated on the master
+    host; nodes now publish their reachable IP through the rendezvous store
+    and every PADDLE_TRAINER_ENDPOINTS entry carries its owner's host."""
+    import threading
+
+    from paddle_tpu.core.native import TCPStoreServer
+    from paddle_tpu.distributed.launch.controllers.collective import (
+        CollectiveController,
+    )
+
+    srv = TCPStoreServer(port=0)
+    try:
+        master = f"127.0.0.1:{srv.port}"
+        ctl = [
+            CollectiveController("x.py", nproc_per_node=2, nnodes=2,
+                                 node_rank=n, master=master, job_id="epjob")
+            for n in (0, 1)
+        ]
+        results = {}
+
+        def go(n):
+            results[n] = ctl[n]._node_hosts("127.0.0.1", srv.port)
+
+        ts = [threading.Thread(target=go, args=(n,)) for n in (0, 1)]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert results[0] == results[1] == ["127.0.0.1", "127.0.0.1"]
+        # env built from the exchanged hosts: rank 3 endpoint owned by node 1
+        env = ctl[1]._worker_env(1, "127.0.0.1", srv.port, results[1])
+        eps = env["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == 4
+        assert env["PADDLE_CURRENT_ENDPOINT"] == eps[3]
+        assert all(e.startswith("127.0.0.1:") for e in eps)
+    finally:
+        srv.stop()
+
+
+def test_rpc_rejects_unauthenticated_connections():
+    """Cross-process rpc requires the per-job token before unpickling."""
+    import pickle
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    from paddle_tpu.core.native import TCPStore, TCPStoreServer
+
+    srv = TCPStoreServer(port=0)
+    master = f"127.0.0.1:{srv.port}"
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        f"os.environ['PADDLE_MASTER'] = {master!r}\n"
+        "from paddle_tpu.distributed import rpc\n"
+        "rpc.init_rpc('authsrv')\n"
+        "import time; time.sleep(60)\n"
+    )
+    p = subprocess.Popen([sys.executable, "-c", script])
+    try:
+        store = TCPStore("127.0.0.1", srv.port)
+        blob = store.wait("rpc_worker:authsrv", timeout_ms=30000)
+        info = pickle.loads(blob)
+        ip, port = info[2], info[3]
+        # no token: the server must drop the connection without executing
+        with socket.create_connection((ip, port), timeout=5) as s:
+            f = s.makefile("rwb")
+            f.write(b"wrong-token\n")
+            pickle.dump(("os.system", ("true",), {}), f)
+            f.flush()
+            got = s.recv(1024)
+        assert got == b""  # connection closed, nothing served
+    finally:
+        p.kill()
+        p.wait()
+        srv.stop()

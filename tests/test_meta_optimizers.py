@@ -167,3 +167,40 @@ class TestFP16AllReduce:
             paddle.optimizer.SGD(learning_rate=0.05,
                                  parameters=m.parameters())))
         assert comp < max(base * 3, 0.01), (base, comp)
+
+
+class TestDGCStrictTopK:
+    def test_exactly_k_on_ties(self):
+        """|v| ties at the threshold must not widen the communicated set
+        (ADVICE r2: the >= thresh mask sent more than k entries on ties)."""
+        paddle.seed(0)
+        lin = nn.Linear(D, 1, bias_attr=False)
+        opt = DGCMomentumOptimizer(
+            learning_rate=1.0, momentum=0.0, sparsity=[0.75],
+            rampup_begin_step=0, parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        # ALL entries tie: a >= threshold mask would apply all 16
+        g = np.full((D, 1), 2.0, np.float32)
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        delta = w0 - lin.weight.numpy()
+        applied = (np.abs(delta) > 1e-8).sum()
+        assert applied == 4, delta.ravel()  # exactly k, not all ties
+
+    def test_nesterov_compressed_consistent_with_dense(self):
+        """Nesterov lookahead in the compressed phase uses the masked
+        velocity (dense form g + m*u), not (1+m)*encoded."""
+        paddle.seed(0)
+        m = 0.9
+        lin = nn.Linear(D, 1, bias_attr=False)
+        opt = DGCMomentumOptimizer(
+            learning_rate=1.0, momentum=m, use_nesterov=True,
+            sparsity=[0.0],  # k = n: dense communication
+            rampup_begin_step=0, parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        g = np.arange(1, D + 1, dtype=np.float32).reshape(D, 1)
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        delta = w0 - lin.weight.numpy()
+        # step 1, u = g, v = g; encoded = v (all), nesterov = encoded + m*u
+        np.testing.assert_allclose(delta, g + m * g, rtol=1e-5)

@@ -304,3 +304,35 @@ class TestVarlenFlashAttention:
         np.testing.assert_allclose(out.numpy()[sum(lens):], 0.0, atol=1e-7)
         out.sum().backward()
         np.testing.assert_allclose(q.grad.numpy()[sum(lens):], 0.0, atol=1e-7)
+
+
+class TestHapiAmpConfigs:
+    def test_prepare_amp_configs_wired(self):
+        """Model.prepare(amp_configs=...) must reach the compiled step."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        net = nn.Linear(8, 8)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.MSELoss(), amp_configs="O1")
+        X = np.random.rand(4, 8).astype("float32")
+        loss = model.train_batch([paddle.to_tensor(X)], [paddle.to_tensor(X)])
+        assert np.isfinite(loss[0])
+        step = model._train_step
+        lowered = step._jitted.lower(
+            step._params, step._buffers, step._states,
+            np.float32(0.05), np.int32(1), X, X).as_text()
+        assert "bf16" in lowered
+
+    def test_prepare_bad_amp_configs_raises(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import pytest as _pytest
+
+        model = paddle.Model(nn.Linear(2, 2))
+        with _pytest.raises(TypeError, match="amp_configs"):
+            model.prepare(None, None, amp_configs=3.14)

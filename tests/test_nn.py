@@ -407,6 +407,37 @@ class TestExtendedNN:
                                   paddle.to_tensor(np.array([T])), paddle.to_tensor(np.array([U]))).numpy())
         assert l_good < l_bad
 
+    def test_rnnt_fastemit_rescales_gradients_not_loss(self):
+        """FastEmit is a pure gradient-level rescaling: identical forward
+        loss, emit-transition gradients scaled linearly in lambda."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        T, U, V = 5, 3, 7
+        acts = rng.randn(2, T, U + 1, V).astype("float32")
+        labels = np.array([[1, 2, 3], [4, 5, 6]])
+        tl = np.array([T, T - 1])
+        ul = np.array([U, U - 1])
+
+        def loss_and_grad(lam):
+            x = paddle.to_tensor(acts)
+            x.stop_gradient = False
+            l = F.rnnt_loss(x, paddle.to_tensor(labels), paddle.to_tensor(tl),
+                            paddle.to_tensor(ul), fastemit_lambda=lam)
+            l.backward()
+            return float(l.numpy()), x.grad.numpy().copy()
+
+        l0, g0 = loss_and_grad(0.0)
+        l1, g1 = loss_and_grad(0.15)
+        l2, g2 = loss_and_grad(0.30)
+        assert l0 == l1 == l2  # forward value untouched
+        assert not np.allclose(g0, g1)  # grads really rescaled
+        # surrogate is linear in lambda: g(0.3)-g(0) == 2*(g(0.15)-g(0))
+        np.testing.assert_allclose(g2 - g0, 2.0 * (g1 - g0), rtol=1e-4, atol=1e-7)
+
     def test_grid_sample_identity_and_shift(self):
         import numpy as np
 
