@@ -1,0 +1,84 @@
+"""Round-3 perf sweep on the real chip: measure MFU across memory/remat
+configs enabled by chunked CE + low-precision moments.  Appends one JSON line
+per variant to bench_sweep.jsonl (order: safe -> risky so OOMs lose nothing).
+
+Run: timeout 3600 python -u bench_sweep.py
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import time
+
+import numpy as np
+
+VARIANTS = [
+    # name, batch, chunk, moment_dtype, policy, recompute_layers
+    ("b16_chunk8192_bf16_rl13", 16, 8192, "bfloat16", None, 13),
+    ("b16_chunk16384_int8_rl13", 16, 16384, "int8", None, 13),
+    ("b16_chunk8192_int8_rl12", 16, 8192, "int8", None, 12),
+    ("b14_chunk8192_int8_rl12", 14, 8192, "int8", None, 12),
+    ("b16_chunk8192_int8_rl11", 16, 8192, "int8", None, 11),
+]
+
+
+def run_variant(name, batch, chunk, md, policy, rl, iters=10):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.static.functionalize import build_train_step
+
+    seq = 2048
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=seq, dtype="bfloat16", recompute=True,
+        loss_chunk_size=chunk, recompute_policy=policy, recompute_layers=rl,
+    )
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01, moment_dtype=md)
+    step = build_train_step(model, None, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 32000, (batch, seq)), dtype="int64")
+    labels = paddle.to_tensor(rng.integers(0, 32000, (batch, seq)), dtype="int64")
+
+    t_c = time.perf_counter()
+    step(ids, labels).numpy()
+    compile_s = time.perf_counter() - t_c
+    step(ids, labels).numpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    lv = float(np.asarray(loss.numpy()))
+    dt = (time.perf_counter() - t0) / iters
+    tok_s = batch * seq / dt
+    flops_per_token = 6 * n_params + 6 * 16 * 2048 * seq
+    tflops = flops_per_token * tok_s / 1e12
+    mfu = tflops / 197.0
+    return {"variant": name, "mfu": round(mfu, 4), "tokens_per_sec": round(tok_s, 1),
+            "step_ms": round(dt * 1000, 1), "tflops": round(tflops, 1),
+            "compile_s": round(compile_s, 1), "loss": round(lv, 3)}
+
+
+def main():
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bench_sweep.jsonl")
+    for v in VARIANTS:
+        print(f"=== {v[0]} ===", flush=True)
+        try:
+            rec = run_variant(*v)
+        except Exception as e:  # OOM etc: record and continue
+            rec = {"variant": v[0], "error": f"{type(e).__name__}: {e}"[:400]}
+        print(json.dumps(rec), flush=True)
+        with open(out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        gc.collect()
+
+
+if __name__ == "__main__":
+    main()

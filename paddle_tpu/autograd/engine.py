@@ -175,6 +175,227 @@ def _is_diff_dtype(dtype) -> bool:
     return is_floating_point(dtype) or is_complex(dtype)
 
 
+# ---------------------------------------------------------------------------------
+# Eager dispatch cache (SURVEY §7 "hard parts": per-(op, shapes, dtypes) jit
+# cache at the dispatch chokepoint).  The traced fwd returns (outputs,
+# residuals) — jax's vjp callable is a tree_util.Partial pytree, so its
+# residual leaves cross the jit boundary and the backward is a second cached
+# jit consuming them: no retracing OR recompute after the first call with a
+# given (op, closure constants, leaf shapes/dtypes) signature.
+# ---------------------------------------------------------------------------------
+
+_DISPATCH_CACHE: dict = {}
+_DISPATCH_CACHE_MAX = 4096
+_DISPATCH_STATS = {"hits": 0, "misses": 0, "bypass": 0}
+_dispatch_cache_on = True
+
+
+def enable_dispatch_cache(flag=True):
+    global _dispatch_cache_on
+    _dispatch_cache_on = bool(flag)
+
+
+def dispatch_cache_info():
+    return {"size": len(_DISPATCH_CACHE), **_DISPATCH_STATS}
+
+
+class _Uncacheable(Exception):
+    pass
+
+
+_SIMPLE_CONSTS = (int, float, bool, str, bytes, type(None))
+
+
+def _const_fingerprint(v, depth=0):
+    """Hashable VALUE fingerprint of a python constant; raises _Uncacheable
+    for anything whose identity-hash could go stale (arrays, Tensors,
+    mutable objects)."""
+    import types
+
+    if depth > 6:
+        raise _Uncacheable
+    if isinstance(v, _SIMPLE_CONSTS):
+        return (type(v).__name__, v)
+    if isinstance(v, np.dtype):
+        return ("dt", str(v))
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(
+            _const_fingerprint(x, depth + 1) for x in v)
+    if isinstance(v, types.ModuleType):
+        return ("mod", v.__name__)
+    if isinstance(v, type):
+        return ("cls", v.__module__, v.__qualname__)
+    if isinstance(v, types.FunctionType):
+        return _fn_fingerprint(v, depth + 1)
+    raise _Uncacheable
+
+
+def _iter_code_names(code, depth=0):
+    """All global names a code object (and its nested lambdas/defs) loads."""
+    if depth > 3:
+        return
+    yield from code.co_names
+    for const in code.co_consts:
+        if hasattr(const, "co_names"):
+            yield from _iter_code_names(const, depth + 1)
+
+
+def _fn_fingerprint(fn, depth=0):
+    cells = tuple(_const_fingerprint(c.cell_contents, depth + 1)
+                  for c in (fn.__closure__ or ()))
+    dflts = tuple(_const_fingerprint(d, depth + 1)
+                  for d in (fn.__defaults__ or ()))
+    # module-level globals the body reads are part of the behavior: value-
+    # fingerprint them SHALLOWLY (a mutated simple global must miss; a
+    # global holding an array/dict makes the op uncacheable; referenced
+    # functions key by code object, no transitive walk).  Names not in
+    # __globals__ are builtins/attribute loads — immutable enough.
+    gl = fn.__globals__
+    gparts = []
+    for nm in sorted(set(_iter_code_names(fn.__code__))):
+        if nm in gl:
+            gparts.append((nm, _global_fingerprint(gl[nm])))
+    return ("fn", fn.__code__, cells, dflts, tuple(gparts))
+
+
+def _global_fingerprint(v):
+    import types
+
+    if isinstance(v, _SIMPLE_CONSTS):
+        return (type(v).__name__, v)
+    if isinstance(v, np.dtype):
+        return ("dt", str(v))
+    if isinstance(v, (tuple, list)):
+        return ("seq",) + tuple(_global_fingerprint(x) for x in v)
+    if isinstance(v, types.ModuleType):
+        return ("mod", v.__name__)
+    if isinstance(v, type):
+        return ("cls", v.__module__, v.__qualname__)
+    if isinstance(v, types.FunctionType):
+        return ("fnref", v.__code__)
+    if isinstance(v, (types.BuiltinFunctionType, types.BuiltinMethodType)):
+        return ("bif", getattr(v, "__qualname__", ""))
+    raise _Uncacheable
+
+
+_UNCACHEABLE = object()  # negative-cache sentinel: op needs concrete values
+
+# trace-time errors meaning the op's python body reads concrete values
+# (int(x.max()), bool(mask.any()), data-dependent shapes): run it eagerly
+_CONCRETIZATION_ERRORS = (
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,
+)
+
+
+class _DispatchEntry:
+    __slots__ = ("jfwd", "jraw", "bwd", "jbwd", "boxes")
+
+    def __init__(self):
+        self.jfwd = self.jraw = self.bwd = self.jbwd = None
+        self.boxes = {}
+
+
+def _build_dispatch_entry(fn, treedef, leaves, tensor_pos, diff_pos):
+    entry = _DispatchEntry()
+    boxes = entry.boxes
+    tensor_set = set(tensor_pos)
+    consts = {i: l for i, l in enumerate(leaves) if i not in tensor_set}
+    n_leaves = len(leaves)
+
+    def rebuild(tdatas):
+        full, ti = [], 0
+        for i in range(n_leaves):
+            if i in consts:
+                full.append(consts[i])
+            else:
+                full.append(tdatas[ti])
+                ti += 1
+        return jax.tree_util.tree_unflatten(treedef, full)
+
+    if diff_pos:
+        diff_in_t = [tensor_pos.index(p) for p in diff_pos]
+
+        def fwd(*tdatas):
+            def raw_diff(*ddatas):
+                sub = list(tdatas)
+                for p, d in zip(diff_in_t, ddatas):
+                    sub[p] = d
+                a, kw = rebuild(sub)
+                return fn(*a, **kw)
+
+            out, vjp_fn = jax.vjp(raw_diff,
+                                  *(tdatas[p] for p in diff_in_t))
+            out_leaves, out_td = jax.tree_util.tree_flatten(out)
+            res_leaves, res_td = jax.tree_util.tree_flatten(vjp_fn)
+            boxes["out_td"], boxes["res_td"] = out_td, res_td
+            return list(out_leaves), list(res_leaves)
+
+        entry.jfwd = jax.jit(fwd)
+
+        def bwd(res_leaves, ct_leaves):
+            vjp_fn = jax.tree_util.tree_unflatten(boxes["res_td"], res_leaves)
+            ct = jax.tree_util.tree_unflatten(boxes["out_td"], ct_leaves)
+            return vjp_fn(ct)
+
+        entry.bwd = bwd
+        entry.jbwd = jax.jit(bwd)
+    else:
+        def raw_all(*tdatas):
+            a, kw = rebuild(list(tdatas))
+            return fn(*a, **kw)
+
+        entry.jraw = jax.jit(raw_all)
+    return entry
+
+
+def _dispatch_lookup(name, fn, leaves, treedef, diff_pos):
+    """Return (entry, tensor_pos) or None when this call is uncacheable."""
+    from paddle_tpu.tensor.tensor import Tensor
+
+    import types
+
+    try:
+        if not isinstance(fn, types.FunctionType):
+            raise _Uncacheable  # bound methods / partials: identity unsafe
+        sig = [_fn_fingerprint(fn)]
+    except _Uncacheable:
+        _DISPATCH_STATS["bypass"] += 1
+        return None
+    tensor_pos = []
+    try:
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, Tensor):
+                if isinstance(leaf.data, jax.core.Tracer):
+                    _DISPATCH_STATS["bypass"] += 1
+                    return None  # inside another trace: no double-jit
+                tensor_pos.append(i)
+                sig.append(("T", tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                sig.append(_const_fingerprint(leaf))
+    except _Uncacheable:
+        _DISPATCH_STATS["bypass"] += 1
+        return None
+    key = (name, treedef, tuple(diff_pos), tuple(sig))
+    entry = _DISPATCH_CACHE.get(key)
+    if entry is _UNCACHEABLE:
+        _DISPATCH_STATS["bypass"] += 1
+        return None
+    if entry is None:
+        _DISPATCH_STATS["misses"] += 1
+        if len(_DISPATCH_CACHE) >= _DISPATCH_CACHE_MAX:
+            _DISPATCH_CACHE.clear()
+        entry = _build_dispatch_entry(fn, treedef, leaves, tensor_pos,
+                                      diff_pos)
+        _DISPATCH_CACHE[key] = entry
+    else:
+        _DISPATCH_STATS["hits"] += 1
+    return entry, tensor_pos, key
+
+
 def apply(name: str, fn: Callable, *args, **kwargs):
     """Run an eager op through the tape.
 
@@ -217,6 +438,49 @@ def apply(name: str, fn: Callable, *args, **kwargs):
     requires = bool(diff_pos)
 
     const_leaves = [l.data if is_tensor(l) else l for l in leaves]
+
+    cached = (_dispatch_lookup(name, fn, leaves, treedef, diff_pos)
+              if _dispatch_cache_on else None)
+    if cached is not None:
+        entry, tensor_pos, _ck = cached
+        tdatas = [const_leaves[i] for i in tensor_pos]
+        try:
+            if not requires:
+                out = entry.jraw(*tdatas)
+                if _nan_check_enabled():
+                    _check_op_outputs(name, out)
+                return _wrap_outputs(out, None)
+            out_leaves, res_leaves = entry.jfwd(*tdatas)
+        except _CONCRETIZATION_ERRORS:
+            # fn's python body needs concrete values — permanently eager
+            _DISPATCH_CACHE[_ck] = _UNCACHEABLE
+            cached = None
+    if cached is not None:
+        out_td = entry.boxes["out_td"]
+        out_data = jax.tree_util.tree_unflatten(out_td, out_leaves)
+        if _nan_check_enabled():
+            _check_op_outputs(name, out_data)
+
+        def vjp_fn(ct, _e=entry, _res=res_leaves):
+            ct_leaves = jax.tree_util.tree_flatten(ct)[0]
+            if any(getattr(c, "dtype", None) == jax.dtypes.float0
+                   for c in ct_leaves):
+                return _e.bwd(_res, ct_leaves)  # float0 can't cross jit
+            return _e.jbwd(_res, ct_leaves)
+
+        def raw_fn(*xs):
+            sub = list(const_leaves)
+            for p, x in zip(diff_pos, xs):
+                sub[p] = x
+            a, kw = jax.tree_util.tree_unflatten(treedef, sub)
+            return fn(*a, **kw)
+
+        out_avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
+        node = GradNode(
+            name, vjp_fn, tuple(leaves[i] for i in diff_pos), out_avals,
+            out_td, raw_fn=raw_fn,
+        )
+        return _wrap_outputs(out_data, node)
 
     if not requires:
         a, kw = jax.tree_util.tree_unflatten(treedef, const_leaves)

@@ -1,5 +1,5 @@
 """Distributed checkpoint (python/paddle/distributed/checkpoint parity)."""
 from paddle_tpu.distributed.checkpoint.save_state_dict import (  # noqa: F401
-    save_state_dict, wait_async_save,
+    ShardedWeight, save_state_dict, wait_async_save,
 )
 from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict  # noqa: F401

@@ -1,11 +1,22 @@
 """load_state_dict with reshard-on-load (reference:
 python/paddle/distributed/checkpoint/load_state_dict.py:467).
 
-Reads the metadata file written by save_state_dict, reassembles each tensor
-from its shard files (which may have been written under a different
-mesh/parallel strategy), and lays the result out with the CURRENT sharding of
-the destination tensor (jax.device_put with its existing sharding) — the
-reference's "reshard onto a different mesh" load path.
+Reads the metadata file written by save_state_dict and fills each destination
+tensor by reading ONLY the saved shards that overlap the destination's local
+placement (reference get_local_load_files → read overlapping slices):
+
+* sharded jax.Array destination: each addressable device shard is assembled
+  from the overlapping file regions and the global array is built with
+  ``jax.make_array_from_single_device_arrays`` — the full global array is
+  NEVER materialized on the host, so 13B-class checkpoints load on meshes
+  whose hosts can't hold the whole tensor;
+* :class:`ShardedWeight` destination (launcher multi-process world): only the
+  declared slice is read;
+* replicated / single-device destination: plain assembly (the destination
+  itself is the full tensor, so full-size reads are inherent).
+
+Shard files are opened with ``np.load(mmap_mode="r")`` so only the overlapping
+byte ranges are actually paged in.
 """
 from __future__ import annotations
 
@@ -17,23 +28,90 @@ import numpy as np
 __all__ = ["load_state_dict"]
 
 
-def _assemble(entry, path):
-    import jax.numpy as jnp
-    import ml_dtypes  # bundled with jax
-
-    dtype_s = entry["dtype"]
+def _np_dtype(dtype_s):
     try:
-        np_dtype = np.dtype(dtype_s)
+        return np.dtype(dtype_s)
     except TypeError:
-        np_dtype = np.dtype(getattr(ml_dtypes, dtype_s))
-    out = np.empty(entry["global_shape"], dtype=np_dtype)
-    for sh in entry["shards"]:
-        block = np.load(os.path.join(path, sh["file"]))
-        if block.dtype != np_dtype:
-            block = block.view(np_dtype)
-        idx = tuple(slice(a, b) for a, b in sh["index"])
-        out[idx] = block
+        import ml_dtypes  # bundled with jax
+
+        return np.dtype(getattr(ml_dtypes, dtype_s))
+
+
+class _FileCache:
+    """Memory-mapped shard files, opened lazily, viewed as the right dtype."""
+
+    def __init__(self, path, np_dtype):
+        self._path = path
+        self._dtype = np_dtype
+        self._open = {}
+
+    def get(self, fname):
+        m = self._open.get(fname)
+        if m is None:
+            m = np.load(os.path.join(self._path, fname), mmap_mode="r")
+            if m.dtype != self._dtype:
+                m = m.view(self._dtype)
+            self._open[fname] = m
+        return m
+
+
+def _overlap(dst_index, src_index):
+    """Per-dim ((lo, hi)) intersection of two global index ranges, or None."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(dst_index, src_index):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
     return out
+
+
+def _fill_region(dst, dst_index, entry, cache):
+    """Copy every saved shard's overlap with ``dst_index`` into ``dst``
+    (whose origin is dst_index's start)."""
+    covered = 0
+    for sh in entry["shards"]:
+        src_index = [tuple(p) for p in sh["index"]]
+        ov = _overlap(dst_index, src_index)
+        if ov is None:
+            continue
+        block = cache.get(sh["file"])
+        dst_sl = tuple(slice(lo - d0, hi - d0)
+                       for (lo, hi), (d0, _) in zip(ov, dst_index))
+        src_sl = tuple(slice(lo - s0, hi - s0)
+                       for (lo, hi), (s0, _) in zip(ov, src_index))
+        dst[dst_sl] = block[src_sl]
+        covered += int(np.prod([hi - lo for lo, hi in ov]))
+    want = int(np.prod([hi - lo for lo, hi in dst_index])) if dst_index else 1
+    if covered < want:
+        raise ValueError(
+            f"checkpoint does not cover the requested region {dst_index} "
+            f"({covered}/{want} elements found) — saved with fewer ranks "
+            "than are loading, or shards missing")
+
+
+def _load_sharded_jax(value_arr, entry, cache):
+    """Destination is a sharded jax.Array: assemble per-device local blocks
+    only, then stitch the global array from them."""
+    import jax
+
+    np_dtype = _np_dtype(entry["dtype"])
+    locals_ = []
+    devices = []
+    for shard in value_arr.addressable_shards:
+        idx = tuple(
+            (0 if sl.start is None else int(sl.start),
+             int(value_arr.shape[d]) if sl.stop is None else int(sl.stop))
+            for d, sl in enumerate(shard.index)
+        )
+        local = np.empty([hi - lo for lo, hi in idx], dtype=np_dtype)
+        _fill_region(local, idx, entry, cache)
+        locals_.append(local)
+        devices.append(shard.device)
+    arrs = [jax.device_put(l.astype(value_arr.dtype, copy=False), d)
+            for l, d in zip(locals_, devices)]
+    return jax.make_array_from_single_device_arrays(
+        value_arr.shape, value_arr.sharding, arrs)
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
@@ -41,6 +119,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     """Fill ``state_dict``'s tensors in place from the checkpoint at ``path``."""
     import jax
 
+    from paddle_tpu.distributed.checkpoint.save_state_dict import ShardedWeight
     from paddle_tpu.tensor.tensor import Tensor
 
     with open(os.path.join(path, "metadata.json")) as f:
@@ -48,20 +127,51 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     missing = [k for k in state_dict if k not in meta]
     if missing:
         raise ValueError(f"keys not found in checkpoint: {missing}")
+
     for name, value in state_dict.items():
         entry = meta[name]
-        assembled = _assemble(entry, path)
-        if isinstance(value, Tensor):
-            cur = value.data
-            if list(cur.shape) != list(assembled.shape):
+        np_dtype = _np_dtype(entry["dtype"])
+        cache = _FileCache(path, np_dtype)
+
+        if isinstance(value, ShardedWeight):
+            if list(value.global_shape) != list(entry["global_shape"]):
                 raise ValueError(
-                    f"{name}: checkpoint shape {assembled.shape} != "
-                    f"current {tuple(cur.shape)}"
-                )
-            arr = jax.numpy.asarray(assembled)
+                    f"{name}: checkpoint global shape {entry['global_shape']}"
+                    f" != declared {list(value.global_shape)}")
+            idx = value.index
+            local = np.empty([hi - lo for lo, hi in idx], dtype=np_dtype)
+            _fill_region(local, idx, entry, cache)
+            if isinstance(value.local, jax.Array):
+                value.local = jax.numpy.asarray(
+                    local.astype(value.local.dtype, copy=False))
+            else:
+                value.local = local
+            continue
+
+        cur = value.data if isinstance(value, Tensor) else value
+        if hasattr(cur, "shape") and list(cur.shape) != list(entry["global_shape"]):
+            raise ValueError(
+                f"{name}: checkpoint shape {entry['global_shape']} != "
+                f"current {tuple(cur.shape)}"
+            )
+        if (isinstance(cur, jax.Array) and hasattr(cur, "sharding")
+                and not cur.sharding.is_fully_replicated
+                and hasattr(cur, "addressable_shards")):
+            arr = _load_sharded_jax(cur, entry, cache)
+            if isinstance(value, Tensor):
+                value._data = arr
+            else:
+                state_dict[name] = arr
+            continue
+        # replicated / plain destination: full assembly is the destination
+        full_idx = tuple((0, s) for s in entry["global_shape"])
+        out = np.empty(entry["global_shape"], dtype=np_dtype)
+        _fill_region(out, full_idx, entry, cache)
+        if isinstance(value, Tensor):
+            arr = jax.numpy.asarray(out)
             if hasattr(cur, "sharding"):
                 arr = jax.device_put(arr, cur.sharding)  # reshard-on-load
             value._data = arr
         else:
-            state_dict[name] = assembled
+            state_dict[name] = out
     return state_dict

@@ -2,54 +2,165 @@
 
 Layout on disk:
   path/
-    metadata.json      — {param: {"global_shape": [...], "dtype": str,
-                          "shards": [{"index": [[start, stop], ...], "file": f}]}}
-    shard_*.npy        — one file per DISTINCT global slice (replicated device
-                          shards are deduplicated, the reference's dedup_tensor
-                          behavior)
+    metadata.json           — {param: {"global_shape": [...], "dtype": str,
+                               "shards": [{"index": [[start, stop], ...],
+                               "file": f}]}} — written by the COORDINATOR rank
+                               only, covering every rank's shards (the
+                               reference's gathered global metadata file)
+    shard_r{rank}_{hash}.npy — rank-owned data files; the owner rank is in the
+                               name so no two processes ever write the same
+                               file, and the hash is derived from
+                               (tensor, slice) so names are deterministic
+                               across processes
 
-Works for any jax.Array layout: fully-replicated, NamedSharding over any mesh,
-or single-device — the shard index recorded is the global slice each saved
-block covers, so load can reshard onto a different mesh/strategy.
+Multi-host correctness, mirroring the reference's two coordination levels:
+
+* **Single-controller SPMD** (jax.process_count() > 1): every process computes
+  the same global device→slice map from each array's sharding.  A distinct
+  global slice is OWNED (written) only by the process of the first device
+  holding it — replicated shards land exactly once cluster-wide (reference
+  dedup_tensor) — and since filenames are deterministic, every process derives
+  the identical global metadata; the coordinator writes it.
+* **Launcher multi-process** (independent jax per process, the kill-recover
+  world): ranks publish the metadata for the shards they wrote through the
+  rendezvous TCPStore (``PADDLE_MASTER``); the coordinator merges all ranks'
+  entries into one metadata.json (reference: gather_object + coordinator
+  write).  Plain replicated tensors are written by the coordinator only; a
+  rank's own slice of a logically-global tensor is declared with
+  :class:`ShardedWeight`.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import pickle
 
 import numpy as np
 
-__all__ = ["save_state_dict"]
+__all__ = ["save_state_dict", "wait_async_save", "ShardedWeight"]
 
 
-def _tensor_shards(arr):
-    """Yield (global_index, ndarray) for one copy of each distinct shard."""
+class ShardedWeight:
+    """One rank's LOCAL slice of a logically-global tensor (the reference's
+    LocalTensorMetadata/LocalTensorIndex pair, as an explicit value type).
+
+    ``local``: the slice this rank holds; ``global_shape``: full tensor shape;
+    ``global_offset``: start index of the slice in every dim."""
+
+    def __init__(self, local, global_shape, global_offset):
+        from paddle_tpu.tensor.tensor import Tensor
+
+        self.local = local.data if isinstance(local, Tensor) else local
+        self.global_shape = tuple(int(s) for s in global_shape)
+        self.global_offset = tuple(int(o) for o in global_offset)
+        if len(self.global_shape) != len(self.global_offset):
+            raise ValueError("global_shape and global_offset rank mismatch")
+
+    @property
+    def index(self):
+        return tuple(
+            (o, o + s) for o, s in zip(self.global_offset, self.local.shape)
+        )
+
+
+def _env_rank_world(process_group=None):
+    if process_group is not None and hasattr(process_group, "rank"):
+        return int(process_group.rank), int(process_group.world_size)
+    try:
+        import jax
+
+        if jax.process_count() > 1:  # single-controller SPMD
+            return jax.process_index(), jax.process_count()
+    except Exception:
+        pass
+    return (int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+            int(os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+
+
+def _ckpt_store():
+    """TCPStore client for cross-process metadata merge (launcher contract)."""
+    master = os.environ.get("PADDLE_MASTER")
+    if not master:
+        return None
+    from paddle_tpu.core.native import TCPStore
+
+    host, port = master.rsplit(":", 1)
+    return TCPStore(host, int(port))
+
+
+_SAVE_SEQ: dict = {}
+
+
+def _store_prefix(path, unique_id):
+    """Store namespace for ONE save call: path tag + restart epoch + this
+    process's per-path save sequence number.  Ranks checkpoint in lockstep
+    (the reference's implicit assumption — its gather IS a barrier), so all
+    ranks derive the same sequence for the same logical save; the restart
+    epoch (launcher PADDLE_RESTART_COUNT) moves a relaunched job into a fresh
+    namespace so keys left by a killed attempt can never be mistaken for
+    this attempt's."""
+    ap = os.path.abspath(path)
+    tag = hashlib.md5(ap.encode()).hexdigest()[:10]
+    seq = _SAVE_SEQ.get(ap, 0)
+    _SAVE_SEQ[ap] = seq + 1
+    epoch = os.environ.get("PADDLE_RESTART_COUNT", "0")
+    return (f"ckpt/{tag}/{unique_id if unique_id is not None else 0}"
+            f"/e{epoch}/s{seq}")
+
+
+def _shard_fname(owner, name, index):
+    h = hashlib.md5(f"{name}|{index}".encode()).hexdigest()[:12]
+    return f"shard_r{owner}_{h}.npy"
+
+
+def _iter_slices(arr, my_proc, coordinator_rank):
+    """Yield (global_index, owner, block-or-None) for every DISTINCT global
+    slice of ``arr``; ``block`` is the host copy when this process owns the
+    slice, else None (metadata-only).  jax.Arrays: ownership = process of the
+    first device holding the slice (global dedup without communication);
+    plain arrays: one slice owned by the coordinator."""
     import jax
 
     if not isinstance(arr, jax.Array) or not hasattr(arr, "addressable_shards"):
         # copy: np.asarray is a no-copy passthrough for numpy inputs, and the
         # async writer thread must never alias the caller's mutable buffer
         a = np.array(arr, copy=True)
-        yield tuple((0, s) for s in a.shape), a
+        full = tuple((0, s) for s in a.shape)
+        yield full, coordinator_rank, (a if my_proc == coordinator_rank else None)
         return
-    seen = set()
-    for shard in arr.addressable_shards:
-        idx = shard.index  # tuple of slices into the global array
-        norm = tuple(
+
+    def norm_index(idx):
+        return tuple(
             (0 if sl.start is None else int(sl.start),
              int(arr.shape[d]) if sl.stop is None else int(sl.stop))
             for d, sl in enumerate(idx)
         )
-        if norm in seen:
-            continue
-        seen.add(norm)
-        yield norm, np.asarray(shard.data)
+
+    owners = {}
+    try:
+        dmap = arr.sharding.devices_indices_map(arr.shape)
+        for dev in sorted(dmap, key=lambda d: d.id):
+            owners.setdefault(norm_index(dmap[dev]), dev.process_index)
+    except Exception:
+        pass
+    local = {}
+    for shard in arr.addressable_shards:
+        local.setdefault(norm_index(shard.index), shard)
+    if not owners:  # exotic/single-device sharding: local view only
+        owners = {k: my_proc for k in local}
+    for norm, owner in owners.items():
+        block = None
+        if owner == my_proc and norm in local:
+            block = np.asarray(local[norm].data)
+        yield norm, owner, block
 
 
 _ASYNC = {"executor": None, "last": None}
 
 
-def _write_blocks(path, meta, blocks):
+def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
+                  prefix):
     for fname, block in blocks:
         # bfloat16 & friends: store as raw uint16/uint8 view + dtype tag
         if block.dtype.kind not in "biufc":
@@ -58,8 +169,51 @@ def _write_blocks(path, meta, blocks):
                                else np.uint16))
         else:
             np.save(os.path.join(path, fname), block)
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+
+    if world <= 1:
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        return
+    if store is None:
+        # SPMD without a store: metadata is identical on every process
+        # (deterministic filenames + global ownership map) — coordinator writes
+        if rank == coordinator_rank:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(meta, f, indent=1)
+        return
+
+    # Launcher mode: publish local metadata under this save's OWN store
+    # namespace (_store_prefix: path + restart epoch + save sequence), so
+    # stale keys from earlier saves or killed attempts are unreachable;
+    # the coordinator merges all ranks' entries and writes one metadata.json
+    # (reference: gather_object + coordinator write).
+    store.set(f"{prefix}/meta/{rank}", pickle.dumps(meta))
+    if rank == coordinator_rank:
+        merged, seen = {}, set()
+        for r in range(world):
+            part = pickle.loads(store.wait(f"{prefix}/meta/{r}"))
+            for name, entry in part.items():
+                cur = merged.setdefault(
+                    name, {"global_shape": entry["global_shape"],
+                           "dtype": entry["dtype"], "shards": []})
+                if (cur["global_shape"] != entry["global_shape"]
+                        or cur["dtype"] != entry["dtype"]):
+                    raise ValueError(
+                        f"rank {r} disagrees on {name}: "
+                        f"{entry['global_shape']}/{entry['dtype']} vs "
+                        f"{cur['global_shape']}/{cur['dtype']}")
+                for sh in entry["shards"]:
+                    key = (name, json.dumps(sh["index"]), sh["file"])
+                    if key not in seen:
+                        seen.add(key)
+                        cur["shards"].append(sh)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(merged, f, indent=1)
+        store.set(f"{prefix}/done", b"1")
+    else:
+        # checkpoint-complete semantics: return only once THIS save's
+        # metadata has landed (the done key lives in this save's namespace)
+        store.wait(f"{prefix}/done", timeout_ms=600_000)
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
@@ -72,32 +226,47 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     writer thread, so checkpoints never interleave."""
     from paddle_tpu.tensor.tensor import Tensor
 
+    rank, world = _env_rank_world(process_group)
     os.makedirs(path, exist_ok=True)
+    store = _ckpt_store() if world > 1 else None
+    prefix = _store_prefix(path, unique_id)
+
     meta = {}
     blocks = []
-    n_files = 0
     for name, value in state_dict.items():
+        if isinstance(value, ShardedWeight):
+            local = np.array(np.asarray(value.local), copy=True)
+            index = value.index
+            fname = _shard_fname(rank, name, index)
+            blocks.append((fname, local))
+            meta[name] = {
+                "global_shape": list(value.global_shape),
+                "dtype": str(local.dtype),
+                "shards": [{"index": [list(p) for p in index], "file": fname}],
+            }
+            continue
         arr = value.data if isinstance(value, Tensor) else value
         entry = {"global_shape": list(np.asarray(arr).shape)
                  if not hasattr(arr, "shape") else list(arr.shape),
                  "dtype": str(arr.dtype), "shards": []}
-        for norm_idx, block in _tensor_shards(arr):
-            fname = f"shard_{n_files}.npy"
-            n_files += 1
-            blocks.append((fname, block))  # host copy, safe from mutation
+        for norm_idx, owner, block in _iter_slices(arr, rank, coordinator_rank):
+            fname = _shard_fname(owner, name, norm_idx)
+            if block is not None:
+                blocks.append((fname, block))  # host copy, safe from mutation
             entry["shards"].append(
                 {"index": [list(p) for p in norm_idx], "file": fname}
             )
         meta[name] = entry
 
+    args = (path, meta, blocks, rank, world, coordinator_rank, store, prefix)
     if not async_save:
-        _write_blocks(path, meta, blocks)
+        _write_blocks(*args)
         return None
     from concurrent.futures import ThreadPoolExecutor
 
     if _ASYNC["executor"] is None:
         _ASYNC["executor"] = ThreadPoolExecutor(max_workers=1)
-    fut = _ASYNC["executor"].submit(_write_blocks, path, meta, blocks)
+    fut = _ASYNC["executor"].submit(_write_blocks, *args)
     _ASYNC["last"] = fut
     return fut
 

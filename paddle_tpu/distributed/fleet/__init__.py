@@ -129,6 +129,30 @@ def distributed_optimizer(optimizer, strategy=None):
                     parameters=optimizer._parameter_list,
                     grad_clip=optimizer._grad_clip,
                 )
+        if getattr(strategy, "lars", False):
+            from paddle_tpu.incubate.optimizer import LarsMomentumOptimizer
+
+            if not isinstance(optimizer, LarsMomentumOptimizer):
+                cfg = getattr(strategy, "lars_configs", None) or {}
+                optimizer = LarsMomentumOptimizer(
+                    learning_rate=optimizer._learning_rate,
+                    momentum=getattr(optimizer, "_momentum", 0.9),
+                    lars_coeff=cfg.get("lars_coeff", 0.001),
+                    lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                    epsilon=cfg.get("epsilon", 0.0),
+                    exclude_from_weight_decay=cfg.get(
+                        "exclude_from_weight_decay", []),
+                    parameters=optimizer._parameter_list,
+                    grad_clip=optimizer._grad_clip,
+                )
+        if getattr(strategy, "gradient_merge", False):
+            from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+            if not isinstance(optimizer, GradientMergeOptimizer):
+                cfg = getattr(strategy, "gradient_merge_configs", None) or {}
+                optimizer = GradientMergeOptimizer(
+                    optimizer, k_steps=cfg.get("k_steps", 1),
+                    avg=cfg.get("avg", True))
         if getattr(strategy, "fp16_allreduce", False):
             optimizer = _mo.FP16AllReduceOptimizer(optimizer)
         if getattr(strategy, "localsgd", False):

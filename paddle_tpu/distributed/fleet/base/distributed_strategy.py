@@ -54,6 +54,10 @@ class DistributedStrategy:
                                       sparsity=[0.999])
         self.lamb = False
         self.lars = False
+        self.lars_configs = _SubConfig(
+            lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=0.0,
+            exclude_from_weight_decay=[],
+        )
         self.localsgd = False
         self.localsgd_configs = _SubConfig(k_steps=1, begin_step=1)
         self.fp16_allreduce = False
@@ -69,7 +73,6 @@ class DistributedStrategy:
     # knobs the TPU runtime implements or deliberately delegates; enabling
     # anything in _UNIMPLEMENTED warns instead of silently no-opping
     _UNIMPLEMENTED = {
-        "lars": "LARS is not implemented; use optimizer-level Lamb or SGD",
         "heter_ccl_mode": "heterogeneous NCCL/Gloo mode has no TPU analog",
         "a_sync": "geo/async PS training is not implemented; the PS service "
                   "(distributed.ps) supports push_sparse_async instead",

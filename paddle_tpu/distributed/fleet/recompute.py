@@ -15,9 +15,34 @@ from paddle_tpu.tensor.tensor import Tensor
 __all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
 
 
+_POLICIES = {
+    None: None,
+    "full": None,  # save only the region inputs, recompute everything
+    # save matmul/conv outputs: backward recomputes only cheap elementwise
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    # save outputs tagged jax.ad_checkpoint.checkpoint_name(x, "ckpt")
+    "named": "save_only_these_names",
+}
+
+
+def _resolve_policy(name):
+    if name in (None, "full"):
+        return None
+    import jax.ad_checkpoint as adc
+
+    key = _POLICIES.get(name)
+    if key is None:
+        raise ValueError(
+            f"unknown recompute policy {name!r}; one of {sorted(_POLICIES)}")
+    pol = getattr(adc.checkpoint_policies, key)
+    return pol("ckpt") if name == "named" else pol
+
+
 def recompute(function, *args, **kwargs):
     use_reentrant = kwargs.pop("use_reentrant", True)  # noqa: F841 (API parity)
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # noqa: F841
+    policy = _resolve_policy(kwargs.pop("policy", None))
 
     fn = function.forward if hasattr(function, "forward") else function
 
@@ -41,7 +66,7 @@ def recompute(function, *args, **kwargs):
             is_leaf=lambda t: isinstance(t, Tensor),
         )
 
-    ck = jax.checkpoint(raw)
+    ck = jax.checkpoint(raw, policy=policy)
     return _engine.apply("recompute", lambda *xs: ck(*xs), *tensor_args)
 
 

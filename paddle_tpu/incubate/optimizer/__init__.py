@@ -1,5 +1,13 @@
 """paddle.incubate.optimizer (reference python/paddle/incubate/optimizer/)."""
+from paddle_tpu.incubate.optimizer.distributed_fused_lamb import (
+    DistributedFusedLamb,
+)
+from paddle_tpu.incubate.optimizer.gradient_merge import GradientMergeOptimizer
+from paddle_tpu.incubate.optimizer.lars_momentum import LarsMomentumOptimizer
 from paddle_tpu.incubate.optimizer.lookahead import LookAhead
 from paddle_tpu.incubate.optimizer.modelaverage import ModelAverage
 
-__all__ = ['LookAhead', 'ModelAverage']
+__all__ = [
+    'DistributedFusedLamb', 'GradientMergeOptimizer', 'LarsMomentumOptimizer',
+    'LookAhead', 'ModelAverage',
+]

@@ -54,6 +54,23 @@ class LlamaConfig:
     # stream between blocks stays sequence-sharded (requires
     # fleet.init(mp_degree>1) before model construction)
     sequence_parallel: bool = False
+    # tokens per chunk for the LM loss: >0 computes the big-vocab
+    # cross-entropy as a lax.scan over token chunks with per-chunk remat, so
+    # the (B*L, vocab) fp32 logits tensor (≈4.2GB at batch 16/seq 2048/32k
+    # vocab) never materializes — the usual TPU big-vocab loss shape; the
+    # reference materializes full logits (fused_softmax_mask kernels help
+    # softmax but not the memory)
+    loss_chunk_size: int = 0
+    # jax.checkpoint policy for per-layer recompute: None/"full" saves only
+    # layer inputs; "named" additionally saves the flash-attention output
+    # (checkpoint_name-tagged) so backward skips the quadratic attention
+    # recompute at b*l*h extra bytes per layer; "dots"/"dots_no_batch" save
+    # every matmul output (memory-hungry, small models only)
+    recompute_policy: str | None = None
+    # remat only the FIRST k decoder layers (None = all): un-remat layers
+    # keep their intermediates (~14*h bytes/token/layer in bf16) and cost no
+    # recompute FLOPs in backward — the HBM-for-FLOPs dial
+    recompute_layers: int | None = None
 
     # tiny preset used by tests / dryrun
     @staticmethod
@@ -96,6 +113,48 @@ def _sp_linears():
     row = lambda i, o: RowSequenceParallelLinear(
         i, o, has_bias=False, input_is_parallel=True, seq_axis=1)
     return col, row
+
+
+def _chunked_lm_loss_fn(chunk_size):
+    """Mean next-token cross-entropy computed chunk-by-chunk: the lm-head
+    matmul + fp32 softmax run on ``chunk_size`` tokens at a time inside a
+    ``lax.scan`` with per-chunk remat, so peak memory is one chunk's logits
+    (the backward rescans and recomputes each chunk's matmul)."""
+    import jax
+
+    def f(h, lab, w):  # h: (B, L, H) bf16, lab: (B, L) int, w: (H, V)
+        B, L, H = h.shape
+        n = B * L
+        if n == 0:  # seq_len == 1: no next-token targets exist
+            return jnp.zeros((), jnp.float32)
+        h2 = h.reshape(n, H)
+        lab2 = lab.reshape(n).astype(jnp.int32)
+        c = min(chunk_size, n)
+        pad = (-n) % c
+        if pad:  # pad with label -1 → masked out of the mean
+            h2 = jnp.concatenate([h2, jnp.zeros((pad, H), h2.dtype)], 0)
+            lab2 = jnp.concatenate([lab2, jnp.full((pad,), -1, jnp.int32)], 0)
+        hc = h2.reshape(-1, c, H)
+        lc = lab2.reshape(-1, c)
+
+        def chunk_loss(hx, lx):
+            logits = jnp.dot(hx, w, preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, jnp.maximum(lx, 0)[:, None], axis=-1)[:, 0]
+            valid = (lx >= 0).astype(jnp.float32)
+            return ((lse - gold) * valid).sum(), valid.sum()
+
+        chunk_loss = jax.checkpoint(chunk_loss)
+
+        def body(acc, xs):
+            s, k = chunk_loss(*xs)
+            return (acc[0] + s, acc[1] + k), None
+
+        (total, count), _ = jax.lax.scan(body, (0.0, 0.0), (hc, lc))
+        return total / jnp.maximum(count, 1.0)
+
+    return f
 
 
 class LlamaAttention(Layer):
@@ -161,6 +220,12 @@ class LlamaAttention(Layer):
                 q, k, v, attn_mask=attn_mask,
                 is_causal=attn_mask is None and l > 1,
             )
+        if cfg.recompute and cfg.recompute_policy == "named":
+            from jax.ad_checkpoint import checkpoint_name
+
+            # saved under the "named" remat policy: backward reuses the
+            # attention output instead of re-running the quadratic kernel
+            out = apply("attn_ckpt", lambda x: checkpoint_name(x, "ckpt"), out)
         out = M.reshape(out, [b, l, nh * hd])
         out = self.o_proj(out)
         if cache is not None:
@@ -241,10 +306,14 @@ class LlamaModel(Layer):
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             layer_fn = layer
-            if self.config.recompute and caches is None:
+            remat_this = self.config.recompute and caches is None and (
+                self.config.recompute_layers is None
+                or i < self.config.recompute_layers)
+            if remat_this:
                 from paddle_tpu.distributed.fleet.recompute import recompute
 
-                h = recompute(layer_fn, h, attn_mask)
+                h = recompute(layer_fn, h, attn_mask,
+                              policy=self.config.recompute_policy)
             elif caches is not None:
                 h, c = layer_fn(h, attn_mask, caches[i], position_offset)
                 new_caches.append(c)
@@ -274,6 +343,14 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         h = self.llama(input_ids, attn_mask)
+        if labels is not None and self.config.loss_chunk_size > 0:
+            w = (M.transpose(self.llama.embed_tokens.weight, [1, 0])
+                 if self.config.tie_word_embeddings else self.lm_head.weight)
+            return apply(
+                "chunked_lm_loss",
+                _chunked_lm_loss_fn(self.config.loss_chunk_size),
+                h[:, :-1, :], labels[:, 1:], w,
+            )
         if self.config.tie_word_embeddings:
             w = self.llama.embed_tokens.weight
             logits = F.linear(h, M.transpose(w, [1, 0]))

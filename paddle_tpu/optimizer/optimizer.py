@@ -44,6 +44,11 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = collections.defaultdict(dict)
         self._global_step = 0
         self._is_lr_scheduler = isinstance(learning_rate, LRScheduler)
+        # multi_precision: fp32 master weights for low-precision params in the
+        # functional (compiled) path; moment_dtype: storage dtype for the
+        # accumulators ("bfloat16" halves optimizer-state HBM, math stays fp32)
+        self._multi_precision = bool(kwargs.get("multi_precision", False))
+        self._moment_dtype = kwargs.get("moment_dtype", None)
 
     @staticmethod
     def _flatten_params(parameters):
@@ -196,35 +201,119 @@ class Optimizer:
                         )
 
     # ------------------------------------------------- jit/fused-step support
+    # 8-bit blockwise moment storage (moment_dtype="int8"): symmetric int8
+    # codes at param shape + one fp32 absmax scale per 256-value block, the
+    # bitsandbytes-style layout; update math always runs in fp32
+    _Q8_BLOCK = 256
+
+    @classmethod
+    def _q8_encode(cls, x):
+        b = cls._Q8_BLOCK
+        flat = x.reshape(-1)
+        n = flat.size
+        nb = -(-n // b)
+        fp = jnp.pad(flat, (0, nb * b - n)).reshape(nb, b)
+        s = jnp.max(jnp.abs(fp), axis=1) / 127.0
+        codes = jnp.round(fp / jnp.maximum(s, 1e-30)[:, None])
+        codes = codes.reshape(-1)[:n].reshape(x.shape).astype(jnp.int8)
+        return codes, s.astype(jnp.float32)
+
+    @classmethod
+    def _q8_decode(cls, codes, s):
+        b = cls._Q8_BLOCK
+        flat = codes.reshape(-1).astype(jnp.float32)
+        n = flat.size
+        nb = s.shape[0]
+        fp = jnp.pad(flat, (0, nb * b - n)).reshape(nb, b) * s[:, None]
+        return fp.reshape(-1)[:n].reshape(codes.shape)
+
     def functional_update(self, params: dict, grads: dict, states: dict, lr):
         """Pure update over flat dicts of arrays — called inside jitted train steps
         (static mode / distributed fused path).  states layout:
-        {acc_name: {param_name: array}}."""
-        new_params, new_states = {}, {n: {} for n in self._accum_names}
+        {acc_name: {param_name: array}}; optional "master_weight" sub-dict
+        holds fp32 shadows for low-precision params (multi_precision).
+        Accumulators stored below fp32 (moment_dtype) are widened to fp32 for
+        the update math and narrowed back for storage."""
+        new_params = {}
+        new_states = {n: {} for n in states}
+        masters = states.get("master_weight", {})
         for k, p_arr in params.items():
             g = grads.get(k)
             if g is None:
                 new_params[k] = p_arr
-                for n in self._accum_names:
-                    new_states[n][k] = states[n][k]
+                for n in states:
+                    if k in states[n]:
+                        new_states[n][k] = states[n][k]
                 continue
             g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
             if self._l2_coeff and not getattr(self, "_decoupled", False):
-                g = g + self._l2_coeff * p_arr.astype(g.dtype)
-            holder = _ArrayParam(p_arr, name=k)
-            st = {n: states[n][k] for n in self._accum_names}
+                g = g + self._l2_coeff * (
+                    masters[k] if k in masters else p_arr).astype(g.dtype)
+            holder = _ArrayParam(masters.get(k, p_arr), name=k)
+            st = {}
+            for n in self._accum_names:
+                sv = states[n][k]
+                if sv.dtype == jnp.int8 and (n + "@scale") in states:
+                    st[n] = self._q8_decode(sv, states[n + "@scale"][k])
+                elif sv.dtype in (jnp.bfloat16, jnp.float16):
+                    st[n] = sv.astype(jnp.float32)
+                else:
+                    st[n] = sv
             np_, ns = self._update(holder, g, st, lr)
             new_params[k] = np_.astype(p_arr.dtype)
+            if k in masters:
+                new_states["master_weight"][k] = np_.astype(jnp.float32)
             for n, v in ns.items():
-                new_states[n][k] = v
+                if states[n][k].dtype == jnp.int8 and (n + "@scale") in states:
+                    codes, scale = self._q8_encode(v)
+                    new_states[n][k] = codes
+                    new_states[n + "@scale"][k] = scale
+                else:
+                    new_states[n][k] = v.astype(states[n][k].dtype)
         return new_params, new_states
 
+    def _moment_storage(self, name):
+        """Storage dtype for accumulator ``name`` under self._moment_dtype.
+        "int8" applies blockwise int8 to FIRST moments only; second moments
+        (grad^2) span too much dynamic range for linear int8 quantization
+        (the 8-bit-Adam paper needs dynamic quant there) and are stored bf16
+        — exponent-coded, so tiny v never truncates to a zero denominator."""
+        md = self._moment_dtype
+        if md is None:
+            return None
+        if md == "int8":
+            first = ("moment1", "moment", "velocity", "avg_grad")
+            return jnp.int8 if name in first else jnp.bfloat16
+        return jnp.dtype(md)
+
     def functional_init_states(self, params: dict):
-        return {
-            n: {k: jnp.zeros(v.shape, jnp.float32 if v.dtype == jnp.bfloat16 else v.dtype)
-                for k, v in params.items()}
-            for n in self._accum_names
-        }
+        low = (jnp.bfloat16, jnp.float16)
+
+        states = {}
+        for n in self._accum_names:
+            stor = self._moment_storage(n)
+
+            def acc_dtype(v, stor=stor):
+                if stor is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                    return stor
+                return jnp.float32 if v.dtype in low else v.dtype
+
+            states[n] = {
+                k: jnp.zeros(v.shape, acc_dtype(v)) for k, v in params.items()
+            }
+            if stor == jnp.int8:
+                states[n + "@scale"] = {
+                    k: jnp.zeros((-(-int(np.prod(v.shape)) // self._Q8_BLOCK),),
+                                 jnp.float32)
+                    for k, v in params.items()
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                }
+        if self._multi_precision:
+            states["master_weight"] = {
+                k: v.astype(jnp.float32)
+                for k, v in params.items() if v.dtype in low
+            }
+        return states
 
 
 class _ArrayParam:

@@ -23,7 +23,8 @@ class SGD(Optimizer):
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision=multi_precision)
 
     def _update(self, p, g, state, lr):
         return p.data - lr * g.astype(p.data.dtype), {}
@@ -35,7 +36,8 @@ class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=False, rescale_grad=1.0, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision=multi_precision)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
         self._rescale = rescale_grad
@@ -56,8 +58,10 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, use_multi_tensor=False, amsgrad=False,
-                 name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+                 moment_dtype=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision=multi_precision,
+                         moment_dtype=moment_dtype)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._amsgrad = amsgrad
         if amsgrad:
@@ -87,10 +91,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, amsgrad=False, name=None):
+                 multi_precision=False, amsgrad=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         amsgrad=amsgrad, moment_dtype=moment_dtype, name=name)
         self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
@@ -254,7 +259,8 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
-        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision=multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._lamb_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn

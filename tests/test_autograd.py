@@ -187,3 +187,109 @@ class TestPyLayer:
         Mul.apply(a, b).sum().backward()
         np.testing.assert_allclose(a.grad.numpy(), [3.0])
         np.testing.assert_allclose(b.grad.numpy(), [2.0])
+
+
+class TestDispatchCache:
+    """Eager dispatch cache (SURVEY §7: per-(op, shapes, dtypes) jit cache)."""
+
+    def test_cache_hits_on_repeat_and_keys_on_shape(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import engine
+
+        x = paddle.to_tensor(np.ones((4, 4), "float32"))
+        x.stop_gradient = False
+        before = dict(engine.dispatch_cache_info())
+        y = (x * 2.0).sum(); y.backward()
+        mid = dict(engine.dispatch_cache_info())
+        x2 = paddle.to_tensor(np.ones((4, 4), "float32"))
+        x2.stop_gradient = False
+        y2 = (x2 * 2.0).sum(); y2.backward()
+        after = dict(engine.dispatch_cache_info())
+        assert after["hits"] > mid["hits"]  # identical signature: cache hit
+        x3 = paddle.to_tensor(np.ones((8, 4), "float32"))  # new shape: miss
+        (x3 * 2.0).sum()
+        assert engine.dispatch_cache_info()["misses"] > after["misses"]
+
+    def test_closure_constants_key_the_cache(self):
+        """Two ops with the same code but different captured scalars must not
+        collide (the stale-closure hazard of code-keyed caches)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd.engine import apply
+
+        def make(scale):
+            def f(a):
+                return a * scale
+            return f
+
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        a = apply("scale_op", make(2.0), x)
+        b = apply("scale_op", make(5.0), x)
+        np.testing.assert_allclose(a.numpy(), 2.0)
+        np.testing.assert_allclose(b.numpy(), 5.0)
+
+    def test_array_closure_bypasses_cache(self):
+        """fns closing over arrays (PRNG keys, weights) are identity-unsafe
+        and must bypass, not poison, the cache."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import engine
+        from paddle_tpu.autograd.engine import apply
+
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        outs = []
+        for v in (1.0, 3.0):
+            arr = jnp.full((4,), v)
+
+            def f(a, _arr=arr):
+                return a + _arr
+
+            before = engine.dispatch_cache_info()["bypass"]
+            outs.append(apply("arrclose_op", f, x).numpy())
+            assert engine.dispatch_cache_info()["bypass"] > before
+        np.testing.assert_allclose(outs[0], 2.0)
+        np.testing.assert_allclose(outs[1], 4.0)
+
+    def test_grads_identical_with_and_without_cache(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.autograd import engine
+
+        def run():
+            paddle.seed(5)
+            net = nn.Sequential(nn.Linear(6, 8), nn.GELU(), nn.Linear(8, 2))
+            x = paddle.to_tensor(np.random.RandomState(0).randn(3, 6).astype("float32"))
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            return [p.grad.numpy().copy() for p in net.parameters()]
+
+        engine.enable_dispatch_cache(False)
+        try:
+            g_off = run()
+        finally:
+            engine.enable_dispatch_cache(True)
+        g_on = run()
+        g_on2 = run()  # second pass: exercised through cache hits
+        for a, b, c in zip(g_off, g_on, g_on2):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+            np.testing.assert_allclose(b, c, rtol=1e-6)
+
+    def test_double_grad_through_cached_op(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        y = x * x * x  # cached mul ops
+        (g,) = paddle.grad(y, x, create_graph=True)
+        (gg,) = paddle.grad(g, x)
+        np.testing.assert_allclose(g.numpy(), 12.0, rtol=1e-5)   # 3x^2
+        np.testing.assert_allclose(gg.numpy(), 12.0, rtol=1e-5)  # 6x

@@ -1,0 +1,229 @@
+"""Multi-host-correct distributed checkpoint (VERDICT r2 item 3).
+
+Reference: python/paddle/distributed/checkpoint/save_state_dict.py:145
+(per-rank files + gathered global metadata) and load_state_dict.py:467
+(read only the shards overlapping the local placement).
+
+Covers: rank-unique shard files with coordinator-merged metadata across real
+processes, reshard-on-load onto a different process layout, global dedup of
+replicated jax shards, overlap-only loads, and checkpoint/resume through the
+launcher's kill-recover path.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SAVER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from paddle_tpu.distributed.checkpoint import ShardedWeight, save_state_dict
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+rows = 4
+local = np.arange(rows * 4, dtype=np.float32).reshape(rows, 4) + 100 * rank
+state = {{
+    "w": ShardedWeight(local, global_shape=(12, 4), global_offset=(rank * rows, 0)),
+    "bias": np.full((3,), 7.0, np.float32),  # replicated: coordinator writes
+}}
+save_state_dict(state, {path!r})
+"""
+
+_LOADER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from paddle_tpu.distributed.checkpoint import ShardedWeight, load_state_dict
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+rows = 6  # DIFFERENT sharding than the 3-way save: 2 ranks x 6 rows
+state = {{
+    "w": ShardedWeight(np.zeros((rows, 4), np.float32),
+                       global_shape=(12, 4), global_offset=(rank * rows, 0)),
+    "bias": np.zeros((3,), np.float32),
+}}
+load_state_dict(state, {path!r})
+got = state["w"].local
+expect = np.concatenate([
+    np.arange(16, dtype=np.float32).reshape(4, 4) + 100 * r for r in range(3)
+])[rank * rows:(rank + 1) * rows]
+np.testing.assert_allclose(got, expect)
+np.testing.assert_allclose(state["bias"], 7.0)
+print("LOAD_OK", rank)
+"""
+
+
+def _spawn_world(script_tmpl, world, master, **fmt):
+    procs = []
+    for r in range(world):
+        env = {**os.environ, "PADDLE_TRAINER_ID": str(r),
+               "PADDLE_TRAINERS_NUM": str(world), "PADDLE_MASTER": master,
+               "PYTHONPATH": REPO}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script_tmpl.format(repo=REPO, **fmt)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    return [p.returncode for p in procs], outs
+
+
+def test_multiprocess_save_merges_metadata_no_collisions(tmp_path):
+    from paddle_tpu.core.native import TCPStoreServer
+
+    srv = TCPStoreServer(port=0)
+    try:
+        master = f"127.0.0.1:{srv.port}"
+        path = str(tmp_path / "ckpt")
+        rcs, outs = _spawn_world(_SAVER, 3, master, path=path)
+        assert rcs == [0, 0, 0], outs
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        # one merged metadata covering all 3 w-slices + the replicated bias
+        assert meta["w"]["global_shape"] == [12, 4]
+        assert len(meta["w"]["shards"]) == 3
+        assert len(meta["bias"]["shards"]) == 1
+        files = [s["file"] for e in meta.values() for s in e["shards"]]
+        assert len(files) == len(set(files))  # rank-unique, no collisions
+        # every referenced file exists; rank tag present in the name
+        for fn in files:
+            assert os.path.exists(os.path.join(path, fn)), fn
+            assert fn.startswith("shard_r"), fn
+        # reshard-on-load with a DIFFERENT world size (2 ranks x 6 rows)
+        rcs, outs = _spawn_world(_LOADER, 2, master, path=path)
+        assert rcs == [0, 0], outs
+        assert all("LOAD_OK" in o for o in outs)
+    finally:
+        srv.stop()
+
+
+def test_sharded_jax_save_dedups_replicas_and_loads_overlap(tmp_path):
+    """NamedSharding save writes one file per DISTINCT slice (replicas
+    deduplicated), and load onto a different sharding reads per-device
+    overlaps without a host-side global assembly."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict,
+    )
+    from paddle_tpu.tensor.tensor import Tensor
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "mp"))
+    x = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+    # shard rows over mp (2 distinct slices), REPLICATED over dp (4 copies)
+    arr = jax.device_put(x, NamedSharding(mesh, P("mp", None)))
+    path = str(tmp_path / "jx")
+    save_state_dict({"x": Tensor(arr)}, path)
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    assert len(meta["x"]["shards"]) == 2  # dedup: distinct slices, not 8 devs
+    data_files = [f for f in os.listdir(path) if f.endswith(".npy")]
+    assert len(data_files) == 2
+
+    # load into a DIFFERENT layout: cols over mp, rows over dp
+    dst = jax.device_put(jnp.zeros((16, 8)), NamedSharding(mesh, P("dp", "mp")))
+    t = Tensor(dst)
+    load_state_dict({"x": t}, path)
+    np.testing.assert_allclose(np.asarray(t.data), np.asarray(x))
+    assert t.data.sharding.spec == P("dp", "mp")  # destination layout kept
+
+
+def test_load_missing_region_raises(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (
+        ShardedWeight, load_state_dict, save_state_dict,
+    )
+
+    path = str(tmp_path / "gap")
+    save_state_dict(
+        {"w": ShardedWeight(np.ones((4, 4), np.float32), (8, 4), (0, 0))},
+        path)
+    import pytest
+
+    with pytest.raises(ValueError, match="does not cover"):
+        load_state_dict(
+            {"w": ShardedWeight(np.zeros((8, 4), np.float32), (8, 4), (0, 0))},
+            path)
+
+
+_KR_WORKER = """
+import json, os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from paddle_tpu.distributed.checkpoint import (
+    ShardedWeight, load_state_dict, save_state_dict)
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+workdir = {workdir!r}
+
+latest = os.path.join(workdir, "LATEST")
+start = 0
+w = np.zeros(4, np.float32)  # this rank's slice of the global (8,) param
+if os.path.exists(latest):
+    with open(latest) as f:
+        start = int(f.read())
+    state = {{"w": ShardedWeight(np.zeros(4, np.float32), (8,), (rank * 4,)),
+              "step": np.zeros((), np.int64)}}
+    load_state_dict(state, os.path.join(workdir, f"step_{{start - 1}}"))
+    w = state["w"].local
+    assert int(state["step"]) == start - 1, (int(state["step"]), start)
+
+TOTAL = 8
+for step in range(start, TOTAL):
+    w = w + (rank + 1)  # the training step
+    save_state_dict(
+        {{"w": ShardedWeight(w, (8,), (rank * 4,)),
+          "step": np.asarray(step, np.int64)}},
+        os.path.join(workdir, f"step_{{step}}"))
+    if rank == 0:  # coordinator: save has landed cluster-wide when it returns
+        tmp = latest + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(step + 1))
+        os.replace(tmp, latest)
+    if rank == 1 and restart == 0 and step == 3:
+        os.kill(os.getpid(), signal.SIGKILL)  # die mid-training
+    time.sleep(0.02)
+
+with open(os.path.join(workdir, f"done_{{rank}}_{{restart}}"), "w") as f:
+    f.write(json.dumps({{"w": w.tolist(), "step": TOTAL}}))
+"""
+
+
+def test_kill_recover_resumes_through_dist_checkpoint(tmp_path):
+    """SIGKILL one worker mid-training; the relaunched peer group resumes
+    from the per-rank sharded checkpoint — the multi-process extension of
+    test_launch's kill-recover (VERDICT r2: 'the launcher's kill-recover
+    story doesn't extend past one host')."""
+    workdir = str(tmp_path)
+    script = tmp_path / "train.py"
+    script.write_text(_KR_WORKER.format(repo=REPO, workdir=workdir))
+    log_dir = os.path.join(workdir, "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", "--max_restarts=1", "--log_dir", log_dir,
+         "--job_id", "ckptjob", str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    done = [p for p in os.listdir(workdir) if p.startswith("done_")]
+    # both ranks finished after exactly one restart
+    assert sorted(done) == ["done_0_1", "done_1_1"], sorted(done)
+    for r in (0, 1):
+        with open(os.path.join(workdir, f"done_{r}_1")) as f:
+            rec = json.load(f)
+        # 8 steps of +(rank+1) survived the kill: the checkpoint carried them
+        np.testing.assert_allclose(rec["w"], [(r + 1) * 8.0] * 4)
+    # the resumed run really loaded from a step dir with merged metadata
+    with open(os.path.join(workdir, "step_3", "metadata.json")) as f:
+        meta = json.load(f)
+    assert len(meta["w"]["shards"]) == 2

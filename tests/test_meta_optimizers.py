@@ -204,3 +204,194 @@ class TestDGCStrictTopK:
         delta = w0 - lin.weight.numpy()
         # step 1, u = g, v = g; encoded = v (all), nesterov = encoded + m*u
         np.testing.assert_allclose(delta, g + m * g, rtol=1e-5)
+
+
+class TestLarsMomentum:
+    """VERDICT r2 item 8: LARS stops warning and starts working.
+    Reference incubate/optimizer/lars_momentum.py formula."""
+
+    def test_converges_on_regression(self):
+        """LARS holds the effective step at lr*coeff*||p||/||g||, so it needs
+        the decaying LR schedule it was designed around (You et al. use
+        poly decay); with one it converges tightly."""
+        from paddle_tpu.incubate.optimizer import LarsMomentumOptimizer
+
+        X, Y = _problem()
+        paddle.seed(5)
+        model = nn.Linear(D, 1)
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(
+            learning_rate=2.0, T_max=300)
+        opt = LarsMomentumOptimizer(
+            learning_rate=sched, momentum=0.9, lars_coeff=0.1,
+            lars_weight_decay=1e-3, parameters=model.parameters())
+        loss_fn = nn.MSELoss()
+        xb, yb = paddle.to_tensor(X), paddle.to_tensor(Y)
+        for _ in range(300):
+            loss = loss_fn(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            sched.step()
+        assert float(loss.numpy()) < 0.01, float(loss.numpy())
+
+    def test_update_matches_reference_formula(self):
+        from paddle_tpu.incubate.optimizer import LarsMomentumOptimizer
+
+        w = paddle.create_parameter([4], "float32")
+        w.set_value(np.array([3.0, 0.0, 4.0, 0.0], "float32"))  # ||p|| = 5
+        opt = LarsMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, lars_coeff=0.01,
+            lars_weight_decay=0.5, parameters=[w])
+        g = np.array([0.0, 3.0, 0.0, 4.0], "float32")  # ||g|| = 5
+        w.grad = paddle.to_tensor(g)
+        opt.step()
+        # local_lr = 0.1 * 0.01 * 5 / (5 + 0.5*5) = 1/1500
+        # v = local_lr * (g + 0.5 * p); p_new = p - v
+        local_lr = 0.1 * 0.01 * 5 / 7.5
+        v = local_lr * (g + 0.5 * np.array([3, 0, 4, 0], "float32"))
+        np.testing.assert_allclose(
+            w.numpy(), np.array([3, 0, 4, 0], "float32") - v, rtol=1e-5)
+
+    def test_exclude_from_weight_decay(self):
+        from paddle_tpu.incubate.optimizer import LarsMomentumOptimizer
+
+        w = paddle.create_parameter([2], "float32", name="batch_norm_scale")
+        w.set_value(np.array([1.0, 1.0], "float32"))
+        opt = LarsMomentumOptimizer(
+            learning_rate=0.1, momentum=0.0, lars_coeff=0.1,
+            lars_weight_decay=0.9, parameters=[w],
+            exclude_from_weight_decay=["batch_norm"])
+        w.grad = paddle.to_tensor(np.array([1.0, 1.0], "float32"))
+        opt.step()
+        # excluded: wd = 0 -> plain momentum at the base lr
+        # (reference kernel: lars scaling only when lars_weight_decay > 0)
+        np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 1.0, rtol=1e-5)
+
+    def test_strategy_wires_lars_without_warning(self):
+        import warnings
+
+        from paddle_tpu.incubate.optimizer import LarsMomentumOptimizer
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            strategy = fleet.DistributedStrategy()
+            strategy.lars = True
+            strategy.lars_configs = {"lars_coeff": 0.02}
+        assert not [w for w in rec if "NOT implemented" in str(w.message)]
+        m = nn.Linear(D, 1)
+        base = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=m.parameters())
+        opt = fleet.distributed_optimizer(base, strategy)
+        assert isinstance(opt, LarsMomentumOptimizer)
+        assert opt._lars_coeff == 0.02
+
+
+class TestGradientMerge:
+    def test_eager_accumulates_then_applies(self):
+        from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+        w = paddle.create_parameter([2], "float32")
+        w.set_value(np.zeros(2, "float32"))
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+        opt = GradientMergeOptimizer(inner, k_steps=4, avg=True)
+        grads = [np.array([1.0, 2.0], "float32") * (i + 1) for i in range(4)]
+        for i, g in enumerate(grads):
+            w.grad = paddle.to_tensor(g)
+            opt.step()
+            if i < 3:  # no update until the k-th step
+                np.testing.assert_allclose(w.numpy(), 0.0)
+        # avg of grads = [2.5, 5.0]; SGD lr=1 -> w = -avg
+        np.testing.assert_allclose(w.numpy(), [-2.5, -5.0], rtol=1e-6)
+
+    def test_compiled_step_parity_with_large_batch(self):
+        """GM(k) over k microbatches == one step on the concatenated batch
+        (exact for SGD + mean losses)."""
+        from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+        from paddle_tpu.static.functionalize import build_train_step
+
+        X, Y = _problem()
+        init = np.random.RandomState(1).randn(D, 1).astype("float32")
+
+        def make(k_steps):
+            m = nn.Linear(D, 1, bias_attr=False)
+            m.weight.set_value(init)
+            inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                         parameters=m.parameters())
+            opt = (GradientMergeOptimizer(inner, k_steps=k_steps, avg=True)
+                   if k_steps > 1 else inner)
+            return m, build_train_step(m, nn.MSELoss(), opt)
+
+        m_big, step_big = make(1)
+        step_big(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+        m_gm, step_gm = make(4)
+        for i in range(4):
+            step_gm(paddle.to_tensor(X[i * 16:(i + 1) * 16]),
+                    paddle.to_tensor(Y[i * 16:(i + 1) * 16]))
+        np.testing.assert_allclose(
+            m_gm.weight.numpy(), m_big.weight.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_strategy_wires_gradient_merge(self):
+        from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+        strategy = fleet.DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 3, "avg": False}
+        m = nn.Linear(D, 1)
+        base = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=m.parameters())
+        opt = fleet.distributed_optimizer(base, strategy)
+        assert isinstance(opt, GradientMergeOptimizer)
+        assert opt.k_steps == 3 and opt.avg is False
+
+
+class TestDistributedFusedLamb:
+    def test_converges_and_matches_lamb(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        from paddle_tpu.static.functionalize import build_train_step
+
+        X, Y = _problem()
+        init = np.random.RandomState(2).randn(D, 1).astype("float32")
+
+        def run(opt_cls, **kw):
+            m = nn.Linear(D, 1, bias_attr=False)
+            m.weight.set_value(init)
+            opt = opt_cls(learning_rate=0.05, parameters=m.parameters(), **kw)
+            step = build_train_step(m, nn.MSELoss(), opt)
+            for _ in range(50):
+                l = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            return m.weight.numpy(), float(l.numpy())
+
+        w_ref, l_ref = run(paddle.optimizer.Lamb, lamb_weight_decay=0.01)
+        w_dfl, l_dfl = run(DistributedFusedLamb, lamb_weight_decay=0.01)
+        np.testing.assert_allclose(w_dfl, w_ref, rtol=1e-4, atol=1e-6)
+        assert l_dfl < 1.0
+
+    def test_rejects_non_global_norm_clip(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+        with pytest.raises(TypeError, match="ClipGradByGlobalNorm"):
+            DistributedFusedLamb(parameters=[], grad_clip=nn.ClipGradByValue(1.0))
+
+    def test_gradient_accumulation_steps(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        from paddle_tpu.static.functionalize import build_train_step
+
+        X, Y = _problem()
+        init = np.random.RandomState(3).randn(D, 1).astype("float32")
+
+        def run(acc_steps, feeds):
+            m = nn.Linear(D, 1, bias_attr=False)
+            m.weight.set_value(init)
+            opt = DistributedFusedLamb(
+                learning_rate=0.05, parameters=m.parameters(),
+                gradient_accumulation_steps=acc_steps)
+            step = build_train_step(m, nn.MSELoss(), opt)
+            for xb, yb in feeds:
+                step(paddle.to_tensor(xb), paddle.to_tensor(yb))
+            return m.weight.numpy()
+
+        w_acc = run(4, [(X[i * 16:(i + 1) * 16], Y[i * 16:(i + 1) * 16])
+                        for i in range(4)])
+        w_big = run(1, [(X, Y)])
+        np.testing.assert_allclose(w_acc, w_big, rtol=1e-4, atol=1e-6)
