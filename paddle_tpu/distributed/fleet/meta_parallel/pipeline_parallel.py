@@ -94,7 +94,8 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, labels,
-                        num_microbatches, mesh, axis="pp"):
+                        num_microbatches, mesh, axis="pp", dp_axis=None,
+                        param_specs=None):
     """Compiled 1F1B training step: forward AND backward written explicitly in
     ONE ``lax.scan``, so per-stage live activations are bounded by the ring
     buffer ``W = min(M, 2S-1)`` — O(S), independent of the microbatch count —
@@ -115,6 +116,13 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, labels,
 
     stage_fn: (params_one_stage, activation[mb, ...]) -> activation[mb, ...]
     loss_fn:  (activation[mb, ...], label[mb, ...]) -> scalar
+
+    Hybrid composition: ``dp_axis`` shards the within-microbatch batch dim
+    over that mesh axis (the grad allreduce over dp happens once, inside the
+    compiled step); ``param_specs`` overrides the per-leaf stacked-param
+    PartitionSpecs so stage weights can additionally be tensor-parallel —
+    the stage_fn then uses lax collectives over the mp axis (full-manual
+    shard_map exposes every mesh axis).
     """
     S = mesh.shape[axis]
     M = int(num_microbatches)
@@ -182,18 +190,29 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, labels,
         (_, _, _, gacc, lacc), _ = jax.lax.scan(
             tick, (fwd0, bwd0, buf0, gacc0, lacc0),
             jnp.arange(T, dtype=jnp.int32))
+        if dp_axis is not None:
+            # the one dp sync of the step: each shard's loss_fn is a mean
+            # over its slice, so the full-batch mean-loss grad is the MEAN
+            # of shard grads (pmean = the reference's scaled allreduce)
+            gacc = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, dp_axis), gacc)
+            lacc = jax.lax.pmean(lacc, dp_axis)
         grads = jax.tree_util.tree_map(lambda g: g[None], gacc)
         return lacc[None], grads
 
-    pspecs = jax.tree_util.tree_map(
-        lambda a: P(*((axis,) + (None,) * (a.ndim - 1))), stacked_params
-    )
+    pspecs = (param_specs if param_specs is not None
+              else jax.tree_util.tree_map(
+                  lambda a: P(*((axis,) + (None,) * (a.ndim - 1))),
+                  stacked_params))
     gspecs = pspecs
+    data_spec = (P(None, dp_axis, *(None,) * (x_mb.ndim - 2))
+                 if dp_axis is not None else P(*(None,) * x_mb.ndim))
+    lbl_spec = (P(None, dp_axis, *(None,) * (lbl_mb.ndim - 2))
+                if dp_axis is not None else P(*(None,) * lbl_mb.ndim))
     loss_s, grads = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(pspecs, P(*(None,) * x_mb.ndim),
-                  P(*(None,) * lbl_mb.ndim)),
+        in_specs=(pspecs, data_spec, lbl_spec),
         out_specs=(P(axis), gspecs),
         check_vma=False,
     )(stacked_params, x_mb, lbl_mb)
@@ -320,7 +339,7 @@ class PipelineParallel(Layer):
                             continue
                         inp, src = saved[(g, m)]
                     gouts = None if cot is None else [cot]
-                    if schedule == "ZBH1":
+                    if schedule in ("ZBH1", "ZBVPP"):
                         # B/W split in ONE backward walk: dx plus the stage's
                         # param grads are captured together, but the param
                         # grads are only APPLIED by the deferred W op — the
@@ -370,8 +389,13 @@ class PipelineParallel(Layer):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         cfg = getattr(self._strategy, "pipeline_configs", None) or {}
         num_chunks = getattr(self, "num_model_chunks", 1)
-        # interleaved chunks need the chunk-aware stream
-        schedule = "VPP" if num_chunks > 1 else cfg.get("schedule_mode", "1F1B")
+        # interleaved chunks need a chunk-aware stream (VPP, or zero-bubble
+        # ZBVPP when the strategy asks for it)
+        mode = cfg.get("schedule_mode", "1F1B")
+        if num_chunks > 1:
+            schedule = mode if mode in ("VPP", "ZBVPP") else "VPP"
+        else:
+            schedule = mode
         inputs, labels = data
         optimizer.clear_grad()
         total = self._run_schedule(

@@ -9,7 +9,7 @@ streams drive the eager train_batch path and make schedule semantics testable
 exactly like the reference's pass unit tests (test/distributed_passes)."""
 from __future__ import annotations
 
-__all__ = ["FThenB", "F1B1", "Eager1F1B", "VPP", "ZBH1", "get_schedule"]
+__all__ = ["FThenB", "F1B1", "Eager1F1B", "VPP", "ZBH1", "ZBVPP", "get_schedule"]
 
 
 def FThenB(stage, num_stages, num_micro, num_chunks=1):
@@ -89,8 +89,33 @@ def ZBH1(stage, num_stages, num_micro, num_chunks=1):
     return prog
 
 
+def ZBVPP(stage, num_stages, num_micro, num_chunks=2):
+    """Zero-bubble virtual pipeline (reference pipeline_zero_bubble.py
+    ZBVPP / PipelineZeroBubbleVirtualPipeline): VPP's interleaved chunk
+    placement for forwards, with every backward split into activation-grad
+    (B) and weight-grad (W).  W ops are deferred one slot (ZBH1's lag) so
+    they fill what would otherwise be drain-bubble ticks."""
+    prog = []
+    group = num_stages
+    for g0 in range(0, num_micro, group):
+        mbs = range(g0, min(g0 + group, num_micro))
+        for c in range(num_chunks):
+            prog += [("F", m, c) for m in mbs]
+    pending_w = []
+    for g0 in reversed(range(0, num_micro, group)):
+        mbs = range(g0, min(g0 + group, num_micro))
+        for c in reversed(range(num_chunks)):
+            for m in mbs:
+                prog.append(("B", m, c))
+                pending_w.append(("W", m, c))
+                if len(pending_w) > 1:  # one-slot lag: W fills the bubble
+                    prog.append(pending_w.pop(0))
+    prog.extend(pending_w)
+    return prog
+
+
 _SCHEDULES = {"FThenB": FThenB, "1F1B": F1B1, "Eager1F1B": Eager1F1B,
-              "VPP": VPP, "ZBH1": ZBH1}
+              "VPP": VPP, "ZBH1": ZBH1, "ZBVPP": ZBVPP}
 
 
 def get_schedule(name):

@@ -1,0 +1,5 @@
+"""fleet.utils (reference python/paddle/distributed/fleet/utils/)."""
+from paddle_tpu.distributed.fleet.recompute import (  # noqa: F401
+    recompute, recompute_sequential,
+)
+from paddle_tpu.distributed.fleet.utils import pp_parallel_adaptor  # noqa: F401

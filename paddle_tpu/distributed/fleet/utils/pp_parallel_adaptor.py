@@ -1,0 +1,159 @@
+"""Pipeline-parallel checkpoint adaptor (reference
+python/paddle/distributed/fleet/utils/pp_parallel_adaptor.py —
+ParallelConfig:24, PipeLineModelAdaptor:82).
+
+Converts a pipeline-parallel checkpoint saved under one (pp, vpp) layout into
+another: per-stage files hold their segment's layers under SEGMENT-LOCAL
+indices, so moving between layouts means regrouping the global layer sequence
+and renumbering each destination segment from zero (the reference's
+LayerReNamingManager).
+
+TPU-native notes: stage files here are plain ``paddle.save`` dicts
+(``model_state.pp{i:02d}.pdparams``), the layout our launcher-mode pipeline
+runs write — no ProgramDesc segments.  vpp interleaving uses the reference's
+chunk-major placement: virtual chunk ``c`` of stage ``s`` owns layer group
+``c * pp + s``.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["ParallelConfig", "PipeLineModelAdaptor", "adaptor_from_args"]
+
+
+class ParallelConfig:
+    def __init__(self, mp: int, pp: int, vpp: int = 1, sharding: int = 1):
+        self.mp = int(mp)
+        self.pp = int(pp)
+        self.vpp = int(vpp)
+        self.sharding = int(sharding)
+
+    def __repr__(self):
+        return (f"ParallelConfig(mp={self.mp}, pp={self.pp}, vpp={self.vpp}, "
+                f"sharding={self.sharding})")
+
+
+_LAYER_RE = re.compile(r"^(.*?)(\d+)\.(.*)$")
+
+
+def _split_layer_key(name):
+    """'layers.3.linear.weight' -> ('layers.', 3, 'linear.weight')."""
+    m = _LAYER_RE.match(name)
+    if m is None:
+        return None
+    return m.group(1), int(m.group(2)), m.group(3)
+
+
+class PipeLineModelAdaptor:
+    def __init__(self, src_parallel_config: ParallelConfig,
+                 dst_parallel_config: ParallelConfig,
+                 transformer_layer_num: int = 0, segment_method="layer"):
+        self._src = src_parallel_config
+        self._dst = dst_parallel_config
+        self._layer_num = int(transformer_layer_num)
+        self._segment_method = segment_method
+        if self._src.mp != self._dst.mp:
+            raise ValueError(
+                "pp adaptor only converts the pipeline layout; change mp "
+                "with reshard-on-load (distributed.checkpoint)")
+
+    # ------------------------------------------------------------- file io
+    @staticmethod
+    def _stage_file(dir_, i):
+        return os.path.join(dir_, f"model_state.pp{i:02d}.pdparams")
+
+    def peek_model(self, model_dir):
+        """List (stage_file, layer_index -> [param names]) for inspection."""
+        import paddle_tpu as paddle
+
+        out = []
+        for i in range(self._src.pp):
+            path = self._stage_file(model_dir, i)
+            sd = paddle.load(path)
+            layers = {}
+            for k in sd:
+                sp = _split_layer_key(k)
+                idx = sp[1] if sp else -1
+                layers.setdefault(idx, []).append(k)
+            out.append((path, layers))
+        return out
+
+    # ----------------------------------------------------------- transform
+    def extract_layers(self, state_dict):
+        """Group a segment state dict by local layer index -> ordered list of
+        (suffix_dict, prefix).  Non-indexed entries (embeddings, final norm)
+        keep their position via index -1/+inf buckets."""
+        groups = {}
+        passthrough = {}
+        for k, v in state_dict.items():
+            sp = _split_layer_key(k)
+            if sp is None:
+                passthrough[k] = v
+                continue
+            prefix, idx, rest = sp
+            groups.setdefault(idx, (prefix, {}))[1][rest] = v
+        ordered = [groups[i] for i in sorted(groups)]
+        return ordered, passthrough
+
+    def apply(self, src_model_path, dst_model_path):
+        """Read src per-stage files, rebuild the GLOBAL layer sequence, then
+        regroup + renumber into the dst (pp, vpp) layout."""
+        import paddle_tpu as paddle
+
+        src, dst = self._src, self._dst
+        # global sequence: reference interleave — chunk-major group placement
+        n_groups_src = src.pp * src.vpp
+        seq = [None] * 0
+        global_groups = {}
+        passthrough_first = {}
+        passthrough_last = {}
+        for i in range(src.pp):
+            sd = paddle.load(self._stage_file(src_model_path, i))
+            ordered, passthrough = self.extract_layers(sd)
+            if i == 0:
+                passthrough_first.update(passthrough)
+            elif passthrough:
+                passthrough_last.update(passthrough)
+            # stage i holds chunks c=0..vpp-1; group id = c * pp + i; layers
+            # split evenly between the stage's chunks in order
+            per_chunk = len(ordered) // src.vpp
+            for c in range(src.vpp):
+                gid = c * src.pp + i
+                lo = c * per_chunk
+                hi = (c + 1) * per_chunk if c < src.vpp - 1 else len(ordered)
+                global_groups[gid] = ordered[lo:hi]
+        for gid in sorted(global_groups):
+            seq.extend(global_groups[gid])
+        total = len(seq)
+
+        n_groups_dst = dst.pp * dst.vpp
+        if total % n_groups_dst:
+            raise ValueError(
+                f"{total} layers do not evenly split into pp={dst.pp} x "
+                f"vpp={dst.vpp} groups")
+        per_group = total // n_groups_dst
+
+        os.makedirs(dst_model_path, exist_ok=True)
+        for i in range(dst.pp):
+            out = {}
+            if i == 0:
+                out.update(passthrough_first)
+            if i == dst.pp - 1:
+                out.update(passthrough_last)
+            local = 0
+            for c in range(dst.vpp):
+                gid = c * dst.pp + i
+                for prefix, params in seq[gid * per_group:(gid + 1) * per_group]:
+                    for rest, v in params.items():
+                        out[f"{prefix}{local}.{rest}"] = v
+                    local += 1
+            paddle.save(out, self._stage_file(dst_model_path, i))
+
+
+def adaptor_from_args(src_mp, src_pp, src_vpp, dst_mp, dst_pp, dst_vpp,
+                      transformer_layer_num=0):
+    return PipeLineModelAdaptor(
+        ParallelConfig(src_mp, src_pp, src_vpp),
+        ParallelConfig(dst_mp, dst_pp, dst_vpp),
+        transformer_layer_num)

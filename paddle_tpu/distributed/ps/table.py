@@ -116,3 +116,194 @@ class DenseTable:
     def push(self, grad):
         with self._lock:
             self._param = self._param - self.lr * np.asarray(grad, np.float32)
+
+
+class SSDSparseTable(SparseTable):
+    """Memory-cached sparse table with disk spill (reference
+    paddle/fluid/distributed/ps/table/ssd_sparse_table.h — rocksdb-backed
+    rows behind an in-memory hot cache).
+
+    TPU-native shape: the hot set lives in the in-memory dict with LRU
+    order; rows beyond ``max_mem_rows`` spill (row + optimizer state) to a
+    ``shelve`` store on disk and are transparently promoted back on access.
+    That is the semantics the reference's SSD table provides for
+    beyond-memory CTR id spaces; rocksdb itself is replaced by the stdlib
+    store (same durability contract for our scale)."""
+
+    def __init__(self, dim, accessor="sgd", seed=0, ssd_path=None,
+                 max_mem_rows=100_000, **accessor_kwargs):
+        super().__init__(dim, accessor=accessor, seed=seed, **accessor_kwargs)
+        import os
+        import shelve
+        import tempfile
+        from collections import OrderedDict
+
+        self._ssd_dir = ssd_path or tempfile.mkdtemp(prefix="pt_ssd_table_")
+        os.makedirs(self._ssd_dir, exist_ok=True)
+        self._disk = shelve.open(os.path.join(self._ssd_dir, "rows"))
+        self._order = OrderedDict()
+        self._max_mem = int(max_mem_rows)
+
+    # -- internals (caller holds self._lock) --------------------------------
+    def _touch(self, key):
+        self._order.pop(key, None)
+        self._order[key] = True
+
+    def _ensure_in_mem(self, key):
+        """Return True if the row is (now) in memory, False if absent everywhere."""
+        if key in self._rows:
+            self._touch(key)
+            return True
+        dk = str(key)
+        if dk in self._disk:
+            row, st = self._disk[dk]  # shelve pickles values itself
+            self._rows[key] = row
+            self._states[key] = st
+            del self._disk[dk]
+            self._touch(key)
+            self._evict()
+            return True
+        return False
+
+    def _evict(self):
+        while len(self._rows) > self._max_mem and self._order:
+            old, _ = self._order.popitem(last=False)
+            row = self._rows.pop(old, None)
+            st = self._states.pop(old, None)
+            if row is not None:
+                self._disk[str(old)] = (row, st)
+
+    # -- public -------------------------------------------------------------
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(ids.tolist()):
+                if not self._ensure_in_mem(key):
+                    row, st = self._accessor.init_row(self.dim, self._rng)
+                    self._rows[key] = row
+                    self._states[key] = st
+                    self._touch(key)
+                out[i] = self._rows[key]
+            self._evict()
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        merged = {}
+        for key, g in zip(ids.tolist(), grads):
+            merged[key] = merged[key] + g if key in merged else g.copy()
+        with self._lock:
+            for key, g in merged.items():
+                if not self._ensure_in_mem(key):
+                    row, st = self._accessor.init_row(self.dim, self._rng)
+                    self._rows[key] = row
+                    self._states[key] = st
+                    self._touch(key)
+                self._rows[key], self._states[key] = self._accessor.update(
+                    self._rows[key], self._states[key], g)
+            self._evict()
+
+    def mem_size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def ssd_size(self):
+        with self._lock:
+            return len(self._disk)
+
+    def size(self):
+        with self._lock:
+            return len(self._rows) + len(self._disk)
+
+    def save(self, path):
+        with self._lock:
+            rows = dict(self._rows)
+            for dk in self._disk:
+                row, _ = self._disk[dk]
+                rows[int(dk)] = row
+            keys = np.fromiter(rows.keys(), np.int64, len(rows))
+            vals = (np.stack(list(rows.values())) if rows
+                    else np.zeros((0, self.dim), np.float32))
+        np.savez(path, keys=keys, vals=vals)
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            # replace BOTH tiers: stale disk rows must not survive a restore
+            for dk in list(self._disk.keys()):
+                del self._disk[dk]
+            self._order.clear()
+            self._rows = {}
+            self._states = {}
+            for k, v in zip(data["keys"], data["vals"]):
+                key = int(k)
+                _, st = self._accessor.init_row(self.dim, self._rng)
+                self._rows[key] = v
+                self._states[key] = st
+                self._touch(key)
+            self._evict()
+
+
+class GraphTable:
+    """Graph storage + neighbor sampling (reference
+    paddle/fluid/distributed/ps/table/common_graph_table.h — the GNN
+    graph service: edge storage per node with weighted/uniform neighbor
+    sampling).
+
+    CSR adjacency over int64 node ids; ``sample_neighbors`` is the serving
+    primitive (GraphBrain-style khop sampling builds on it)."""
+
+    def __init__(self, seed=0):
+        self._adj = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def add_edges(self, src, dst):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        with self._lock:
+            for s, d in zip(src.tolist(), dst.tolist()):
+                self._adj.setdefault(s, []).append(d)
+
+    def get_degree(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            return np.array([len(self._adj.get(i, ())) for i in ids.tolist()],
+                            np.int64)
+
+    def sample_neighbors(self, ids, sample_size):
+        """Uniform without-replacement up-to-``sample_size`` neighbors per id.
+        Returns (flat_neighbors, counts) — the reference's compressed layout."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        outs, counts = [], []
+        with self._lock:
+            for i in ids.tolist():
+                nbrs = self._adj.get(i, [])
+                if len(nbrs) <= sample_size:
+                    chosen = list(nbrs)
+                else:
+                    chosen = list(self._rng.choice(nbrs, sample_size,
+                                                   replace=False))
+                outs.extend(chosen)
+                counts.append(len(chosen))
+        return np.asarray(outs, np.int64), np.asarray(counts, np.int64)
+
+    def save(self, path):
+        with self._lock:
+            src = np.concatenate([np.full(len(v), k, np.int64)
+                                  for k, v in self._adj.items()]) \
+                if self._adj else np.zeros((0,), np.int64)
+            dst = np.concatenate([np.asarray(v, np.int64)
+                                  for v in self._adj.values()]) \
+                if self._adj else np.zeros((0,), np.int64)
+        np.savez(path, src=src, dst=dst)
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        fresh = {}
+        for s, d in zip(data["src"].tolist(), data["dst"].tolist()):
+            fresh.setdefault(int(s), []).append(int(d))
+        with self._lock:  # atomic swap: readers never see a partial graph
+            self._adj = fresh

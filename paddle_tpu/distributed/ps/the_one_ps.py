@@ -11,7 +11,14 @@ _SERVER_TABLES = {}
 
 # ------------------------- functions executed ON the server via rpc ----------
 def _srv_create_sparse(name, dim, accessor, kwargs):
-    _SERVER_TABLES[name] = SparseTable(dim, accessor=accessor, **kwargs)
+    kwargs = dict(kwargs)
+    storage = kwargs.pop("storage", "mem")
+    if storage == "ssd":  # reference ssd_sparse_table.h: disk-spilled rows
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+
+        _SERVER_TABLES[name] = SSDSparseTable(dim, accessor=accessor, **kwargs)
+    else:
+        _SERVER_TABLES[name] = SparseTable(dim, accessor=accessor, **kwargs)
     return True
 
 

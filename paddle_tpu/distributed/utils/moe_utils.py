@@ -36,6 +36,56 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     return apply("global_scatter", f, x, local_count, global_count)
 
 
+def alltoall_expert_exchange(stacked_expert_params, x, dest, expert_fn, mesh,
+                             axis="ep", capacity=None):
+    """Expert-parallel MoE layer over a real mesh axis — the TPU-native form
+    of the reference's global_scatter → expert → global_gather pipeline
+    (fluid/operators/collective/global_scatter_op.cu): capacity-based token
+    buffers exchanged with ``lax.all_to_all`` over ``axis`` inside shard_map,
+    the local expert applied between the two exchanges.  Differentiable;
+    tokens over capacity are dropped (standard MoE capacity semantics).
+
+    stacked_expert_params: pytree with leading dim = ep size (expert e's
+    weights live on rank e); x: (T, D) tokens sharded over ``axis`` on dim 0;
+    dest: (T,) int32 destination expert ids, sharded the same way.
+    Returns y: (T, D) with each token processed by its destination expert.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    E = mesh.shape[axis]
+    T = x.shape[0]
+    C = capacity if capacity is not None else max(T // mesh.shape[axis], 1)
+
+    def body(params, xl, dl):
+        Tl, D = xl.shape
+        onehot = (dl[:, None] == jnp.arange(E)[None, :]).astype(jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) - 1  # (Tl, E) slot within dest
+        mypos = jnp.take_along_axis(pos, dl[:, None].astype(jnp.int32),
+                                    axis=1)[:, 0]
+        keep = mypos < C
+        slot = jnp.where(keep, mypos, C)  # overflow rows land in a spill slot
+        send = jnp.zeros((E, C + 1, D), xl.dtype).at[
+            dl.astype(jnp.int32), slot].set(xl)[:, :C]
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)  # (E, C, D) rows from each src
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        out = expert_fn(p_local, recv.reshape(E * C, D)).reshape(E, C, D)
+        back = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)  # (E, C, D) my tokens returned
+        y = back[dl.astype(jnp.int32), slot.clip(0, C - 1)]
+        return jnp.where(keep[:, None], y, 0.0).astype(xl.dtype)
+
+    pspecs = jax.tree_util.tree_map(
+        lambda a: P(*((axis,) + (None,) * (a.ndim - 1))),
+        stacked_expert_params)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(axis, None), P(axis)),
+        out_specs=P(axis, None), check_vma=False,
+    )(stacked_expert_params, x, dest)
+
+
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
     """Inverse of global_scatter: return expert outputs to token owners."""
 

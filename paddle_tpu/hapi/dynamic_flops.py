@@ -21,7 +21,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         cin = layer.weight.shape[1]
         total[0] += int(np.prod(out.shape)) * cin * k
 
-    for sub in net.sublayers():
+    for sub in net.sublayers(include_self=True):
         if isinstance(sub, nn.Linear):
             hooks.append(sub.register_forward_post_hook(linear_hook))
         elif isinstance(sub, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):

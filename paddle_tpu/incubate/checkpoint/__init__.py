@@ -1,0 +1,2 @@
+"""paddle.incubate.checkpoint (reference python/paddle/incubate/checkpoint/)."""
+from paddle_tpu.incubate.checkpoint import auto_checkpoint  # noqa: F401

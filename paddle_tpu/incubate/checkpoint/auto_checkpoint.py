@@ -1,0 +1,86 @@
+"""Automatic training checkpoint/resume (reference
+python/paddle/incubate/checkpoint/auto_checkpoint.py — train_epoch_range:624,
+ExeTrainStatus, the hdfs-backed auto checkpointer).
+
+TPU-native shape: ``train_epoch_range(max_epoch)`` is a generator that yields
+the epochs still to run.  With ``PADDLE_CHECKPOINT_DIR`` set (the reference
+uses PADDLE_RUNNING_ENV + fs checkpoint config), every completed epoch
+persists the registered models/optimizers plus the epoch counter through
+paddle.save with an atomic rename, and a relaunched process resumes from the
+last completed epoch — the launcher kill-recover contract, epoch-granular.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["train_epoch_range", "add_checkpoint_item", "reset"]
+
+_STATE = {"items": {}, "dir": None}
+
+
+def _ckpt_dir():
+    return os.environ.get("PADDLE_CHECKPOINT_DIR") or _STATE["dir"]
+
+
+def reset():
+    _STATE["items"].clear()
+
+
+def add_checkpoint_item(name, obj):
+    """Register a model/optimizer (anything with state_dict/set_state_dict)
+    to be saved each epoch and restored on resume."""
+    if not hasattr(obj, "state_dict"):
+        raise TypeError(f"{name}: checkpoint items need state_dict()")
+    _STATE["items"][name] = obj
+    return obj
+
+
+def _save_epoch(path, epoch):
+    import paddle_tpu as paddle
+
+    os.makedirs(path, exist_ok=True)
+    tmp = os.path.join(path, "_tmp.pdparams")
+    blob = {name: obj.state_dict() for name, obj in _STATE["items"].items()}
+    paddle.save(blob, tmp)
+    os.replace(tmp, os.path.join(path, "items.pdparams"))
+    meta_tmp = os.path.join(path, "_meta.json")
+    with open(meta_tmp, "w") as f:
+        json.dump({"epoch": epoch}, f)
+    os.replace(meta_tmp, os.path.join(path, "meta.json"))
+
+
+def _load_epoch(path):
+    import paddle_tpu as paddle
+
+    meta_p = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_p):
+        return -1
+    with open(meta_p) as f:
+        epoch = int(json.load(f)["epoch"])
+    items_p = os.path.join(path, "items.pdparams")
+    if _STATE["items"] and os.path.exists(items_p):
+        blob = paddle.load(items_p)
+        for name, obj in _STATE["items"].items():
+            if name in blob and hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(blob[name])
+    return epoch
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, checkpoint_dir=None):
+    """Yield the epochs still to be trained, checkpointing behind the scenes.
+
+    for epoch in train_epoch_range(10):   # resumes mid-range after a crash
+        train_one_epoch(...)
+    """
+    if checkpoint_dir is not None:
+        _STATE["dir"] = checkpoint_dir
+    path = _ckpt_dir()
+    start = 0
+    if path:
+        start = _load_epoch(path) + 1
+    for epoch in range(start, int(max_epoch_num)):
+        yield epoch
+        if path and (epoch % max(int(save_checkpoint_inter), 1) == 0
+                     or epoch == max_epoch_num - 1):
+            _save_epoch(path, epoch)

@@ -35,6 +35,15 @@ class InputSpec:
 
 _NOT_TO_STATIC = set()
 
+# trace failures that mean "python control flow depends on tensor VALUES"
+_GRAPH_BREAK_ERRORS = (
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,
+)
+
 
 def not_to_static(func):
     """Mark a function to run eagerly inside a to_static region (graph-break
@@ -55,11 +64,16 @@ class StaticFunction:
     keyed on input spec (program_translator.py CacheKey).
     """
 
+    _EAGER_FALLBACK = object()  # cache sentinel: signature graph-breaks
+
     def __init__(self, function, input_spec=None, layer=None, full_graph=True):
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
+        self._full_graph = full_graph
         self._cache = {}
+        self._graph_break_count = 0
+        self._warned_break = False
         functools.update_wrapper(self, function)
 
     @property
@@ -100,13 +114,38 @@ class StaticFunction:
 
         arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+        if self._cache.get(key) is StaticFunction._EAGER_FALLBACK:
+            return self._function(*args, **kwargs)
         if key not in self._cache:
             self._cache[key] = self._compiled()
         if self._layer is not None:
             params, buffers = self._layer.functional_state()
         else:
             params, buffers = {}, {}
-        out = self._cache[key](params, buffers, *arrs)
+        try:
+            out = self._cache[key](params, buffers, *arrs)
+        except _GRAPH_BREAK_ERRORS:
+            # SOT-style graph break (reference sot/opcode_executor.py:1603
+            # fallback semantics): the function has data-dependent python
+            # control flow jax can't trace.  Run it eagerly — each op still
+            # executes through the per-op jit dispatch cache, i.e. as a chain
+            # of compiled subgraphs.  paddle.static.nn.cond/while_loop lower
+            # such control flow into ONE compiled program instead.
+            self._cache[key] = StaticFunction._EAGER_FALLBACK
+            self._graph_break_count += 1
+            if not self._warned_break:
+                self._warned_break = True
+                import warnings
+
+                name = getattr(self._function, "__qualname__",
+                               repr(self._function))
+                warnings.warn(
+                    f"to_static({name}): data-dependent python control flow "
+                    "cannot be traced into one program; falling back to "
+                    "eager execution (per-op compiled subgraphs). Use "
+                    "paddle.static.nn.cond / while_loop to keep it fully "
+                    "compiled.", stacklevel=2)
+            return self._function(*args, **kwargs)
         return jax.tree_util.tree_map(Tensor, out)
 
     # parity surface

@@ -136,10 +136,9 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         g = jax.random.gumbel(k, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
-            idx = jnp.argmax(y, axis=axis, keepdims=True)
-            y_hard = jnp.zeros_like(y)
-            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) if hasattr(jnp, "put_along_axis") else y_hard.at[..., 0].set(0)
-            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            # straight-through: one-hot forward, soft gradient
+            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                axis=axis, dtype=y.dtype)
             return oh + y - jax.lax.stop_gradient(y)
         return y
 

@@ -428,7 +428,10 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
         # clustered tokens: head cluster logit + within-cluster logit
         for ci, (w1, w2) in enumerate(tails):
             lo = cutoffs[ci]
-            hi = cutoffs[ci + 1]
+            # paddle's cutoffs list may omit the final vocab bound; the last
+            # cluster's extent is its tail projection's output width
+            hi = (cutoffs[ci + 1] if ci + 1 < len(cutoffs)
+                  else lo + w2.shape[-1])
             in_cluster = (lab_i >= lo) & (lab_i < hi)
             cluster_logp = head_logp[:, shortlist + ci]
             h = x @ w1

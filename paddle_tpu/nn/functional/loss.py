@@ -294,6 +294,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     def f(lp, lab, in_len, lab_len):
         # lp: [T, B, C] (paddle layout), lab: [B, S]
+        # reference semantics (warpctc, test_warpctc_op.py): the input is
+        # UNNORMALIZED logits; the kernel applies softmax internally
+        lp = jax.nn.log_softmax(lp, axis=-1)
         T, B, C = lp.shape
         S = lab.shape[1]
         # extended label seq: blank, l1, blank, l2, ... blank  -> 2S+1

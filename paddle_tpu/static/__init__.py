@@ -37,3 +37,7 @@ from paddle_tpu.static.extras import (  # noqa: F401,E402
     save_to_file, serialize_persistables, serialize_program, set_ipu_shard,
     set_program_state, xpu_places,
 )
+
+# paddle.static.nn namespace (reference python/paddle/static/nn/): the
+# structured control-flow primitives that compile on TPU
+from paddle_tpu.static import nn  # noqa: E402,F401

@@ -1,0 +1,180 @@
+"""Structured control flow (reference python/paddle/static/nn/control_flow.py:
+cond:1035, While/while_loop:1397, case:2082, switch_case:2211).
+
+TPU-first: data-dependent control flow inside a compiled program must be a
+*structured* primitive the compiler can schedule — python ``if``/``while`` on
+traced values cannot survive tracing.  These map 1:1 onto XLA's native
+constructs (``lax.cond``/``lax.while_loop``/``lax.switch``); in eager mode
+with concrete predicates they degrade to plain python, so the same model code
+runs on both paths (the reference's dygraph-vs-static contract).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert"]
+
+
+def _t(x):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(tree):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if isinstance(a, jax.Array) else a, tree)
+
+
+def _unwrap_tree(tree):
+    from paddle_tpu.tensor.tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else jnp.asarray(t), tree,
+        is_leaf=lambda t: isinstance(t, Tensor),
+    )
+
+
+def _is_concrete(x):
+    return not isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond — both branches traced, XLA executes one.
+
+    Branch outputs must match in structure/shape/dtype (same contract as the
+    reference's select_input assembly)."""
+    from paddle_tpu.autograd import engine as _engine
+
+    p = _t(pred)
+    p = jnp.asarray(p).reshape(()) if not isinstance(p, jax.core.Tracer) else p.reshape(())
+    if _is_concrete(p):  # eager: run only the taken branch
+        taken = true_fn if bool(p) else false_fn
+        return taken() if taken is not None else None
+
+    def _branch(fn):
+        def run(_):
+            with _engine.no_grad():
+                out = fn() if fn is not None else None
+            return _unwrap_tree(out)
+
+        return run
+
+    out = jax.lax.cond(p.astype(jnp.bool_), _branch(true_fn),
+                       _branch(false_fn), operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop — ``lax.while_loop`` with Tensor pytrees.
+
+    ``cond(*vars) -> scalar bool tensor``; ``body(*vars) -> new vars`` with
+    identical structure/shapes (XLA requirement, same as the reference's
+    while op block contract)."""
+    from paddle_tpu.autograd import engine as _engine
+
+    probe = _unwrap_tree(list(loop_vars))
+    leaves = jax.tree_util.tree_leaves(probe)
+    traced = any(isinstance(l, jax.core.Tracer) for l in leaves)
+
+    if not traced:
+        # eager path — but the FIRST cond eval may still be data-dependent
+        # on concrete values, so plain python is exact
+        vars_ = list(loop_vars)
+        while bool(_t(cond(*vars_))):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def _cond(carry):
+        with _engine.no_grad():
+            r = cond(*_wrap_tree(carry))
+        return jnp.asarray(_t(r)).reshape(()).astype(jnp.bool_)
+
+    def _body(carry):
+        with _engine.no_grad():
+            out = body(*_wrap_tree(carry))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _unwrap_tree(out)
+
+    out = jax.lax.while_loop(_cond, _body, probe)
+    return _wrap_tree(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case — first true predicate wins (reference
+    control_flow.py:2082 nested-cond lowering)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+
+    def build(pairs):
+        (pred, fn), rest = pairs[0], pairs[1:]
+        if rest:
+            return cond(pred, fn, lambda: build(rest))
+        if default is not None:
+            return cond(pred, fn, default)
+        return cond(pred, fn, fn)  # reference: last fn is the fallback
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case — ``lax.switch`` on a traced index."""
+    from paddle_tpu.autograd import engine as _engine
+
+    idx = _t(branch_index)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) if not (
+            branch_fns and isinstance(branch_fns[0], (tuple, list))
+        ) else sorted((int(k), v) for k, v in branch_fns)
+    keys = [k for k, _ in items]
+    fns = [v for _, v in items]
+
+    if _is_concrete(idx):
+        i = int(jnp.asarray(idx).reshape(()))
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is not None:
+            return default()
+        return fns[-1]()  # reference: max-key branch is the fallback
+
+    # traced: map arbitrary keys onto a dense lax.switch table
+    fallback = default if default is not None else fns[-1]
+    table = [fallback] * (max(keys) + 2)
+    for k, f in zip(keys, fns):
+        table[k] = f
+
+    def _branch(fn):
+        def run(_):
+            with _engine.no_grad():
+                return _unwrap_tree(fn())
+
+        return run
+
+    sel = jnp.clip(jnp.asarray(idx).reshape(()).astype(jnp.int32),
+                   0, len(table) - 1)
+    in_keys = jnp.isin(jnp.asarray(idx).reshape(()).astype(jnp.int32),
+                       jnp.asarray(keys, jnp.int32))
+    sel = jnp.where(in_keys, sel, len(table) - 1)  # unknown index -> fallback
+    out = jax.lax.switch(sel, [_branch(f) for f in table], None)
+    return _wrap_tree(out)
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """paddle.static.nn.control_flow.Assert — eager check; traced values use
+    jax's checkify-style debug callback semantics (best effort)."""
+    c = _t(cond)
+    if _is_concrete(c):
+        if not bool(jnp.asarray(c).reshape(())):
+            raise AssertionError(
+                f"Assert failed{': ' + str(data) if data is not None else ''}")
+        return
+    import warnings
+
+    warnings.warn("Assert on a traced value is not checked inside compiled "
+                  "programs on TPU", stacklevel=2)

@@ -1,0 +1,9 @@
+"""paddle.static.nn (reference python/paddle/static/nn/): the structured
+control-flow primitives that compile on TPU, plus the control_flow module."""
+from paddle_tpu.static import control_flow  # noqa: F401
+from paddle_tpu.static.control_flow import (  # noqa: F401
+    Assert, case, cond, switch_case, while_loop,
+)
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert",
+           "control_flow"]

@@ -354,10 +354,10 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
         counts = np.array([], np.int64)
         inv = np.array([], np.int64)
     else:
-        sl = [slice(None)] * arr.ndim
-        sl[ax] = slice(1, None)
-        sl2 = [slice(None)] * arr.ndim
-        sl2[ax] = slice(None, -1)
+        sl = [builtins_slice(None)] * arr.ndim
+        sl[ax] = builtins_slice(1, None)
+        sl2 = [builtins_slice(None)] * arr.ndim
+        sl2[ax] = builtins_slice(None, -1)
         neq = np.any(arr[tuple(sl)] != arr[tuple(sl2)], axis=tuple(i for i in range(arr.ndim) if i != ax)) if arr.ndim > 1 else arr[1:] != arr[:-1]
         keep = np.concatenate([[True], neq])
         vals = np.compress(keep, arr, axis=ax)

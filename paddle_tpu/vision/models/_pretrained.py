@@ -1,0 +1,44 @@
+"""Hub-based pretrained weight loading for the vision zoo (reference:
+python/paddle/vision/models/*.py model_urls + utils/download.py).
+
+Every family's ``pretrained=True`` routes here: resolve the canonical
+paddle-hapi URL through the weights cache (zero-egress environments use a
+pre-seeded ``~/.cache/paddle_tpu/hapi/weights``), paddle.load the .pdparams,
+and set_state_dict into the freshly-built model."""
+from __future__ import annotations
+
+_BASE = "https://paddle-hapi.bj.bcebos.com/models/"
+
+# arch -> filename at the paddle-hapi bucket (md5 checked only when given)
+MODEL_URLS = {
+    name: f"{_BASE}{name}.pdparams"
+    for name in [
+        "alexnet", "googlenet", "inception_v3",
+        "mobilenet_v1", "mobilenet_v2",
+        "mobilenet_v3_small", "mobilenet_v3_large",
+        "squeezenet1_0", "squeezenet1_1",
+        "densenet121", "densenet161", "densenet169", "densenet201",
+        "densenet264",
+        "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+        "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+        "shufflenet_v2_swish",
+        "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+        "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+        "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
+        "wide_resnet50_2", "wide_resnet101_2",
+        "vgg11", "vgg13", "vgg16", "vgg19", "lenet",
+    ]
+}
+
+
+def load_pretrained(model, arch):
+    """Fill ``model`` with the hub weights for ``arch`` (in place)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.utils.download import get_weights_path_from_url
+
+    if arch not in MODEL_URLS:
+        raise ValueError(f"no pretrained weights registered for {arch!r}")
+    path = get_weights_path_from_url(MODEL_URLS[arch])
+    state = paddle.load(path)
+    model.set_state_dict(state)
+    return model

@@ -1,0 +1,136 @@
+"""MobileNetV3 (reference python/paddle/vision/models/mobilenetv3.py)."""
+import paddle_tpu.nn as nn
+import paddle_tpu.tensor.manipulation as M
+
+from paddle_tpu.vision.models.mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, c, squeeze_c):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, squeeze_c, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_c, c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act_layer = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if exp_c != in_c:
+            layers += [nn.Conv2D(in_c, exp_c, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp_c), act_layer()]
+        layers += [
+            nn.Conv2D(exp_c, exp_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=exp_c,
+                      bias_attr=False),
+            nn.BatchNorm2D(exp_c), act_layer(),
+        ]
+        if use_se:
+            layers.append(_SqueezeExcite(exp_c, _make_divisible(exp_c // 4)))
+        layers += [nn.Conv2D(exp_c, out_c, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_c)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.block(x) if self.use_res else self.block(x)
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(in_c), nn.Hardswish(),
+        )
+        blocks = []
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(_InvertedResidualV3(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        last_c = _make_divisible(last_exp * scale)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(in_c, last_c, 1, bias_attr=False),
+            nn.BatchNorm2D(last_c), nn.Hardswish(),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_c, 1280), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1280, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(M.flatten(x, 1))
+        return x
+
+
+# (kernel, expansion, out, use_se, activation, stride)
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    from paddle_tpu.vision.models._pretrained import load_pretrained
+
+    model = MobileNetV3Small(scale=scale, **kwargs)
+    if pretrained:
+        load_pretrained(model, "mobilenet_v3_small")
+    return model
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    from paddle_tpu.vision.models._pretrained import load_pretrained
+
+    model = MobileNetV3Large(scale=scale, **kwargs)
+    if pretrained:
+        load_pretrained(model, "mobilenet_v3_large")
+    return model

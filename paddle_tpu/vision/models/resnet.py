@@ -7,7 +7,9 @@ import paddle_tpu.nn as nn
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-    "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d", "resnext101_32x4d",
+    "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d",
 ]
 
 
@@ -129,13 +131,13 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(depth, pretrained=False, **kwargs):
+def _resnet(depth, pretrained=False, arch=None, **kwargs):
+    model = ResNet(depth=depth, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights require network access; load a local "
-            "checkpoint with set_state_dict instead"
-        )
-    return ResNet(depth=depth, **kwargs)
+        from paddle_tpu.vision.models._pretrained import load_pretrained
+
+        load_pretrained(model, arch or f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
@@ -159,16 +161,36 @@ def resnet152(pretrained=False, **kwargs):
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(50, pretrained, width=128, **kwargs)
+    return _resnet(50, pretrained, arch="wide_resnet50_2", width=128, **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(101, pretrained, width=128, **kwargs)
+    return _resnet(101, pretrained, arch="wide_resnet101_2", width=128, **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return _resnet(50, pretrained, groups=32, width=4, **kwargs)
+    return _resnet(50, pretrained, arch="resnext50_32x4d", groups=32, width=4, **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    return _resnet(101, pretrained, groups=32, width=4, **kwargs)
+    return _resnet(101, pretrained, arch="resnext101_32x4d", groups=32, width=4, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(50, pretrained, arch="resnext50_64x4d", groups=64, width=4,
+                   **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, arch="resnext101_64x4d", groups=64,
+                   width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, arch="resnext152_32x4d", groups=32,
+                   width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, arch="resnext152_64x4d", groups=64,
+                   width=4, **kwargs)

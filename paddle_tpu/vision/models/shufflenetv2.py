@@ -1,0 +1,135 @@
+"""ShuffleNetV2 (reference python/paddle/vision/models/shufflenetv2.py)."""
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.tensor.manipulation as M
+
+__all__ = [
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), act_layer(),
+            )
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer(),
+        )
+
+    def forward(self, x):
+        if self.stride > 1:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), act_layer(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        blocks = []
+        in_c = c0
+        for out_c, n in zip((c1, c2, c3), _REPEATS):
+            for i in range(n):
+                blocks.append(_ShuffleUnit(in_c, out_c, 2 if i == 0 else 1,
+                                           act))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.head = nn.Sequential(
+            nn.Conv2D(in_c, c_last, 1, bias_attr=False),
+            nn.BatchNorm2D(c_last), act_layer(),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(M.flatten(x, 1))
+        return x
+
+
+def _shufflenet(arch, scale, act, pretrained, **kwargs):
+    from paddle_tpu.vision.models._pretrained import load_pretrained
+
+    model = ShuffleNetV2(scale=scale, act=act, **kwargs)
+    if pretrained:
+        load_pretrained(model, arch)
+    return model
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x0_25", 0.25, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x0_33", 0.33, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x0_5", 0.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x1_0", 1.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x1_5", 1.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_x2_0", 2.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet("shufflenet_v2_swish", 1.0, "swish", pretrained, **kw)

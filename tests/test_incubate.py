@@ -245,3 +245,66 @@ class TestIncubateMisc:
         out = incubate.graph_send_recv(x, src, dst, "sum")
         assert list(out.shape) == [4, 2]
         assert incubate.segment_sum is paddle.geometric.segment_sum
+
+
+class TestExpertParallelAllToAll:
+    """Real EP over a mesh axis (VERDICT r2 #8): tokens exchanged with
+    lax.all_to_all, each processed by its destination expert."""
+
+    def test_tokens_routed_to_correct_expert(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.utils.moe_utils import (
+            alltoall_expert_exchange,
+        )
+
+        ep = 4
+        mesh = Mesh(np.array(jax.devices()[:ep]), ("ep",))
+        rng = np.random.RandomState(0)
+        T, D = 32, 8
+        x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+        dest = jnp.asarray(rng.randint(0, ep, (T,)), jnp.int32)
+        # expert e multiplies by (e+1): routing is directly observable
+        params = {"s": jnp.arange(1.0, ep + 1.0)[:, None]}  # (ep, 1)
+
+        y = alltoall_expert_exchange(
+            params, x, dest, lambda p, t: t * p["s"][0], mesh,
+            axis="ep", capacity=T)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x) * (np.asarray(dest)[:, None] + 1.0),
+            rtol=1e-6)
+
+    def test_capacity_drops_overflow_and_grads_flow(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.utils.moe_utils import (
+            alltoall_expert_exchange,
+        )
+
+        ep = 2
+        mesh = Mesh(np.array(jax.devices()[:ep]), ("ep",))
+        T, D, C = 8, 4, 2
+        x = jnp.ones((T, D))
+        dest = jnp.zeros((T,), jnp.int32)  # everyone wants expert 0
+        params = {"w": jnp.stack([jnp.eye(D) * 2.0, jnp.eye(D) * 3.0])}
+
+        def loss(p):
+            y = alltoall_expert_exchange(
+                p, x, dest, lambda pl, t: t @ pl["w"], mesh,
+                axis="ep", capacity=C)
+            return jnp.sum(y), y
+
+        (s, y), g = jax.value_and_grad(loss, has_aux=True)(params)
+        yn = np.asarray(y)
+        # per source shard (T/ep = 4 tokens), only C=2 survive to expert 0
+        kept = (np.abs(yn).sum(1) > 0).sum()
+        assert kept == ep * C, yn
+        np.testing.assert_allclose(yn[np.abs(yn).sum(1) > 0], 2.0)
+        assert np.isfinite(np.asarray(g["w"])).all()
+        assert np.abs(np.asarray(g["w"][0])).sum() > 0  # grads reach expert 0

@@ -1,0 +1,881 @@
+"""Numeric sweep over paddle.nn.functional (VERDICT r2 item 4, second half).
+
+Same contract as test_numeric_sweep.py: every name in the reference's
+nn/functional/__all__ is numerically tested here or exempted with a reason in
+NF_EXEMPT; TestNFCompleteness enforces it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+SEED = np.random.RandomState(11)
+
+
+def _any(shape):
+    return SEED.randn(*shape).astype("float32")
+
+
+def _pos(shape):
+    return SEED.rand(*shape).astype("float32") + 0.5
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------- activations
+NF_ACT = {
+    "relu": lambda x: np.maximum(x, 0),
+    "relu6": lambda x: np.clip(x, 0, 6),
+    "elu": lambda x: np.where(x > 0, x, np.expm1(x)),
+    "celu": lambda x: np.where(x > 0, x, np.expm1(x)),  # alpha=1
+    "selu": lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * np.expm1(x)),
+    "gelu": lambda x: 0.5 * x * (1 + np.vectorize(
+        lambda v: float(__import__("math").erf(v / np.sqrt(2))))(x)),
+    "silu": lambda x: x * _np_sigmoid(x),
+    "swish": lambda x: x * _np_sigmoid(x),
+    "mish": lambda x: x * np.tanh(np.log1p(np.exp(x))),
+    "sigmoid": _np_sigmoid,
+    "hardsigmoid": lambda x: np.clip(x / 6 + 0.5, 0, 1),
+    "hardswish": lambda x: x * np.clip(x + 3, 0, 6) / 6,
+    "hardtanh": lambda x: np.clip(x, -1, 1),
+    "hardshrink": lambda x: np.where(np.abs(x) > 0.5, x, 0),
+    "softshrink": lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0),
+    "tanhshrink": lambda x: x - np.tanh(x),
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "log_sigmoid": lambda x: -np.log1p(np.exp(-x)),
+    "leaky_relu": lambda x: np.where(x > 0, x, 0.01 * x),
+    "thresholded_relu": lambda x: np.where(x > 1.0, x, 0),
+    "tanh": np.tanh,
+    "softmax": _np_softmax,
+    "log_softmax": lambda x: np.log(_np_softmax(x)),
+}
+
+
+class TestActivations(OpTest):
+    @pytest.mark.parametrize("name", sorted(NF_ACT), ids=str)
+    def test_forward_and_grad(self, name):
+        op = getattr(F, name)
+        x = _any((3, 5))
+        self.check_output(op, NF_ACT[name], [x], rtol=5e-4, atol=5e-5)
+        if name not in ("hardshrink", "softshrink", "thresholded_relu"):
+            self.check_grad(op, [_any((2, 3)) + 0.1])
+
+
+# -------------------------------------------------------------------- losses
+NF_LOSS = {}
+
+
+def loss_case(name):
+    def deco(fn):
+        NF_LOSS[name] = fn
+        return fn
+    return deco
+
+
+@loss_case("mse_loss")
+def _l_mse():
+    a, b = _any((4, 3)), _any((4, 3))
+    got = F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(float(got.numpy()), ((a - b) ** 2).mean(),
+                               rtol=1e-5)
+
+
+@loss_case("l1_loss")
+def _l_l1():
+    a, b = _any((4, 3)), _any((4, 3))
+    got = F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(float(got.numpy()), np.abs(a - b).mean(),
+                               rtol=1e-5)
+
+
+@loss_case("smooth_l1_loss")
+def _l_smooth_l1():
+    a, b = _any((4, 3)), _any((4, 3))
+    d = a - b
+    want = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5).mean()
+    got = F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("cross_entropy")
+def _l_ce():
+    x = _any((4, 5))
+    y = np.array([0, 2, 4, 1])
+    logp = np.log(_np_softmax(x))
+    want = -logp[np.arange(4), y].mean()
+    got = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("nll_loss")
+def _l_nll():
+    x = np.log(_np_softmax(_any((4, 5))))
+    y = np.array([1, 0, 3, 2])
+    got = F.nll_loss(paddle.to_tensor(x.astype("float32")), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()),
+                               -x[np.arange(4), y].mean(), rtol=1e-5)
+
+
+@loss_case("binary_cross_entropy")
+def _l_bce():
+    p = SEED.rand(4, 3).astype("float32") * 0.8 + 0.1
+    y = (SEED.rand(4, 3) > 0.5).astype("float32")
+    want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    got = F.binary_cross_entropy(paddle.to_tensor(p), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("binary_cross_entropy_with_logits")
+def _l_bce_logits():
+    x = _any((4, 3))
+    y = (SEED.rand(4, 3) > 0.5).astype("float32")
+    p = _np_sigmoid(x)
+    want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    got = F.binary_cross_entropy_with_logits(paddle.to_tensor(x),
+                                             paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("kl_div")
+def _l_kl():
+    logq = np.log(_np_softmax(_any((3, 4)))).astype("float32")
+    p = _np_softmax(_any((3, 4))).astype("float32")
+    want = (p * (np.log(p) - logq)).sum(-1).mean()
+    got = F.kl_div(paddle.to_tensor(logq), paddle.to_tensor(p),
+                   reduction="batchmean")
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("log_loss")
+def _l_log_loss():
+    p = SEED.rand(4, 1).astype("float32") * 0.8 + 0.1
+    y = (SEED.rand(4, 1) > 0.5).astype("float32")
+    eps = 1e-4
+    want = -(y * np.log(p + eps) + (1 - y) * np.log(1 - p + eps))
+    got = F.log_loss(paddle.to_tensor(p), paddle.to_tensor(y))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@loss_case("square_error_cost")
+def _l_sec():
+    a, b = _any((4, 3)), _any((4, 3))
+    got = F.square_error_cost(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), (a - b) ** 2, rtol=1e-5)
+
+
+@loss_case("margin_ranking_loss")
+def _l_mrl():
+    a, b = _any((5,)), _any((5,))
+    y = np.sign(_any((5,))).astype("float32")
+    want = np.maximum(0, -y * (a - b)).mean()
+    got = F.margin_ranking_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("hinge_embedding_loss")
+def _l_hel():
+    x = _pos((5,))
+    y = np.array([1, -1, 1, -1, 1], "float32")
+    want = np.where(y == 1, x, np.maximum(0, 1.0 - x)).mean()
+    got = F.hinge_embedding_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("soft_margin_loss")
+def _l_sml():
+    x = _any((4,))
+    y = np.sign(_any((4,))).astype("float32")
+    want = np.log1p(np.exp(-y * x)).mean()
+    got = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("multi_label_soft_margin_loss")
+def _l_mlsml():
+    x = _any((3, 4))
+    y = (SEED.rand(3, 4) > 0.5).astype("float32")
+    want = -(y * np.log(_np_sigmoid(x))
+             + (1 - y) * np.log(1 - _np_sigmoid(x))).mean(-1).mean()
+    got = F.multi_label_soft_margin_loss(paddle.to_tensor(x),
+                                         paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("multi_margin_loss")
+def _l_mml():
+    x = _any((3, 4))
+    y = np.array([0, 2, 1])
+    m = 1.0
+    want = 0.0
+    for i in range(3):
+        margins = np.maximum(0, m - x[i, y[i]] + x[i])
+        margins[y[i]] = 0
+        want += margins.sum() / 4
+    want /= 3
+    got = F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-5)
+
+
+@loss_case("triplet_margin_loss")
+def _l_tml():
+    a, p, n = _any((3, 4)), _any((3, 4)), _any((3, 4))
+    dp = np.linalg.norm(a - p, axis=1)
+    dn = np.linalg.norm(a - n, axis=1)
+    want = np.maximum(0, dp - dn + 1.0).mean()
+    got = F.triplet_margin_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                                paddle.to_tensor(n))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("triplet_margin_with_distance_loss")
+def _l_tmwdl():
+    a, p, n = _any((3, 4)), _any((3, 4)), _any((3, 4))
+    dp = np.linalg.norm(a - p, axis=1)
+    dn = np.linalg.norm(a - n, axis=1)
+    want = np.maximum(0, dp - dn + 1.0).mean()
+    got = F.triplet_margin_with_distance_loss(
+        paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("cosine_embedding_loss")
+def _l_cel():
+    a, b = _any((4, 3)), _any((4, 3))
+    y = np.array([1, -1, 1, -1], "float32")
+    cos = (a * b).sum(1) / (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))
+    want = np.where(y == 1, 1 - cos, np.maximum(0, cos)).mean()
+    got = F.cosine_embedding_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                  paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("poisson_nll_loss")
+def _l_pnl():
+    x, y = _any((4,)), _pos((4,))
+    want = (np.exp(x) - y * x).mean()  # log_input=True
+    got = F.poisson_nll_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("gaussian_nll_loss")
+def _l_gnl():
+    x, y, v = _any((4,)), _any((4,)), _pos((4,))
+    want = 0.5 * (np.log(v) + (x - y) ** 2 / v).mean()
+    got = F.gaussian_nll_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                              paddle.to_tensor(v))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("sigmoid_focal_loss")
+def _l_sfl():
+    x = _any((4, 1))
+    y = (SEED.rand(4, 1) > 0.5).astype("float32")
+    p = _np_sigmoid(x)
+    gamma, alpha = 2.0, 0.25
+    ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    pt = y * p + (1 - y) * (1 - p)
+    af = y * alpha + (1 - y) * (1 - alpha)
+    want = (af * (1 - pt) ** gamma * ce).sum()
+    got = F.sigmoid_focal_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                               reduction="sum")
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("dice_loss")
+def _l_dice():
+    x = _np_softmax(_any((2, 3, 4))).astype("float32")  # (N, T, C)
+    y = SEED.randint(0, 4, (2, 3, 1))
+    oh = np.eye(4)[y[..., 0]]
+    inter = (x * oh).sum(axis=(1, 2))
+    union = x.sum(axis=(1, 2)) + oh.sum(axis=(1, 2))
+    want = (1 - 2 * (inter + 1e-5) / (union + 1e-5)).mean()
+    got = F.dice_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+@loss_case("npair_loss")
+def _l_npair():
+    a, p = _any((3, 4)), _any((3, 4))
+    y = np.arange(3)
+    got = F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                       paddle.to_tensor(y))
+    logits = a @ p.T
+    ce = -np.log(_np_softmax(logits))[np.arange(3), np.arange(3)].mean()
+    l2 = 0.002 * 0.25 * ((a ** 2).sum() + (p ** 2).sum()) / 3
+    np.testing.assert_allclose(float(got.numpy()), ce + l2, rtol=1e-3)
+
+
+@loss_case("softmax_with_cross_entropy")
+def _l_swce():
+    x = _any((4, 5))
+    y = np.array([[0], [2], [4], [1]])
+    logp = np.log(_np_softmax(x))
+    want = -logp[np.arange(4), y[:, 0]][:, None]
+    got = F.softmax_with_cross_entropy(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@loss_case("ctc_loss")
+def _l_ctc():
+    # single frame, single label: loss = -log p(label) exactly
+    logits = _any((1, 1, 3))  # (T, N, C), blank=0
+    p = _np_softmax(logits)[0, 0]
+    got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(np.array([[1]])),
+                     paddle.to_tensor(np.array([1])), paddle.to_tensor(np.array([1])),
+                     reduction="none")
+    np.testing.assert_allclose(float(np.asarray(got.numpy()).ravel()[0]),
+                               -np.log(p[1]), rtol=1e-4)
+
+
+class TestLosses:
+    @pytest.mark.parametrize("name", sorted(NF_LOSS), ids=str)
+    def test_loss(self, name):
+        NF_LOSS[name]()
+
+
+# ------------------------------------------------------------ pools / shapes
+NF_MISC = {}
+
+
+def misc(name):
+    def deco(fn):
+        NF_MISC[name] = fn
+        return fn
+    return deco
+
+
+def _pool_ref_2d(x, k, op):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // k, w // k), x.dtype)
+    for i in range(h // k):
+        for j in range(w // k):
+            out[:, :, i, j] = op(x[:, :, i*k:(i+1)*k, j*k:(j+1)*k], axis=(2, 3))
+    return out
+
+
+@misc("avg_pool1d")
+def _m_avg_pool1d():
+    x = _any((2, 3, 8))
+    got = F.avg_pool1d(paddle.to_tensor(x), 2, stride=2)
+    np.testing.assert_allclose(got.numpy(), x.reshape(2, 3, 4, 2).mean(-1),
+                               rtol=1e-5)
+
+
+@misc("max_pool1d")
+def _m_max_pool1d():
+    x = _any((2, 3, 8))
+    got = F.max_pool1d(paddle.to_tensor(x), 2, stride=2)
+    np.testing.assert_allclose(got.numpy(), x.reshape(2, 3, 4, 2).max(-1),
+                               rtol=1e-5)
+
+
+@misc("avg_pool3d")
+def _m_avg_pool3d():
+    x = _any((1, 2, 4, 4, 4))
+    got = F.avg_pool3d(paddle.to_tensor(x), 2, stride=2)
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+@misc("max_pool3d")
+def _m_max_pool3d():
+    x = _any((1, 2, 4, 4, 4))
+    got = F.max_pool3d(paddle.to_tensor(x), 2, stride=2)
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@misc("lp_pool1d")
+def _m_lp_pool1d():
+    x = _pos((2, 3, 8))
+    got = F.lp_pool1d(paddle.to_tensor(x), 2.0, 2, stride=2)
+    want = np.sqrt((x.reshape(2, 3, 4, 2) ** 2).sum(-1))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+
+@misc("lp_pool2d")
+def _m_lp_pool2d():
+    x = _pos((1, 2, 4, 4))
+    got = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, stride=2)
+    want = np.sqrt(_pool_ref_2d(x ** 2, 2, np.sum))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+
+@misc("adaptive_avg_pool1d")
+def _m_aap1():
+    x = _any((2, 3, 8))
+    got = F.adaptive_avg_pool1d(paddle.to_tensor(x), 4)
+    np.testing.assert_allclose(got.numpy(), x.reshape(2, 3, 4, 2).mean(-1),
+                               rtol=1e-5)
+
+
+@misc("adaptive_avg_pool2d")
+def _m_aap2():
+    x = _any((1, 2, 6, 6))
+    got = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3)
+    np.testing.assert_allclose(got.numpy(), _pool_ref_2d(x, 2, np.mean),
+                               rtol=1e-5)
+
+
+@misc("adaptive_avg_pool3d")
+def _m_aap3():
+    x = _any((1, 2, 4, 4, 4))
+    got = F.adaptive_avg_pool3d(paddle.to_tensor(x), 2)
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@misc("adaptive_max_pool1d")
+def _m_amp1():
+    x = _any((2, 3, 8))
+    got = F.adaptive_max_pool1d(paddle.to_tensor(x), 4)
+    np.testing.assert_allclose(got.numpy(), x.reshape(2, 3, 4, 2).max(-1),
+                               rtol=1e-5)
+
+
+@misc("adaptive_max_pool2d")
+def _m_amp2():
+    x = _any((1, 2, 6, 6))
+    got = F.adaptive_max_pool2d(paddle.to_tensor(x), 3)
+    np.testing.assert_allclose(got.numpy(), _pool_ref_2d(x, 2, np.max),
+                               rtol=1e-5)
+
+
+@misc("adaptive_max_pool3d")
+def _m_amp3():
+    x = _any((1, 2, 4, 4, 4))
+    got = F.adaptive_max_pool3d(paddle.to_tensor(x), 2)
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@misc("fractional_max_pool2d")
+def _m_fmp2():
+    x = _any((1, 2, 8, 8))
+    got = F.fractional_max_pool2d(paddle.to_tensor(x), output_size=4)
+    assert list(got.shape) == [1, 2, 4, 4]
+    # every output value must exist in the input (it's a max over a window)
+    assert np.isin(got.numpy(), x).all()
+
+
+@misc("fractional_max_pool3d")
+def _m_fmp3():
+    x = _any((1, 1, 4, 4, 4))
+    got = F.fractional_max_pool3d(paddle.to_tensor(x), output_size=2)
+    assert list(got.shape) == [1, 1, 2, 2, 2]
+    assert np.isin(got.numpy(), x).all()
+
+
+@misc("max_unpool1d")
+def _m_unpool1():
+    x = _any((1, 1, 8))
+    p, idx = F.max_pool1d(paddle.to_tensor(x), 2, stride=2, return_mask=True)
+    up = F.max_unpool1d(p, idx, 2, stride=2)
+    nz = up.numpy()[up.numpy() != 0]
+    np.testing.assert_allclose(np.sort(nz), np.sort(p.numpy().ravel()))
+
+
+@misc("max_unpool3d")
+def _m_unpool3():
+    x = _any((1, 1, 4, 4, 4))
+    p, idx = F.max_pool3d(paddle.to_tensor(x), 2, stride=2, return_mask=True)
+    up = F.max_unpool3d(p, idx, 2, stride=2)
+    nz = up.numpy()[up.numpy() != 0]
+    np.testing.assert_allclose(np.sort(nz), np.sort(p.numpy().ravel()))
+
+
+@misc("conv1d")
+def _m_conv1d():
+    x = _any((1, 1, 8))
+    w = _any((2, 1, 3))
+    got = F.conv1d(paddle.to_tensor(x), paddle.to_tensor(w))
+    want = np.stack([np.correlate(x[0, 0], w[o, 0], mode="valid")
+                     for o in range(2)])[None]
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+
+@misc("conv3d")
+def _m_conv3d():
+    x = _any((1, 1, 3, 3, 3))
+    w = np.ones((1, 1, 3, 3, 3), "float32")
+    got = F.conv3d(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(float(got.numpy().ravel()[0]), x.sum(),
+                               rtol=1e-4)
+
+
+@misc("conv1d_transpose")
+def _m_conv1dt():
+    x = np.array([[[1.0, 2.0]]], "float32")
+    w = np.array([[[1.0, 1.0, 1.0]]], "float32")
+    got = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(got.numpy(), [[[1.0, 3.0, 3.0, 2.0]]],
+                               rtol=1e-5)
+
+
+@misc("conv2d_transpose")
+def _m_conv2dt():
+    x = np.ones((1, 1, 2, 2), "float32")
+    w = np.ones((1, 1, 2, 2), "float32")
+    got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w))
+    want = np.array([[[[1, 2, 1], [2, 4, 2], [1, 2, 1]]]], "float32")
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+@misc("conv3d_transpose")
+def _m_conv3dt():
+    x = np.ones((1, 1, 1, 1, 1), "float32")
+    w = np.ones((1, 1, 2, 2, 2), "float32")
+    got = F.conv3d_transpose(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(got.numpy(), np.ones((1, 1, 2, 2, 2)),
+                               rtol=1e-5)
+
+
+@misc("interpolate")
+def _m_interpolate():
+    x = _any((1, 1, 2, 2))
+    got = F.interpolate(paddle.to_tensor(x), scale_factor=2, mode="nearest")
+    np.testing.assert_allclose(got.numpy(), x.repeat(2, 2).repeat(2, 3))
+
+
+@misc("upsample")
+def _m_upsample():
+    x = _any((1, 1, 2, 2))
+    got = F.upsample(paddle.to_tensor(x), scale_factor=2, mode="nearest")
+    np.testing.assert_allclose(got.numpy(), x.repeat(2, 2).repeat(2, 3))
+
+
+@misc("pixel_shuffle")
+def _m_pixel_shuffle():
+    x = _any((1, 4, 2, 2))
+    got = F.pixel_shuffle(paddle.to_tensor(x), 2)
+    assert list(got.shape) == [1, 1, 4, 4]
+    np.testing.assert_allclose(got.numpy()[0, 0, 0, 0], x[0, 0, 0, 0])
+    np.testing.assert_allclose(got.numpy()[0, 0, 0, 1], x[0, 1, 0, 0])
+
+
+@misc("pixel_unshuffle")
+def _m_pixel_unshuffle():
+    x = _any((1, 1, 4, 4))
+    got = F.pixel_unshuffle(paddle.to_tensor(x), 2)
+    back = F.pixel_shuffle(got, 2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+@misc("channel_shuffle")
+def _m_channel_shuffle():
+    x = _any((1, 4, 2, 2))
+    got = F.channel_shuffle(paddle.to_tensor(x), 2)
+    want = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(1, 4, 2, 2)
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+@misc("embedding")
+def _m_embedding():
+    w = _any((5, 3))
+    idx = np.array([[0, 4], [2, 2]])
+    got = F.embedding(paddle.to_tensor(idx), paddle.to_tensor(w))
+    np.testing.assert_allclose(got.numpy(), w[idx], rtol=1e-6)
+
+
+@misc("one_hot")
+def _m_one_hot():
+    idx = np.array([0, 2, 1])
+    got = F.one_hot(paddle.to_tensor(idx), 4)
+    np.testing.assert_allclose(got.numpy(), np.eye(4)[idx])
+
+
+@misc("normalize")
+def _m_normalize():
+    x = _any((3, 4))
+    got = F.normalize(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(
+        got.numpy(), x / np.linalg.norm(x, axis=1, keepdims=True), rtol=1e-5)
+
+
+@misc("label_smooth")
+def _m_label_smooth():
+    y = np.eye(4, dtype="float32")[[0, 2]]
+    got = F.label_smooth(paddle.to_tensor(y), epsilon=0.1)
+    np.testing.assert_allclose(got.numpy(), y * 0.9 + 0.1 / 4, rtol=1e-5)
+
+
+@misc("zeropad2d")
+def _m_zeropad2d():
+    x = _any((1, 1, 2, 2))
+    got = F.zeropad2d(paddle.to_tensor(x), [1, 0, 0, 1])
+    want = np.pad(x, [(0, 0), (0, 0), (0, 1), (1, 0)])
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+@misc("glu")
+def _m_glu():
+    x = _any((2, 6))
+    a, b = x[:, :3], x[:, 3:]
+    got = F.glu(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(got.numpy(), a * _np_sigmoid(b), rtol=1e-5)
+
+
+@misc("gumbel_softmax")
+def _m_gumbel_softmax():
+    paddle.seed(0)
+    x = _any((4, 5))
+    got = F.gumbel_softmax(paddle.to_tensor(x), hard=True)
+    g = got.numpy()
+    np.testing.assert_allclose(g.sum(-1), 1.0, rtol=1e-5)
+    # straight-through one-hot up to fp32 residue of (y - stop_grad(y))
+    assert np.allclose(np.sort(g, -1)[:, :-1], 0.0, atol=1e-6)
+    assert np.allclose(g.max(-1), 1.0, atol=1e-6)
+
+
+@misc("sequence_mask")
+def _m_sequence_mask():
+    got = F.sequence_mask(paddle.to_tensor(np.array([1, 3])), maxlen=4)
+    want = np.array([[1, 0, 0, 0], [1, 1, 1, 0]])
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+@misc("dropout2d")
+def _m_dropout2d():
+    x = _pos((2, 3, 4, 4))
+    paddle.seed(1)
+    got = F.dropout2d(paddle.to_tensor(x), p=0.5, training=True).numpy()
+    # channel granularity: each (n, c) map is all-zero or fully scaled
+    per = got.reshape(2 * 3, -1)
+    zeros = (per == 0).all(1)
+    kept = ~zeros
+    assert (zeros | kept).all()
+    np.testing.assert_allclose(per[kept], x.reshape(6, -1)[kept] * 2.0,
+                               rtol=1e-5)
+
+
+@misc("dropout3d")
+def _m_dropout3d():
+    x = _pos((1, 4, 2, 2, 2))
+    paddle.seed(2)
+    got = F.dropout3d(paddle.to_tensor(x), p=0.5, training=True).numpy()
+    per = got.reshape(4, -1)
+    zeros = (per == 0).all(1)
+    np.testing.assert_allclose(per[~zeros], x.reshape(4, -1)[~zeros] * 2.0,
+                               rtol=1e-5)
+
+
+@misc("alpha_dropout")
+def _m_alpha_dropout():
+    paddle.seed(3)
+    x = _any((1000,))
+    got = F.alpha_dropout(paddle.to_tensor(x), p=0.3, training=True).numpy()
+    # alpha dropout preserves mean/variance approximately
+    assert abs(got.mean() - x.mean()) < 0.2
+    assert not np.allclose(got, x)
+
+
+@misc("feature_alpha_dropout")
+def _m_feature_alpha_dropout():
+    paddle.seed(4)
+    x = _any((4, 100))
+    got = F.feature_alpha_dropout(paddle.to_tensor(x), p=0.5, training=True)
+    assert got.numpy().shape == x.shape
+
+
+@misc("pairwise_distance")
+def _m_pairwise_distance():
+    a, b = _any((3, 4)), _any((3, 4))
+    got = F.pairwise_distance(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(),
+                               np.linalg.norm(a - b + 1e-6, axis=1), rtol=1e-4)
+
+
+@misc("cosine_similarity")
+def _m_cosine_similarity():
+    a, b = _any((3, 4)), _any((3, 4))
+    got = F.cosine_similarity(paddle.to_tensor(a), paddle.to_tensor(b))
+    want = (a * b).sum(1) / (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+
+@misc("bilinear")
+def _m_bilinear():
+    x1, x2 = _any((2, 3)), _any((2, 4))
+    w = _any((5, 3, 4))
+    got = F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                     paddle.to_tensor(w))
+    want = np.einsum("bi,oij,bj->bo", x1, w, x2)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+
+@misc("maxout")
+def _m_maxout():
+    x = _any((1, 4, 2, 2))
+    got = F.maxout(paddle.to_tensor(x), groups=2)
+    want = np.maximum(x[:, 0::2][:, [0, 1]], 0)  # placeholder, checked below
+    want = x.reshape(1, 2, 2, 2, 2).max(2)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+
+
+@misc("prelu")
+def _m_prelu():
+    x = _any((2, 3))
+    got = F.prelu(paddle.to_tensor(x), paddle.to_tensor(np.array([0.2], "float32")))
+    np.testing.assert_allclose(got.numpy(), np.where(x > 0, x, 0.2 * x),
+                               rtol=1e-5)
+
+
+@misc("rrelu")
+def _m_rrelu():
+    x = _any((2, 3))
+    got = F.rrelu(paddle.to_tensor(x), training=False).numpy()
+    lower, upper = 1 / 8, 1 / 3
+    np.testing.assert_allclose(
+        got, np.where(x > 0, x, (lower + upper) / 2 * x), rtol=1e-5)
+
+
+@misc("local_response_norm")
+def _m_lrn():
+    x = _pos((1, 4, 3, 3))
+    got = F.local_response_norm(paddle.to_tensor(x), size=3).numpy()
+    assert got.shape == x.shape and np.isfinite(got).all()
+    assert (np.abs(got) <= np.abs(x) + 1e-6).all()  # divisive normalization
+
+
+@misc("fold")
+def _m_fold():
+    # fold(unfold(x)) with non-overlapping patches reconstructs x
+    x = _any((1, 1, 4, 4))
+    cols = F.unfold(paddle.to_tensor(x), 2, strides=2)
+    back = F.fold(cols, output_sizes=[4, 4], kernel_sizes=2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+@misc("temporal_shift")
+def _m_temporal_shift():
+    x = _any((4, 4, 2, 2))  # (N*T, C, H, W), T=2
+    got = F.temporal_shift(paddle.to_tensor(x), seg_num=2, shift_ratio=0.25)
+    g = got.numpy().reshape(2, 2, 4, 2, 2)
+    xr = x.reshape(2, 2, 4, 2, 2)
+    # first C/4 channels shifted backward: out[:, t, 0] = in[:, t+1, 0]
+    np.testing.assert_allclose(g[:, 0, 0], xr[:, 1, 0], rtol=1e-6)
+    np.testing.assert_allclose(g[:, 1, 0], 0.0)
+
+
+@misc("one_hot_dtype")
+def _m_one_hot_dtype():
+    got = F.one_hot(paddle.to_tensor(np.array([1])), 3)
+    assert "float" in str(got.dtype)
+
+
+@misc("class_center_sample")
+def _m_ccs():
+    paddle.seed(5)
+    labels = np.array([0, 5, 9, 5])
+    remapped, sampled = F.class_center_sample(paddle.to_tensor(labels), 10, 6)
+    s = np.asarray(sampled.numpy())
+    assert set(np.unique(labels)) <= set(s.tolist())  # positives kept
+    r = np.asarray(remapped.numpy())
+    np.testing.assert_array_equal(s[r], labels)  # remap consistent
+
+
+@misc("hsigmoid_loss")
+def _m_hsigmoid():
+    x = _any((3, 4))
+    y = np.array([0, 3, 1])
+    w = _any((7, 4))
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), 8,
+                          paddle.to_tensor(w))
+    assert np.isfinite(float(got.numpy()))
+
+
+@misc("adaptive_log_softmax_with_loss")
+def _m_alsl():
+    x = _any((4, 8))
+    y = np.array([0, 1, 2, 3])
+    head_w = _any((8, 2 + 1))  # cutoffs [2]: head = 2 + 1 cluster
+    tail_ws = [[paddle.to_tensor(_any((8, 4))), paddle.to_tensor(_any((4, 2)))]]
+    out = F.adaptive_log_softmax_with_loss(
+        paddle.to_tensor(x), paddle.to_tensor(y), paddle.to_tensor(head_w),
+        tail_ws, [2])
+    loss = out[1] if isinstance(out, (tuple, list)) else out
+    assert np.isfinite(float(loss.numpy()))
+
+
+class TestMisc:
+    @pytest.mark.parametrize("name", sorted(NF_MISC), ids=str)
+    def test_misc(self, name):
+        NF_MISC[name]()
+
+
+# --------------------------------------------------------------------------
+# NF_EXEMPT: nn.functional names not handled above, with reasons/pointers
+# --------------------------------------------------------------------------
+NF_EXEMPT = {
+    "conv2d": "numeric identity/shift/group cases in tests/test_nn.py",
+    "linear": "bias+matmul identity in tests/test_nn.py + every model test",
+    "pad": "mode-by-mode numeric cases in tests/test_nn.py",
+    "unfold": "im2col round-trip tested here via fold (NF_MISC['fold'])",
+    "avg_pool2d": "numeric strided cases in tests/test_nn.py",
+    "max_pool2d": "numeric + return_mask cases in tests/test_nn.py",
+    "max_unpool2d": "scatter-back case in tests/test_nn.py",
+    "dropout": "mask/scale distribution case in tests/test_nn.py",
+    "batch_norm": "normalization + running-stats cases in tests/test_nn.py",
+    "layer_norm": "parity vs manual formula in tests/test_nn.py and "
+                  "tests/test_decomposition.py",
+    "instance_norm": "tests/test_decomposition.py numeric parity",
+    "group_norm": "tests/test_decomposition.py numeric parity",
+    "margin_cross_entropy": "arcface margin case in tests/test_nn.py",
+    "rnnt_loss": "DP + fastemit gradient cases in tests/test_nn.py",
+    "affine_grid": "identity/shift grids in tests/test_nn.py",
+    "grid_sample": "identity/shift sampling in tests/test_nn.py",
+    "gather_tree": "beam backtrace case in tests/test_nn.py",
+    "scaled_dot_product_attention": "vs dense softmax reference in "
+                                    "tests/test_models.py::TestFlashAttention",
+    "sparse_attention": "block-sparse mask case in tests/test_nn.py",
+    "flashmask_attention": "tests/test_models.py flashmask cases",
+    "flash_attn_qkvpacked": "packed wrapper over flash attention; kernel "
+                            "numerics in tests/test_models.py",
+    "flash_attn_varlen_qkvpacked": "tests/test_models.py::TestVarlenFlash"
+                                   "Attention",
+}
+_NF_INPLACE = {"elu_", "hardtanh_", "leaky_relu_", "relu_", "softmax_",
+               "tanh_", "thresholded_relu_"}
+
+
+class TestNFCompleteness:
+    def test_every_nf_name_tested_or_exempted(self):
+        import os
+        import re
+
+        ref = "/root/reference/python/paddle/nn/functional/__init__.py"
+        if not os.path.exists(ref):
+            pytest.skip("reference checkout not present")
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", open(ref).read(), re.S)
+        names = re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1))
+        covered = (set(NF_ACT) | set(NF_LOSS) | set(NF_MISC) | set(NF_EXEMPT)
+                   | _NF_INPLACE)
+        leftover = [n for n in names
+                    if n not in covered
+                    and not (n.endswith("_") and n[:-1] in covered)]
+        assert not leftover, (
+            f"nn.functional ops neither tested nor exempted: {sorted(leftover)}")
+
+    def test_exempt_pointers_name_real_suites(self):
+        import os
+
+        for n, reason in NF_EXEMPT.items():
+            assert hasattr(F, n), n
+            for tok in reason.split():
+                if tok.startswith("tests/") and tok.endswith(".py"):
+                    assert os.path.exists(tok), (n, tok)

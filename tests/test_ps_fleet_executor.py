@@ -148,3 +148,95 @@ class TestFleetExecutor:
         c.connect("prog", "sink")
         res = c.run()
         assert [float(r) for r in res["sink"]] == [0.0, 8.0, 16.0]
+
+
+class TestSSDSparseTable:
+    """Disk-spilled sparse table (reference ssd_sparse_table.h semantics:
+    hot cache + beyond-memory rows, VERDICT r2 missing #9)."""
+
+    def test_spills_beyond_memory_and_preserves_values(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+
+        t = SSDSparseTable(4, accessor="sgd", lr=1.0,
+                           ssd_path=str(tmp_path), max_mem_rows=8)
+        ids = np.arange(32)
+        first = t.pull(ids).copy()          # 32 rows through an 8-row cache
+        assert t.mem_size() <= 8
+        assert t.ssd_size() >= 24
+        assert t.size() == 32
+        again = t.pull(ids)                  # promoted back from disk intact
+        np.testing.assert_allclose(again, first)
+
+    def test_push_updates_spilled_rows(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+
+        t = SSDSparseTable(2, accessor="sgd", lr=1.0,
+                           ssd_path=str(tmp_path), max_mem_rows=2)
+        row0 = t.pull([7])[0].copy()
+        t.pull([1, 2, 3, 4])                 # evict id 7 to disk
+        assert t.ssd_size() >= 1
+        t.push([7], np.ones((1, 2), np.float32))  # update promotes from disk
+        np.testing.assert_allclose(t.pull([7])[0], row0 - 1.0, rtol=1e-6)
+
+    def test_save_merges_mem_and_disk(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import SparseTable, SSDSparseTable
+
+        t = SSDSparseTable(3, ssd_path=str(tmp_path / "s"), max_mem_rows=4)
+        vals = {i: t.pull([i])[0].copy() for i in range(12)}
+        t.save(str(tmp_path / "ckpt"))
+        t2 = SparseTable(3)
+        t2.load(str(tmp_path / "ckpt"))
+        assert t2.size() == 12
+        for i, v in vals.items():
+            np.testing.assert_allclose(t2.pull([i])[0], v)
+
+
+class TestGraphTable:
+    def test_degree_and_sampling(self):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import GraphTable
+
+        g = GraphTable(seed=0)
+        g.add_edges([0, 0, 0, 1], [10, 11, 12, 20])
+        np.testing.assert_array_equal(g.get_degree([0, 1, 5]), [3, 1, 0])
+        flat, counts = g.sample_neighbors([0, 1, 5], 2)
+        np.testing.assert_array_equal(counts, [2, 1, 0])
+        assert set(flat[:2]) <= {10, 11, 12}
+        assert flat[2] == 20
+
+    def test_save_load_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import GraphTable
+
+        g = GraphTable()
+        g.add_edges([3, 3, 4], [7, 8, 9])
+        g.save(str(tmp_path / "graph"))
+        g2 = GraphTable()
+        g2.load(str(tmp_path / "graph"))
+        np.testing.assert_array_equal(g2.get_degree([3, 4]), [2, 1])
+
+    def test_load_replaces_both_tiers(self, tmp_path):
+        """load() must wipe stale disk rows — a restore is a full state swap
+        (review finding: inherited load double-counted and resurrected old
+        spilled rows)."""
+        import numpy as np
+
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+
+        t = SSDSparseTable(4, ssd_path=str(tmp_path / "a"), max_mem_rows=8)
+        t.pull(np.arange(32))        # 24 rows spilled
+        t.save(str(tmp_path / "ck"))
+        t.pull(np.arange(100, 140))  # post-save garbage in both tiers
+        t.load(str(tmp_path / "ck"))
+        assert t.size() == 32        # not 56/72: stale tiers gone
+        assert t.mem_size() <= 8     # cap re-enforced after load
+        assert t.pull([100]) is not None  # new row, freshly initialized
+        assert t.size() == 33
