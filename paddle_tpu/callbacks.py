@@ -1,4 +1,5 @@
 """paddle.callbacks namespace (python/paddle/callbacks.py parity)."""
 from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    VisualDL,
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
 )

@@ -25,6 +25,7 @@ class Engine:
         self._eval_fn = None
         self._pred_fn = None
         self._example_specs = None  # first-seen input (shape, dtype)s, for export
+        self._label_specs = None
         self.history = {"loss": []}
 
     # ----------------------------------------------------------------- build
@@ -63,6 +64,9 @@ class Engine:
                     # keep the FIRST batch's shapes: a ragged final batch
                     # would pin the exported model to its smaller batch size
                     self._record_specs(inputs)
+                if self._label_specs is None:
+                    self._label_specs = [
+                        (list(label.shape), str(label.dtype)) for label in labels]
                 if len(labels) > 1:
                     raise NotImplementedError(
                         "Engine.fit: the compiled train step takes one label "
@@ -259,4 +263,40 @@ class Engine:
         return [batch], []
 
     def cost(self, mode="train"):
-        return None
+        """Reference engine.py cost(): estimated FLOPs/memory of the program.
+        Here the COMPILER is the cost model — XLA's cost_analysis on the
+        compiled step/eval/predict program (flops, bytes accessed, peak
+        memory) instead of the reference's hand-built op-cost tables."""
+        self._build(mode)
+        if self._example_specs is None:
+            raise RuntimeError(
+                "Engine.cost needs recorded input shapes; run fit/evaluate/"
+                "predict first")
+        args = [np.zeros(shape, dtype)
+                for shape, dtype in self._example_specs]
+        lbl = [np.zeros(shape, dtype)
+               for shape, dtype in (self._label_specs or [])]
+        try:
+            if mode == "train":
+                fn = self._train_step
+                if self._label_specs is None:
+                    return None  # no labels seen yet: the step can't lower
+                compiled = fn._jitted.lower(
+                    fn._params, fn._buffers, fn._states,
+                    np.float32(0.0), np.int32(1), *args, *lbl).compile()
+            else:
+                fn = self._eval_fn if mode == "eval" else self._pred_fn
+                params, buffers = fn._network.functional_state()
+                extra = lbl if (mode == "eval" and self._loss is not None) else []
+                compiled = fn._jitted.lower(
+                    params, buffers, *args, *extra).compile()
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            mem = compiled.memory_analysis()
+            return {
+                "flops": float(ca.get("flops", 0.0)) if ca else None,
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0)) if ca else None,
+                "peak_memory_bytes": getattr(mem, "temp_size_in_bytes", None),
+            }
+        except Exception:
+            return None

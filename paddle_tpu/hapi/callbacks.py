@@ -4,7 +4,7 @@ from __future__ import annotations
 import os
 import time
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "VisualDL",
            "LRScheduler", "config_callbacks"]
 
 
@@ -172,3 +172,67 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         "metrics": metrics or [],
     })
     return cl
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py VisualDL).
+
+    The visualdl package is not bundled here; when importable it is used
+    directly (add_scalar per metric), otherwise scalars stream to
+    ``{log_dir}/scalars.jsonl`` — one JSON record per step/epoch, the same
+    data VisualDL would plot, readable by any dashboard."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._file = None
+        self._train_step = 0
+
+    def _ensure_writer(self):
+        if self._writer is not None or self._file is not None:
+            return
+        os.makedirs(self.log_dir, exist_ok=True)
+        try:  # pragma: no cover - visualdl absent in this environment
+            from visualdl import LogWriter
+
+            self._writer = LogWriter(self.log_dir)
+        except ImportError:
+            self._file = open(
+                os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _log(self, tag, value, step):
+        import json
+
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        self._ensure_writer()
+        if self._writer is not None:  # pragma: no cover
+            self._writer.add_scalar(tag=tag, value=value, step=step)
+        else:
+            self._file.write(json.dumps(
+                {"tag": tag, "value": value, "step": step}) + "\n")
+            self._file.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        for k, v in (logs or {}).items():
+            if k in ("batch_size",):
+                continue
+            self._log(f"train/{k}", v, self._train_step)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            if k in ("batch_size",):
+                continue
+            self._log(f"eval/{k}", v, self._train_step)
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._writer is not None:  # pragma: no cover
+            self._writer.close()
+            self._writer = None

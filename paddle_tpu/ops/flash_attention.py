@@ -88,11 +88,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (8, block_q))
 
 
-def _pick_block(n: int, preferred: int) -> int:
+def _pick_block(n: int, preferred: int, kind: str = "") -> int:
+    """Largest power-of-two-ish divisor of ``n`` at most ``preferred``.
+
+    When ``kind`` is given ("q"/"k"), PADDLE_TPU_FLASH_BLOCK[_Q|_K] overrides
+    ``preferred`` for perf sweeps (bench_sweep.jsonl).  NOTE: the enclosing
+    kernels are jax.jit'd, so the env is read at TRACE time — sweep in
+    separate processes (as bench_sweep does), not by mutating os.environ
+    between calls.  Callers passing explicit blocking (kind="") are never
+    overridden."""
+    if kind:
+        import os
+        import warnings
+
+        env = (os.environ.get(f"PADDLE_TPU_FLASH_BLOCK_{kind.upper()}")
+               or os.environ.get("PADDLE_TPU_FLASH_BLOCK"))
+        if env:
+            try:
+                v = int(env)
+            except ValueError:
+                v = 0
+            if v >= 8:
+                preferred = v
+            else:
+                warnings.warn(
+                    f"ignoring invalid flash block override {env!r} "
+                    "(need an integer >= 8)", stacklevel=2)
     b = min(preferred, n)
     while n % b:
         b //= 2
-    return max(b, 1)
+    b = max(b, 1)
+    if kind and b != min(preferred, n):
+        import warnings
+
+        warnings.warn(
+            f"flash block_{kind} {preferred} does not divide L={n}; "
+            f"using {b}", stacklevel=2)
+    return b
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
@@ -106,8 +138,10 @@ def _flash_fwd_pallas(q, k, v, causal=False, scale=None, interpret=False):
     qh = jnp.swapaxes(q, 1, 2).reshape(b * h, lq, d)
     kh = jnp.swapaxes(k, 1, 2).reshape(b * h, lk, d)
     vh = jnp.swapaxes(v, 1, 2).reshape(b * h, lk, d)
-    block_q = _pick_block(lq, 512)
-    block_k = _pick_block(lk, 512)
+    # sweep-chosen defaults (v5e, L=2048): k blocks 1024 beat 512 by ~1.2%
+    # MFU; 256 loses 16% and full-L k overflows VMEM (bench_sweep.jsonl)
+    block_q = _pick_block(lq, 512, "q")
+    block_k = _pick_block(lk, 1024, "k")
     grid = (b * h, lq // block_q)
     out, lse = pl.pallas_call(
         functools.partial(
@@ -272,8 +306,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal=False, scale=None,
     # replicated over 8 sublanes to match the lse tiling
     delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, lq))
-    block_q = _pick_block(lq, 512)
-    block_k = _pick_block(lk, 512)
+    # sweep-chosen defaults (v5e, L=2048): k blocks 1024 beat 512 by ~1.2%
+    # MFU; 256 loses 16% and full-L k overflows VMEM (bench_sweep.jsonl)
+    block_q = _pick_block(lq, 512, "q")
+    block_k = _pick_block(lk, 1024, "k")
 
     dk, dv = pl.pallas_call(
         functools.partial(

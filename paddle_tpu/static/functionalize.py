@@ -250,4 +250,8 @@ def build_eval_fn(network, loss_fn=None):
         out = eval_fn(p, b, *arrs)
         return jax.tree_util.tree_map(Tensor, out)
 
+    # expose the jitted callable + live state for cost analysis
+    # (auto_parallel Engine.cost lowers it with XLA's cost model)
+    run._jitted = eval_fn
+    run._network = network
     return run

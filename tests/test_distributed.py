@@ -825,3 +825,23 @@ class TestEngineAmpStrategy:
             fn._params, fn._buffers, fn._states,
             np.float32(0.05), np.int32(1), X, X).as_text()
         assert "bf16" in lowered
+
+    def test_engine_cost_model(self):
+        """Engine.cost(): XLA cost_analysis as the reference's cost model."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet import auto
+
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        eng = auto.Engine(net, loss=nn.MSELoss(), optimizer=opt)
+        X = np.random.rand(8, 4).astype("float32")
+        Y = X.sum(1, keepdims=True).astype("float32")
+        eng.fit([(paddle.to_tensor(X), paddle.to_tensor(Y))], epochs=1,
+                verbose=0)
+        c = eng.cost("train")
+        assert c is not None and c["flops"] and c["flops"] > 0
+        assert c["bytes_accessed"] and c["bytes_accessed"] > 0

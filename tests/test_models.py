@@ -336,3 +336,31 @@ class TestHapiAmpConfigs:
         model = paddle.Model(nn.Linear(2, 2))
         with _pytest.raises(TypeError, match="amp_configs"):
             model.prepare(None, None, amp_configs=3.14)
+
+
+class TestVisualDLCallback:
+    def test_scalars_logged_during_fit(self, tmp_path):
+        import json
+
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.05,
+                                           parameters=net.parameters()),
+                      nn.MSELoss())
+        X = np.random.rand(16, 4).astype("float32")
+        Y = X.sum(1, keepdims=True).astype("float32")
+        cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path))
+        model.fit([(paddle.to_tensor(X), paddle.to_tensor(Y))], epochs=2,
+                  callbacks=[cb], verbose=0)
+        recs = [json.loads(l) for l in
+                open(tmp_path / "scalars.jsonl").read().splitlines()]
+        assert len(recs) >= 2
+        assert all(r["tag"].startswith("train/") for r in recs)
+        assert all(np.isfinite(r["value"]) for r in recs)
+        steps = [r["step"] for r in recs if r["tag"] == "train/loss"]
+        assert steps == sorted(steps)
