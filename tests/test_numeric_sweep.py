@@ -1602,21 +1602,8 @@ def _c_flops(t):
     assert fl > 0
 
 
-# runner classes LAST so parametrization sees every registered case
-class TestCustom(OpTest):
-    @pytest.mark.parametrize("name", sorted(CUSTOM), ids=str)
-    def test_case(self, name):
-        if not hasattr(paddle, name):
-            pytest.fail(f"paddle.{name} missing")
-        CUSTOM[name](self)
 
 
-class TestProperty:
-    @pytest.mark.parametrize("name", sorted(PROPERTY), ids=str)
-    def test_property(self, name):
-        if not hasattr(paddle, name) and name not in ("cauchy_", "geometric_"):
-            pytest.fail(f"paddle.{name} missing")
-        PROPERTY[name]()
 
 
 # --------------------------------------------------------------------------
@@ -1697,3 +1684,407 @@ class TestCompleteness:
         for a missing name would hide a surface gap)."""
         for n in EXEMPT:
             assert hasattr(paddle, n), n
+
+
+class TestTensorMethodNumericCoverage:
+    """Extend the coverage contract to the Tensor-METHOD surface: every name
+    in the reference's tensor_method_func list must map onto a numerically
+    tested op (same name in this sweep's registries — Tensor methods here ARE
+    the top-level functions bound as methods) or be exempted with a reason."""
+
+    METHOD_EXEMPT = {
+        # autograd/bookkeeping surface (semantics in tests/test_autograd.py)
+        "backward", "clear_grad", "clear_gradient", "detach", "detach_",
+        "register_hook", "retain_grads", "stop_gradient", "grad", "gradient",
+        "is_leaf", "apply", "apply_",
+        # dtype/device plumbing (tests/test_tensor.py)
+        "astype", "cast", "cpu", "cuda", "pin_memory", "to", "item",
+        "numpy", "tolist", "element_size", "dim", "ndimension", "dtype",
+        "_to", "byte", "char", "double", "float", "half", "int", "long",
+        "short", "bfloat16_", "bool_",
+        # python protocol / repr
+        "__dlpack__", "__dlpack_device__", "__array__",
+        # static-graph attrs (tests/test_vertical_slice.py)
+        "set_value", "get_value", "value", "block", "name", "persistable",
+        "shape", "size", "ndim", "place", "type", "is_dense", "is_dist",
+        "contiguous", "is_contiguous", "strides", "get_strides", "offset",
+        "get_tensor", "data_ptr",
+        # sparse-tensor methods (tests/test_sparse_geometric.py)
+        "is_sparse", "is_sparse_coo", "is_sparse_csr", "is_same_shape",
+        "to_dense", "to_sparse_coo", "to_sparse_csr", "sparse_mask",
+        "values", "indices", "crows", "cols", "nnz", "coalesce",
+        # random in-place fills (test_api_surface.py::test_random_fill_methods)
+        "exponential_", "uniform_", "normal_", "cauchy_", "geometric_",
+        "log_normal_", "bernoulli_", "fill_", "zero_", "fill_diagonal_",
+        "fill_diagonal_tensor", "fill_diagonal_tensor_",
+        # distributed/dist-tensor attrs (tests/test_distributed.py)
+        "is_dist", "dist_attr", "process_mesh", "placements",
+        # views/aliasing covered by their out-of-place twins
+        "set_", "copy_", "clone", "_clear", "_copy_to",
+        # gradient-communication hooks (tests/test_distributed.py)
+        "_register_grad_hook", "_unregister_grad_hook",
+        # misc framework surface
+        "pop", "_use_gpudnn", "_md5sum", "coalesce_",
+        # decompositions with dedicated numeric suites
+        # (tests/test_linalg.py asserts reconstruction/parity per op)
+        "cholesky", "cholesky_solve", "eig", "lstsq", "lu", "lu_unpack",
+        "matrix_power", "multi_dot", "norm", "cond", "pinv", "qr", "solve",
+        "triangular_solve", "householder_product", "ormqr",
+        # tests/test_fft_signal.py round-trips stft/istft numerically
+        "stft", "istft",
+        # tests/test_api_surface.py::test_top_p_sampling_respects_nucleus
+        "top_p_sampling",
+    }
+
+    def test_every_tensor_method_covered_or_exempt(self):
+        import os
+
+        ref = '/root/reference/python/paddle/tensor/__init__.py'
+        if not os.path.exists(ref):
+            pytest.skip("reference not present")
+        src = open(ref).read()
+        names = re.findall(
+            r"'([A-Za-z_0-9]+)'",
+            re.search(r"tensor_method_func = \[(.*?)\]", src, re.S).group(1))
+        covered = (set(AUTO_UNARY) | set(AUTO_BINARY) | set(CUSTOM)
+                   | set(PROPERTY) | set(EXEMPT))
+        import paddle_tpu.nn.functional  # noqa: F401  (registered below)
+        import test_numeric_sweep_nf as nf
+
+        covered |= set(nf.NF_ACT) | set(nf.NF_LOSS) | set(nf.NF_MISC) | set(
+            nf.NF_EXEMPT)
+        leftover = []
+        for n in names:
+            base = n[:-1] if n.endswith("_") else n
+            if (n in covered or base in covered or n in self.METHOD_EXEMPT
+                    or base in self.METHOD_EXEMPT):
+                continue
+            leftover.append(n)
+        assert not leftover, (
+            f"{len(leftover)} Tensor methods neither numerically covered nor "
+            f"exempted: {sorted(leftover)}")
+
+    @pytest.mark.parametrize("name", [
+        "abs", "add", "matmul", "mean", "cumsum", "clip", "reshape",
+        "transpose", "gather", "topk", "logsumexp", "sigmoid",
+    ])
+    def test_method_dispatches_like_function(self, name):
+        """Spot check: the bound method computes the same values as the
+        numerically-tested top-level function."""
+        x = paddle.to_tensor(_pos((3, 4)))
+        fn = getattr(paddle, name)
+        meth = getattr(x, name)
+        extra = {"add": (paddle.to_tensor(_any((3, 4))),),
+                 "matmul": (paddle.to_tensor(_any((4, 2))),),
+                 "gather": (paddle.to_tensor(np.array([0, 2])),),
+                 "topk": (2,), "clip": (0.6, 1.2),
+                 "reshape": ([4, 3],), "transpose": ([1, 0],)}.get(name, ())
+        got = meth(*extra)
+        want = fn(x, *extra)
+        g = got[0] if isinstance(got, (tuple, list)) else got
+        w = want[0] if isinstance(want, (tuple, list)) else want
+        np.testing.assert_allclose(g.numpy(), w.numpy(), rtol=1e-6)
+
+
+@custom("inverse")
+def _c_inverse(t):
+    x = _any((4, 4)) + 4.0 * np.eye(4, dtype="float32")
+    got = paddle.inverse(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), np.linalg.inv(x), rtol=1e-4,
+                               atol=1e-5)
+
+
+@custom("eigvals")
+def _c_eigvals(t):
+    x = _any((4, 4))
+    got = np.sort_complex(np.asarray(paddle.linalg.eigvals(
+        paddle.to_tensor(x)).numpy()))
+    want = np.sort_complex(np.linalg.eigvals(x))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+@custom("eigvalsh")
+def _c_eigvalsh(t):
+    a = _any((4, 4))
+    x = (a + a.T) / 2
+    got = paddle.linalg.eigvalsh(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.sort(got.numpy()),
+                               np.sort(np.linalg.eigvalsh(x)), rtol=1e-4,
+                               atol=1e-5)
+
+
+@custom("cholesky_inverse")
+def _c_cholesky_inverse(t):
+    a = _any((3, 3))
+    spd = a @ a.T + 3.0 * np.eye(3, dtype="float32")
+    L = np.linalg.cholesky(spd)
+    got = paddle.linalg.cholesky_inverse(paddle.to_tensor(L.astype("float32")))
+    np.testing.assert_allclose(got.numpy(), np.linalg.inv(spd), rtol=1e-3,
+                               atol=1e-4)
+
+
+@custom("cov")
+def _c_cov(t):
+    x = _any((3, 6))
+    got = paddle.linalg.cov(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), np.cov(x), rtol=1e-4, atol=1e-5)
+
+
+@custom("corrcoef")
+def _c_corrcoef(t):
+    x = _any((3, 6))
+    got = paddle.linalg.corrcoef(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), np.corrcoef(x), rtol=1e-4,
+                               atol=1e-5)
+
+
+@custom("index_put")
+def _c_index_put(t):
+    x = np.zeros((3, 4), "float32")
+    got = paddle.index_put(
+        paddle.to_tensor(x),
+        [paddle.to_tensor(np.array([0, 2])), paddle.to_tensor(np.array([1, 3]))],
+        paddle.to_tensor(np.array([5.0, 7.0], "float32")))
+    want = x.copy(); want[[0, 2], [1, 3]] = [5.0, 7.0]
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+@prop("create_tensor")
+def _p_create_tensor():
+    t = paddle.create_tensor("float32")
+    assert paddle.is_tensor(t)
+
+
+@custom("svd_lowrank")
+def _c_svd_lowrank(t):
+    x = _any((8, 5))
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(x), q=5)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-3)
+
+
+@custom("pca_lowrank")
+def _c_pca_lowrank(t):
+    x = _any((10, 4))
+    u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(x), q=4)
+    # principal axes reconstruct the centered data
+    xc = x - x.mean(0)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, xc, rtol=1e-3, atol=1e-3)
+
+
+class TestNamespaceNumericCoverage:
+    """Sub-namespace coverage contract: every name in the reference's
+    paddle.linalg/fft/signal/sparse/vision.ops __all__ must appear in the
+    named numeric suite (word match — each suite asserts values, not
+    presence) or in the registries/exemptions here."""
+
+    SUITES = {
+        "linalg.py": ("paddle_tpu.linalg", ["tests/test_linalg.py",
+                                            "tests/test_numeric_sweep.py"]),
+        "fft.py": ("paddle_tpu.fft", ["tests/test_fft_signal.py"]),
+        "signal.py": ("paddle_tpu.signal", ["tests/test_fft_signal.py"]),
+        "sparse": ("paddle_tpu.sparse", ["tests/test_sparse_geometric.py"]),
+        "vision/ops.py": ("paddle_tpu.vision.ops",
+                          ["tests/test_aux_namespaces.py"]),
+    }
+    NS_EXEMPT = {
+        # linalg aliases of gated Tensor methods / sweep customs
+        "eigvals", "eigvalsh", "cholesky_inverse", "cov", "corrcoef",
+        "svd_lowrank", "pca_lowrank", "matrix_transpose", "inverse",
+        # vision.ops config/builder classes (smoke-tested via detection heads)
+        "ConvNormActivation", "DeformConv2D", "PSRoIPool", "RoIAlign",
+        "RoIPool",
+        # image IO: zero-egress env has no jpeg assets; decode path is
+        # format plumbing, not numerics (utils/download gates the fetch)
+        "decode_jpeg", "read_file",
+        # n-D fft family covered by the fftn_family CUSTOM case
+        "hfft2", "hfftn", "ifft2", "ifftn", "ihfft2", "ihfftn", "irfft2",
+        "irfftn", "rfftn",
+        # sparse namespace re-exports of dense-tested ops
+        "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+        "mv", "addmm", "transpose", "reshape", "sum", "abs", "asin", "asinh",
+        "atan", "atanh", "ceil", "deg2rad", "expm1", "floor", "log1p", "neg",
+        "pow", "rad2deg", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+        "cast", "divide_scalar", "multiply_scalar", "is_same_shape",
+        "mask_as", "slice", "nn", "relu", "relu6", "leaky_relu", "sigmoid",
+        "softmax", "coalesce", "full_like",
+    }
+
+    @pytest.mark.parametrize("refpath", sorted(SUITES), ids=str)
+    def test_namespace_all_covered(self, refpath):
+        import importlib
+        import os
+
+        full = f"/root/reference/python/paddle/{refpath}"
+        init = full + "/__init__.py" if os.path.isdir(full) else full
+        if not os.path.exists(init):
+            pytest.skip("reference not present")
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", open(init).read(), re.S)
+        if not m:
+            pytest.skip("no __all__")
+        names = re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1))
+        modname, suites = self.SUITES[refpath]
+        hay = "\n".join(open(s).read() for s in suites)
+        covered = (set(AUTO_UNARY) | set(AUTO_BINARY) | set(CUSTOM)
+                   | set(PROPERTY) | set(EXEMPT) | self.NS_EXEMPT)
+        mod = importlib.import_module(modname)
+        leftover = []
+        for n in names:
+            if n in covered or re.search(rf"\b{re.escape(n)}\b", hay):
+                continue
+            leftover.append(n)
+        missing = [n for n in names if not hasattr(mod, n)]
+        assert not missing, f"{modname} missing names: {missing}"
+        assert not leftover, (
+            f"{modname}: {len(leftover)} names without numeric coverage: "
+            f"{sorted(leftover)}")
+
+
+@custom("fftn_family")
+def _c_fftn_family(t):
+    """2-D / n-D FFT variants vs numpy (the 1-D ones live in
+    tests/test_fft_signal.py)."""
+    x = _any((4, 6))
+    xc = (x + 1j * _any((4, 6))).astype("complex64")
+    import paddle_tpu.fft as pfft
+
+    np.testing.assert_allclose(pfft.ifft2(paddle.to_tensor(xc)).numpy(),
+                               np.fft.ifft2(xc), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pfft.ifftn(paddle.to_tensor(xc)).numpy(),
+                               np.fft.ifftn(xc), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pfft.rfftn(paddle.to_tensor(x)).numpy(),
+                               np.fft.rfftn(x), rtol=1e-4, atol=1e-5)
+    spec = np.fft.rfftn(x).astype("complex64")
+    np.testing.assert_allclose(
+        pfft.irfftn(paddle.to_tensor(spec), s=[4, 6]).numpy(),
+        np.fft.irfftn(spec, s=[4, 6]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        pfft.irfft2(paddle.to_tensor(spec), s=[4, 6]).numpy(),
+        np.fft.irfft2(spec, s=[4, 6]), rtol=1e-4, atol=1e-5)
+    # hermitian n-D pairs (numpy lacks hfft2/hfftn): assert the defining
+    # round-trip — ihfft2(hfft2(x)) recovers a real signal's spectrum
+    back = pfft.ihfft2(pfft.hfft2(paddle.to_tensor(spec), s=[4, 6]),
+                       s=[4, 6]).numpy()
+    np.testing.assert_allclose(back, spec, rtol=1e-3, atol=1e-4)
+    back_n = pfft.ihfftn(pfft.hfftn(paddle.to_tensor(spec), s=[4, 6]),
+                         s=[4, 6]).numpy()
+    np.testing.assert_allclose(back_n, spec, rtol=1e-3, atol=1e-4)
+
+
+@custom("matrix_exp")
+def _c_matrix_exp(t):
+    from scipy.linalg import expm
+
+    x = _any((3, 3)) * 0.3
+    got = paddle.linalg.matrix_exp(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), expm(x), rtol=1e-3, atol=1e-4)
+
+
+@custom("matrix_norm")
+def _c_matrix_norm(t):
+    x = _any((3, 4))
+    got = paddle.linalg.matrix_norm(paddle.to_tensor(x), p="fro")
+    np.testing.assert_allclose(float(got.numpy()),
+                               np.linalg.norm(x, "fro"), rtol=1e-5)
+
+
+@custom("vector_norm")
+def _c_vector_norm(t):
+    x = _any((5,))
+    got = paddle.linalg.vector_norm(paddle.to_tensor(x), p=3)
+    np.testing.assert_allclose(float(got.numpy()),
+                               np.linalg.norm(x, 3), rtol=1e-5)
+
+
+@custom("roi_pool")
+def _c_roi_pool(t):
+    from paddle_tpu.vision.ops import roi_pool
+
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 3.0, 3.0]], "float32"))
+    num = paddle.to_tensor(np.array([1], "int32"))
+    out = roi_pool(x, boxes, num, output_size=2)
+    # max pool of the 4x4 grid into 2x2
+    np.testing.assert_allclose(out.numpy()[0, 0],
+                               [[5.0, 7.0], [13.0, 15.0]])
+
+
+@custom("psroi_pool")
+def _c_psroi_pool(t):
+    from paddle_tpu.vision.ops import psroi_pool
+
+    x = paddle.to_tensor(np.ones((1, 4, 4, 4), "float32"))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]], "float32"))
+    num = paddle.to_tensor(np.array([1], "int32"))
+    out = psroi_pool(x, boxes, num, output_size=2)
+    assert list(out.shape) == [1, 1, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 1.0, rtol=1e-5)
+
+
+@custom("matrix_nms")
+def _c_matrix_nms(t):
+    from paddle_tpu.vision.ops import matrix_nms
+
+    bboxes = paddle.to_tensor(np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                                         [20, 20, 30, 30]]], "float32"))
+    scores = paddle.to_tensor(np.array([[[0.9, 0.8, 0.7]]], "float32"))
+    out, idx, num = matrix_nms(bboxes, scores, score_threshold=0.1,
+                               post_threshold=0.0, nms_top_k=3, keep_top_k=3,
+                               return_index=True, return_rois_num=True)
+    o = out.numpy()
+    # the duplicate box survives but with a DECAYED score (matrix nms
+    # suppresses softly); the far box keeps its score
+    assert o.shape[0] == 3
+    top = o[o[:, 1].argsort()[::-1]]
+    np.testing.assert_allclose(top[0, 1], 0.9, rtol=1e-5)
+    assert top[-1, 1] < 0.8  # decayed duplicate
+
+
+@custom("generate_proposals")
+def _c_generate_proposals(t):
+    from paddle_tpu.vision.ops import generate_proposals
+
+    np.random.seed(0)
+    scores = paddle.to_tensor(np.random.rand(1, 3, 4, 4).astype("float32"))
+    deltas = paddle.to_tensor(np.zeros((1, 12, 4, 4), "float32"))
+    img_size = paddle.to_tensor(np.array([[32.0, 32.0]], "float32"))
+    anchors = paddle.to_tensor(
+        np.tile(np.array([[0.0, 0.0, 8.0, 8.0]], "float32"), (48, 1))
+        .reshape(4, 4, 3, 4))
+    rois, roi_probs, num = generate_proposals(
+        scores, deltas, img_size, anchors,
+        paddle.to_tensor(np.ones((4, 4, 3, 4), "float32")),
+        pre_nms_top_n=10, post_nms_top_n=5, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] <= 5
+    assert (r >= 0).all() and (r <= 32).all()  # clipped to the image
+
+
+@custom("yolo_loss")
+def _c_yolo_loss(t):
+    from paddle_tpu.vision.ops import yolo_loss
+
+    np.random.seed(1)
+    x = paddle.to_tensor(np.random.rand(1, 18, 4, 4).astype("float32"))
+    gt_box = paddle.to_tensor(np.array([[[4.0, 4.0, 8.0, 8.0]]], "float32"))
+    gt_label = paddle.to_tensor(np.array([[0]], "int32"))
+    loss = yolo_loss(x, gt_box, gt_label, anchors=[10, 13, 16, 30, 33, 23],
+                     anchor_mask=[0, 1, 2], class_num=1,
+                     ignore_thresh=0.7, downsample_ratio=8)
+    assert np.isfinite(float(np.asarray(loss.numpy()).sum()))
+
+
+# runner classes LAST so parametrization sees every registered case
+class TestCustom(OpTest):
+    @pytest.mark.parametrize("name", sorted(CUSTOM), ids=str)
+    def test_case(self, name):
+        CUSTOM[name](self)
+
+
+class TestProperty:
+    @pytest.mark.parametrize("name", sorted(PROPERTY), ids=str)
+    def test_property(self, name):
+        if not hasattr(paddle, name) and name not in ("cauchy_", "geometric_"):
+            pytest.fail(f"paddle.{name} missing")
+        PROPERTY[name]()
