@@ -160,7 +160,7 @@ _ASYNC = {"executor": None, "last": None}
 
 
 def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
-                  prefix):
+                  prefix, on_writer_thread=False):
     for fname, block in blocks:
         # bfloat16 & friends: store as raw uint16/uint8 view + dtype tag
         if block.dtype.kind not in "biufc":
@@ -176,10 +176,62 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
         return
     if store is None:
         # SPMD without a store: metadata is identical on every process
-        # (deterministic filenames + global ownership map) — coordinator writes
+        # (deterministic filenames + global ownership map) — but metadata.json
+        # is the checkpoint-complete marker, so the coordinator must not write
+        # it until every process's shard files have landed (the reference's
+        # gather_object is an implicit barrier).  sync once before the write
+        # and once after, so non-coordinators also return only after the
+        # checkpoint is fully complete.
+        import jax
+
+        multiproc = jax.process_count() > 1
+        if multiproc and not on_writer_thread:
+            # synchronous save: device barrier so metadata.json (the
+            # checkpoint-complete marker) is written strictly after every
+            # process's shard files.  Failures must propagate, never be
+            # swallowed — a missed barrier means a checkpoint could look
+            # complete with shards missing.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_shards_done:{path}")
+        elif multiproc:
+            # async save runs on the background writer thread, where issuing
+            # a device collective would interleave with the main thread's
+            # training collectives in host-dependent order and deadlock the
+            # runtime.  Coordinate through the (shared) checkpoint directory
+            # instead: per-rank done markers, coordinator polls.
+            tag = hashlib.md5(prefix.encode()).hexdigest()[:10]
+            marker = os.path.join(path, f".shards_done_{tag}_r{rank}")
+            with open(marker, "w") as f:
+                f.write("1")
+            if rank == coordinator_rank:
+                import time
+
+                deadline = time.time() + 600
+                want = [os.path.join(path, f".shards_done_{tag}_r{r}")
+                        for r in range(world)]
+                while not all(os.path.exists(m) for m in want):
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"async checkpoint: shard markers missing after "
+                            f"600s: "
+                            f"{[m for m in want if not os.path.exists(m)]}")
+                    time.sleep(0.05)
         if rank == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f, indent=1)
+            if multiproc and on_writer_thread:
+                tag = hashlib.md5(prefix.encode()).hexdigest()[:10]
+                for r in range(world):
+                    try:
+                        os.remove(os.path.join(path,
+                                               f".shards_done_{tag}_r{r}"))
+                    except OSError:
+                        pass
+        if multiproc and not on_writer_thread:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_meta_done:{path}")
         return
 
     # Launcher mode: publish local metadata under this save's OWN store
@@ -266,7 +318,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     if _ASYNC["executor"] is None:
         _ASYNC["executor"] = ThreadPoolExecutor(max_workers=1)
-    fut = _ASYNC["executor"].submit(_write_blocks, *args)
+    fut = _ASYNC["executor"].submit(_write_blocks, *args,
+                                    on_writer_thread=True)
     _ASYNC["last"] = fut
     return fut
 

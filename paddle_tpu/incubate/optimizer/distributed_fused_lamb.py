@@ -73,6 +73,11 @@ class DistributedFusedLamb(Lamb):
         }
         eff = {kk: (new_acc[kk] / k if grads.get(kk) is not None else None)
                for kk in grads}
+        # global-norm clip on the MERGED gradient (reference clips at apply
+        # time after accumulation); installed by TrainStep when acc_steps>1
+        merged_clip = self.__dict__.get("_merged_clip")
+        if merged_clip is not None:
+            eff = merged_clip(eff)
         inner_states = {n: v for n, v in states.items() if n != "acc_grad"}
         prev = self._global_step
         self._global_step = step // k

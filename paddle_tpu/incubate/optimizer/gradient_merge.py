@@ -110,6 +110,12 @@ class GradientMergeOptimizer:
             if grads.get(kk) is not None else None
             for kk in grads
         }
+        # grad clip on the MERGED gradient at apply time (reference clips the
+        # effective gradient once per merge window, not each micro-grad);
+        # installed by TrainStep when a clip is configured with k_steps>1
+        merged_clip = self.__dict__.get("_merged_clip")
+        if merged_clip is not None:
+            eff = merged_clip(eff)
         inner_states = {n: v for n, v in states.items() if n != "gm_acc"}
         # inner optimizer sees the merged step index (1, 2, ... per apply)
         prev = inner._global_step

@@ -12,6 +12,8 @@ and full tp/pp/dp/sharding meshes.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -33,6 +35,18 @@ class _ClipStub:
         self.name = name
         self.shape = shape
         self.dtype = dtype
+
+
+def _apply_clip(clip, grads, stubs):
+    """Run a grad-clip object over a {name: array} grad tree inside the trace
+    (None entries = frozen params, passed through untouched)."""
+    keys = [k for k, g in grads.items() if g is not None]
+    pgs = [(stubs[k], Tensor(grads[k])) for k in keys]
+    clipped = clip(pgs)
+    out = dict(grads)
+    for k, (_, t) in zip(keys, clipped):
+        out[k] = t.data if isinstance(t, Tensor) else t
+    return out
 
 
 class TrainStep:
@@ -128,13 +142,23 @@ class TrainStep:
         # (elementwise), ClipGradByNorm (per-tensor), ClipGradByGlobalNorm
         # (one fused norm), and any user subclass — reference
         # python/paddle/nn/clip.py applies the same objects on both paths.
+        # When the optimizer ACCUMULATES (GradientMergeOptimizer k_steps>1 /
+        # DistributedFusedLamb gradient_accumulation_steps>1), the reference
+        # clips the MERGED gradient once at apply time, not each micro-grad —
+        # hand the traced clip to functional_update instead.
         clip = getattr(optimizer, "_grad_clip", None)
+        merge_k = max(int(getattr(optimizer, "k_steps", 1) or 1),
+                      int(getattr(optimizer, "_acc_steps", 1) or 1))
+        # always reset: a stale hook from a previous TrainStep (different
+        # network / clip since removed) must never survive into this trace
+        optimizer._merged_clip = None
         if clip is not None:
-            keys = [k for k, g in grads.items() if g is not None]
-            pgs = [(self._clip_stubs[k], Tensor(grads[k])) for k in keys]
-            clipped = clip(pgs)
-            for k, (_, t) in zip(keys, clipped):
-                grads[k] = t.data if isinstance(t, Tensor) else t
+            if merge_k > 1:
+                stubs = self._clip_stubs  # capture only (clip, stubs), not self
+                optimizer._merged_clip = functools.partial(
+                    _apply_clip, clip, stubs=stubs)
+            else:
+                grads = _apply_clip(clip, grads, self._clip_stubs)
 
         # ZeRO stage-2: constrain each grad to the accumulators' sharded
         # layout at the point the update consumes it — the update then runs

@@ -215,5 +215,17 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_
     return p
 
 
+def create_tensor(dtype, name=None, persistable=False):
+    """reference tensor/creation.py:265 — a variable that will hold a Tensor
+    of `dtype`.  Eager semantics: an empty placeholder the user assigns into
+    (paddle.assign(x, output=t)); the first assignment defines the shape."""
+    dt = _dtype.convert_dtype(dtype)
+    t = Tensor(jnp.zeros((0,), dt))
+    t.name = name or "create_tensor"
+    t.persistable = persistable
+    t._shape_undefined = True  # first set_value adopts the value's shape
+    return t
+
+
 def clone_no_grad(x):
     return Tensor(x.data)

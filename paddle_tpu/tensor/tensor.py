@@ -277,7 +277,10 @@ class Tensor:
     # ------------------------------------------------------------- in-place
     def set_value(self, value):
         new = _to_jax(value)
-        if tuple(new.shape) != tuple(self._data.shape):
+        if getattr(self, "_shape_undefined", False):
+            # create_tensor placeholder: first assignment defines the shape
+            self._shape_undefined = False
+        elif tuple(new.shape) != tuple(self._data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {new.shape} vs {self._data.shape}"
             )

@@ -48,8 +48,8 @@ class _InvertedResidualV3(nn.Layer):
 
 
 class _MobileNetV3(nn.Layer):
-    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
-                 with_pool=True):
+    def __init__(self, cfg, last_exp, last_channel, scale=1.0,
+                 num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
@@ -74,8 +74,8 @@ class _MobileNetV3(nn.Layer):
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
             self.classifier = nn.Sequential(
-                nn.Linear(last_c, 1280), nn.Hardswish(), nn.Dropout(0.2),
-                nn.Linear(1280, num_classes),
+                nn.Linear(last_c, last_channel), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes),
             )
 
     def forward(self, x):
@@ -110,12 +110,16 @@ _LARGE = [
 
 class MobileNetV3Small(_MobileNetV3):
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        super().__init__(_SMALL, 576, scale, num_classes, with_pool)
+        # reference mobilenetv3.py: Small last_channel = divisible(1024*scale)
+        super().__init__(_SMALL, 576, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
 
 
 class MobileNetV3Large(_MobileNetV3):
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        super().__init__(_LARGE, 960, scale, num_classes, with_pool)
+        # reference mobilenetv3.py: Large last_channel = divisible(1280*scale)
+        super().__init__(_LARGE, 960, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):

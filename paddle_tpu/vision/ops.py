@@ -89,12 +89,18 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             scores_c = s_c[order]
             iou = _iou_matrix(boxes_c)
             iou = np.triu(iou, 1)
+            # iou_cmax[i] = max IoU of candidate i with any higher-scored one
             iou_cmax = iou.max(0) if len(order) else np.zeros(0)
+            # decay of candidate i = min over higher-ranked j of f(iou[j,i],
+            # iou_cmax[j]); rows j>=i hold iou 0 and contribute values >= 1,
+            # so a final clip at 1 reproduces the reference's min_decay=1 seed
+            # (matrix_nms_kernel.cc decay_score: linear (1-iou)/(1-max_iou),
+            # gaussian exp((max_iou^2-iou^2)*sigma) -- sigma MULTIPLIES).
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2) / gaussian_sigma).min(0)
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2) * gaussian_sigma)
             else:
-                decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :], 1e-10)).min(0)
-            decayed = scores_c * decay
+                decay = (1 - iou) / np.maximum(1 - iou_cmax[:, None], 1e-10)
+            decayed = scores_c * np.minimum(decay.min(0), 1.0)
             keep = decayed > post_threshold
             for j in np.flatnonzero(keep):
                 outs.append([c, decayed[j], *boxes_c[j]])
@@ -610,10 +616,104 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thresh,
               downsample_ratio, gt_score=None, use_label_smooth=True, name=None,
               scale_x_y=1.0):
-    raise NotImplementedError(
-        "yolo_loss: compose the YOLOv3 loss from yolo_box decode + paddle.nn "
-        "losses; the reference's fused CUDA kernel has no TPU counterpart yet."
-    )
+    """YOLOv3 loss (reference phi/kernels/cpu/yolo_loss_kernel.cc YoloLossKernel).
+
+    Eager host op like the other detection losses here: the per-gt anchor
+    matching is data-dependent sequential selection.  gt_box is normalized
+    [cx, cy, w, h]; x is [N, mask_num*(5+C), H, W] with per-anchor channel
+    layout [tx, ty, tw, th, obj, cls...].  Returns per-image loss [N].
+    """
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x, np.float64)
+    gtb = np.asarray(gt_box.numpy() if isinstance(gt_box, Tensor) else gt_box, np.float64)
+    gtl = np.asarray(gt_label.numpy() if isinstance(gt_label, Tensor) else gt_label, np.int64)
+    anchors = [int(a) for a in anchors]
+    mask = [int(a) for a in anchor_mask]
+    n, _, h, w = xv.shape
+    an_num, m, nc = len(anchors) // 2, len(mask), int(class_num)
+    nb = gtb.shape[1]
+    input_size = downsample_ratio * h
+    sxy = float(scale_x_y)
+    bias = -0.5 * (sxy - 1.0)
+    gts = (np.ones((n, nb)) if gt_score is None
+           else np.asarray(gt_score.numpy() if isinstance(gt_score, Tensor) else gt_score, np.float64))
+    if use_label_smooth:
+        sw = min(1.0 / nc, 1.0 / 40)
+        lab_pos, lab_neg = 1.0 - sw, sw
+    else:
+        lab_pos, lab_neg = 1.0, 0.0
+    xv = xv.reshape(n, m, 5 + nc, h, w)
+
+    def sce(logit, label):  # numerically-stable sigmoid cross-entropy
+        return np.maximum(logit, 0) - logit * label + np.log1p(np.exp(-np.abs(logit)))
+
+    def iou_cw(x1, y1, w1, h1, x2, y2, w2, h2):
+        ow = np.minimum(x1 + w1 / 2, x2 + w2 / 2) - np.maximum(x1 - w1 / 2, x2 - w2 / 2)
+        oh = np.minimum(y1 + h1 / 2, y2 + h2 / 2) - np.maximum(y1 - h1 / 2, y2 - h2 / 2)
+        inter = np.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+        return inter / np.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    # decoded pred boxes per cell (normalized; reference GetYoloBox divides
+    # x/y by grid_size=h and w/h by input_size)
+    gx = np.arange(w, dtype=np.float64)[None, None, None, :]
+    gy = np.arange(h, dtype=np.float64)[None, None, :, None]
+    aw = np.asarray([anchors[2 * a] for a in mask], np.float64)[None, :, None, None]
+    ah = np.asarray([anchors[2 * a + 1] for a in mask], np.float64)[None, :, None, None]
+    px = (gx + sig(xv[:, :, 0]) * sxy + bias) / h
+    py = (gy + sig(xv[:, :, 1]) * sxy + bias) / h
+    pw = np.exp(xv[:, :, 2]) * aw / input_size
+    ph = np.exp(xv[:, :, 3]) * ah / input_size
+
+    valid = (gtb[:, :, 2] >= 1e-6) & (gtb[:, :, 3] >= 1e-6)
+    # objness mask: -1 = ignored (best gt IoU > thresh), 0 = negative,
+    # score = positive (set below at the matched cell)
+    obj_mask = np.zeros((n, m, h, w))
+    best_iou = np.zeros((n, m, h, w))
+    for t in range(nb):
+        gx_, gy_, gw_, gh_ = (gtb[:, t, k][:, None, None, None] for k in range(4))
+        iou = iou_cw(px, py, pw, ph, gx_, gy_, gw_, gh_)
+        iou = np.where(valid[:, t][:, None, None, None], iou, 0.0)
+        best_iou = np.maximum(best_iou, iou)
+    obj_mask[best_iou > ignore_thresh] = -1.0
+
+    loss = np.zeros(n)
+    an_w = np.asarray(anchors[0::2], np.float64) / input_size
+    an_h = np.asarray(anchors[1::2], np.float64) / input_size
+    for i in range(n):
+        for t in range(nb):
+            if not valid[i, t]:
+                continue
+            gcx, gcy, gw_, gh_ = gtb[i, t]
+            gi = min(max(int(gcx * w), 0), w - 1)
+            gj = min(max(int(gcy * h), 0), h - 1)
+            # best anchor for this gt by shape-only IoU
+            a_iou = iou_cw(0.0, 0.0, an_w, an_h, 0.0, 0.0, gw_, gh_)
+            best_n = int(np.argmax(a_iou))
+            mask_idx = mask.index(best_n) if best_n in mask else -1
+            if mask_idx < 0:
+                continue
+            score = gts[i, t]
+            cell = xv[i, mask_idx, :, gj, gi]
+            tx = gcx * h - gi
+            ty = gcy * h - gj
+            tw = np.log(max(gw_ * input_size / anchors[2 * best_n], 1e-10))
+            th = np.log(max(gh_ * input_size / anchors[2 * best_n + 1], 1e-10))
+            box_scale = (2.0 - gw_ * gh_) * score
+            loss[i] += (sce(cell[0], tx) + sce(cell[1], ty)) * box_scale
+            loss[i] += (abs(cell[2] - tw) + abs(cell[3] - th)) * box_scale
+            obj_mask[i, mask_idx, gj, gi] = score
+            label = int(gtl[i, t])
+            cls_tgt = np.full(nc, lab_neg)
+            if 0 <= label < nc:
+                cls_tgt[label] = lab_pos
+            loss[i] += float(np.sum(sce(cell[5:], cls_tgt)) * score)
+    # objectness: positives weighted by mixup score, ignored cells skipped
+    obj_logit = xv[:, :, 4]
+    pos = obj_mask > 1e-5
+    neg = (obj_mask <= 1e-5) & (obj_mask > -0.5)
+    loss += np.sum(sce(obj_logit, 1.0) * obj_mask * pos, axis=(1, 2, 3))
+    loss += np.sum(sce(obj_logit, 0.0) * neg, axis=(1, 2, 3))
+    return Tensor(loss.astype(np.float32))
 
 
 # --------------------------------------------------------------------- misc ----

@@ -2026,19 +2026,28 @@ def _c_psroi_pool(t):
 def _c_matrix_nms(t):
     from paddle_tpu.vision.ops import matrix_nms
 
-    bboxes = paddle.to_tensor(np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+    bboxes = paddle.to_tensor(np.array([[[0, 0, 10, 10], [1, 0, 11, 10],
                                          [20, 20, 30, 30]]], "float32"))
     scores = paddle.to_tensor(np.array([[[0.9, 0.8, 0.7]]], "float32"))
+    # default background_label=0 skips class 0 (reference
+    # matrix_nms_kernel.cc:180) -> no detections for single-class scores
+    o0, _, n0 = matrix_nms(bboxes, scores, score_threshold=0.1,
+                           post_threshold=0.0, nms_top_k=3, keep_top_k=3,
+                           return_index=True, return_rois_num=True)
+    assert o0.numpy().shape[0] == 0 and int(n0.numpy()[0]) == 0
     out, idx, num = matrix_nms(bboxes, scores, score_threshold=0.1,
                                post_threshold=0.0, nms_top_k=3, keep_top_k=3,
+                               background_label=-1,
                                return_index=True, return_rois_num=True)
     o = out.numpy()
-    # the duplicate box survives but with a DECAYED score (matrix nms
+    # the overlapping box survives but with a DECAYED score (matrix nms
     # suppresses softly); the far box keeps its score
     assert o.shape[0] == 3
     top = o[o[:, 1].argsort()[::-1]]
     np.testing.assert_allclose(top[0, 1], 0.9, rtol=1e-5)
-    assert top[-1, 1] < 0.8  # decayed duplicate
+    # linear decay: (1-iou)/(1-0) * 0.8 with iou = 90/110
+    np.testing.assert_allclose(top[-1, 1], 0.8 * (1 - 90.0 / 110.0), rtol=1e-5)
+    assert top[1, 1] == np.float32(0.7)
 
 
 @custom("generate_proposals")
