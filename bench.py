@@ -53,11 +53,13 @@ def bench_llama(iters):
     from paddle_tpu.static.functionalize import build_train_step
 
     batch, seq = 16, 2048
+    # GQA config (G=4, llama-3-style grouping): the r4 flash kernels consume
+    # kv heads natively — KV HBM traffic is 1/G of an expanded-heads kernel
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
         max_position_embeddings=seq, dtype="bfloat16", recompute=True,
-        loss_chunk_size=8192, recompute_layers=13,
+        loss_chunk_size=8192, recompute_layers=10,
     )
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())

@@ -312,3 +312,42 @@ class Engine:
             "bytes_accessed": float(ca.get("bytes accessed", 0.0)) if ca else None,
             "peak_memory_bytes": getattr(mem, "temp_size_in_bytes", None),
         }
+
+    def tune(self, batch_size, seq_len, n_devices=None, model_desc=None,
+             device_spec=None, top_k=0):
+        """Auto-parallel planner (reference static/tuner/parallel_tuner.py):
+        choose the (dp, mp, pp, sep) mesh degrees + remat policy for this
+        model on ``n_devices``.
+
+        TPU-native: GSPMD does the op partitioning once degrees are fixed, so
+        tuning reduces to ranking meshes with the analytic compute/HBM/ICI
+        model in ``static/tuner``.  When a compiled step already exists,
+        its XLA cost analysis calibrates the compute-efficiency term.
+
+        Returns the best ``ParallelPlan`` (or the ``top_k`` best as a list)."""
+        import jax
+
+        from paddle_tpu.distributed.auto_parallel.static.tuner import (
+            DeviceSpec, ModelDesc, Planner)
+
+        import dataclasses
+
+        desc = model_desc or ModelDesc.from_model(
+            self._model, batch_size, seq_len)
+        # copy: calibration must not mutate a caller-held spec (repeated
+        # tune() calls would compound the efficiency scaling)
+        dev = dataclasses.replace(device_spec or DeviceSpec.detect())
+        c = self.cost("train") if self._train_step is not None else None
+        if c and c.get("flops"):
+            # calibrate: measured-or-modeled achieved flops vs analytic peak
+            analytic = (6 * desc.n_params
+                        + 6 * desc.n_layers * desc.hidden * desc.seq
+                        ) * desc.batch * desc.seq
+            ratio = analytic / max(float(c["flops"]), 1.0)
+            if 0.1 < ratio < 10.0:
+                dev.mxu_efficiency = min(
+                    0.9, max(0.1, dev.mxu_efficiency * ratio))
+        planner = Planner(desc, int(n_devices or jax.device_count()), dev)
+        ranked = planner.plan()
+        self._tuned_plan = ranked[0] if ranked else None
+        return ranked[:top_k] if top_k else self._tuned_plan

@@ -198,36 +198,63 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
             # async save runs on the background writer thread, where issuing
             # a device collective would interleave with the main thread's
             # training collectives in host-dependent order and deadlock the
-            # runtime.  Coordinate through the (shared) checkpoint directory
-            # instead: per-rank done markers, coordinator polls.
+            # runtime.  Coordinate through the checkpoint directory instead:
+            # per-rank done markers, coordinator polls.  This requires the
+            # checkpoint path to be SHARED storage (GCS/NFS) — which a
+            # multi-host SPMD checkpoint needs anyway for load to see every
+            # rank's shard files.
+            import glob
+            import time
+
             tag = hashlib.md5(prefix.encode()).hexdigest()[:10]
+            if rank == coordinator_rank:
+                # GC markers from completed earlier saves (saves serialize on
+                # the one writer thread and ranks checkpoint in lockstep, so
+                # anything not tagged for THIS save is stale)
+                for old in glob.glob(os.path.join(path, ".meta_done_*")):
+                    if not old.endswith(tag):
+                        try:
+                            os.remove(old)
+                        except OSError:
+                            pass
             marker = os.path.join(path, f".shards_done_{tag}_r{rank}")
             with open(marker, "w") as f:
                 f.write("1")
+            deadline = time.time() + 600
             if rank == coordinator_rank:
-                import time
-
-                deadline = time.time() + 600
                 want = [os.path.join(path, f".shards_done_{tag}_r{r}")
                         for r in range(world)]
                 while not all(os.path.exists(m) for m in want):
                     if time.time() > deadline:
                         raise TimeoutError(
-                            f"async checkpoint: shard markers missing after "
-                            f"600s: "
-                            f"{[m for m in want if not os.path.exists(m)]}")
+                            "async checkpoint: shard markers missing after "
+                            "600s (is the checkpoint dir on shared storage?)"
+                            f": {[m for m in want if not os.path.exists(m)]}")
                     time.sleep(0.05)
         if rank == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f, indent=1)
             if multiproc and on_writer_thread:
-                tag = hashlib.md5(prefix.encode()).hexdigest()[:10]
+                with open(os.path.join(path, f".meta_done_{tag}"), "w") as f:
+                    f.write("1")
                 for r in range(world):
                     try:
                         os.remove(os.path.join(path,
                                                f".shards_done_{tag}_r{r}"))
                     except OSError:
                         pass
+        elif multiproc and on_writer_thread:
+            # checkpoint-complete symmetry with the sync/store paths: a
+            # non-coordinator's future resolves only once THIS save's
+            # metadata has landed (the per-save marker — metadata.json alone
+            # is ambiguous on repeated saves to the same path)
+            done = os.path.join(path, f".meta_done_{tag}")
+            while not os.path.exists(done):
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "async checkpoint: coordinator metadata marker "
+                        "missing after 600s")
+                time.sleep(0.05)
         if multiproc and not on_writer_thread:
             from jax.experimental import multihost_utils
 

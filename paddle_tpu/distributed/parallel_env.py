@@ -57,14 +57,37 @@ def init_parallel_env():
               or os.environ.get("MASTER_ADDR")
               or os.environ.get("PADDLE_MASTER"))
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if master and nnodes > 1 and jax.process_count() == 1:
+    # probe the distributed client WITHOUT jax.process_count(): that call
+    # initializes the XLA backend, after which jax.distributed.initialize
+    # refuses to run.  The probe is private jax API — degrade to
+    # "not initialized" if it moves (initialize() itself then reports
+    # double-init, caught below).
+    try:
+        from jax._src import distributed as _jdist
+
+        already_initialized = _jdist.global_state.client is not None
+    except Exception:
+        already_initialized = False
+    if master and nnodes > 1 and not already_initialized:
         port = os.environ.get("MASTER_PORT")
         addr = master if ":" in master or not port else f"{master}:{port}"
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                 nnodes)),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+        except RuntimeError as e:
+            msg = str(e)
+            if not any(t in msg for t in ("already", "must be called",
+                                          "only be called once")):
+                raise  # real rendezvous failure
+            import warnings
+
+            warnings.warn(
+                f"init_parallel_env: jax.distributed not (re)initialized "
+                f"({e}); continuing with the current world", stacklevel=2)
     _WORLD["mesh"] = _build_world_mesh()
     _WORLD["initialized"] = True
     return ParallelEnv()

@@ -19,8 +19,13 @@ def _t(x):
 
 def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
               dropout_key=None):
-    """[B, L, H, D] layout (paddle flash_attention layout)."""
+    """[B, L, H, D] layout (paddle flash_attention layout); k/v may carry
+    fewer (kv) heads than q (GQA/MQA), expanded here for the dense path."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        from paddle_tpu.ops.flash_attention import repeat_kv
+
+        k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     # -> [B, H, L, D]
     qt = jnp.swapaxes(q, 1, 2)
@@ -59,7 +64,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         try:
             from paddle_tpu.ops.flash_attention import flash_attention_blhd, available
 
-            if available(query.shape):
+            if available(query.shape, key.shape):
                 return apply(
                     "flash_attention",
                     lambda q, k, v: flash_attention_blhd(q, k, v, causal=is_causal),

@@ -694,6 +694,10 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thres
                 continue
             score = gts[i, t]
             cell = xv[i, mask_idx, :, gj, gi]
+            # NOTE: tx deliberately uses h while gi came from w — the
+            # reference kernel passes grid_size=h into CalcBoxLocationLoss
+            # (yolo_loss_kernel.cc:336 'h') though gi = int(gt.x * w)
+            # (:299); faithful parity includes its square-map assumption
             tx = gcx * h - gi
             ty = gcy * h - gj
             tw = np.log(max(gw_ * input_size / anchors[2 * best_n], 1e-10))

@@ -1,0 +1,108 @@
+"""One rank of a multi-process global-mesh job (tests/test_multiprocess_mesh.py).
+
+Modeled on the reference's cluster workers
+(/root/reference/test/legacy_test/test_dist_base.py:957 _run_cluster): each
+process is a full trainer; here the trainers form ONE jax global mesh
+(2 procs x 4 CPU devices = 8 devices) via jax.distributed.initialize and run
+SPMD DP + ZeRO-1 training with cross-process gloo collectives.
+
+argv: rank nproc coordinator_port workdir mode(train|resume) steps
+Writes {workdir}/result_r{rank}.json with the per-step losses.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    rank, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, workdir, mode, steps = (sys.argv[3], sys.argv[4], sys.argv[5],
+                                  int(sys.argv[6]))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    os.environ["PADDLE_NNODES"] = str(nproc)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nproc)
+    os.environ["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.static.functionalize import build_train_step
+
+    dist.init_parallel_env()
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+    assert dist.get_rank() == rank
+    assert dist.get_world_size() == 4 * nproc
+
+    paddle.seed(7)  # identical init on every process (SPMD contract)
+    model = nn.Linear(16, 16)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                                 parameters=model.parameters())
+    # ZeRO-1 over the WORLD axis: moment accumulators shard across all 8
+    # devices, i.e. across the process boundary
+    model, opt, _ = group_sharded_parallel(model, opt, "os")
+    dp = paddle.DataParallel(model)
+    step = build_train_step(dp, nn.MSELoss(), opt, donate=False)
+
+    ckpt = os.path.join(workdir, "ckpt")
+    if mode == "resume":
+        # reload params + ZeRO-sharded optimizer moments through the SPMD
+        # distributed-checkpoint path (reshard-on-load keeps each tensor's
+        # existing global sharding)
+        tensors = {k: paddle.Tensor(v) for k, v in step._params.items()}
+        tensors.update({f"opt/{n}/{k}": paddle.Tensor(v)
+                        for n, d in step._states.items()
+                        if isinstance(d, dict) for k, v in d.items()})
+        load_state_dict(tensors, ckpt)
+        # replicated params come back committed to the local device; in a
+        # multi-process world every pjit operand must be a GLOBAL array, so
+        # re-place them replicated over the world mesh (the sharded moments
+        # already reloaded with their global shardings preserved)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from paddle_tpu.distributed.parallel_env import world_mesh
+
+        rep = NamedSharding(world_mesh(), PartitionSpec())
+        for key, t in tensors.items():
+            if key.startswith("opt/"):
+                _, n, kk = key.split("/", 2)
+                step._states[n][kk] = t.data
+            else:
+                step._params[key] = jax.device_put(np.asarray(t.data), rep)
+
+    rng = np.random.RandomState(11)  # same data stream on every process
+    losses = []
+    for i in range(steps):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = (x @ np.eye(16, dtype=np.float32) * 0.5 + 0.1).astype(np.float32)
+        loss = step(paddle.Tensor(x), paddle.Tensor(y))
+        losses.append(float(np.asarray(loss.numpy())))
+
+    # distributed checkpoint across the process boundary: every process owns
+    # the slices of the ZeRO-sharded moments that live on ITS devices; the
+    # coordinator writes metadata.json after the global barrier
+    sd = {**step._params,
+          **{f"opt/{n}/{k}": v for n, d in step._states.items()
+             if isinstance(d, dict) for k, v in d.items()}}
+    save_state_dict(sd, ckpt)
+
+    with open(os.path.join(workdir, f"result_r{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "losses": losses,
+                   "process_count": jax.process_count(),
+                   "device_count": jax.device_count()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,21 +98,36 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
 
-    def test_pallas_interpret_matches_dense(self):
+    @pytest.mark.parametrize("hkv", [4, 2, 1])  # MHA, GQA, MQA
+    def test_pallas_interpret_matches_dense(self, hkv):
         from paddle_tpu.ops.flash_attention import _flash_fwd_pallas
 
-        q, k, v = self._qkv(L=256, D=128)
+        h, d = 4, 128
+        q, _, _ = self._qkv(L=256, H=h, D=d)
+        _, k, v = self._qkv(L=256, H=hkv, D=d)
+        b, l = q.shape[:2]
         for causal in (False, True):
-            out, lse = _flash_fwd_pallas(q, k, v, causal=causal, interpret=True)
+            out, lse = _flash_fwd_pallas(
+                q.reshape(b, l, h * d), k.reshape(b, l, hkv * d),
+                v.reshape(b, l, hkv * d), h, hkv, causal=causal,
+                interpret=True)
             ref = self._dense(q, k, v, causal)
-            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                       rtol=2e-5, atol=2e-5)
+            np.testing.assert_allclose(
+                np.asarray(out.reshape(b, l, h, d)), np.asarray(ref),
+                rtol=2e-5, atol=2e-5)
 
-    def test_pallas_bwd_matches_dense_grads(self):
+    @pytest.mark.parametrize("hkv", [4, 2])
+    def test_pallas_bwd_matches_dense_grads(self, hkv):
         from paddle_tpu.ops.flash_attention import (
             _flash_bwd_pallas, _flash_fwd_pallas)
 
-        q, k, v = self._qkv(L=256, D=128)
+        h, d = 4, 128
+        q, _, _ = self._qkv(L=256, H=h, D=d)
+        _, k, v = self._qkv(L=256, H=hkv, D=d)
+        b, l = q.shape[:2]
+        qp = q.reshape(b, l, h * d)
+        kp = k.reshape(b, l, hkv * d)
+        vp = v.reshape(b, l, hkv * d)
         rng = np.random.default_rng(7)
         for causal in (False, True):
             do = jnp.asarray(
@@ -122,22 +137,61 @@ class TestFlashAttention:
                 return jnp.vdot(self._dense(q_, k_, v_, _c), do)
 
             gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
-            out, lse = _flash_fwd_pallas(q, k, v, causal=causal,
+            out, lse = _flash_fwd_pallas(qp, kp, vp, h, hkv, causal=causal,
                                          interpret=True)
-            gp = _flash_bwd_pallas(q, k, v, out, lse, do, causal=causal,
-                                   interpret=True)
-            for a, b in zip(gp, gd):
-                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                           rtol=2e-4, atol=2e-4)
+            gp = _flash_bwd_pallas(qp, kp, vp, out, lse,
+                                   do.reshape(b, l, h * d), h, hkv,
+                                   causal=causal, interpret=True)
+            shapes = [(h, d), (hkv, d), (hkv, d)]
+            for a, b_, (hh, dd) in zip(gp, gd, shapes):
+                np.testing.assert_allclose(
+                    np.asarray(a.reshape(b, l, hh, dd)), np.asarray(b_),
+                    rtol=2e-4, atol=2e-4)
+
+    def test_pallas_cross_length_causal(self):
+        """Lq < Lk (kv-cache chunked prefill): the kernel's causal mask must
+        be bottom-right aligned, matching the dense fallback's tril(kl-ql) —
+        a top-left mask would silently hide the cached prefix."""
+        from paddle_tpu.ops.flash_attention import (_flash_bwd_pallas,
+                                                    _flash_fwd_pallas)
+
+        B, LQ, LK, h, hkv, d = 1, 128, 256, 4, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        q = jax.random.normal(ks[0], (B, LQ, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (B, LK, hkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (B, LK, hkv, d), jnp.float32)
+        out, lse = _flash_fwd_pallas(
+            q.reshape(B, LQ, h * d), k.reshape(B, LK, hkv * d),
+            v.reshape(B, LK, hkv * d), h, hkv, causal=True, interpret=True)
+        ref = self._dense(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out.reshape(B, LQ, h, d)),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+        do = jax.random.normal(ks[3], (B, LQ, h * d), jnp.float32)
+        gd = jax.grad(
+            lambda q_, k_, v_: jnp.vdot(self._dense(q_, k_, v_, True),
+                                        do.reshape(B, LQ, h, d)),
+            argnums=(0, 1, 2))(q, k, v)
+        gp = _flash_bwd_pallas(
+            q.reshape(B, LQ, h * d), k.reshape(B, LK, hkv * d),
+            v.reshape(B, LK, hkv * d), out, lse, do, h, hkv, causal=True,
+            interpret=True)
+        for a, b_, (hh, ll) in zip(gp, gd, [(h, LQ), (hkv, LK), (hkv, LK)]):
+            np.testing.assert_allclose(np.asarray(a.reshape(B, ll, hh, d)),
+                                       np.asarray(b_), rtol=2e-4, atol=2e-4)
 
     @staticmethod
     def _dense(q, k, v, causal):
         d = q.shape[-1]
+        if k.shape[2] != q.shape[2]:  # GQA: expand kv heads for the reference
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
         s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
         if causal:
-            L = s.shape[-1]
-            s = jnp.where(jnp.tril(jnp.ones((L, L), bool)), s, -1e30)
+            lq, lk = s.shape[-2], s.shape[-1]
+            s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool), lk - lq),
+                          s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
 
