@@ -126,8 +126,114 @@ def bench_resnet50(iters=10, batch=128):
         loss = step(x, y)
     loss.numpy()
     dt = (time.perf_counter() - t0) / iters
+    # conv MFU: ResNet-50 forward ≈ 4.089 GFLOPs per 224x224 image (the
+    # standard multiply-add-counted-as-2 figure); training ≈ 3x forward
+    train_flops_per_img = 3 * 4.089e9
+    conv_mfu = train_flops_per_img * (batch / dt) / 1e12 / _peak_tflops()
     return {"resnet50_img_per_sec": round(batch / dt, 1),
+            "resnet50_conv_mfu": round(conv_mfu, 4),
             "resnet50_step_ms": round(dt * 1000, 1)}
+
+
+def bench_bert(iters=10, batch=64, seq=512):
+    """BERT-base MLM pretraining samples/sec (BASELINE.md ERNIE/BERT north
+    star; reference: PaddleNLP pretraining configs on Fleet DP)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.static.functionalize import build_train_step
+
+    cfg = BertConfig(hidden_dropout_prob=0.0, dtype="bfloat16",
+                     max_position_embeddings=seq)
+    model = BertForMaskedLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01)
+
+    # labels flow through the model's own masked-LM loss
+    class _Net(paddle.nn.Layer):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, ids, labels):
+            loss, _ = self.m(ids, labels=labels)
+            return loss
+
+    step = build_train_step(_Net(model), None, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64")
+    labels_np = rng.integers(0, cfg.vocab_size, (batch, seq))
+    labels_np[rng.random((batch, seq)) > 0.15] = -100  # 15% masked positions
+    labels = paddle.to_tensor(labels_np, dtype="int64")
+    step(ids, labels).numpy()
+    step(ids, labels).numpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / iters
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers \
+        * cfg.hidden_size * seq  # full (bidirectional) attention
+    mfu = flops_per_token * batch * seq / dt / 1e12 / _peak_tflops()
+    return {"bert_base_samples_per_sec": round(batch / dt, 1),
+            "bert_base_mfu": round(mfu, 4),
+            "bert_step_ms": round(dt * 1000, 1)}
+
+
+def bench_moe(iters=10, batch_tokens=16384, d_model=2048, n_experts=8):
+    """MoE (expert-parallel layer) training step: tokens/sec through a top-2
+    gshard-gated 8-expert FFN block (BASELINE.md DeepSeek-MoE stretch row;
+    single chip exercises the dense dispatch/combine path, the ep dryrun
+    covers the all-to-all)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.static.functionalize import build_train_step
+
+    d_hidden = 4 * d_model
+
+    class Expert(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.up = nn.Linear(d_model, d_hidden)
+            self.down = nn.Linear(d_hidden, d_model)
+
+        def forward(self, x):
+            return self.down(paddle.nn.functional.gelu(self.up(x)))
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = MoELayer(d_model, [Expert() for _ in range(n_experts)],
+                                gate={"type": "gshard", "top_k": 2})
+
+        def forward(self, x):
+            return self.moe(x)
+
+    model = Block()
+    model.to(dtype="bfloat16")
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = build_train_step(model, paddle.nn.MSELoss(), opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((batch_tokens, d_model)).astype(np.float32)
+    ).astype("bfloat16")
+    y = paddle.to_tensor(
+        rng.standard_normal((batch_tokens, d_model)).astype(np.float32)
+    ).astype("bfloat16")
+    step(x, y).numpy()
+    step(x, y).numpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / iters
+    return {"moe_tokens_per_sec": round(batch_tokens / dt, 1),
+            "moe_step_ms": round(dt * 1000, 1)}
 
 
 def bench_eager(iters=200):
@@ -177,7 +283,8 @@ def main():
 
     secondary = {}
     if os.environ.get("BENCH_PRIMARY_ONLY") != "1":
-        for fn in (bench_resnet50, bench_eager, bench_collectives):
+        for fn in (bench_resnet50, bench_bert, bench_moe, bench_eager,
+                   bench_collectives):
             try:
                 secondary.update(fn())
             except Exception as e:

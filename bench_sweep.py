@@ -15,13 +15,9 @@ import numpy as np
 
 VARIANTS = [
     # name, batch, chunk, moment_dtype, policy, recompute_layers, kv_heads
-    # r4: GQA (kv4) freed ~0.9GB (fewer params+masters+moments) — retry the
-    # remat dial that was memory-capped in r3, safe -> risky
-    ("r4_b16_kv4_rl13", 16, 8192, "int8", None, 13, 4),
-    ("r4_b16_kv4_rl12", 16, 8192, "int8", None, 12, 4),
-    ("r4_b16_kv4_rl11", 16, 8192, "int8", None, 11, 4),
-    ("r4_b16_kv4_rl10", 16, 8192, "int8", None, 10, 4),
-    ("r4_b18_kv4_rl12", 18, 8192, "int8", None, 12, 4),
+    ("r4_b16_kv4_rl9", 16, 8192, "int8", None, 9, 4),
+    ("r4_b16_kv4_rl8", 16, 8192, "int8", None, 8, 4),
+    ("r4_b16_kv4_rl7", 16, 8192, "int8", None, 7, 4),
 ]
 
 

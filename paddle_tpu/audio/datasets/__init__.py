@@ -1,24 +1,133 @@
-"""paddle.audio.datasets (reference python/paddle/audio/datasets/): TESS / ESC50
-require downloads — constructors raise with instructions (zero-egress build)."""
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/).
+
+Zero-egress build: no downloads.  ESC50/TESS parse the reference's ON-DISK
+layout when given a local ``root=`` path (the extracted archive the reference
+downloads); with no local path the constructor raises with instructions
+(VERDICT r3 next-round #10).  ``feat_type='raw'`` yields the waveform via the
+wave backend; spectrogram-family features ride paddle.audio.features.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
 from paddle_tpu.io import Dataset
 
-
-class _DownloadDataset(Dataset):
-    name = "dataset"
-
-    def __init__(self, *a, **kw):
-        raise RuntimeError(
-            f"{self.name} requires downloading; place the files locally and use "
-            "paddle.audio.load + a custom paddle.io.Dataset."
-        )
-
-
-class TESS(_DownloadDataset):
-    name = "TESS"
-
-
-class ESC50(_DownloadDataset):
-    name = "ESC50"
-
-
 __all__ = ['TESS', 'ESC50']
+
+
+class _AudioClassificationDataset(Dataset):
+    """reference audio/datasets/dataset.py AudioClassificationDataset:
+    (waveform-or-feature, label) records from (files, labels)."""
+
+    def __init__(self, files, labels, feat_type='raw', sample_rate=None,
+                 **feat_config):
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+        self._extractor = None  # built once per (sr, config), not per item
+
+    def _features(self, waveform):
+        if self.feat_type == 'raw':
+            return waveform
+        from paddle_tpu.tensor.tensor import Tensor
+
+        if self._extractor is None:
+            from paddle_tpu.audio import features as F
+
+            name = {"melspectrogram": "MelSpectrogram",
+                    "mfcc": "MFCC",
+                    "logmelspectrogram": "LogMelSpectrogram",
+                    "spectrogram": "Spectrogram"}.get(self.feat_type)
+            if name is None:
+                raise ValueError(f"unknown feat_type {self.feat_type!r}")
+            self._extractor = getattr(F, name)(
+                sr=self.sample_rate or 16000, **self.feat_config)
+        return self._extractor(Tensor(waveform[None])).numpy()[0]
+
+    def __getitem__(self, idx):
+        from paddle_tpu.audio.backends import load
+
+        waveform, sr = load(self.files[idx])
+        self.sample_rate = sr
+        waveform = np.asarray(waveform)
+        if waveform.ndim == 2:
+            waveform = waveform[0]
+        return self._features(waveform.astype(np.float32)), \
+            np.array(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require_root(root, name, expected):
+    if root is None:
+        raise RuntimeError(
+            f"{name} requires downloading the archive, which this "
+            f"zero-egress build does not do; pass root= pointing at "
+            f"{expected}")
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"{name}: root {root!r} not found")
+    return root
+
+
+class ESC50(_AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    parses ESC-50-master/meta/esc50.csv + audio/*.wav; 'train' keeps folds
+    != split, 'dev' keeps fold == split."""
+
+    meta = os.path.join('meta', 'esc50.csv')
+    audio_path = 'audio'
+
+    def __init__(self, mode='train', split=1, feat_type='raw', root=None,
+                 archive=None, **kwargs):
+        root = _require_root(root, "ESC50",
+                             "the extracted ESC-50-master directory")
+        if os.path.isdir(os.path.join(root, 'ESC-50-master')):
+            root = os.path.join(root, 'ESC-50-master')
+        files, labels = [], []
+        with open(os.path.join(root, self.meta)) as rf:
+            for line in rf.readlines()[1:]:
+                filename, fold, target = line.strip().split(',')[:3]
+                keep = (int(fold) != split) if mode == 'train' \
+                    else (int(fold) == split)
+                if keep:
+                    files.append(os.path.join(root, self.audio_path,
+                                              filename))
+                    labels.append(int(target))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(_AudioClassificationDataset):
+    """TESS emotional speech (reference audio/datasets/tess.py): walks the
+    extracted archive for *.wav named ..._<emotion>.wav; deterministic
+    n-fold split, fold ``split`` is dev."""
+
+    label_list = ['angry', 'disgust', 'fear', 'happy', 'neutral',
+                  'ps', 'sad']
+
+    def __init__(self, mode='train', n_folds=5, split=1, feat_type='raw',
+                 root=None, archive=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, n_folds
+        assert split in range(1, n_folds + 1), (split, n_folds)
+        root = _require_root(root, "TESS", "the extracted TESS directory")
+        wavs = []
+        for dirpath, _, fns in os.walk(root):
+            for fn in sorted(fns):
+                if fn.lower().endswith('.wav'):
+                    wavs.append(os.path.join(dirpath, fn))
+        files, labels = [], []
+        for i, f in enumerate(sorted(wavs)):
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == 'train' else (fold == split)
+            if not keep:
+                continue
+            emotion = os.path.splitext(os.path.basename(f))[0] \
+                .split('_')[-1].lower()
+            if emotion in self.label_list:
+                files.append(f)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

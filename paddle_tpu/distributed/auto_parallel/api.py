@@ -72,19 +72,54 @@ def _axis_size(mesh: ProcessMesh, entry) -> int:
     return n
 
 
-def _put(arr: jax.Array, mesh: ProcessMesh, placements) -> jax.Array:
+def _put(arr: jax.Array, mesh: ProcessMesh, placements, pad_uneven=False):
+    """device_put to the placement layout.  NamedSharding demands divisible
+    dims; for a dim its axis does not divide there are two behaviours:
+
+    * default — that dim falls back to replicated on its axis.  The global
+      value AND shape stay exact, so the tensor is safe for arbitrary
+      downstream compute (``t.mean()`` etc).
+    * ``pad_uneven=True`` — the dim is ZERO-PADDED to the next multiple (the
+      reference's uneven-reshard storage behaviour: reshard_funcs pad the
+      trailing shard).  The padded STORAGE is visible to ops; exits from the
+      dist world (reshard to a new layout, unshard) slice the padding back
+      off.  Use for storage-layout moves, not for tensors fed to compute.
+
+    Returns (sharded_array, logical_shape-or-None)."""
     spec = to_partition_spec(placements, mesh, arr.ndim)
-    # XLA shards evenly; a dim the axis doesn't divide falls back to replicated on
-    # that axis (value-identical — the reference pads uneven shards instead).
-    entries = [
-        e if (e is None or arr.shape[d] % _axis_size(mesh, e) == 0) else None
-        for d, e in enumerate(spec)
-    ]
-    return jax.device_put(arr, NamedSharding(mesh.jax_mesh, P(*entries)))
+    if not pad_uneven:
+        entries = [
+            e if (e is None or arr.shape[d] % _axis_size(mesh, e) == 0)
+            else None
+            for d, e in enumerate(spec)
+        ]
+        return (jax.device_put(arr, NamedSharding(mesh.jax_mesh,
+                                                  P(*entries))), None)
+    pads = []
+    padded = False
+    for d, e in enumerate(spec):
+        if e is None:
+            pads.append((0, 0))
+            continue
+        n = _axis_size(mesh, e)
+        rem = arr.shape[d] % n
+        pads.append((0, (n - rem) % n))
+        padded = padded or rem != 0
+    logical = tuple(arr.shape) if padded else None
+    if padded:
+        arr = jnp.pad(arr, pads)
+    return (jax.device_put(arr, NamedSharding(mesh.jax_mesh, P(*spec))),
+            logical)
+
+
+def _unpad(arr: jax.Array, logical):
+    if logical is None or tuple(arr.shape) == tuple(logical):
+        return arr
+    return arr[tuple(slice(0, s) for s in logical)]
 
 
 def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
-                 stop_gradient=None):
+                 stop_gradient=None, pad_uneven=False):
     """Reference api.py:205.  Returns a Tensor whose storage is globally laid out per
     ``placements``; value semantics are unchanged (same global value, new layout)."""
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
@@ -101,9 +136,10 @@ def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
         out._dist_mesh, out._dist_placements = mesh, placements
         out._partial_hidden = True
         return out
-    arr = _put(t.data, mesh, placements)
+    arr, logical = _put(t.data, mesh, placements, pad_uneven=pad_uneven)
     out = _mk_like(t, arr, stop_gradient)
     out._dist_mesh, out._dist_placements = mesh, placements
+    out._dist_logical_shape = logical
     return out
 
 
@@ -119,7 +155,8 @@ def _mk_like(t: Tensor, arr, stop_gradient=None):
     return out
 
 
-def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements,
+            pad_uneven=False):
     """Reference api.py:727 + the C++ reshard engine
     (phi/core/distributed/auto_parallel/reshard/) — every transition in the reference's
     test matrix (p_to_r, s_to_r, r_to_s, s_to_s, p_to_s, r_to_p, …) reduces here to at
@@ -128,7 +165,9 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
     """
     placements = _normalize_placements(placements, mesh)
     t = dist_tensor
-    arr = t.data
+    # a previous uneven transition left zero-padding in storage: strip it
+    # before computing the new layout (every transition sees logical values)
+    arr = _unpad(t.data, getattr(t, "_dist_logical_shape", None))
     src_placements = getattr(t, "_dist_placements", None)
 
     if getattr(t, "_partial_hidden", False):
@@ -141,8 +180,11 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
         else:
             red = {"sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min}[rt]
             arr = red(arr, axis=0)
-            out = _mk_like(t, _put(arr, mesh, placements))
+            sharded, logical = _put(arr, mesh, placements,
+                                    pad_uneven=pad_uneven)
+            out = _mk_like(t, sharded)
             out._dist_mesh, out._dist_placements = mesh, placements
+            out._dist_logical_shape = logical
             return out
     if any(isinstance(pl, Partial) for pl in placements):
         # r/s -> p: value becomes one rank's contribution, zeros elsewhere (reference
@@ -156,8 +198,13 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
         out._partial_hidden = True
         return out
 
-    out = _mk_like(t, _put(arr, mesh, placements))
+    # cross-mesh moves (the reference's same_status reshard + mesh->submesh)
+    # are the same device_put: the destination NamedSharding names the target
+    # mesh's devices and jax moves/reslices the committed data accordingly.
+    sharded, logical = _put(arr, mesh, placements, pad_uneven=pad_uneven)
+    out = _mk_like(t, sharded)
     out._dist_mesh, out._dist_placements = mesh, placements
+    out._dist_logical_shape = logical
     return out
 
 
@@ -166,7 +213,8 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
 
 
 def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
-    arr = dist_tensor.data
+    arr = _unpad(dist_tensor.data,
+                 getattr(dist_tensor, "_dist_logical_shape", None))
     if getattr(dist_tensor, "_partial_hidden", False):
         src = getattr(dist_tensor, "_dist_placements", None) or []
         rts = [pl.reduce_type for pl in src if isinstance(pl, Partial)]

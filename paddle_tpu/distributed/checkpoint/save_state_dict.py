@@ -207,16 +207,6 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
             import time
 
             tag = hashlib.md5(prefix.encode()).hexdigest()[:10]
-            if rank == coordinator_rank:
-                # GC markers from completed earlier saves (saves serialize on
-                # the one writer thread and ranks checkpoint in lockstep, so
-                # anything not tagged for THIS save is stale)
-                for old in glob.glob(os.path.join(path, ".meta_done_*")):
-                    if not old.endswith(tag):
-                        try:
-                            os.remove(old)
-                        except OSError:
-                            pass
             marker = os.path.join(path, f".shards_done_{tag}_r{rank}")
             with open(marker, "w") as f:
                 f.write("1")
@@ -231,6 +221,16 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
                             "600s (is the checkpoint dir on shared storage?)"
                             f": {[m for m in want if not os.path.exists(m)]}")
                     time.sleep(0.05)
+                # every rank has entered THIS save (its shards_done marker is
+                # written strictly after it finished waiting on the previous
+                # save), so earlier saves' meta_done markers are now
+                # unobserved — safe to GC without stranding a lagging rank
+                for old in glob.glob(os.path.join(path, ".meta_done_*")):
+                    if not old.endswith(tag):
+                        try:
+                            os.remove(old)
+                        except OSError:
+                            pass
         if rank == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f, indent=1)

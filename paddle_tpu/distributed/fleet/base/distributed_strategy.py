@@ -1,8 +1,19 @@
 """DistributedStrategy facade (reference: paddle/fluid/framework/distributed_strategy.proto
 + python/paddle/distributed/fleet/base/distributed_strategy.py, 2826 LoC).
 
-The reference round-trips a protobuf; the TPU build keeps the same attribute surface as
-plain Python config (nothing downstream needs wire format)."""
+The reference round-trips a protobuf; the TPU build keeps the same attribute
+surface as plain Python config (nothing downstream needs wire format).  Every
+top-level field of ``message DistributedStrategy``
+(distributed_strategy.proto:364-428) exists here, classified:
+
+* **implemented** — wired to real behavior (meta-optimizers, hybrid topology,
+  amp/recompute/sharding transforms, gradient_scale_configs.scale_strategy).
+* **delegated** — the concern the knob tunes is owned wholesale by XLA on
+  TPU (collective fusion/overlap, stream assignment, workspace sizes); the
+  knob is accepted so user scripts run unchanged, and `delegation_note()`
+  reports what supersedes it.
+* **unimplemented** — no TPU analog; enabling warns loudly.
+"""
 from __future__ import annotations
 
 __all__ = ["DistributedStrategy"]
@@ -14,8 +25,30 @@ _DEFAULT_HYBRID = {
     "sharding_degree": 1,
     "sep_degree": 1,
     "order": ["dp", "pp", "sharding", "sep", "mp"],
-    "mp_configs": {},
-    "pp_configs": {},
+    # MpConfig (proto:63-80): comm/compute overlap + sync knobs
+    "mp_configs": {
+        "sync_param": True, "sync_grad": False, "sync_moment": False,
+        "sync_mode": "broadcast", "mp_async_allreduce": False,
+        "mp_skip_c_identity": False, "mp_fused_linear_param_grad_add": False,
+        "need_broadcast_data": True, "recompute_allgather": False,
+        "sp_async_reduce_scatter": False,
+    },
+    # PpConfig (proto:83-94)
+    "pp_configs": {
+        "dp_comm_overlap": False, "delay_scale_loss": False,
+        "enable_timer": False, "sharding_comm_overlap": False,
+        "profiling": False, "release_gradients": False,
+        "overlap_p2p_comm": False, "clear_every_step_cache": False,
+        "use_batch_p2p_comm": True, "best_unbalanced_scheduler": False,
+    },
+    # DygraphShardingConfig (proto:96-106): tensor fusion + reduce-avg
+    "sharding_configs": {
+        "tensor_fusion": False, "accumulate_steps": 1, "comm_overlap": False,
+        "split_param": False, "fuse_optimizer": True, "use_reduce_avg": True,
+        "comm_buffer_size_MB": 256, "release_gradients": False,
+        "free_grads_in_comm": False,
+    },
+    "enable_optimizer_timer": False,
 }
 
 
@@ -26,49 +59,111 @@ class _SubConfig(dict):
         self[k] = v
 
 
+def _hybrid_merge(value):
+    merged = _SubConfig()
+    for k, v in _DEFAULT_HYBRID.items():
+        merged[k] = (_SubConfig(v) if isinstance(v, dict)
+                     else (list(v) if isinstance(v, list) else v))
+    for k, v in (value or {}).items():
+        if isinstance(v, dict) and isinstance(merged.get(k), dict):
+            merged[k].update(v)
+        else:
+            merged[k] = v
+    return merged
+
+
 class DistributedStrategy:
     def __init__(self):
-        self.amp = False
+        # ---- implemented toggles (proto field numbers in comments) --------
+        self.amp = False                       # 2 — autocast in TrainStep
+        self.recompute = False                 # 3 — jax.checkpoint
+        self.localsgd = False                  # 4 — meta_optimizers.LocalSGD
+        self.dgc = False                       # 5 — DGCMomentumOptimizer
+        self.gradient_merge = False            # 6 — GradientMergeOptimizer
+        self.lars = False                      # 7 — LarsMomentumOptimizer
+        self.lamb = False                      # 8 — Lamb
+        self.pipeline = False                  # 9 — pipeline schedules
+        self.sharding = False                  # 26 — group_sharded (ZeRO)
+        self.fp16_allreduce = False            # 25 — FP16AllReduce meta-opt
+        self.asp = False                       # 33 — incubate.asp 2:4
+        self.qat = False                       # 41 — quantization-aware train
+        self.tensor_parallel = False           # 29 — mp_layers
+        self.semi_auto = False                 # 35 — auto_parallel api
+        self.auto = False                      # 11 — auto_parallel Engine
+        self.auto_search = False               # 37 — Engine.tune planner
+        self.elastic = False                   # 10 — elastic manager
+        self.sync_batch_norm = False           # 17 — nn.SyncBatchNorm
+        self.find_unused_parameters = False    # 28 — DataParallel kwarg
+
+        # ---- delegated to XLA/runtime (accepted; see delegation_note) -----
+        self.sync_nccl_allreduce = True        # 13
+        self.nccl_comm_num = 1                 # 14
+        self.use_hierarchical_allreduce = False  # 15
+        self.hierarchical_allreduce_inter_nranks = 1  # 16
+        self.fuse_all_reduce_ops = True        # 18
+        self.fuse_grad_size_in_MB = 32         # 19
+        self.fuse_grad_size_in_TFLOPS = 50.0   # 20
+        self.cudnn_exhaustive_search = False   # 21
+        self.conv_workspace_size_limit = 512   # 22
+        self.cudnn_batchnorm_spatial_persistent = False  # 23
+        self.last_comm_group_size_MB = 1.0     # 27
+        self.without_graph_optimization = True  # 30
+        self.fuse_grad_size_in_num = 8         # 31
+        self.calc_comm_same_stream = False     # 32
+        self.fuse_grad_merge = False           # 34
+        self.split_data = True                 # 42
+
+        # ---- unimplemented (warn on enable) -------------------------------
+        self.a_sync = False                    # 12 — geo/async PS
+        self.adaptive_localsgd = False         # 24
+        self.heter_ccl_mode = False            # 38
+        self.adam_d2sum = False                # 36
+        self.is_fl_ps_mode = False             # 39
+        self.with_coordinator = False          # 40
+
+        # ---- sub-configs --------------------------------------------------
         self.amp_configs = _SubConfig(
-            init_loss_scaling=32768.0, use_pure_fp16=False, use_bf16=False,
-            custom_white_list=[], custom_black_list=[],
+            init_loss_scaling=32768.0, incr_every_n_steps=1000,
+            decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8,
+            use_dynamic_loss_scaling=True, custom_white_list=[],
+            custom_black_list=[], custom_black_varnames=[],
+            use_pure_fp16=False, use_fp16_guard=True, use_bf16=False,
         )
-        self.recompute = False
-        self.recompute_configs = _SubConfig(checkpoints=[])
-        self.sharding = False
+        self.recompute_configs = _SubConfig(
+            checkpoints=[], enable_offload=False, checkpoint_shape=[])
         self.sharding_configs = _SubConfig(
-            stage=1, sharding_degree=1, segment_broadcast_MB=32.0,
-            comm_buffer_size_MB=-1, split_param=False,
+            sharding_segment_strategy="segment_broadcast_MB",
+            segment_broadcast_MB=32.0, segment_anchors=[], sharding_degree=1,
+            stage=1, comm_buffer_size_MB=-1, split_param=False,
+            gradient_merge_acc_step=1, optimize_offload=False,
         )
-        self.pipeline = False
         self.pipeline_configs = _SubConfig(
-            accumulate_steps=1, micro_batch_size=1, schedule_mode="1F1B",
-        )
-        self.hybrid_configs = _SubConfig({k: (dict(v) if isinstance(v, dict) else
-                                              (list(v) if isinstance(v, list) else v))
-                                          for k, v in _DEFAULT_HYBRID.items()})
-        self.gradient_merge = False
+            accumulate_steps=1, micro_batch_size=1, schedule_mode="1F1B")
+        self.hybrid_configs = _hybrid_merge({})
         self.gradient_merge_configs = _SubConfig(k_steps=1, avg=True)
-        self.dgc = False
         self.dgc_configs = _SubConfig(rampup_begin_step=0, rampup_step=1,
                                       sparsity=[0.999])
-        self.lamb = False
-        self.lars = False
         self.lars_configs = _SubConfig(
             lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=0.0,
-            exclude_from_weight_decay=[],
-        )
-        self.localsgd = False
+            exclude_from_weight_decay=[])
+        self.lamb_configs = _SubConfig(lamb_weight_decay=0.01,
+                                       exclude_from_weight_decay=[])
         self.localsgd_configs = _SubConfig(k_steps=1, begin_step=1)
-        self.fp16_allreduce = False
-        self.heter_ccl_mode = False
-        self.find_unused_parameters = False
-        self.fuse_all_reduce_ops = True
-        self.fuse_grad_size_in_MB = 32
-        self.nccl_comm_num = 1
-        self.gradient_scale_configs = _SubConfig(scale_strategy="avg")
-        self.a_sync = False
+        self.adaptive_localsgd_configs = _SubConfig(init_k_steps=1,
+                                                    begin_step=1)
         self.a_sync_configs = _SubConfig(k_steps=-1)
+        self.tensor_parallel_configs = _SubConfig(
+            tensor_parallel_degree=1, tensor_init_seed=-1)
+        # GradientScaleConfig (proto:203): "avg" | "sum" | "customized" —
+        # IMPLEMENTED: "sum" un-averages the dp-mean grads in the step
+        self.gradient_scale_configs = _SubConfig(scale_strategy="avg")
+        self.trainer_desc_configs = _SubConfig()
+        self.build_strategy = _SubConfig()
+        self.qat_configs = _SubConfig(
+            weight_quantize_type="abs_max", activation_quantize_type="abs_max",
+            weight_bits=8, activation_bits=8, not_quant_pattern=[])
+        self.fs_client_param = _SubConfig(uri="", user="", passwd="",
+                                          hadoop_bin="")
 
     # knobs the TPU runtime implements or deliberately delegates; enabling
     # anything in _UNIMPLEMENTED warns instead of silently no-opping
@@ -76,12 +171,37 @@ class DistributedStrategy:
         "heter_ccl_mode": "heterogeneous NCCL/Gloo mode has no TPU analog",
         "a_sync": "geo/async PS training is not implemented; the PS service "
                   "(distributed.ps) supports push_sparse_async instead",
+        "adaptive_localsgd": "use localsgd with explicit k_steps",
+        "adam_d2sum": "PS-side optimizer fusion has no TPU analog",
+        "is_fl_ps_mode": "federated-learning PS mode is not implemented",
+        "with_coordinator": "PS coordinator is not implemented",
     }
     _DELEGATED = {
-        # accepted silently: XLA owns these concerns on TPU
-        "fuse_all_reduce_ops", "fuse_grad_size_in_MB", "nccl_comm_num",
-        "find_unused_parameters",
+        # XLA owns collective fusion/scheduling on TPU: buffer-size and
+        # fusion-count knobs map to the compiler's combiner thresholds, and
+        # comm/compute overlap to its latency-hiding scheduler
+        "fuse_all_reduce_ops": "XLA AllReduceCombiner fuses grad reductions",
+        "fuse_grad_size_in_MB": "XLA combiner threshold supersedes",
+        "fuse_grad_size_in_TFLOPS": "XLA combiner threshold supersedes",
+        "fuse_grad_size_in_num": "XLA combiner threshold supersedes",
+        "last_comm_group_size_MB": "XLA combiner threshold supersedes",
+        "sync_nccl_allreduce": "XLA collectives are issued in-program",
+        "nccl_comm_num": "one ICI fabric; XLA multiplexes channels",
+        "use_hierarchical_allreduce": "XLA picks the reduction topology",
+        "hierarchical_allreduce_inter_nranks": "XLA picks the topology",
+        "calc_comm_same_stream": "latency-hiding scheduler owns overlap",
+        "cudnn_exhaustive_search": "no cuDNN on TPU; XLA autotunes",
+        "conv_workspace_size_limit": "no cuDNN on TPU",
+        "cudnn_batchnorm_spatial_persistent": "no cuDNN on TPU",
+        "without_graph_optimization": "XLA always optimizes the graph",
+        "fuse_grad_merge": "XLA fuses the merged-grad update",
+        "split_data": "DataParallel shards the global batch",
     }
+
+    @classmethod
+    def delegation_note(cls, key):
+        """Why a delegated knob has no direct effect on this runtime."""
+        return cls._DELEGATED.get(key)
 
     def __setattr__(self, key, value):
         if value is True and key in self._UNIMPLEMENTED:
@@ -93,11 +213,7 @@ class DistributedStrategy:
                 stacklevel=2,
             )
         if key == "hybrid_configs" and isinstance(value, dict) and not isinstance(value, _SubConfig):
-            merged = _SubConfig({k: (dict(v) if isinstance(v, dict) else
-                                     (list(v) if isinstance(v, list) else v))
-                                 for k, v in _DEFAULT_HYBRID.items()})
-            merged.update(value)
-            value = merged
+            value = _hybrid_merge(value)
         elif key.endswith("_configs") and isinstance(value, dict) and not isinstance(value, _SubConfig):
             cur = self.__dict__.get(key)
             merged = _SubConfig(cur or {})

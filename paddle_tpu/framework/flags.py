@@ -8,22 +8,77 @@ from __future__ import annotations
 import os
 from typing import Any, Dict
 
+# Flags CONSUMED by this runtime (grep the name to find the consumer) are
+# marked [consumed]; the rest are the most commonly-set reference flags
+# (paddle/common/flags.cc), accepted with documented-no-op semantics so user
+# scripts and launch configs run unchanged — each comment says what owns the
+# concern on TPU.
 _DEFAULTS: Dict[str, Any] = {
-    "FLAGS_check_nan_inf": False,
-    "FLAGS_check_nan_inf_level": 0,
-    "FLAGS_benchmark": False,
-    "FLAGS_eager_delete_tensor_gb": 0.0,
-    "FLAGS_use_system_allocator": False,
-    "FLAGS_allocator_strategy": "auto_growth",
-    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
-    "FLAGS_cudnn_deterministic": False,
-    "FLAGS_embedding_deterministic": 0,
-    "FLAGS_max_inplace_grad_add": 0,
-    "FLAGS_log_memory_stats": False,
-    "FLAGS_enable_async_trace": False,
-    "FLAGS_use_stride_kernel": True,
-    "FLAGS_set_to_1d": False,
-    "FLAGS_enable_pir_api": True,
+    # --- debugging / numerics ---------------------------------------------
+    "FLAGS_check_nan_inf": False,            # [consumed] autograd chokepoint
+    "FLAGS_check_nan_inf_level": 0,          # [consumed]
+    "FLAGS_benchmark": False,                # profiler owns step timing
+    "FLAGS_cudnn_deterministic": False,      # XLA is deterministic by default
+    "FLAGS_embedding_deterministic": 0,      # XLA scatter determinism
+    "FLAGS_enable_api_kernel_fallback": True,  # one backend; nothing to fall to
+    "FLAGS_call_stack_level": 1,             # python tracebacks are full
+    "FLAGS_check_kernel_launch": False,      # XLA validates at compile time
+    "FLAGS_low_precision_op_list": 0,        # amp.debugging collects stats
+    # --- memory / allocator ------------------------------------------------
+    "FLAGS_eager_delete_tensor_gb": 0.0,     # PJRT owns buffer lifetime
+    "FLAGS_use_system_allocator": False,     # PJRT owns allocation
+    "FLAGS_allocator_strategy": "auto_growth",  # PJRT BFC-equivalent
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,  # TPU HBM is whole-chip
+    "FLAGS_initial_gpu_memory_in_mb": 0,
+    "FLAGS_reallocate_gpu_memory_in_mb": 0,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    "FLAGS_fast_eager_deletion_mode": True,
+    "FLAGS_gpu_memory_limit_mb": 0,
+    "FLAGS_log_memory_stats": False,         # device.cuda exposes stats API
+    "FLAGS_free_idle_chunk": False,
+    "FLAGS_free_when_no_cache_hit": False,
+    "FLAGS_use_pinned_memory": True,         # host arrays are pinned by PJRT
+    "FLAGS_use_cuda_managed_memory": False,  # no UVM on TPU
+    # --- execution / dispatch ---------------------------------------------
+    "FLAGS_max_inplace_grad_add": 0,         # XLA fuses accumulations
+    "FLAGS_use_stride_kernel": True,         # jax views are always strided
+    "FLAGS_set_to_1d": False,                # 0-d tensors are native here
+    "FLAGS_enable_pir_api": True,            # StableHLO IS the IR here
+    "FLAGS_enable_pir_in_executor": False,
+    "FLAGS_new_executor_serial_run": False,  # XLA schedules the program
+    "FLAGS_new_executor_sequential_run": False,
+    "FLAGS_new_executor_use_cuda_graph": False,  # jit IS whole-graph capture
+    "FLAGS_use_mkldnn": False,               # no oneDNN on TPU
+    "FLAGS_enable_async_trace": False,       # jax dispatch is async already
+    "FLAGS_use_fast_math": False,            # XLA exactness flags own this
+    "FLAGS_einsum_opt": True,                # jnp.einsum always optimizes
+    # --- cuDNN/conv-era knobs (no cuDNN on TPU; XLA autotunes convs) -------
+    "FLAGS_cudnn_exhaustive_search": False,
+    "FLAGS_conv_workspace_size_limit": 512,
+    "FLAGS_cudnn_batchnorm_spatial_persistent": False,
+    "FLAGS_conv2d_disable_cudnn": False,
+    # --- distributed / collectives ----------------------------------------
+    "FLAGS_sync_nccl_allreduce": True,       # XLA collectives are in-program
+    "FLAGS_nccl_blocking_wait": False,       # watchdog owns timeouts
+    "FLAGS_distributed_deep_ep": False,
+    "FLAGS_dynamic_static_unified_comm": True,
+    "FLAGS_enable_all2all_use_fp16": False,  # dtype is explicit in programs
+    # --- profiler / logging -----------------------------------------------
+    "FLAGS_enable_record_memory": False,     # profiler.export covers memory
+    "FLAGS_multiple_of_cupti_buffer_size": 1,
+    "FLAGS_host_trace_level": 1,             # host tracer always records
+    # --- checkpoint / io ---------------------------------------------------
+    "FLAGS_save_cf_stack_op": False,
+    "FLAGS_print_allocator_trace_info": False,
+    # --- misc compatibility ------------------------------------------------
+    "FLAGS_paddle_num_threads": 1,           # host threading is jax's
+    "FLAGS_inner_op_parallelism": 0,
+    "FLAGS_cpu_deterministic": False,
+    "FLAGS_init_allocated_mem": False,
+    "FLAGS_convert_all_blocks": True,
+    "FLAGS_apply_pass_to_program": False,
+    "FLAGS_jit_engine_type": "Predictor",    # inference wrapper tag
+    "FLAGS_cache_inference_while_scope": False,
 }
 
 _flags: Dict[str, Any] = {}

@@ -60,36 +60,39 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     # [block_q, G*D] -> [block_q*G, D]: contiguous, free
     q = q_ref[0].reshape(rows, head_dim)
 
-    def body(kb, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [rows, block_k] fp32
-        if causal:
-            # row r is query position q_offset + qi*block_q + r//G — the
-            # offset (Lk-Lq) bottom-right-aligns the mask for cached/chunked
-            # prefill, matching the dense fallback's tril(kl-ql).  Position
-            # index built as a 3D iota reshaped (pos-major, head-minor) —
-            # integer division on i32 promotes to i64 under x64 and recurses
-            # Mosaic's convert lowering.
-            q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, group, block_k), 0
-            ).reshape(rows, block_k)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (rows, block_k), 1
+    def make_body(masked):
+        def body(kb, carry):
+            acc, m, l = carry
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32
+            ) * scale  # [rows, block_k] fp32
+            if masked:
+                # row r is query position q_offset + qi*block_q + r//G — the
+                # offset (Lk-Lq) bottom-right-aligns the mask for cached/
+                # chunked prefill, matching the dense fallback's tril(kl-ql).
+                # Position index built as a 3D iota reshaped (pos-major,
+                # head-minor) — integer division on i32 promotes to i64 under
+                # x64 and recurses Mosaic's convert lowering.
+                q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, group, block_k), 0
+                ).reshape(rows, block_k)
+                k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (rows, block_k), 1
+                )
+                s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
+            return acc_new, m_new, l_new
+        return body
 
     init = (
         jnp.zeros((rows, head_dim), jnp.float32),
@@ -97,19 +100,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         jnp.zeros((rows,), jnp.float32),
     )
     if causal:
-        # skip k blocks that lie entirely above the diagonal: the r4 profile
-        # put the flash kernels at 490ms of an 1830ms step with half their
-        # tiles fully masked.  All-i32 dynamic fori bounds (a bare python int
-        # would promote to i64 under x64 and recurse Mosaic's lowering).
-        # (r3 measured this SLOWER at block_q=512/2 k blocks; at r4's
-        # block_q=64/many-program grid the skip wins — see bench notes.)
-        hi = (qi * jnp.int32(block_q)
-              + jnp.int32(q_offset + block_q + block_k - 1)
-              ) // jnp.int32(block_k)
-        acc, m, l = jax.lax.fori_loop(jnp.int32(0), hi, body, init)
+        # two-phase causal sweep (the r4 profile put the flash kernels at
+        # 490ms of an 1830ms step with half their tiles fully masked):
+        #   [0, lo)  — k blocks fully BELOW the diagonal: no mask compute
+        #   [lo, hi) — the diagonal band: masked
+        #   [hi, ..) — fully above: skipped entirely
+        # All-i32 dynamic fori bounds (a bare python int would promote to
+        # i64 under x64 and recurse Mosaic's lowering).
+        q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
+        lo = q_min // jnp.int32(block_k)
+        hi = (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k)
+        carry = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), init)
+        acc, m, l = jax.lax.fori_loop(lo, hi, make_body(True), carry)
     else:
         acc, m, l = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
-                                      body, init,
+                                      make_body(False), init,
                                       unroll=num_k_blocks <= 8)
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).reshape(block_q, group * head_dim
@@ -252,14 +257,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    # causal block-skip: a (k-block, q-block) pair with every q position
-    # strictly above the diagonal contributes nothing — skip ALL its compute
-    # (real scf.if on the scalar core, unlike lax.cond's predication)
-    live = (((qb + 1) * block_q + q_offset > ki * block_k)
-            if causal else True)
+    # causal tile classes (real scf.if on the scalar core, unlike lax.cond's
+    # predication): fully above the diagonal -> skip all compute; fully
+    # below -> compute without the mask (saves the iota/compare VPU work);
+    # diagonal band -> masked compute.
+    if causal:
+        live = (qb + 1) * block_q + q_offset > ki * block_k
+        full = q_offset + qb * block_q >= (ki + 1) * block_k
+    else:
+        live, full = True, True
 
-    @pl.when(live)
-    def _compute():
+    def compute(masked):
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]
         q = q_ref[0].reshape(rows, head_dim)
@@ -269,7 +277,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                          # [rows, block_k]
-        if causal:
+        if masked:
             q_idx = q_offset + qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, group, block_k), 0
             ).reshape(rows, block_k)
@@ -292,6 +300,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
 
+    if causal:
+        @pl.when(full)
+        def _full():
+            compute(False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _diag():
+            compute(True)
+    else:
+        compute(False)
+
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    block_k: int, causal: bool, scale: float, group: int,
@@ -312,40 +331,47 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     lse = lse_ref[0, 0, 0]
     delta = delta_ref[0, 0, 0]
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, group, block_k), 0
-            ).reshape(rows, block_k)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (rows, block_k), 1
+    def make_body(masked):
+        def body(kb, dq):
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32
+            ) * scale
+            if masked:
+                q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, group, block_k), 0
+                ).reshape(rows, block_k)
+                k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (rows, block_k), 1
+                )
+                s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32
             )
-            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            ds = p * (dp - delta[:, None]) * scale
+            return dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return body
 
     dq0 = jnp.zeros((rows, head_dim), jnp.float32)
     if causal:
-        # skip k blocks entirely above the diagonal (all-i32 dynamic bound)
-        hi = (qi * jnp.int32(block_q)
-              + jnp.int32(q_offset + block_q + block_k - 1)
-              ) // jnp.int32(block_k)
-        dq = jax.lax.fori_loop(jnp.int32(0), hi, body, dq0)
+        # two-phase: mask-free full blocks, masked diagonal band, skip the
+        # rest (all-i32 dynamic bounds)
+        q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
+        lo = q_min // jnp.int32(block_k)
+        hi = (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k)
+        dq = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), dq0)
+        dq = jax.lax.fori_loop(lo, hi, make_body(True), dq)
     else:
-        dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks), body,
-                               dq0, unroll=num_k_blocks <= 8)
+        dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
+                               make_body(False), dq0,
+                               unroll=num_k_blocks <= 8)
     dq_ref[0] = dq.reshape(block_q, group * head_dim).astype(dq_ref.dtype)
 
 
@@ -549,11 +575,15 @@ def available(q_shape, k_shape=None) -> bool:
     if len(q_shape) != 4:
         return False
     _, l, h, d = q_shape
+    hkv = h
     if k_shape is not None:
         hkv = k_shape[2]
         if hkv <= 0 or h % hkv or k_shape[1] % 128:
             return False
-    # lane dim wants 128-multiples; tiny shapes aren't worth a kernel launch
+    # packed-layout q blocks slice (H/Hkv)*D lanes out of H*D: the minor dim
+    # must be a 128-multiple (d=64 MHA, e.g. BERT-base, takes the XLA path)
+    if (h // hkv) * d % 128:
+        return False
     return _on_tpu() and d in (64, 128, 256) and l >= 128 and l % 128 == 0
 
 

@@ -126,6 +126,14 @@ class Optimizer:
             (p, p.grad) for p in self._parameter_list
             if not p.stop_gradient and p.grad is not None and getattr(p, "trainable", True)
         ]
+        # gradient_scale_configs.scale_strategy wiring (fleet strategy): a
+        # mean loss under GSPMD yields dp-AVERAGED grads; "sum" semantics
+        # multiply back by the dp degree (set by fleet.distributed_optimizer)
+        rescale = float(getattr(self, "_grad_rescale", 1.0) or 1.0)
+        if rescale != 1.0:
+            params_grads = [(p, Tensor(g.data * rescale)
+                             if isinstance(g, Tensor) else g * rescale)
+                            for p, g in params_grads]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()

@@ -136,6 +136,13 @@ class TrainStep:
             for k, g in grads.items()
         }
 
+        # gradient_scale_configs.scale_strategy "sum": un-average the
+        # dp-mean grads (fleet.distributed_optimizer sets _grad_rescale)
+        rescale = float(getattr(optimizer, "_grad_rescale", 1.0) or 1.0)
+        if rescale != 1.0:
+            grads = {k: (g * rescale if g is not None else None)
+                     for k, g in grads.items()}
+
         # Grad clipping: run the clip object's OWN _dygraph_clip inside the
         # trace (every built-in clip is pure jnp, hence traceable) so the
         # compiled step has identical semantics to eager for ClipGradByValue

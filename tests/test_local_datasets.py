@@ -1,0 +1,193 @@
+"""Local-file text/audio dataset parsers (VERDICT r3 next-round #10).
+
+Each test synthesizes a corpus in the REFERENCE's on-disk format (aclImdb
+tar layout, PTB simple-examples tar, housing.data, ml-1m zip, ESC-50 csv +
+wavs, TESS wav tree) and drives the parser end-to-end; the no-local-path
+constructors must still raise with instructions (zero-egress contract).
+"""
+import io
+import os
+import struct
+import tarfile
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.audio.datasets import ESC50, TESS
+from paddle_tpu.text.datasets import (WMT14, Imdb, Imikolov, Movielens,
+                                      UCIHousing)
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+class TestImdb:
+    def _make_tar(self, path):
+        docs = {
+            "train/pos/0_9.txt": b"a wonderful movie, truly great great!",
+            "train/pos/1_8.txt": b"great fun. great cast",
+            "train/neg/0_2.txt": b"terrible film; great waste of time",
+            "test/pos/0_8.txt": b"great",
+            "test/neg/0_3.txt": b"awful. not great",
+        }
+        with tarfile.open(path, "w:gz") as tf:
+            for rel, text in docs.items():
+                _tar_add(tf, f"aclImdb/{rel}", text)
+
+    def test_parses_acl_imdb_tar(self, tmp_path):
+        p = str(tmp_path / "aclImdb_v1.tar.gz")
+        self._make_tar(p)
+        ds = Imdb(data_file=p, mode="train", cutoff=1)
+        assert len(ds) == 3
+        # 'great' appears > cutoff across the corpus -> in the dict
+        assert b"great" in ds.word_idx
+        doc, label = ds[0]
+        assert doc.dtype.kind == "i" and label.shape == (1,)
+        labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+        assert labels == [0, 0, 1]  # 2 pos, 1 neg
+
+    def test_parses_extracted_dir(self, tmp_path):
+        root = tmp_path / "aclImdb"
+        for rel, text in [("train/pos/0_9.txt", "great great great"),
+                          ("train/neg/0_1.txt", "bad but great")]:
+            f = root / rel
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_text(text)
+        ds = Imdb(data_file=str(root), mode="train", cutoff=1)
+        assert len(ds) == 2
+
+    def test_raises_without_path(self):
+        with pytest.raises(RuntimeError, match="data_file"):
+            Imdb()
+
+
+class TestImikolov:
+    def _make_tar(self, path):
+        train = b"the cat sat on the mat\nthe dog sat too\n" * 30
+        valid = b"the cat ran\n" * 10
+        test = b"a cat sat\nthe mat sat\n"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, data in (("ptb.train.txt", train),
+                               ("ptb.valid.txt", valid),
+                               ("ptb.test.txt", test)):
+                _tar_add(tf, f"./simple-examples/data/{name}", data)
+
+    def test_ngram_and_seq(self, tmp_path):
+        p = str(tmp_path / "simple-examples.tgz")
+        self._make_tar(p)
+        ds = Imikolov(data_file=p, data_type="NGRAM", window_size=3,
+                      mode="train", min_word_freq=5)
+        assert len(ds) > 0
+        gram = ds[0]
+        assert len(gram) == 3 and all(g.dtype.kind == "i" for g in gram)
+        seq = Imikolov(data_file=p, data_type="SEQ", mode="test",
+                       min_word_freq=5)
+        src, trg = seq[0]
+        assert len(src) == len(trg)
+
+    def test_raises_without_path(self):
+        with pytest.raises(RuntimeError, match="data_file"):
+            Imikolov()
+
+
+class TestUCIHousing:
+    def test_parse_and_normalize(self, tmp_path):
+        rng = np.random.RandomState(0)
+        rows = rng.rand(50, 14) * 10 + 1
+        p = tmp_path / "housing.data"
+        p.write_text("\n".join(" ".join(f"{v:.4f}" for v in r)
+                               for r in rows))
+        tr = UCIHousing(data_file=str(p), mode="train")
+        te = UCIHousing(data_file=str(p), mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are (x-avg)/(max-min)-normalized: bounded by 1
+        assert np.abs(x).max() <= 1.0
+
+
+class TestMovielens:
+    def test_parse_ml1m(self, tmp_path):
+        p = str(tmp_path / "ml-1m.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("ml-1m/users.dat",
+                        "1::M::25::4::55117\n2::F::35::7::02139\n")
+            zf.writestr("ml-1m/movies.dat",
+                        "10::Toy Story (1995)::Animation|Comedy\n"
+                        "20::Heat (1995)::Action\n")
+            zf.writestr("ml-1m/ratings.dat",
+                        "1::10::5::978300760\n2::20::3::978302109\n"
+                        "1::20::4::978301968\n")
+        tr = Movielens(data_file=p, mode="train", test_ratio=0.34)
+        te = Movielens(data_file=p, mode="test", test_ratio=0.34)
+        assert len(tr) + len(te) == 3 and len(tr) == 1
+        row = tr[0]
+        assert len(row) == 8 and isinstance(row[7], float)
+
+    def test_raises_without_path(self):
+        with pytest.raises(RuntimeError, match="data_file"):
+            Movielens()
+
+
+def _write_wav(path, n=1600, sr=16000):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(struct.pack(f"<{n}h", *([100] * n)))
+
+
+class TestESC50:
+    def test_parse_layout(self, tmp_path):
+        root = tmp_path / "ESC-50-master"
+        (root / "meta").mkdir(parents=True)
+        (root / "audio").mkdir()
+        lines = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(4):
+            fn = f"1-{i}-A-{i}.wav"
+            _write_wav(root / "audio" / fn)
+            lines.append(f"{fn},{i % 2 + 1},{i},label{i},True,x,A")
+        (root / "meta" / "esc50.csv").write_text("\n".join(lines))
+        tr = ESC50(mode="train", split=1, root=str(tmp_path))
+        dv = ESC50(mode="dev", split=1, root=str(tmp_path))
+        assert len(tr) == 2 and len(dv) == 2
+        wavf, label = tr[0]
+        assert wavf.dtype == np.float32 and wavf.ndim == 1
+        assert label.dtype == np.int64
+
+    def test_raises_without_root(self):
+        with pytest.raises(RuntimeError, match="root"):
+            ESC50()
+
+
+class TestTESS:
+    def test_parse_layout(self, tmp_path):
+        d = tmp_path / "TESS"
+        d.mkdir()
+        for i, emo in enumerate(["angry", "happy", "sad", "neutral",
+                                 "fear"]):
+            _write_wav(d / f"OAF_word{i}_{emo}.wav")
+        tr = TESS(mode="train", n_folds=5, split=1, root=str(tmp_path))
+        dv = TESS(mode="dev", n_folds=5, split=1, root=str(tmp_path))
+        assert len(tr) == 4 and len(dv) == 1
+        wavf, label = tr[0]
+        assert wavf.ndim == 1 and 0 <= int(label) < len(TESS.label_list)
+
+    def test_raises_without_root(self):
+        with pytest.raises(RuntimeError, match="root"):
+            TESS()
+
+
+class TestUnparsedCorpora:
+    def test_wmt_still_raises_with_reason(self, tmp_path):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            WMT14()
+        f = tmp_path / "wmt14.tgz"
+        f.write_bytes(b"x")
+        with pytest.raises(NotImplementedError):
+            WMT14(data_file=str(f))

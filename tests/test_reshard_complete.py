@@ -1,0 +1,115 @@
+"""Reshard completeness: cross-mesh (same_status) + uneven shards
+(VERDICT r3 next-round #6).
+
+Reference: auto_parallel/static/reshard_funcs/same_status_reshard_func.py
+(move a tensor between two meshes keeping its distribution) and the C++
+reshard engine's padded uneven shards.  Here every transition is one
+device_put; with ``pad_uneven=True`` uneven dims are zero-padded in STORAGE
+to the next axis multiple (logical shape tracked on the tensor and stripped
+at every exit); the default keeps uneven dims replicated so values and
+shapes stay exact for downstream compute.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel.api import (reshard, shard_tensor,
+                                                      unshard_dtensor)
+from paddle_tpu.distributed.auto_parallel.placement_type import (Partial,
+                                                                 Replicate,
+                                                                 Shard)
+from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+
+
+def _mesh8():
+    return ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["x", "y"])
+
+
+def _submesh4():
+    return ProcessMesh(np.arange(4), dim_names=["x"])
+
+
+def _uppermesh4():
+    return ProcessMesh(np.arange(4, 8), dim_names=["z"])
+
+
+class TestUnevenShards:
+    def test_uneven_r_to_s_roundtrip(self):
+        """dim 10 over a 4-way axis: storage pads to 12, logical value is
+        preserved through shard and unshard."""
+        mesh = _mesh8()
+        v = np.arange(10, dtype=np.float32)
+        t = shard_tensor(paddle.to_tensor(v), mesh, [Shard(0), Replicate()],
+                         pad_uneven=True)
+        # actually sharded over x (not the replicate fallback)
+        assert "x" in str(t.data.sharding.spec)
+        assert t.data.shape == (12,)          # padded storage
+        assert t._dist_logical_shape == (10,)
+        back = unshard_dtensor(t)
+        np.testing.assert_array_equal(back.numpy(), v)
+
+    def test_uneven_s_to_s_transition(self):
+        mesh = _mesh8()
+        v = np.arange(30, dtype=np.float32).reshape(10, 3)
+        t = shard_tensor(paddle.to_tensor(v), mesh, [Shard(0), Replicate()],
+                         pad_uneven=True)
+        # s(0) -> s(1): dim1=3 over y=2 is ALSO uneven; value survives
+        t2 = reshard(t, mesh, [Shard(1), Replicate()], pad_uneven=True)
+        assert t2.data.shape == (10, 4)
+        np.testing.assert_array_equal(unshard_dtensor(t2).numpy(), v)
+
+    def test_uneven_then_even_clears_padding(self):
+        mesh = _mesh8()
+        v = np.arange(10, dtype=np.float32)
+        t = shard_tensor(paddle.to_tensor(v), mesh, [Shard(0), Replicate()],
+                         pad_uneven=True)
+        t2 = reshard(t, mesh, [Replicate(), Replicate()])
+        assert t2.data.shape == (10,)
+        assert t2._dist_logical_shape is None
+        np.testing.assert_array_equal(t2.numpy(), v)
+
+    def test_uneven_partial_materialization(self):
+        mesh = _mesh8()
+        v = np.arange(10, dtype=np.float32)
+        t = shard_tensor(paddle.to_tensor(v), mesh,
+                         [Partial(), Replicate()])
+        out = reshard(t, mesh, [Shard(0), Replicate()], pad_uneven=True)
+        np.testing.assert_allclose(unshard_dtensor(out).numpy(), v * 4)
+
+
+class TestCrossMesh:
+    def test_same_status_disjoint_mesh(self):
+        """The reference's same_status reshard: identical distribution, a
+        DIFFERENT mesh (here devices 0-3 -> devices 4-7)."""
+        v = np.arange(8, dtype=np.float32)
+        t = shard_tensor(paddle.to_tensor(v), _submesh4(), [Shard(0)])
+        moved = reshard(t, _uppermesh4(), [Shard(0)])
+        ids = {d.id for d in moved.data.sharding.device_set}
+        assert ids == {4, 5, 6, 7}, ids
+        np.testing.assert_array_equal(unshard_dtensor(moved).numpy(), v)
+
+    def test_mesh_to_submesh(self):
+        mesh, sub = _mesh8(), _submesh4()
+        v = np.arange(16, dtype=np.float32).reshape(8, 2)
+        t = shard_tensor(paddle.to_tensor(v), mesh, [Shard(0), Shard(1)])
+        down = reshard(t, sub, [Shard(0)])
+        assert {d.id for d in down.data.sharding.device_set} == {0, 1, 2, 3}
+        np.testing.assert_array_equal(unshard_dtensor(down).numpy(), v)
+
+    def test_submesh_to_mesh_with_layout_change(self):
+        mesh, sub = _mesh8(), _submesh4()
+        v = np.arange(16, dtype=np.float32).reshape(8, 2)
+        t = shard_tensor(paddle.to_tensor(v), sub, [Shard(0)])
+        up = reshard(t, mesh, [Replicate(), Shard(1)])
+        assert len(up.data.sharding.device_set) == 8
+        np.testing.assert_array_equal(unshard_dtensor(up).numpy(), v)
+
+    def test_cross_mesh_uneven(self):
+        """same_status move composed with an uneven dim."""
+        v = np.arange(10, dtype=np.float32)
+        t = shard_tensor(paddle.to_tensor(v), _submesh4(), [Shard(0)],
+                         pad_uneven=True)
+        assert t.data.shape == (12,)
+        moved = reshard(t, _uppermesh4(), [Shard(0)], pad_uneven=True)
+        assert moved.data.shape == (12,)
+        np.testing.assert_array_equal(unshard_dtensor(moved).numpy(), v)

@@ -1,0 +1,160 @@
+"""Strategy/flag breadth (VERDICT r3 next-round #9).
+
+Parity gates:
+* every TOP-LEVEL field of the reference's ``message DistributedStrategy``
+  (/root/reference/paddle/fluid/framework/distributed_strategy.proto:364-428)
+  exists on DistributedStrategy (parsed from the proto at test time, so new
+  reference fields fail loudly);
+* hybrid sub-config knob surfaces (MpConfig / PpConfig /
+  DygraphShardingConfig) are present with reference defaults;
+* gradient_scale_configs.scale_strategy="sum" / use_reduce_avg=False have
+  REAL semantics: the compiled step multiplies the dp-averaged grads back by
+  the dp degree.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+
+PROTO = "/root/reference/paddle/fluid/framework/distributed_strategy.proto"
+
+
+def _proto_fields(message):
+    if not os.path.exists(PROTO):
+        pytest.skip("reference proto unavailable")
+    src = open(PROTO).read()
+    m = re.search(rf"message {message} \{{(.*?)\n\}}", src, re.S)
+    assert m, message
+    return re.findall(r"optional\s+\S+\s+(\w+)\s*=", m.group(1))
+
+
+class TestProtoParity:
+    def test_top_level_fields_exist(self):
+        s = fleet.DistributedStrategy()
+        missing = []
+        for f in _proto_fields("DistributedStrategy"):
+            if f == "mode":
+                continue  # COLLECTIVE is the only mode on this runtime
+            if not (hasattr(s, f) or f in s.__dict__):
+                missing.append(f)
+        assert not missing, f"strategy fields missing vs proto: {missing}"
+
+    @pytest.mark.parametrize("msg,where", [
+        ("MpConfig", "mp_configs"),
+        ("PpConfig", "pp_configs"),
+        ("DygraphShardingConfig", "sharding_configs"),
+    ])
+    def test_hybrid_subconfig_fields(self, msg, where):
+        s = fleet.DistributedStrategy()
+        sub = s.hybrid_configs[where]
+        missing = [f for f in _proto_fields(msg) if f not in sub]
+        assert not missing, f"{where} missing {missing}"
+
+    def test_unimplemented_warns(self):
+        s = fleet.DistributedStrategy()
+        with pytest.warns(UserWarning, match="NOT implemented"):
+            s.a_sync = True
+
+    def test_delegated_documented(self):
+        assert fleet.DistributedStrategy.delegation_note(
+            "fuse_grad_size_in_MB")
+        assert fleet.DistributedStrategy.delegation_note(
+            "calc_comm_same_stream")
+
+
+class TestGradScaleSemantics:
+    def _train(self, scale_strategy):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        strategy.gradient_scale_configs = {"scale_strategy": scale_strategy}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        opt = fleet.distributed_optimizer(opt, strategy)
+        from paddle_tpu.static.functionalize import build_train_step
+
+        step = build_train_step(net, nn.MSELoss(), opt)
+        w0 = np.asarray(step._params["weight"])
+        x = np.full((8, 4), 1.0, np.float32)
+        y = np.zeros((8, 4), np.float32)
+        step(paddle.Tensor(x), paddle.Tensor(y))
+        return np.asarray(step._params["weight"]) - w0
+
+    def test_sum_scales_update_by_dp_degree(self):
+        d_avg = self._train("avg")
+        d_sum = self._train("sum")
+        np.testing.assert_allclose(d_sum, d_avg * 8, rtol=1e-5, atol=1e-7)
+
+    def test_use_reduce_avg_false_equivalent(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 8,
+            "sharding_configs": {"use_reduce_avg": False},
+        }
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=nn.Linear(2, 2).parameters())
+        opt = fleet.distributed_optimizer(opt, strategy)
+        assert getattr(opt, "_grad_rescale", 1.0) == 8.0
+
+
+class TestFlagBreadth:
+    def test_top_flags_registered(self):
+        """The ~50 most commonly-set reference FLAGS_* are settable and
+        readable (real or documented-no-op)."""
+        from paddle_tpu.framework import flags
+
+        assert len(flags._DEFAULTS) >= 50
+        for name in ("FLAGS_check_nan_inf", "FLAGS_allocator_strategy",
+                     "FLAGS_sync_nccl_allreduce", "FLAGS_use_mkldnn",
+                     "FLAGS_conv_workspace_size_limit",
+                     "FLAGS_fraction_of_gpu_memory_to_use"):
+            assert name in flags._DEFAULTS, name
+        paddle.set_flags({"FLAGS_conv_workspace_size_limit": 1024})
+        assert paddle.get_flags("FLAGS_conv_workspace_size_limit")[
+            "FLAGS_conv_workspace_size_limit"] == 1024
+
+    def test_flag_names_exist_in_reference(self):
+        """Every registered flag name must be a REAL reference flag — no
+        invented names (checked against paddle/common/flags.cc +
+        paddle/phi/core/flags.cc when available)."""
+        ref_candidates = [
+            "/root/reference/paddle/common/flags.cc",
+            "/root/reference/paddle/phi/core/flags.cc",
+        ]
+        srcs = "".join(open(f).read() for f in ref_candidates
+                       if os.path.exists(f))
+        if not srcs:
+            pytest.skip("reference flags.cc unavailable")
+        from paddle_tpu.framework import flags
+
+        known_extra = {
+            # defined in other reference translation units (grep-verified
+            # against /root/reference/paddle: allocator_facade.cc,
+            # program_interpreter.cc, auto_growth_best_fit_allocator*.cc,
+            # system_allocator.cc, op_kernel_type.h, build_strategy.h,
+            # naive_best_fit_allocator.cc, dependency_builder.cc,
+            # graph_to_program_pass, pir flags)
+            "FLAGS_enable_pir_api", "FLAGS_enable_pir_in_executor",
+            "FLAGS_jit_engine_type", "FLAGS_save_cf_stack_op",
+            "FLAGS_distributed_deep_ep", "FLAGS_use_system_allocator",
+            "FLAGS_log_memory_stats", "FLAGS_free_idle_chunk",
+            "FLAGS_free_when_no_cache_hit", "FLAGS_use_pinned_memory",
+            "FLAGS_use_cuda_managed_memory", "FLAGS_use_stride_kernel",
+            "FLAGS_new_executor_serial_run",
+            "FLAGS_new_executor_sequential_run",
+            "FLAGS_print_allocator_trace_info", "FLAGS_cpu_deterministic",
+            "FLAGS_init_allocated_mem", "FLAGS_convert_all_blocks",
+        }
+        missing = [
+            n for n in flags._DEFAULTS
+            if n.removeprefix("FLAGS_") not in srcs and n not in known_extra
+        ]
+        assert not missing, f"flags not found in reference flags.cc: {missing}"
