@@ -52,7 +52,10 @@ class _AudioClassificationDataset(Dataset):
         from paddle_tpu.audio.backends import load
 
         waveform, sr = load(self.files[idx])
-        self.sample_rate = sr
+        if self.sample_rate is None:
+            # adopt the corpus rate only when the user didn't pin one; the
+            # cached extractor stays consistent either way
+            self.sample_rate = sr
         waveform = np.asarray(waveform)
         if waveform.ndim == 2:
             waveform = waveform[0]

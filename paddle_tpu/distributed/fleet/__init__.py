@@ -162,21 +162,20 @@ def distributed_optimizer(optimizer, strategy=None):
                 k_steps=getattr(cfg, "k_steps", 1),
                 begin_step=getattr(cfg, "begin_step", 1),
             )
-        # gradient_scale_configs.scale_strategy / hybrid sharding
-        # use_reduce_avg (reference distributed_strategy.proto
-        # GradientScaleConfig + DygraphShardingConfig.use_reduce_avg): under
-        # GSPMD a mean loss yields dp-AVERAGED grads; "sum" (or
-        # use_reduce_avg=False) asks for summed grads, so the step
-        # multiplies back by the dp degree.
+        # gradient_scale_configs.scale_strategy (reference
+        # distributed_strategy.proto GradientScaleConfig): under GSPMD a
+        # mean loss yields dp-AVERAGED grads; "sum" asks for summed grads,
+        # so the step multiplies back by the batch-sharding degree.
+        # NOTE: DygraphShardingConfig.use_reduce_avg is numerically NEUTRAL
+        # in the reference (False = SUM-reduce + explicit 1/nranks scale,
+        # tensor_fusion_helper.py:681) — a comm-op precision knob, not a
+        # semantics change — so it maps to no-op here.
         scale = getattr(getattr(strategy, "gradient_scale_configs", None),
                         "scale_strategy", "avg") or "avg"
-        hy = getattr(strategy, "hybrid_configs", None) or {}
-        shc = hy.get("sharding_configs") if isinstance(hy, dict) else None
-        use_reduce_avg = (shc or {}).get("use_reduce_avg", True)
-        if scale == "sum" or not use_reduce_avg:
+        if scale == "sum":
             hcg = get_hybrid_communicate_group()
-            # grads are mean-reduced over EVERY batch-sharding axis: dp AND
-            # the ZeRO sharding group (use_reduce_avg is a sharding knob)
+            # grads are mean-reduced over every batch-sharding axis: dp AND
+            # the ZeRO sharding group
             if hcg is not None:
                 deg = (hcg.get_data_parallel_world_size()
                        * hcg.get_sharding_parallel_world_size())

@@ -1,3 +1,9 @@
 from paddle_tpu.distributed.launch.controllers.collective import (  # noqa: F401
     CollectiveController,
 )
+from paddle_tpu.distributed.launch.controllers.ps import (  # noqa: F401
+    PSController,
+)
+from paddle_tpu.distributed.launch.controllers.rpc import (  # noqa: F401
+    RpcController,
+)

@@ -25,7 +25,7 @@ import time
 class CollectiveController:
     def __init__(self, script, script_args=None, nproc_per_node=1, nnodes=1,
                  node_rank=0, master=None, job_id="default", log_dir=None,
-                 max_restarts=0, env=None):
+                 max_restarts=0, env=None, elastic=False, min_nproc=1):
         self.script = script
         self.script_args = list(script_args or [])
         self.nproc = int(nproc_per_node)
@@ -35,6 +35,14 @@ class CollectiveController:
         self.job_id = job_id
         self.log_dir = log_dir
         self.max_restarts = int(max_restarts)
+        # elastic level 2 (reference fleet/elastic/manager.py:218-248): on a
+        # worker failure the controller REWRITES the world — drops the dead
+        # rank, shrinks PADDLE_TRAINERS_NUM/endpoints, and relaunches the
+        # survivors at the NEW world size (instead of same-size peer restart);
+        # workers redistribute state by resuming from the distributed
+        # checkpoint, whose reshard-on-load maps old shards onto the new mesh
+        self.elastic = bool(elastic)
+        self.min_nproc = int(min_nproc)
         self.base_env = dict(env if env is not None else os.environ)
         self.procs = []
         self.restart_count = 0
@@ -178,6 +186,20 @@ class CollectiveController:
                     if self.restart_count < self.max_restarts:
                         self.restart_count += 1
                         self._kill_all()
+                        if self.elastic and self.nnodes == 1:
+                            new_np = max(self.min_nproc,
+                                         self.nproc - len(failed))
+                            if new_np != self.nproc:
+                                self.nproc = new_np
+                        elif self.elastic:
+                            import logging
+
+                            logging.getLogger("paddle_tpu.launch").warning(
+                                "elastic shrink needs a cross-node "
+                                "controller consensus this single-node "
+                                "controller cannot provide for nnodes=%d; "
+                                "doing a same-size peer restart",
+                                self.nnodes)
                         self._spawn_all(host, port, node_hosts)
                     else:
                         self._kill_all()

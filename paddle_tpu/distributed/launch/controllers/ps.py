@@ -1,0 +1,131 @@
+"""Parameter-server launch controller.
+
+Reference: python/paddle/distributed/launch/controllers/ps.py (PSController:
+build a pod of PS *server* processes + *trainer* processes with the PS env
+contract; the job is done when the TRAINERS finish — servers are then torn
+down).
+
+TPU-native notes: the PS tier here is the rpc-backed table service
+(paddle_tpu/distributed/ps): servers host sparse/dense tables over real
+sockets, trainers pull/push through PsWorker.  Rendezvous is the same native
+TCPStore as collective mode; roles are conveyed with the reference's env
+names (TRAINING_ROLE / PADDLE_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from paddle_tpu.distributed.launch.controllers.collective import (
+    CollectiveController,
+)
+
+
+class PSController(CollectiveController):
+    def __init__(self, script, script_args=None, server_num=1, trainer_num=1,
+                 master=None, job_id="default", log_dir=None, env=None):
+        super().__init__(script, script_args,
+                         nproc_per_node=server_num + trainer_num,
+                         master=master, job_id=job_id, log_dir=log_dir,
+                         env=env)
+        self.server_num = int(server_num)
+        self.trainer_num = int(trainer_num)
+        self.server_procs = []
+        self.trainer_procs = []
+
+    # --------------------------------------------------------------- env
+    def _ps_env(self, role, idx, host, port):
+        """Reference ps.py env contract (controllers/ps.py _build_pod_*)."""
+        world = self.trainer_num
+        server_eps = ",".join(
+            f"{host}:{port + 1 + s}" for s in range(self.server_num))
+        trainer_eps = ",".join(
+            f"{host}:{port + 1 + self.server_num + t}" for t in range(world))
+        env = dict(self.base_env)
+        env.update({
+            "PADDLE_MASTER": f"{host}:{port}",
+            "PADDLE_JOB_ID": str(self.job_id),
+            "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+            "PADDLE_TRAINER_ENDPOINTS": trainer_eps,
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_PSERVER_NUM": str(self.server_num),
+            "PADDLE_RESTART_COUNT": str(self.restart_count),
+        })
+        if role == "PSERVER":
+            ep = f"{host}:{port + 1 + idx}"
+            env.update({
+                "TRAINING_ROLE": "PSERVER",
+                "PADDLE_ROLE": "PSERVER",
+                "PADDLE_PORT": ep.rsplit(":", 1)[1],
+                "POD_IP": host,
+                "PADDLE_SERVER_ID": str(idx),
+                "PADDLE_CURRENT_ENDPOINT": ep,
+            })
+        else:
+            env.update({
+                "TRAINING_ROLE": "TRAINER",
+                "PADDLE_ROLE": "TRAINER",
+                "PADDLE_TRAINER_ID": str(idx),
+                "PADDLE_CURRENT_ENDPOINT":
+                    f"{host}:{port + 1 + self.server_num + idx}",
+            })
+        return env
+
+    def _spawn(self, role, idx, host, port):
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            f = open(os.path.join(
+                self.log_dir,
+                f"{'serverlog' if role == 'PSERVER' else 'workerlog'}.{idx}"),
+                "ab")
+            self._log_files.append(f)
+            out = err = f
+        else:
+            out = err = None
+        return subprocess.Popen(
+            [sys.executable, "-u", self.script] + self.script_args,
+            env=self._ps_env(role, idx, host, port), stdout=out, stderr=err)
+
+    # --------------------------------------------------------------- run
+    def run(self, poll_interval=0.2, timeout=None):
+        """Servers first, then trainers; done when every TRAINER exits 0
+        (servers are long-running and torn down by the controller, the
+        reference's PS pod semantics)."""
+        host, port = self._ensure_master()
+        deadline = None if timeout is None else time.time() + timeout
+        try:
+            self.server_procs = [
+                self._spawn("PSERVER", s, host, port)
+                for s in range(self.server_num)]
+            self.trainer_procs = [
+                self._spawn("TRAINER", t, host, port)
+                for t in range(self.trainer_num)]
+            self.procs = self.server_procs + self.trainer_procs
+            while True:
+                states = [p.poll() for p in self.trainer_procs]
+                if all(s == 0 for s in states):
+                    return 0
+                bad = [s for s in states if s not in (None, 0)]
+                if bad:
+                    return bad[0]
+                dead_servers = [
+                    p.poll() for p in self.server_procs
+                    if p.poll() is not None]
+                if dead_servers:  # a server died under live trainers
+                    return dead_servers[0] or 1
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutError("PS job did not finish in time")
+                time.sleep(poll_interval)
+        finally:
+            self._kill_all(sig=signal.SIGTERM)
+            for f in self._log_files:
+                try:
+                    f.close()
+                except OSError:  # pragma: no cover
+                    pass
+            if self._server is not None:
+                self._server.stop()
+                self._server = None

@@ -1,0 +1,32 @@
+"""RPC launch controller.
+
+Reference: python/paddle/distributed/launch/controllers/rpc.py
+(RpcController: a pod of rpc workers with the master/rank/world env so
+``paddle.distributed.rpc.init_rpc`` can rendezvous).
+
+The env contract matches rpc/rpc.py: PADDLE_MASTER points at the native
+TCPStore the controller hosts, PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM give
+each worker its identity, PADDLE_WORKER_NAME a default worker name."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.launch.controllers.collective import (
+    CollectiveController,
+)
+
+
+class RpcController(CollectiveController):
+    """Same process management as collective mode; the env deltas are the
+    rpc worker names and the absence of a jax coordinator (rpc jobs don't
+    form a device mesh)."""
+
+    def _worker_env(self, local_rank, host, port, node_hosts):
+        env = super()._worker_env(local_rank, host, port, node_hosts)
+        rank = env["PADDLE_TRAINER_ID"]
+        env["PADDLE_WORKER_NAME"] = f"worker{rank}"
+        # rpc jobs rendezvous through the store only — a jax distributed
+        # coordinator would make every worker wait for a mesh that never
+        # forms
+        env.pop("PADDLE_COORDINATOR", None)
+        env.pop("MASTER_ADDR", None)
+        env.pop("MASTER_PORT", None)
+        return env

@@ -26,7 +26,8 @@ _ENV_FLAGS = {
 }
 _KNOWN_FLAGS = set(_ENV_FLAGS) | {
     "--nproc_per_node", "--devices", "--log_dir", "--ips", "--gpus", "--xpus",
-    "--run_mode", "--max_restarts", "--elastic_level",
+    "--run_mode", "--max_restarts", "--elastic_level", "--server_num",
+    "--trainer_num", "--servers", "--trainers",
 }
 
 
@@ -67,14 +68,46 @@ def launch():
         if flag in opts:
             os.environ.setdefault(env, opts[flag])
 
+    run_mode = (opts.get("--run_mode") or "").lower()
+    # PS mode (reference controllers/ps.py enable()): explicit run_mode or
+    # any server/trainer count/list argument
+    if (run_mode == "ps" or opts.get("--server_num")
+            or opts.get("--trainer_num") or opts.get("--servers")
+            or opts.get("--trainers")):
+        from paddle_tpu.distributed.launch.controllers import PSController
+
+        for flag in ("--servers", "--trainers"):
+            eps = opts.get(flag)
+            if eps and any(
+                    not ep.split(":")[0] in ("127.0.0.1", "localhost", "")
+                    for ep in eps.split(",")):
+                raise NotImplementedError(
+                    f"{flag}: multi-host PS endpoint lists are not "
+                    "supported by this single-node controller — run one "
+                    "launcher per host with --server_num/--trainer_num")
+        server_num = int(opts.get("--server_num")
+                         or len((opts.get("--servers") or "x").split(",")))
+        trainer_num = int(opts.get("--trainer_num")
+                          or len((opts.get("--trainers") or "x").split(",")))
+        ctl = PSController(
+            script, script_args, server_num=server_num,
+            trainer_num=trainer_num,
+            master=opts.get("--master") or os.environ.get("PADDLE_MASTER"),
+            job_id=opts.get("--job_id",
+                            os.environ.get("PADDLE_JOB_ID", "default")),
+            log_dir=opts.get("--log_dir"),
+        )
+        return ctl.run()
+
     nproc = opts.get("--nproc_per_node") or os.environ.get(
         "PADDLE_NPROC_PER_NODE")
     if nproc and int(nproc) >= 1:
         from paddle_tpu.distributed.launch.controllers import (
-            CollectiveController,
+            CollectiveController, RpcController,
         )
 
-        ctl = CollectiveController(
+        cls = RpcController if run_mode == "rpc" else CollectiveController
+        ctl = cls(
             script, script_args,
             nproc_per_node=int(nproc),
             nnodes=int(opts.get("--nnodes",
@@ -86,6 +119,9 @@ def launch():
                             os.environ.get("PADDLE_JOB_ID", "default")),
             log_dir=opts.get("--log_dir"),
             max_restarts=int(opts.get("--max_restarts", 0)),
+            # elastic level >= 2: on worker death relaunch the survivors at
+            # the SHRUNK world size (reference elastic manager semantics)
+            elastic=int(opts.get("--elastic_level", 0) or 0) >= 2,
         )
         return ctl.run()
 

@@ -113,7 +113,8 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     return info
 
 
-def _resolve(to, timeout_ms=30000):
+def _resolve(to, timeout_ms=120000):
+    # generous: peers may still be importing/registering under load
     info = _STATE["workers"].get(to)
     if info is not None:
         return info

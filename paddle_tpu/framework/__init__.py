@@ -1,5 +1,8 @@
 """paddle.framework parity: flags, dtype helpers, seeds, io."""
 from paddle_tpu.framework import flags  # noqa: F401
+from paddle_tpu.framework.selected_rows import (  # noqa: F401
+    SelectedRows, StringTensor, merge_selected_rows,
+)
 from paddle_tpu.core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
 from paddle_tpu.tensor.random import seed  # noqa: F401
 

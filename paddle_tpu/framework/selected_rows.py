@@ -1,0 +1,111 @@
+"""SelectedRows + StringTensor value types.
+
+Reference: paddle/phi/core/selected_rows.h (rows + value + height — the
+sparse-gradient container produced by sparse embedding lookups and consumed
+by the PS push path / merge_selected_rows) and paddle/phi/core/
+string_tensor.h (the tokenizer-facing string array).
+
+TPU-native stance: dense gradients via XLA scatter-add are the fast path on
+TPU, so SelectedRows is a VALUE TYPE for the places sparse semantics are
+load-bearing — PS sparse push (ps/table.py takes (ids, grads) pairs, i.e.
+exactly rows/value) and user code porting reference sparse-grad flows.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows", "merge_selected_rows", "StringTensor"]
+
+
+class SelectedRows:
+    """rows[i] is the logical row index of value[i]; height is the dense
+    dim-0 extent (reference selected_rows.h:40)."""
+
+    def __init__(self, rows, value, height=None):
+        import jax.numpy as jnp
+
+        from paddle_tpu.tensor.tensor import Tensor
+
+        self._rows = np.asarray(rows, np.int64).reshape(-1)
+        v = value.data if isinstance(value, Tensor) else jnp.asarray(value)
+        if v.shape[0] != self._rows.shape[0]:
+            raise ValueError(
+                f"value rows {v.shape[0]} != len(rows) {len(self._rows)}")
+        self._value = v
+        self._height = int(height if height is not None
+                           else (self._rows.max() + 1 if len(self._rows)
+                                 else 0))
+
+    @property
+    def rows(self):
+        return self._rows
+
+    def value(self):
+        from paddle_tpu.tensor.tensor import Tensor
+
+        return Tensor(self._value)
+
+    def height(self):
+        return self._height
+
+    def numel(self):
+        return int(np.prod(self._value.shape))
+
+    def sync_index(self):  # reference API parity: index is always in sync
+        return self
+
+    def to_dense(self):
+        """Densify via scatter-add (duplicate rows accumulate, matching the
+        reference's merge-on-read semantics)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.tensor.tensor import Tensor
+
+        dense = jnp.zeros((self._height,) + tuple(self._value.shape[1:]),
+                          self._value.dtype)
+        return Tensor(dense.at[jnp.asarray(self._rows)].add(self._value))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self._rows.tolist()[:8]}"
+                f"{'...' if len(self._rows) > 8 else ''}, "
+                f"value shape={tuple(self._value.shape)})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows into unique ones (reference
+    merge_selected_rows op — applied before optimizer updates / PS push)."""
+    import jax.numpy as jnp
+
+    uniq, inv = np.unique(sr.rows, return_inverse=True)
+    merged = jnp.zeros((len(uniq),) + tuple(sr._value.shape[1:]),
+                       sr._value.dtype)
+    merged = merged.at[jnp.asarray(inv)].add(sr._value)
+    return SelectedRows(uniq, merged, sr.height())
+
+
+class StringTensor:
+    """String array (reference phi/core/string_tensor.h): shape + pstring
+    storage; the host-side value type tokenizer-style ops consume."""
+
+    def __init__(self, data, name=""):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return StringTensor(out) if isinstance(out, np.ndarray) else out
+
+    def __len__(self):
+        return self._data.shape[0] if self._data.ndim else 1
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"

@@ -2,6 +2,7 @@
 (python/paddle/tensor/__init__.py + tensor_method_patch parity)."""
 from paddle_tpu.tensor.tensor import Tensor, Parameter, is_tensor  # noqa: F401
 from paddle_tpu.tensor import (  # noqa: F401
+    array,
     creation,
     extra_ops,
     linalg,
@@ -9,6 +10,9 @@ from paddle_tpu.tensor import (  # noqa: F401
     manipulation,
     math,
     random,
+)
+from paddle_tpu.tensor.array import (  # noqa: F401
+    array_length, array_read, array_write, create_array,
 )
 
 _METHOD_SOURCES = [math, manipulation, logic, linalg, creation, extra_ops]

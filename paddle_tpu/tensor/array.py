@@ -1,0 +1,57 @@
+"""TensorArray ops (reference python/paddle/tensor/array.py over
+phi/core/tensor_array.h).
+
+Dynamic mode follows the reference exactly: a TensorArray IS a Python list
+of Tensors; these ops index it with Tensor or int positions.  Under
+``paddle.jit.to_static`` tracing the list ops work unchanged when indices
+are concrete; data-dependent indices belong in ``static.nn.while_loop``
+whose carried arrays are stacked tensors (the XLA-friendly formulation —
+LoD_TENSOR_ARRAY as a VarType is unnecessary by design).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.tensor.tensor import Tensor
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def _idx(i):
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.numpy()).reshape(()))
+    return int(i)
+
+
+def create_array(dtype, initialized_list=None):
+    """reference array.py create_array: a (typed) TensorArray."""
+    arr = list(initialized_list) if initialized_list is not None else []
+    for v in arr:
+        if not isinstance(v, Tensor):
+            raise TypeError(
+                f"initialized_list items must be Tensors, got {type(v)}")
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i``; growing the array like the reference
+    (write at i == len appends; i > len raises)."""
+    if array is None:
+        array = []
+    pos = _idx(i)
+    if pos > len(array):
+        raise IndexError(
+            f"array_write position {pos} beyond array length {len(array)}")
+    if pos == len(array):
+        array.append(x)
+    else:
+        array[pos] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_idx(i)]
+
+
+def array_length(array):
+    return Tensor(np.int64(len(array)))

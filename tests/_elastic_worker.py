@@ -1,0 +1,97 @@
+"""Elastic worker (tests/test_elastic_worldsize.py): reads the launcher env
+contract, forms the multi-process global mesh, trains ZeRO-1, checkpoints
+every step, and (attempt 0 only) rank 1 dies mid-run to trigger the elastic
+scale-in relaunch at a SMALLER world size.
+
+argv: workdir steps
+"""
+import json
+import os
+import sys
+
+
+def main():
+    workdir, steps = sys.argv[1], int(sys.argv[2])
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    attempt = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    # single-node multi-process world: every trainer is a jax "node"
+    os.environ["PADDLE_NNODES"] = str(world)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.static.functionalize import build_train_step
+
+    dist.init_parallel_env()
+    assert jax.process_count() == world
+
+    paddle.seed(7)
+    model = nn.Linear(16, 16)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                                 parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "os")
+    dp = paddle.DataParallel(model)
+    step = build_train_step(dp, nn.MSELoss(), opt, donate=False)
+
+    ckpt = os.path.join(workdir, "ckpt")
+    start = 0
+    if os.path.exists(os.path.join(ckpt, "metadata.json")):
+        tensors = {k: paddle.Tensor(v) for k, v in step._params.items()}
+        tensors.update({f"opt/{n}/{k}": paddle.Tensor(v)
+                        for n, d in step._states.items()
+                        if isinstance(d, dict) for k, v in d.items()})
+        load_state_dict(tensors, ckpt)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from paddle_tpu.distributed.parallel_env import world_mesh
+
+        rep = NamedSharding(world_mesh(), PartitionSpec())
+        for key, t in tensors.items():
+            if key.startswith("opt/"):
+                _, n, kk = key.split("/", 2)
+                step._states[n][kk] = t.data
+            else:
+                step._params[key] = jax.device_put(np.asarray(t.data), rep)
+        with open(os.path.join(workdir, "progress.json")) as f:
+            start = json.load(f)["step"]
+
+    rng = np.random.RandomState(11)
+    losses = []
+    for i in range(steps):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = (x * 0.5 + 0.1).astype(np.float32)
+        if i < start:
+            continue  # replay the data stream to the resume point
+        loss = step(paddle.Tensor(x), paddle.Tensor(y))
+        losses.append(float(np.asarray(loss.numpy())))
+        sd = {**step._params,
+              **{f"opt/{n}/{k}": v for n, d in step._states.items()
+                 if isinstance(d, dict) for k, v in d.items()}}
+        save_state_dict(sd, ckpt)
+        if rank == 0:
+            with open(os.path.join(workdir, "progress.json"), "w") as f:
+                json.dump({"step": i + 1}, f)
+        if attempt == 0 and rank == world - 1 and i == 2:
+            os._exit(17)  # die mid-training: triggers elastic scale-in
+
+    with open(os.path.join(workdir, f"result_a{attempt}_r{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "world_devices": jax.device_count(),
+                   "processes": jax.process_count(), "start": start,
+                   "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()

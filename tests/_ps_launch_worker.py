@@ -1,0 +1,65 @@
+"""Role-branched PS job script (tests/test_launch_modes.py).
+
+Launched by PSController with the reference PS env contract: PSERVER
+processes host rpc table servers; TRAINER processes train sparse rows
+through PsWorker and signal completion through the rendezvous store.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    out_dir = sys.argv[1]
+    role = os.environ["TRAINING_ROLE"]
+    host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+
+    from paddle_tpu.core.native import TCPStore
+    from paddle_tpu.distributed import rpc
+
+    store = TCPStore(host, int(port))
+    n_servers = int(os.environ["PADDLE_PSERVER_NUM"])
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    if role == "PSERVER":
+        sid = os.environ["PADDLE_SERVER_ID"]
+        rpc.init_rpc(f"ps{sid}")
+        store.set(f"ps_ready:{sid}", b"1")
+        store.wait("ps_job_done", timeout_ms=120_000)
+        return
+
+    assert role == "TRAINER"
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import PsWorker
+
+    rpc.init_rpc(f"trainer{tid}")
+    for s in range(n_servers):
+        store.wait(f"ps_ready:{s}", timeout_ms=60_000)
+    worker = PsWorker([f"ps{s}" for s in range(n_servers)])
+    if tid == 0:
+        worker.create_sparse_table("tbl", 4, accessor="sgd", lr=0.5)
+        store.set("tbl_ready", b"1")
+    else:
+        store.wait("tbl_ready", timeout_ms=60_000)
+    ids = np.array([1, 5, 9], np.int64)
+    before = worker.pull_sparse("tbl", ids)
+    worker.push_sparse("tbl", ids, np.ones((3, 4), np.float32))
+    after = worker.pull_sparse("tbl", ids)
+    with open(os.path.join(out_dir, f"trainer_{tid}.json"), "w") as f:
+        json.dump({"tid": tid,
+                   "moved": float(np.abs(after - before).sum())}, f)
+    done = store.add("trainers_done", 1)
+    if tid == 0:
+        import time
+
+        # wait for peers, then release the servers
+        while done < n_trainers:
+            time.sleep(0.05)
+            done = store.add("trainers_done", 0)
+        store.set("ps_job_done", b"1")
+
+
+if __name__ == "__main__":
+    main()

@@ -92,7 +92,10 @@ class TestGradScaleSemantics:
         d_sum = self._train("sum")
         np.testing.assert_allclose(d_sum, d_avg * 8, rtol=1e-5, atol=1e-7)
 
-    def test_use_reduce_avg_false_equivalent(self):
+    def test_use_reduce_avg_is_numerically_neutral(self):
+        """reference tensor_fusion_helper.py:681: use_reduce_avg=False means
+        SUM-reduce + explicit 1/nranks scale — identical numerics, a comm
+        precision knob.  It must NOT rescale gradients here."""
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
             "dp_degree": 8,
@@ -102,7 +105,7 @@ class TestGradScaleSemantics:
         opt = paddle.optimizer.SGD(
             learning_rate=0.1, parameters=nn.Linear(2, 2).parameters())
         opt = fleet.distributed_optimizer(opt, strategy)
-        assert getattr(opt, "_grad_rescale", 1.0) == 8.0
+        assert getattr(opt, "_grad_rescale", 1.0) == 1.0
 
 
 class TestFlagBreadth:
