@@ -5,6 +5,10 @@ TPU-native deployment: the saved model is a serialized jax.export artifact
 (paddle.jit.save writes model.jaxexport next to the weights); the Predictor
 deserializes and executes it — the analysis-pass pipeline of the reference is
 XLA's own optimization pipeline here."""
+from paddle_tpu.inference.passes import (  # noqa: F401
+    PassPipeline, apply_inference_passes, conv_bn_fuse_pass,
+    delete_dropout_op_pass,
+)
 from paddle_tpu.inference.wrapper import (
     Config, DataType, PlaceType, Predictor, PredictorPool, Tensor,
     convert_to_mixed_precision, create_predictor, get_num_bytes_of_data_type,

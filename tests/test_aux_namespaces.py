@@ -359,3 +359,57 @@ class TestInferenceConfigHonesty:
             cfg.enable_memory_optim()
             cfg.disable_gpu()
         assert not w
+
+
+class TestInferencePasses:
+    """Parameter-rewrite pass pipeline (reference ir/conv_bn_fuse_pass.cc +
+    pass_builder API); graph fusions remain XLA's job by design."""
+
+    def _bn_with_stats(self, bn, rs):
+        n = bn._mean.shape[0]
+        bn._mean._data = paddle.to_tensor(rs.rand(n).astype("float32")).data
+        bn._variance._data = paddle.to_tensor(
+            (rs.rand(n) + 0.5).astype("float32")).data
+        bn.weight._data = paddle.to_tensor(
+            (rs.rand(n) + 0.5).astype("float32")).data
+        bn.bias._data = paddle.to_tensor(rs.rand(n).astype("float32")).data
+
+    def test_conv_bn_fuse_preserves_numerics(self):
+        from paddle_tpu.inference import (PassPipeline,
+                                          apply_inference_passes)
+
+        rs = np.random.RandomState(3)
+        paddle.seed(4)
+        net = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+            nn.Dropout(0.5),
+            nn.Conv2D(8, 4, 1, bias_attr=False), nn.BatchNorm2D(4),
+        )
+        net.eval()
+        for m in net:
+            if isinstance(m, nn.BatchNorm2D):
+                self._bn_with_stats(m, rs)
+        x = paddle.to_tensor(rs.rand(2, 3, 8, 8).astype("float32"))
+        before = net(x).numpy()
+        stats = apply_inference_passes(net)
+        after = net(x).numpy()
+        np.testing.assert_allclose(after, before, rtol=2e-5, atol=2e-5)
+        assert stats["conv_bn_fuse_pass"] == 2
+        assert stats["delete_dropout_op_pass"] == 1
+        assert isinstance(net[3], nn.Identity)
+        # a bias-less conv gained the folded bias
+        assert net[4].bias is not None
+
+    def test_pass_builder_api(self):
+        from paddle_tpu.inference import Config
+
+        cfg = Config()
+        pb = cfg.pass_builder()
+        assert "conv_bn_fuse_pass" in pb.all_passes()
+        pb.delete_pass("conv_bn_fuse_pass")
+        assert "conv_bn_fuse_pass" not in pb.all_passes()
+        calls = []
+        pb.append_pass("my_pass", lambda m: calls.append(m) or 1)
+        net = nn.Linear(2, 2)
+        stats = pb.apply(net)
+        assert stats["my_pass"] == 1 and calls == [net]

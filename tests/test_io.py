@@ -306,3 +306,4 @@ class TestProcessWorkers:
                         use_process_workers=True)
         with pytest.raises(ValueError, match="picklable"):
             list(dl)
+
