@@ -51,6 +51,22 @@ def conv_bn_fuse_pass(model):
             "model.eval() first (train-mode BN uses batch stats and would "
             "double-transform activations)")
     fused = 0
+    # single-consumer check (the reference pass's graph property): a conv
+    # module that appears under MORE than one parent is shared — folding one
+    # consumer's BN into it would corrupt every other consumer, so count
+    # occurrences first and fuse only convs with exactly one appearance
+    conv_count = {}
+
+    def count(layer, seen_layers):
+        if id(layer) in seen_layers:
+            return
+        seen_layers.add(id(layer))
+        for _, child in _iter_named_children(layer):
+            if isinstance(child, nn.Conv2D):
+                conv_count[id(child)] = conv_count.get(id(child), 0) + 1
+            count(child, seen_layers)
+
+    count(model, set())
 
     def visit(layer):
         nonlocal fused
@@ -65,9 +81,14 @@ def conv_bn_fuse_pass(model):
                 continue
             if getattr(conv, "_groups", 1) not in (1,):
                 continue  # grouped convs keep their BN (reference skip list)
-            gamma = np.asarray(bn.weight.numpy(), np.float64)
-            beta = np.asarray(bn.bias.numpy(), np.float64)
+            if conv_count.get(id(conv), 0) != 1:
+                continue  # shared conv: other consumers would see fused weights
             mean = np.asarray(bn._mean.numpy(), np.float64)
+            # affine-less BN (weight_attr/bias_attr=False): gamma=1, beta=0
+            gamma = (np.asarray(bn.weight.numpy(), np.float64)
+                     if bn.weight is not None else np.ones_like(mean))
+            beta = (np.asarray(bn.bias.numpy(), np.float64)
+                    if bn.bias is not None else np.zeros_like(mean))
             var = np.asarray(bn._variance.numpy(), np.float64)
             eps = float(getattr(bn, "_epsilon", 1e-5))
             scale = gamma / np.sqrt(var + eps)

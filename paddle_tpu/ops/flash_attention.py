@@ -161,15 +161,13 @@ def _pick_block(n: int, preferred: int, kind: str = "") -> int:
     return b
 
 
-def _row_blocks(lq: int, group: int, target: int = 256):
-    """block_q for a G-grouped kernel: keep the score tile's row count
-    (block_q*G) near ``target`` so VMEM footprint and MXU shape are
-    independent of the GQA group size.  r4 on-chip sweep (v5e, B16 L2048
-    D128, causal): fwd wants rows=256 (GQA4 q64/k1024 11.9ms < q128 14.6;
-    MHA q256/k1024 29.1ms, q512/k1024 overflows the 16M scoped vmem); the
-    dq/dkv passes stream q and amortize better at larger rows (see call
-    sites).  block_q itself is capped at 256: 512-row blocks with a 128-lane
-    minor dim blow the scoped-vmem budget in every pass."""
+def _row_blocks(lq: int, group: int, target: int = 1024):
+    """block_q for a G-grouped kernel.  r4 full-bench sweep (v5e, GQA4
+    B16 L2048 D128, causal block-skip kernels): q256/k512 is the optimum —
+    MFU 0.570 vs 0.549 @ q64-128/k1024, 0.554 @ q64/k512, 0.540 @ q512/k256
+    (q >= 512 with k512 overflows the 16M scoped vmem).  Expressed as a
+    1024-row target with block_q capped at 256; block_k default 512 at the
+    call sites."""
     block_q = _pick_block(lq, max(8, min(256, target // group)), "q")
     return block_q
 
@@ -187,8 +185,8 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
     d = hd_packed // num_heads
     g = num_heads // num_kv_heads
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
-    block_q = _row_blocks(lq, g, target=256)
-    block_k = _pick_block(lk, 1024, "k")
+    block_q = _row_blocks(lq, g)
+    block_k = _pick_block(lk, 512, "k")
     grid = (b, num_kv_heads, lq // block_q)
     # index maps use `i * 0` (not the literal 0) so the constant inherits the
     # i32 index dtype — a literal traces as i64 under jax_enable_x64 and
@@ -395,8 +393,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
     delta = delta.reshape(b, lq, num_kv_heads, g).transpose(0, 2, 1, 3)
     delta = jnp.broadcast_to(
         delta.reshape(b, num_kv_heads, 1, lq * g), lse.shape)
-    block_q = _row_blocks(lq, g, target=512)
-    block_k = _pick_block(lk, 1024, "k")
+    block_q = _row_blocks(lq, g)
+    block_k = _pick_block(lk, 512, "k")
 
     # q blocks stream via the innermost GRID dim; dk/dv blocks (index maps
     # q-independent) stay resident in VMEM across the q sweep and accumulate

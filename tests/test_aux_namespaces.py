@@ -413,3 +413,62 @@ class TestInferencePasses:
         net = nn.Linear(2, 2)
         stats = pb.apply(net)
         assert stats["my_pass"] == 1 and calls == [net]
+
+
+class TestInferencePassSafety:
+    """Edge cases the pass must not corrupt: affine-less BN and convs with
+    multiple consumers (the reference's single-consumer graph check)."""
+
+    def test_affine_less_bn_fuses(self):
+        from paddle_tpu.inference import apply_inference_passes
+
+        net = nn.Sequential(
+            nn.Conv2D(3, 4, 3),
+            nn.BatchNorm2D(4, weight_attr=False, bias_attr=False))
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 3, 8, 8).astype("float32"))
+        before = net(x).numpy()
+        s = apply_inference_passes(net)
+        assert s["conv_bn_fuse_pass"] == 1
+        np.testing.assert_allclose(net(x).numpy(), before,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_shared_conv_not_fused(self):
+        from paddle_tpu.inference import apply_inference_passes
+
+        paddle.seed(2)
+        conv = nn.Conv2D(3, 4, 3, padding=1)
+        b1, b2 = nn.BatchNorm2D(4), nn.BatchNorm2D(4)
+        rs = np.random.RandomState(1)
+        for b in (b1, b2):
+            b._mean._data = paddle.to_tensor(
+                rs.rand(4).astype("float32")).data
+            b._variance._data = paddle.to_tensor(
+                (rs.rand(4) + 0.5).astype("float32")).data
+
+        class TwoBranch(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Sequential(conv, b1)
+                self.b = nn.Sequential(conv, b2)
+
+            def forward(self, x):
+                return self.a(x) + self.b(x)
+
+        m = TwoBranch()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 3, 8, 8).astype("float32"))
+        before = m(x).numpy()
+        s = apply_inference_passes(m)
+        assert s["conv_bn_fuse_pass"] == 0, s
+        np.testing.assert_allclose(m(x).numpy(), before)
+
+    def test_train_mode_rejected(self):
+        from paddle_tpu.inference import conv_bn_fuse_pass
+
+        net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+        net.train()
+        with pytest.raises(RuntimeError, match="inference-only"):
+            conv_bn_fuse_pass(net)
