@@ -191,3 +191,37 @@ class TestUnparsedCorpora:
         f.write_bytes(b"x")
         with pytest.raises(NotImplementedError):
             WMT14(data_file=str(f))
+
+
+class TestWMT16:
+    def _make_tar(self, path):
+        train = "the cat sat\tdie katze sass\na dog ran\tein hund lief\n" * 5
+        val = "the dog sat\tder hund sass\n"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, data in (("wmt16/train", train), ("wmt16/val", val),
+                               ("wmt16/test", val)):
+                _tar_add(tf, name, data.encode())
+
+    def test_parse_and_marks(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT16
+
+        p = str(tmp_path / "wmt16.tar.gz")
+        self._make_tar(p)
+        ds = WMT16(data_file=p, mode="train", lang="en")
+        assert len(ds) == 10
+        src, trg, trg_next = ds[0]
+        assert src[0] == 0 and src[-1] == 1      # <s> ... <e>
+        assert trg[0] == 0 and trg_next[-1] == 1  # shifted pair
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        # lang='de' swaps source/target columns
+        de = WMT16(data_file=p, mode="val", lang="de")
+        assert len(de) == 1
+        # dict truncation keeps the 3 marks + top words
+        small = WMT16(data_file=p, mode="train", lang="en", src_dict_size=5)
+        assert len(small.src_dict) == 5
+
+    def test_raises_without_path(self):
+        from paddle_tpu.text.datasets import WMT16
+
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            WMT16()
