@@ -21,6 +21,19 @@ __all__ = ['Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing',
            'WMT14', 'WMT16']
 
 
+def _tar_member(tf, name):
+    """extractfile with the './'-prefix fallback — archives repacked as
+    'tar -czf x ./dir' store members with a leading './'."""
+    for cand in (name, "./" + name):
+        try:
+            f = tf.extractfile(cand)
+            if f is not None:
+                return f
+        except KeyError:
+            continue
+    raise KeyError(name)
+
+
 def _require_file(data_file, name, expected):
     if data_file is None:
         raise RuntimeError(
@@ -134,15 +147,7 @@ class Imikolov(Dataset):
             word_freq[b'<e>'] += 1
         return word_freq
 
-    @staticmethod
-    def _member(tf, name):
-        # archives in the wild use './simple-examples/...' or bare paths
-        for cand in (name, "./" + name):
-            try:
-                return tf.extractfile(cand)
-            except KeyError:
-                continue
-        raise KeyError(name)
+    _member = staticmethod(_tar_member)
 
     def _build_word_dict(self):
         with tarfile.open(self.data_file) as tf:
@@ -278,32 +283,225 @@ class Movielens(Dataset):
         return len(self.data)
 
 
-class _NeedsLocalCorpus(Dataset):
-    """Multi-file corpora whose reference loaders also need dictionary /
-    alignment side-files from the archive; raises either way (no download,
-    and the side-file layout is not parsed here)."""
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py:117): parses the
+    conll05st-release test.wsj words/props gzip members plus the side
+    dictionaries (wordDict.txt / verbDict.txt / targetDict.txt, one entry
+    per line) and yields the reference's 9-field sample
+    (word, 5 predicate-context columns, predicate, mark, label ids)."""
 
-    name = "dataset"
-    expected = "the reference archive"
+    UNK_IDX = 0
 
-    def __init__(self, *a, **kw):
-        data_file = kw.get("data_file") or (a[0] if a else None)
-        _require_file(data_file, self.name, self.expected)
-        raise NotImplementedError(
-            f"{self.name}: local-file parsing for this corpus's dictionary/"
-            "alignment layout is not implemented; wrap the files in a custom "
-            "paddle.io.Dataset"
-        )
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=False):
+        import gzip
+
+        self.data_file = _require_file(
+            data_file, "Conll05st", "conll05st-tests.tar.gz")
+        self.word_dict = self._load_dict(_require_file(
+            word_dict_file, "Conll05st", "wordDict.txt"))
+        self.predicate_dict = self._load_dict(_require_file(
+            verb_dict_file, "Conll05st", "verbDict.txt"))
+        self.label_dict = self._load_label_dict(_require_file(
+            target_dict_file, "Conll05st", "targetDict.txt"))
+        self.emb_file = emb_file
+        self._gzip = gzip
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        tags = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        index = 0
+        for tag in tags:
+            d["B-" + tag] = index
+            d["I-" + tag] = index + 1
+            index += 2
+        d["O"] = index
+        return d
+
+    def _parse_bracket_labels(self, lbl):
+        """reference conll05.py:258 star-bracket decoding."""
+        cur_tag, in_bracket, seq = "O", False, []
+        for l in lbl:
+            if l == "*" and not in_bracket:
+                seq.append("O")
+            elif l == "*" and in_bracket:
+                seq.append("I-" + cur_tag)
+            elif l == "*)":
+                seq.append("I-" + cur_tag)
+                in_bracket = False
+            elif "(" in l and ")" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = False
+            elif "(" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return seq
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = _tar_member(
+                tf, "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = _tar_member(
+                tf, "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with self._gzip.GzipFile(fileobj=wf) as words, \
+                    self._gzip.GzipFile(fileobj=pf) as props:
+                sentence, one_seg = [], []
+                for word, label in zip(words, props):
+                    word = word.strip().decode()
+                    label = label.strip().decode().split()
+                    if label:
+                        sentence.append(word)
+                        one_seg.append(label)
+                        continue
+                    # end of sentence: column 0 is the predicate column,
+                    # columns 1.. are per-predicate bracketed role rows
+                    cols = [[row[i] for row in one_seg]
+                            for i in range(len(one_seg[0]))] if one_seg else []
+                    if cols:
+                        verbs = [x for x in cols[0] if x != "-"]
+                        for i, lbl in enumerate(cols[1:]):
+                            self.sentences.append(sentence)
+                            self.predicates.append(verbs[i])
+                            self.labels.append(
+                                self._parse_bracket_labels(lbl))
+                    sentence, one_seg = [], []
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, name in ((-2, "n2"), (-1, "n1"), (0, "c0"), (1, "p1"),
+                          (2, "p2")):
+            j = v + off
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = "bos" if off < 0 else "eos"
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        cols = [[wd.get(ctx[k], self.UNK_IDX)] * n
+                for k in ("n2", "n1", "c0", "p1", "p2")]
+        if predicate not in self.predicate_dict:
+            raise KeyError(
+                f"Conll05st: predicate {predicate!r} missing from verbDict")
+        pred_idx = [self.predicate_dict[predicate]] * n
+        try:
+            label_idx = [self.label_dict[w] for w in labels]
+        except KeyError as e:
+            raise KeyError(
+                f"Conll05st: role tag {e.args[0]!r} missing from targetDict"
+            ) from None
+        return (np.array(word_idx), *[np.array(c) for c in cols],
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
 
 
-class Conll05st(_NeedsLocalCorpus):
-    name = "Conll05st"
-    expected = "conll05st-tests.tar.gz + the SRL dict/emb files"
+class WMT14(Dataset):
+    """WMT14 en->fr (reference text/datasets/wmt14.py:113): parses the
+    wmt14.tgz archive — members ending in src.dict / trg.dict give the
+    line-ranked dictionaries, members ending in {mode}/{mode} hold the
+    tab-separated parallel corpus.  <s>=0, <e>=1, <unk>=2 by dict order;
+    train sequences longer than 80 tokens are dropped (reference rule)."""
 
+    START, END, UNK_IDX = "<s>", "<e>", 2
 
-class WMT14(_NeedsLocalCorpus):
-    name = "WMT14"
-    expected = "wmt14.tgz (train/test/gen + dict files)"
+    def __init__(self, data_file=None, mode='train', dict_size=-1,
+                 download=False):
+        assert mode in ('train', 'test', 'gen'), mode
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.data_file = _require_file(
+            data_file, "WMT14", "wmt14.tgz (src.dict/trg.dict + "
+            "{train,test,gen} parallel files)")
+        self.mode = mode
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for count, line in enumerate(fd):
+                if count >= size:
+                    break
+                out[line.strip().decode()] = count
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        tail = f"{self.mode}/{self.mode}"
+        self.src_dict = self.trg_dict = None
+        corpus = []  # raw lines; ids resolved after both dicts are seen —
+        # ONE sequential decompression pass (gzip tars re-decompress from the
+        # start on every backward seek; see the WMT16 loader's convention)
+        with tarfile.open(self.data_file) as f:
+            for m in f:
+                if m.name.endswith("src.dict"):
+                    self.src_dict = to_dict(f.extractfile(m), self.dict_size)
+                elif m.name.endswith("trg.dict"):
+                    self.trg_dict = to_dict(f.extractfile(m), self.dict_size)
+                elif m.name.endswith(tail):
+                    corpus.extend(f.extractfile(m).read().splitlines())
+        assert self.src_dict is not None and self.trg_dict is not None, (
+            "wmt14 archive must carry src.dict and trg.dict members")
+        if not corpus:
+            raise ValueError(
+                f"WMT14: no corpus member ending in {tail!r} found in "
+                f"{self.data_file!r} — not the reference wmt14.tgz layout")
+        for line in corpus:
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src_ids = [self.src_dict.get(w, self.UNK_IDX)
+                       for w in [self.START, *parts[0].split(), self.END]]
+            trg = [self.trg_dict.get(w, self.UNK_IDX)
+                   for w in parts[1].split()]
+            if len(src_ids) > 80 or len(trg) > 80:
+                continue
+            self.src_ids.append(src_ids)
+            self.trg_ids.append([self.trg_dict[self.START], *trg])
+            self.trg_ids_next.append([*trg, self.trg_dict[self.END]])
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
 
 
 class WMT16(Dataset):
@@ -336,15 +534,7 @@ class WMT16(Dataset):
                 trg_dict_size if trg_dict_size > 0 else big)
             self._load_data(tf)
 
-    def _member(self, tf, name):
-        for cand in (name, "./" + name):
-            try:
-                f = tf.extractfile(cand)
-                if f is not None:
-                    return f
-            except KeyError:
-                continue
-        raise KeyError(name)
+    _member = staticmethod(_tar_member)
 
     def _count_both(self, tf):
         en = collections.defaultdict(int)

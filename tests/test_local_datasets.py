@@ -183,14 +183,34 @@ class TestTESS:
             TESS()
 
 
-class TestUnparsedCorpora:
-    def test_wmt_still_raises_with_reason(self, tmp_path):
+class TestWMT14:
+    def _make_tar(self, path):
+        src_dict = "<s>\n<e>\n<unk>\nthe\ncat\nsat\n"
+        trg_dict = "<s>\n<e>\n<unk>\nle\nchat\nassis\n"
+        train = "the cat sat\tle chat assis\n" + ("x " * 100) + "\ty\n"
+        with tarfile.open(path, "w:gz") as tf:
+            _tar_add(tf, "wmt14/src.dict", src_dict.encode())
+            _tar_add(tf, "wmt14/trg.dict", trg_dict.encode())
+            _tar_add(tf, "wmt14/train/train", train.encode())
+            _tar_add(tf, "wmt14/test/test", b"the cat\tle chat\n")
+
+    def test_parse_dicts_and_corpus(self, tmp_path):
+        p = str(tmp_path / "wmt14.tgz")
+        self._make_tar(p)
+        ds = WMT14(data_file=p, mode="train", dict_size=6)
+        assert len(ds) == 1  # the >80-token line is dropped (reference rule)
+        s, t, tn = ds[0]
+        assert s[0] == 0 and s[-1] == 1  # <s> ... <e>
+        np.testing.assert_array_equal(t[1:], tn[:-1])
+        te = WMT14(data_file=p, mode="test", dict_size=6)
+        assert len(te) == 1
+        fwd, _ = ds.get_dict()
+        rev, _ = ds.get_dict(reverse=True)
+        assert rev[fwd["the"]] == "the"
+
+    def test_raises_without_path(self):
         with pytest.raises(RuntimeError, match="zero-egress"):
-            WMT14()
-        f = tmp_path / "wmt14.tgz"
-        f.write_bytes(b"x")
-        with pytest.raises(NotImplementedError):
-            WMT14(data_file=str(f))
+            WMT14(dict_size=10)
 
 
 class TestWMT16:
@@ -225,3 +245,50 @@ class TestWMT16:
 
         with pytest.raises(RuntimeError, match="zero-egress"):
             WMT16()
+
+
+class TestConll05st:
+    def _make_corpus(self, tmp_path):
+        import gzip
+
+        words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+        # col0: predicate column; col1: one bracketed role row per predicate
+        # (whitespace-split columns, one word per line)
+        props = ("- (A0*\nsat *)\n- (V*)\n\n"
+                 "bark (V*)\n- *\n\n")
+        tar = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                     gzip.compress(words.encode()))
+            _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                     gzip.compress(props.encode()))
+        wd = tmp_path / "wordDict.txt"
+        wd.write_text("<unk>\nThe\ncat\nsat\nDogs\nbark\n")
+        vd = tmp_path / "verbDict.txt"
+        vd.write_text("sat\nbark\n")
+        td = tmp_path / "targetDict.txt"
+        td.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+        return str(tar), str(wd), str(vd), str(td)
+
+    def test_parse_srl_samples(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+
+        tar, wd, vd, td = self._make_corpus(tmp_path)
+        ds = Conll05st(data_file=tar, word_dict_file=wd, verb_dict_file=vd,
+                       target_dict_file=td, emb_file=wd)
+        assert len(ds) == 2  # one predicate row per sentence here
+        sample = ds[0]
+        assert len(sample) == 9
+        word_idx, *ctx, pred_idx, mark, label_idx = sample
+        n = len(word_idx)
+        assert all(len(c) == n for c in ctx)
+        assert sum(mark) >= 1 and len(label_idx) == n
+        wdict, pdict, ldict = ds.get_dict()
+        assert "B-V" in ldict and "O" in ldict
+        assert ds.get_embedding() == wd
+
+    def test_raises_without_files(self):
+        from paddle_tpu.text.datasets import Conll05st
+
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            Conll05st()
