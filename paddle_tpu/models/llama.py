@@ -199,16 +199,10 @@ class LlamaAttention(Layer):
                 v = M.concat([pv, v], axis=1)
             new_cache = (k, v)
 
-        # GQA kv heads are consumed NATIVELY by the attention paths below
-        # (flash kernel blocks over kv heads so the G query heads sharing a
-        # kv head reuse the streamed K/V — no jnp.repeat, KV HBM traffic /G);
-        # only ring attention still wants expanded heads for its rotation.
+        # GQA kv heads are consumed NATIVELY by every attention path: the
+        # flash kernel blocks over kv heads (KV HBM traffic /G) and ring
+        # attention rotates kv-head-sized shards (ICI bytes /G).
         if cfg.sep_axis is not None:
-            if nkv != nh:
-                from paddle_tpu.ops.flash_attention import repeat_kv
-
-                k, v = apply("repeat_kv",
-                             lambda ka, va: repeat_kv(ka, va, nh // nkv), k, v)
             from paddle_tpu.distributed.auto_parallel.process_mesh import get_mesh
             from paddle_tpu.ops.ring_attention import ring_attention_sharded
 

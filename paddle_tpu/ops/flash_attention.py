@@ -490,20 +490,29 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
     ``return_carry`` expose the online-softmax state (acc, m, l) so callers can
     stitch multiple k/v shards together.  ``q_segments``/``k_segments``
     ([B, Lq] / [B, Lk] int arrays) restrict attention to same-segment pairs —
+    and k/v may carry fewer (kv) heads than q (GQA/MQA, consumed natively) —
     the varlen/packed-sequence masking (flash_attn_unpadded, padding masks):
     tokens never attend across segment boundaries, and rows whose segment id
     is negative (padding) produce zeros.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    hkv = k.shape[2]
+    if hkv <= 0 or h % hkv:
+        raise ValueError(
+            f"blockwise_attention: query heads ({h}) must be a multiple of "
+            f"kv heads ({hkv})")
+    g = h // hkv  # GQA: kv heads consumed natively (no repeat; a ring
+    # rotation of GQA k/v moves 1/g the ICI bytes of expanded heads)
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     block_k = _pick_block(lk, block_k)
     nblocks = lk // block_k
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [B, H, Lq, D]
+    qt5 = qt.reshape(b, hkv, g, lq, d)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    kb = kt.reshape(b, h, nblocks, block_k, d)
-    vb = vt.reshape(b, h, nblocks, block_k, d)
+    kb = kt.reshape(b, hkv, nblocks, block_k, d)
+    vb = vt.reshape(b, hkv, nblocks, block_k, d)
     q_idx = q_offset + jnp.arange(lq)
 
     kseg_b = (None if k_segments is None
@@ -514,9 +523,9 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
         acc, m, l = carry
         kblk, vblk, kb_idx, kseg = blk
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qt, kblk.astype(jnp.float32),
+            "bkgqd,bkcd->bkgqc", qt5, kblk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )
+        ).reshape(b, h, lq, block_k)
         if causal:
             k_idx = k_offset + kb_idx * block_k + jnp.arange(block_k)
             mask = q_idx[:, None] >= k_idx[None, :]
@@ -529,15 +538,19 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32)
-        )
+            "bkgqc,bkcd->bkgqd", p.reshape(b, hkv, g, lq, block_k),
+            vblk.astype(jnp.float32)
+        ).reshape(b, h, lq, d)
         return (acc_new, m_new, l_new), None
 
     if carry_in is None:
+        # derive the init from qt (0*qt) so its type matches the scan body's
+        # outputs under shard_map (a plain zeros constant is unvarying over
+        # the manual axes and trips the carry-type check)
         carry = (
-            jnp.zeros((b, h, lq, d), jnp.float32),
-            jnp.full((b, h, lq), _NEG_INF, jnp.float32),
-            jnp.zeros((b, h, lq), jnp.float32),
+            jnp.zeros_like(qt),
+            jnp.full((b, h, lq), _NEG_INF, jnp.float32) + 0 * qt[..., 0],
+            0 * qt[..., 0],
         )
     else:
         carry = carry_in

@@ -24,7 +24,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
                    block_k: int = 512):
     """Per-device body: full attention of the local q shard against the global
     sequence, k/v rotating around ``axis_name``.  Differentiable (the backward
-    scan re-rotates in reverse via jax AD of the collective)."""
+    scan re-rotates in reverse via jax AD of the collective).  GQA k/v
+    ([B, L/n, Hkv, D], Hkv < H) rotate NATIVELY — 1/G the ICI bytes of
+    expanded heads (blockwise_attention consumes grouped heads directly)."""
     n = int(jax.lax.psum(1, axis_name))  # axis sizes are static under shard_map
     my = jax.lax.axis_index(axis_name).astype(jnp.int32)
     b, lq, h, d = q.shape
@@ -46,10 +48,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
         vnext = jax.lax.ppermute(vcur, axis_name, perm)
         return acc_m_l, (knext, vnext)
 
+    # derive the init from q so its varying-axes type matches the scan
+    # outputs under shard_map with check_vma=True (a plain zeros constant is
+    # unvarying over the manual axes and trips the carry-type check)
+    q0 = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     carry0 = (
-        jnp.zeros((b, h, lq, d), jnp.float32),
-        jnp.full((b, h, lq), _NEG_INF, jnp.float32),
-        jnp.zeros((b, h, lq), jnp.float32),
+        jnp.zeros_like(q0),
+        jnp.full((b, h, lq), _NEG_INF, jnp.float32) + 0 * q0[..., 0],
+        0 * q0[..., 0],
     )
     carry = (carry0, (k, v))
     # unrolled so XLA overlaps each shard's compute with the ppermute of the next
@@ -81,8 +87,15 @@ def ring_attention_sharded(q, k, v, mesh, axis: str, causal: bool = False,
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     """DeepSpeed-Ulysses style: all-to-all so each device gets the FULL sequence
     for a subset of heads, attends locally, all-to-alls back.  [B, L/n, H, D] →
-    [B, L, H/n, D] → attn → [B, L/n, H, D].  Head count must divide the axis."""
+    [B, L, H/n, D] → attn → [B, L/n, H, D].  Head count must divide the axis.
+    GQA: kv heads scatter natively when the axis divides them (1/G the
+    all-to-all bytes); otherwise kv expands to full heads first."""
     n = jax.lax.psum(1, axis_name)
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h and hkv % n != 0:
+        from paddle_tpu.ops.flash_attention import repeat_kv
+
+        k, v = repeat_kv(k, v, h // hkv)
 
     def a2a(x, split_axis, concat_axis):
         return jax.lax.all_to_all(
