@@ -25,7 +25,7 @@ def main():
         sid = os.environ["PADDLE_SERVER_ID"]
         rpc.init_rpc(f"ps{sid}")
         store.set(f"ps_ready:{sid}", b"1")
-        store.wait("ps_job_done", timeout_ms=120_000)
+        store.wait("ps_job_done", timeout_ms=300_000)
         return
 
     assert role == "TRAINER"
@@ -36,13 +36,13 @@ def main():
 
     rpc.init_rpc(f"trainer{tid}")
     for s in range(n_servers):
-        store.wait(f"ps_ready:{s}", timeout_ms=60_000)
+        store.wait(f"ps_ready:{s}", timeout_ms=180_000)
     worker = PsWorker([f"ps{s}" for s in range(n_servers)])
     if tid == 0:
         worker.create_sparse_table("tbl", 4, accessor="sgd", lr=0.5)
         store.set("tbl_ready", b"1")
     else:
-        store.wait("tbl_ready", timeout_ms=60_000)
+        store.wait("tbl_ready", timeout_ms=180_000)
     ids = np.array([1, 5, 9], np.int64)
     before = worker.pull_sparse("tbl", ids)
     worker.push_sparse("tbl", ids, np.ones((3, 4), np.float32))

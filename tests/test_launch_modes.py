@@ -27,7 +27,7 @@ rpc.shutdown()
 """
 
 
-def _run_launcher(args, timeout=180):
+def _run_launcher(args, timeout=360):
     env = {**os.environ, "PYTHONPATH": REPO}
     return subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch", *args],
