@@ -72,6 +72,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
     world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    _STATE["world_size"] = int(world_size)
     master = master_endpoint or os.environ.get("PADDLE_MASTER")
     # cross-host: bind + advertise the IP the master route uses (the
     # gethostbyname analog) — only that interface, not 0.0.0.0; single host
@@ -152,8 +153,31 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
     return pool.submit(_call, to, fn, args, kwargs)
 
 
-def shutdown():
+def shutdown(graceful=True):
+    """Tear down this worker's rpc server.  ``graceful`` (the torch/reference
+    semantics: every worker calls shutdown) synchronizes through the store
+    so NO worker closes its server while a peer may still have calls in
+    flight — without it, a fast worker's teardown resets the slow worker's
+    connection mid-request."""
     cur, store = _STATE["current"], _STATE["store"]
+    world = int(_STATE.get("world_size", 1) or 1)
+    if graceful and store is not None and world > 1:
+        import os
+        import time
+
+        epoch = os.environ.get("PADDLE_RESTART_COUNT", "0")
+        key = f"rpc:shutdown_barrier/e{epoch}"
+        n = store.add(key, 1)
+        deadline = time.time() + 120
+        while n < world and time.time() < deadline:
+            time.sleep(0.02)
+            n = store.add(key, 0)
+        if n < world:
+            import logging
+
+            logging.getLogger("paddle_tpu.rpc").warning(
+                "rpc.shutdown: only %d/%d workers reached the shutdown "
+                "barrier within 120s; closing anyway", n, world)
     if cur is not None and store is not None:
         try:  # drop the stale endpoint so peers get 'unknown worker', not a
               # connection to a dead port
