@@ -219,7 +219,13 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
                         raise TimeoutError(
                             "async checkpoint: shard markers missing after "
                             "600s (is the checkpoint dir on shared storage?)"
-                            f": {[m for m in want if not os.path.exists(m)]}")
+                            f": {[m for m in want if not os.path.exists(m)]}. "
+                            f"This save's tag is {tag!r} (derived from the "
+                            "restart epoch + this process's per-path save "
+                            "sequence) — every rank must call save_state_dict "
+                            "the same number of times per path, or tags "
+                            "desynchronize and ranks wait on markers that "
+                            "will never appear (ADVICE r4).")
                     time.sleep(0.05)
                 # every rank has entered THIS save (its shards_done marker is
                 # written strictly after it finished waiting on the previous
@@ -253,7 +259,11 @@ def _write_blocks(path, meta, blocks, rank, world, coordinator_rank, store,
                 if time.time() > deadline:
                     raise TimeoutError(
                         "async checkpoint: coordinator metadata marker "
-                        "missing after 600s")
+                        f"{done!r} missing after 600s. Ranks must call "
+                        "save_state_dict the same number of times per path "
+                        "(the marker tag encodes the per-path save sequence); "
+                        "a rank-local conditional save or an unsynchronized "
+                        "retry desynchronizes the tags (ADVICE r4).")
                 time.sleep(0.05)
         if multiproc and not on_writer_thread:
             from jax.experimental import multihost_utils

@@ -35,15 +35,61 @@ class PSController(CollectiveController):
         self.trainer_num = int(trainer_num)
         self.server_procs = []
         self.trainer_procs = []
+        self._ports = None  # probe-bound free ports, assigned in run()
+
+    @staticmethod
+    def _alloc_ports(n, start):
+        """Probe ``n`` free ports walking up from ``start`` (the rendezvous
+        port + 1), SKIPPING occupied ones.  The r4 scheme assigned
+        consecutive ports blindly — any port in the range held by an
+        unrelated process made that worker fail to bind (ADVICE r4).
+        Probing near the rendezvous port (typically outside
+        ip_local_port_range) rather than bind(0) keeps the kernel from
+        handing a probed port to an unrelated outgoing connect() in the
+        probe→spawn window (review r5); sockets are held open until all
+        ``n`` are found so one launch cannot allocate a port twice.  No
+        SO_REUSEADDR on the probe: with it, a TIME_WAIT-held port would
+        probe free but fail the worker's plain bind."""
+        import socket
+
+        socks, ports = [], []
+        try:
+            p = start
+            while len(ports) < n:
+                if p > 65535:
+                    raise RuntimeError(
+                        f"PS launch: no {n} free ports above {start}")
+                s = socket.socket()
+                try:
+                    s.bind(("", p))
+                except OSError:
+                    s.close()
+                    p += 1
+                    continue
+                socks.append(s)
+                ports.append(p)
+                p += 1
+        finally:
+            for s in socks:
+                s.close()
+        return ports
+
+    def _port_of(self, role, idx):
+        return self._ports[idx if role == "PSERVER"
+                           else self.server_num + idx]
 
     # --------------------------------------------------------------- env
     def _ps_env(self, role, idx, host, port):
         """Reference ps.py env contract (controllers/ps.py _build_pod_*)."""
         world = self.trainer_num
+        if self._ports is None:
+            self._ports = self._alloc_ports(self.server_num + world,
+                                            port + 1)
         server_eps = ",".join(
-            f"{host}:{port + 1 + s}" for s in range(self.server_num))
+            f"{host}:{self._ports[s]}" for s in range(self.server_num))
         trainer_eps = ",".join(
-            f"{host}:{port + 1 + self.server_num + t}" for t in range(world))
+            f"{host}:{self._ports[self.server_num + t]}"
+            for t in range(world))
         env = dict(self.base_env)
         env.update({
             "PADDLE_MASTER": f"{host}:{port}",
@@ -55,7 +101,7 @@ class PSController(CollectiveController):
             "PADDLE_RESTART_COUNT": str(self.restart_count),
         })
         if role == "PSERVER":
-            ep = f"{host}:{port + 1 + idx}"
+            ep = f"{host}:{self._port_of('PSERVER', idx)}"
             env.update({
                 "TRAINING_ROLE": "PSERVER",
                 "PADDLE_ROLE": "PSERVER",
@@ -70,7 +116,7 @@ class PSController(CollectiveController):
                 "PADDLE_ROLE": "TRAINER",
                 "PADDLE_TRAINER_ID": str(idx),
                 "PADDLE_CURRENT_ENDPOINT":
-                    f"{host}:{port + 1 + self.server_num + idx}",
+                    f"{host}:{self._port_of('TRAINER', idx)}",
             })
         return env
 
@@ -95,6 +141,8 @@ class PSController(CollectiveController):
         (servers are long-running and torn down by the controller, the
         reference's PS pod semantics)."""
         host, port = self._ensure_master()
+        self._ports = None  # fresh probe per launch: a previous run's ports
+        # may have been taken by unrelated processes in the meantime
         deadline = None if timeout is None else time.time() + timeout
         try:
             self.server_procs = [

@@ -23,19 +23,28 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
     fewer (kv) heads than q (GQA/MQA), expanded here for the dense path."""
     d = q.shape[-1]
     if k.shape[2] != q.shape[2]:
-        from paddle_tpu.ops.flash_attention import repeat_kv
+        from paddle_tpu.ops.flash_attention import repeat_kv, validate_gqa
 
-        k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
+        rep = validate_gqa(q.shape[2], k.shape[2],
+                           "scaled_dot_product_attention")
+        k, v = repeat_kv(k, v, rep)
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     # -> [B, H, L, D]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    dead_rows = 0
     if causal:
         ql, kl = scores.shape[-2], scores.shape[-1]
         cmask = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
         scores = jnp.where(cmask, scores, jnp.asarray(-1e30, scores.dtype))
+        # Lq > Lk: the first Lq-Lk rows have NO live keys under the
+        # bottom-right-aligned mask — with the finite -1e30 sentinel their
+        # softmax would degenerate to uniform attention (mean of V).  Zero
+        # them instead (the same empty-row convention as the q_segments
+        # path in ops.flash_attention.blockwise_attention; review r5).
+        dead_rows = max(ql - kl, 0)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
@@ -46,6 +55,9 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    if dead_rows:
+        row = jnp.arange(out.shape[2])[None, None, :, None]
+        out = jnp.where(row < dead_rows, 0.0, out).astype(out.dtype)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -64,7 +76,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         try:
             from paddle_tpu.ops.flash_attention import flash_attention_blhd, available
 
-            if available(query.shape, key.shape):
+            if available(query.shape, key.shape, causal=is_causal):
                 return apply(
                     "flash_attention",
                     lambda q, k, v: flash_attention_blhd(q, k, v, causal=is_causal),

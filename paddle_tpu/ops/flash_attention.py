@@ -39,6 +39,28 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def validate_gqa(h: int, hkv: int, name: str = "attention") -> int:
+    """Shared GQA head-grouping contract check (one place; the grouping
+    convention itself lives in ``repeat_kv``).  Returns the group size."""
+    if hkv <= 0 or h % hkv:
+        raise ValueError(
+            f"{name}: query heads ({h}) must be an integer multiple of "
+            f"kv heads ({hkv})")
+    return h // hkv
+
+
+def _reject_causal_lq_gt_lk(lq: int, lk: int, causal: bool, name: str):
+    """Causal with Lq > Lk has rows with NO live keys under the bottom-right
+    aligned mask; the finite -1e30 mask sentinel makes those rows degenerate
+    to uniform attention and their lse poisons the backward.  Fail loudly —
+    the dense fallback owns that shape (ADVICE r4 + review r5)."""
+    if causal and lq > lk:
+        raise ValueError(
+            f"{name}: causal attention requires Lq <= Lk (got Lq={lq}, "
+            f"Lk={lk}); rows before the cached prefix would have no live "
+            "keys. Use the dense fallback for this shape.")
+
+
 # --------------------------------------------------------------------------- pallas fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 causal: bool, scale: float, group: int, head_dim: int,
@@ -106,10 +128,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         #   [lo, hi) — the diagonal band: masked
         #   [hi, ..) — fully above: skipped entirely
         # All-i32 dynamic fori bounds (a bare python int would promote to
-        # i64 under x64 and recurse Mosaic's lowering).
+        # i64 under x64 and recurse Mosaic's lowering).  Bounds clamp to
+        # >= 0 as pure defense: with Lq > Lk the q_offset is negative and
+        # floor division would otherwise produce negative k-block indices
+        # whose clamped dynamic slices re-read block 0 (ADVICE r4).  The
+        # shape itself is rejected at the entry points (dead rows are NOT
+        # well-defined here: masked scores equal the finite m init, so a
+        # dead row in a live block degenerates to uniform attention).
         q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
-        lo = q_min // jnp.int32(block_k)
-        hi = (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k)
+        lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
+        hi = jnp.maximum(
+            (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k),
+            jnp.int32(0))
         carry = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), init)
         acc, m, l = jax.lax.fori_loop(lo, hi, make_body(True), carry)
     else:
@@ -182,8 +212,9 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
     lse [B, Hkv, 8, Lq*G])."""
     b, lq, hd_packed = q.shape
     lk = k.shape[1]
+    _reject_causal_lq_gt_lk(lq, lk, causal, "flash_attention")
     d = hd_packed // num_heads
-    g = num_heads // num_kv_heads
+    g = validate_gqa(num_heads, num_kv_heads, "flash_attention")
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     block_q = _row_blocks(lq, g)
     block_k = _pick_block(lk, 512, "k")
@@ -360,10 +391,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq0 = jnp.zeros((rows, head_dim), jnp.float32)
     if causal:
         # two-phase: mask-free full blocks, masked diagonal band, skip the
-        # rest (all-i32 dynamic bounds)
+        # rest (all-i32 dynamic bounds, clamped >= 0 — see _fwd_kernel)
         q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
-        lo = q_min // jnp.int32(block_k)
-        hi = (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k)
+        lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
+        hi = jnp.maximum(
+            (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k),
+            jnp.int32(0))
         dq = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), dq0)
         dq = jax.lax.fori_loop(lo, hi, make_body(True), dq)
     else:
@@ -381,8 +414,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
     """Packed layout in/out; lse [B, Hkv, 8, Lq*G] from the forward kernel."""
     b, lq, _ = q.shape
     lk = k.shape[1]
+    _reject_causal_lq_gt_lk(lq, lk, causal, "flash_attention backward")
     d = (q.shape[2]) // num_heads
-    g = num_heads // num_kv_heads
+    g = validate_gqa(num_heads, num_kv_heads, "flash_attention backward")
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     # delta = rowsum(do ∘ o) per (position, head): one cheap elementwise pass
     # fused by XLA; regrouped to the kernels' (kv-head, pos*G+g) row order and
@@ -498,11 +532,8 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
     b, lq, h, d = q.shape
     lk = k.shape[1]
     hkv = k.shape[2]
-    if hkv <= 0 or h % hkv:
-        raise ValueError(
-            f"blockwise_attention: query heads ({h}) must be a multiple of "
-            f"kv heads ({hkv})")
-    g = h // hkv  # GQA: kv heads consumed natively (no repeat; a ring
+    g = validate_gqa(h, hkv, "blockwise_attention")
+    # GQA: kv heads consumed natively (no repeat; a ring
     # rotation of GQA k/v moves 1/g the ICI bytes of expanded heads)
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     block_k = _pick_block(lk, block_k)
@@ -578,11 +609,15 @@ def _on_tpu() -> bool:
         return False
 
 
-def available(q_shape, k_shape=None) -> bool:
+def available(q_shape, k_shape=None, causal=False) -> bool:
     """Whether the Pallas fast path handles this shape (else XLA composition).
 
     ``k_shape`` (optional, [B, Lk, Hkv, D]) enables the GQA check: query
-    heads must be an integer multiple of kv heads."""
+    heads must be an integer multiple of kv heads.  ``causal`` with Lq > Lk
+    is rejected: the first Lq-Lk query rows have NO live keys under the
+    bottom-right-aligned mask and the backward's lse reconstruction is
+    undefined for empty rows — the dense fallback owns that shape
+    (ADVICE r4)."""
     if len(q_shape) != 4:
         return False
     _, l, h, d = q_shape
@@ -590,6 +625,8 @@ def available(q_shape, k_shape=None) -> bool:
     if k_shape is not None:
         hkv = k_shape[2]
         if hkv <= 0 or h % hkv or k_shape[1] % 128:
+            return False
+        if causal and q_shape[1] > k_shape[1]:
             return False
     # packed-layout q blocks slice (H/Hkv)*D lanes out of H*D: the minor dim
     # must be a 128-multiple (d=64 MHA, e.g. BERT-base, takes the XLA path)

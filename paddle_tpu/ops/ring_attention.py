@@ -15,7 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.ops.flash_attention import _NEG_INF, blockwise_attention
+from paddle_tpu.ops.flash_attention import (_NEG_INF, blockwise_attention,
+                                            validate_gqa)
 
 __all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention"]
 
@@ -92,6 +93,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None)
     all-to-all bytes); otherwise kv expands to full heads first."""
     n = jax.lax.psum(1, axis_name)
     h, hkv = q.shape[2], k.shape[2]
+    validate_gqa(h, hkv, "ulysses_attention")
     if hkv != h and hkv % n != 0:
         from paddle_tpu.ops.flash_attention import repeat_kv
 

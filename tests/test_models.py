@@ -179,6 +179,53 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a.reshape(B, ll, hh, d)),
                                        np.asarray(b_), rtol=2e-4, atol=2e-4)
 
+    def test_causal_lq_gt_lk_rejected_and_clamped(self):
+        """Lq > Lk causal (ADVICE r4 medium): q_offset = Lk - Lq < 0 used to
+        drive the two-phase sweep's fori_loop over NEGATIVE k-block indices,
+        silently double-counting block 0 for every row.  Contract now:
+        (a) available() rejects the shape so sdpa's dense fallback owns it,
+        and (b) direct kernel callers fail LOUDLY (dead rows under the
+        finite mask sentinel would degenerate to uniform attention and their
+        lse would poison the backward — not silently computable)."""
+        from unittest import mock
+
+        from paddle_tpu.ops.flash_attention import (_flash_fwd_pallas,
+                                                    available)
+
+        B, LQ, LK, h, hkv, d = 1, 256, 128, 4, 2, 128
+        with mock.patch("paddle_tpu.ops.flash_attention._on_tpu",
+                        return_value=True):
+            assert not available((B, LQ, h, d), (B, LK, hkv, d), causal=True)
+            assert available((B, LQ, h, d), (B, LK, hkv, d), causal=False)
+
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (B, LQ, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (B, LK, hkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (B, LK, hkv, d), jnp.float32)
+        with pytest.raises(ValueError, match="Lq <= Lk"):
+            _flash_fwd_pallas(
+                q.reshape(B, LQ, h * d), k.reshape(B, LK, hkv * d),
+                v.reshape(B, LK, hkv * d), h, hkv, causal=True,
+                interpret=True)
+        # non-causal Lq > Lk remains a supported fast-path shape
+        out, _ = _flash_fwd_pallas(
+            q.reshape(B, LQ, h * d), k.reshape(B, LK, hkv * d),
+            v.reshape(B, LK, hkv * d), h, hkv, causal=False, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out.reshape(B, LQ, h, d)),
+            np.asarray(self._dense(q, k, v, False)), rtol=2e-5, atol=2e-5)
+        # the dense fallback that owns causal Lq > Lk zeroes the dead rows
+        # (no live keys) instead of degenerating to uniform attention
+        import paddle_tpu.nn.functional as PF
+        from paddle_tpu import to_tensor
+        sd = PF.scaled_dot_product_attention(
+            to_tensor(np.asarray(q)), to_tensor(np.asarray(k)),
+            to_tensor(np.asarray(v)), is_causal=True).numpy()
+        assert np.all(sd[:, :LQ - LK] == 0.0)
+        live_ref = self._dense(q[:, LQ - LK:], k, v, True)
+        np.testing.assert_allclose(sd[:, LQ - LK:], np.asarray(live_ref),
+                                   rtol=2e-5, atol=2e-5)
+
     @staticmethod
     def _dense(q, k, v, causal):
         d = q.shape[-1]
