@@ -158,7 +158,7 @@ def bench_bert(iters=10, batch=64, seq=512):
             self.m = m
 
         def forward(self, ids, labels):
-            loss, _ = self.m(ids, labels=labels)
+            loss, _ = self.m(ids, labels=labels, return_logits=False)
             return loss
 
     step = build_train_step(_Net(model), None, opt)

@@ -119,42 +119,11 @@ def _chunked_lm_loss_fn(chunk_size):
     """Mean next-token cross-entropy computed chunk-by-chunk: the lm-head
     matmul + fp32 softmax run on ``chunk_size`` tokens at a time inside a
     ``lax.scan`` with per-chunk remat, so peak memory is one chunk's logits
-    (the backward rescans and recomputes each chunk's matmul)."""
-    import jax
+    (the backward rescans and recomputes each chunk's matmul).  Shared
+    implementation with BERT's masked-LM loss (ops/chunked_ce.py)."""
+    from paddle_tpu.ops.chunked_ce import chunked_token_ce_fn
 
-    def f(h, lab, w):  # h: (B, L, H) bf16, lab: (B, L) int, w: (H, V)
-        B, L, H = h.shape
-        n = B * L
-        if n == 0:  # seq_len == 1: no next-token targets exist
-            return jnp.zeros((), jnp.float32)
-        h2 = h.reshape(n, H)
-        lab2 = lab.reshape(n).astype(jnp.int32)
-        c = min(chunk_size, n)
-        pad = (-n) % c
-        if pad:  # pad with label -1 → masked out of the mean
-            h2 = jnp.concatenate([h2, jnp.zeros((pad, H), h2.dtype)], 0)
-            lab2 = jnp.concatenate([lab2, jnp.full((pad,), -1, jnp.int32)], 0)
-        hc = h2.reshape(-1, c, H)
-        lc = lab2.reshape(-1, c)
-
-        def chunk_loss(hx, lx):
-            logits = jnp.dot(hx, w, preferred_element_type=jnp.float32)
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(
-                logits, jnp.maximum(lx, 0)[:, None], axis=-1)[:, 0]
-            valid = (lx >= 0).astype(jnp.float32)
-            return ((lse - gold) * valid).sum(), valid.sum()
-
-        chunk_loss = jax.checkpoint(chunk_loss)
-
-        def body(acc, xs):
-            s, k = chunk_loss(*xs)
-            return (acc[0] + s, acc[1] + k), None
-
-        (total, count), _ = jax.lax.scan(body, (0.0, 0.0), (hc, lc))
-        return total / jnp.maximum(count, 1.0)
-
-    return f
+    return chunked_token_ce_fn(chunk_size, vh_weight=False, pad_label=-1)
 
 
 class LlamaAttention(Layer):

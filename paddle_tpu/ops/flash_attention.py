@@ -62,94 +62,147 @@ def _reject_causal_lq_gt_lk(lq: int, lk: int, causal: bool, name: str):
 
 
 # --------------------------------------------------------------------------- pallas fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                causal: bool, scale: float, group: int, head_dim: int,
-                q_offset: int):
-    """One (batch, kv-head, q-block) program: online softmax over k blocks.
+def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
+                head_dim: int, q_offset: int, segmented: bool = False,
+                hp: int = 1):
+    """One (batch, kv-head-block, q-block) program: online softmax over k
+    blocks, for ``hp`` kv heads per program (unrolled in-kernel loop).
 
-    q_ref [1, block_q, G*D] (this kv head's G query heads, packed);
-    k_ref/v_ref [1, Lk, D]; o_ref [1, block_q, G*D];
-    lse_ref [1, 1, 8, block_q*G] — log-sum-exp rows (position-major,
-    group-head-minor), replicated across the 8 sublanes so the stats tensor
-    tiles legally on TPU; consumed by backward.
+    q_ref [1, block_q, hp*G*D] (the G query heads of each of this program's
+    hp kv heads, packed); k_ref/v_ref [1, Lk, hp*D];
+    o_ref [1, block_q, hp*G*D]; lse_ref [1, hp, 8, block_q*G] — log-sum-exp
+    rows (position-major, group-head-minor), replicated across the 8
+    sublanes so the stats tensor tiles legally on TPU; consumed by backward.
+
+    ``hp`` > 1 exists for SMALL head_dims (BERT-shaped MHA, d=64): with one
+    kv head per program, g*d = 64 is an illegal minor tile AND per-program
+    work is so small that program launch overhead dominates (measured 8
+    TF/s at B=64 L=512 H=12 D=64 — slower than XLA dense once the backward
+    is included).  Packing hp kv heads per program makes the minor dim
+    hp*g*d a 128-multiple and amortizes the launch cost, while still
+    consuming the projection layout with zero transposes.
+
+    ``segmented``: two extra i32 inputs qseg_ref [1, 1, 8, block_q*G] (row
+    order) and kseg_ref [1, 1, 8, Lk]; attention is restricted to
+    same-segment (q, k) pairs — the padding/varlen mask.  A live row whose
+    leading k blocks are fully out-of-segment self-corrects: when its first
+    live key arrives, alpha = exp(-1e30 - m_live) = 0 wipes the garbage
+    acc/l.  Rows with NO live key anywhere (padding, qseg < 0) are zeroed by
+    the caller; self-attention guarantees every non-padding row matches its
+    own position.
     """
-    block_q = q_ref.shape[1]
+    if segmented:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+    # 4-D refs = head-major bhld layout ([1, hp, L, D]); 3-D = packed
+    block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
-    lk = k_ref.shape[1]
+    lk = k_ref.shape[2] if k_ref.ndim == 4 else k_ref.shape[1]
     num_k_blocks = lk // block_k
     qi = pl.program_id(2)
+    gd = group * head_dim
 
-    # [block_q, G*D] -> [block_q*G, D]: contiguous, free
-    q = q_ref[0].reshape(rows, head_dim)
+    qseg = qseg_ref[0, 0, 0] if segmented else None  # [rows] i32
+    # hp > 1 refs are HEAD-MAJOR 4-D ([1, hp, L, D]): per-head tiles are
+    # [L, D] with d the full minor dim — lane-aligned at any d.  (Lane
+    # slices at j*d offsets inside a packed [L, hp*d] block measured 2x
+    # slower: 64-lane slices off 128-alignment force Mosaic shuffles.)
+    bhld = q_ref.ndim == 4
 
-    def make_body(masked):
-        def body(kb, carry):
-            acc, m, l = carry
-            k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
-            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32
-            ) * scale  # [rows, block_k] fp32
-            if masked:
-                # row r is query position q_offset + qi*block_q + r//G — the
-                # offset (Lk-Lq) bottom-right-aligns the mask for cached/
-                # chunked prefill, matching the dense fallback's tril(kl-ql).
-                # Position index built as a 3D iota reshaped (pos-major,
-                # head-minor) — integer division on i32 promotes to i64 under
-                # x64 and recurses Mosaic's convert lowering.
-                q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, group, block_k), 0
-                ).reshape(rows, block_k)
-                k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (rows, block_k), 1
+    for j in range(hp):
+        if bhld:
+            q = q_ref[0, j]  # [block_q, D] (g == 1 when hp > 1)
+        else:
+            # [block_q, G*D] -> [block_q*G, D]: contiguous, free
+            q = q_ref[0, :, j * gd:(j + 1) * gd].reshape(rows, head_dim)
+
+        def make_body(masked, q=q, j=j):
+            def body(kb, carry):
+                acc, m, l = carry
+                if bhld:
+                    k = k_ref[0, j, pl.ds(kb * block_k, block_k), :]
+                    v = v_ref[0, j, pl.ds(kb * block_k, block_k), :]
+                else:
+                    k = k_ref[0, pl.ds(kb * block_k, block_k),
+                              j * head_dim:(j + 1) * head_dim]  # [block_k, D]
+                    v = v_ref[0, pl.ds(kb * block_k, block_k),
+                              j * head_dim:(j + 1) * head_dim]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32
+                ) * scale  # [rows, block_k] fp32
+                if segmented:
+                    kseg = kseg_ref[0, 0, 0, pl.ds(kb * block_k, block_k)]
+                    s = jnp.where(qseg[:, None] == kseg[None, :], s,
+                                  jnp.float32(_NEG_INF))
+                if masked:
+                    # row r is query position q_offset + qi*block_q + r//G —
+                    # the offset (Lk-Lq) bottom-right-aligns the mask for
+                    # cached/chunked prefill, matching the dense fallback's
+                    # tril(kl-ql).  Position index built as a 3D iota
+                    # reshaped (pos-major, head-minor) — integer division on
+                    # i32 promotes to i64 under x64 and recurses Mosaic's
+                    # convert lowering.
+                    q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, group, block_k), 0
+                    ).reshape(rows, block_k)
+                    k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (rows, block_k), 1
+                    )
+                    s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+                p = jnp.exp(s - m_new[:, None])
+                alpha = jnp.exp(m - m_new)
+                l_new = l * alpha + jnp.sum(p, axis=-1)
+                acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
                 )
-                s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_new[:, None])
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return acc_new, m_new, l_new
-        return body
+                return acc_new, m_new, l_new
+            return body
 
-    init = (
-        jnp.zeros((rows, head_dim), jnp.float32),
-        jnp.full((rows,), _NEG_INF, jnp.float32),
-        jnp.zeros((rows,), jnp.float32),
-    )
-    if causal:
-        # two-phase causal sweep (the r4 profile put the flash kernels at
-        # 490ms of an 1830ms step with half their tiles fully masked):
-        #   [0, lo)  — k blocks fully BELOW the diagonal: no mask compute
-        #   [lo, hi) — the diagonal band: masked
-        #   [hi, ..) — fully above: skipped entirely
-        # All-i32 dynamic fori bounds (a bare python int would promote to
-        # i64 under x64 and recurse Mosaic's lowering).  Bounds clamp to
-        # >= 0 as pure defense: with Lq > Lk the q_offset is negative and
-        # floor division would otherwise produce negative k-block indices
-        # whose clamped dynamic slices re-read block 0 (ADVICE r4).  The
-        # shape itself is rejected at the entry points (dead rows are NOT
-        # well-defined here: masked scores equal the finite m init, so a
-        # dead row in a live block degenerates to uniform attention).
-        q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
-        lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
-        hi = jnp.maximum(
-            (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k),
-            jnp.int32(0))
-        carry = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), init)
-        acc, m, l = jax.lax.fori_loop(lo, hi, make_body(True), carry)
-    else:
-        acc, m, l = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
-                                      make_body(False), init,
-                                      unroll=num_k_blocks <= 8)
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).reshape(block_q, group * head_dim
+        init = (
+            jnp.zeros((rows, head_dim), jnp.float32),
+            jnp.full((rows,), _NEG_INF, jnp.float32),
+            jnp.zeros((rows,), jnp.float32),
+        )
+        if causal:
+            # two-phase causal sweep (the r4 profile put the flash kernels
+            # at 490ms of an 1830ms step with half their tiles fully
+            # masked):
+            #   [0, lo)  — k blocks fully BELOW the diagonal: no mask compute
+            #   [lo, hi) — the diagonal band: masked
+            #   [hi, ..) — fully above: skipped entirely
+            # All-i32 dynamic fori bounds (a bare python int would promote
+            # to i64 under x64 and recurse Mosaic's lowering).  Bounds clamp
+            # to >= 0 as pure defense: with Lq > Lk the q_offset is negative
+            # and floor division would otherwise produce negative k-block
+            # indices whose clamped dynamic slices re-read block 0 (ADVICE
+            # r4).  The shape itself is rejected at the entry points (dead
+            # rows are NOT well-defined here: masked scores equal the finite
+            # m init, so a dead row in a live block degenerates to uniform
+            # attention).
+            q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
+            lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
+            hi = jnp.maximum(
+                (q_min + jnp.int32(block_q + block_k - 1))
+                // jnp.int32(block_k), jnp.int32(0))
+            carry = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False),
+                                      init)
+            acc, m, l = jax.lax.fori_loop(lo, hi, make_body(True), carry)
+        else:
+            acc, m, l = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(num_k_blocks), make_body(False),
+                init, unroll=num_k_blocks <= 8)
+        l_safe = jnp.maximum(l, 1e-30)
+        if bhld:
+            o_ref[0, j] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        else:
+            o_ref[0, :, j * gd:(j + 1) * gd] = (
+                acc / l_safe[:, None]).reshape(block_q, gd
                                                ).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), (8, rows))
+        lse_ref[0, j] = jnp.broadcast_to(m + jnp.log(l_safe), (8, rows))
 
 
 def _pick_block(n: int, preferred: int, kind: str = "") -> int:
@@ -202,14 +255,60 @@ def _row_blocks(lq: int, group: int, target: int = 1024):
     return block_q
 
 
+def _heads_per_program(hkv: int, g: int, d: int, lk: int) -> int:
+    """kv heads per kernel program.  1 when the single-head minor dim g*d is
+    already a legal (128-multiple) tile — the GQA/llama case.  For small
+    head dims (BERT-shaped MHA, d=64) pick the LARGEST divisor of hkv whose
+    packed minor hp*g*d is a 128-multiple and whose resident k+v blocks fit
+    a vmem budget; the unrolled in-kernel head loop amortizes program launch
+    overhead (the per-head fold measured slower than XLA dense on the
+    backward).  Returns 0 when no legal packing exists (callers fall back
+    to the XLA path)."""
+    if (g * d) % 128 == 0:
+        return 1
+    if g != 1:
+        return 0  # GQA with a sub-128 minor: no head-major packing either
+    import os
+
+    env = os.environ.get("PADDLE_TPU_FLASH_HP")  # perf-sweep override
+    if env:
+        try:
+            v = int(env)
+        except ValueError:
+            v = 0
+        # v >= 2 only: hp == 1 would select the packed layout whose
+        # sub-128 minor tile is exactly what this path exists to avoid
+        if v >= 2 and hkv % v == 0:
+            return v
+    for hp in range(hkv, 1, -1):
+        if hkv % hp:
+            continue
+        if 2 * lk * hp * d * 2 <= 4 * 1024 * 1024:  # k+v bf16 <= 4MB
+            return hp
+    return 0
+
+
+def _seg_rows(segments, g):
+    """[B, L] i32 segment ids -> [B, 1, 8, L*G] in the kernels' row order
+    (position-major, group-head-minor), sublane-replicated for TPU tiling."""
+    s = jnp.asarray(segments, jnp.int32)
+    if g > 1:
+        s = jnp.repeat(s, g, axis=1)
+    return jnp.broadcast_to(s[:, None, None, :],
+                            (s.shape[0], 1, 8, s.shape[1]))
+
+
 @functools.partial(
     jax.jit, static_argnames=("num_heads", "num_kv_heads", "causal", "scale",
                               "interpret"))
 def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
-                      scale=None, interpret=False):
+                      scale=None, interpret=False, q_segments=None,
+                      k_segments=None):
     """q [B, Lq, H*D], k/v [B, Lk, Hkv*D] — the projection layout, consumed
     without any transpose.  Returns (out [B, Lq, H*D],
-    lse [B, Hkv, 8, Lq*G])."""
+    lse [B, Hkv, 8, Lq*G]).  Optional q_segments/k_segments [B, Lq]/[B, Lk]
+    i32 restrict attention to same-segment pairs (padding/varlen); rows with
+    a negative segment id are zeroed."""
     b, lq, hd_packed = q.shape
     lk = k.shape[1]
     _reject_causal_lq_gt_lk(lq, lk, causal, "flash_attention")
@@ -218,32 +317,82 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     block_q = _row_blocks(lq, g)
     block_k = _pick_block(lk, 512, "k")
-    grid = (b, num_kv_heads, lq // block_q)
+    hp = _heads_per_program(num_kv_heads, g, d, lk)
+    if hp == 0:
+        raise ValueError(
+            f"flash_attention: no legal TPU tiling for head_dim={d}, "
+            f"kv_heads={num_kv_heads} (minor dim not a 128-multiple); "
+            "use blockwise_attention or the dense path")
+    grid = (b, num_kv_heads // hp, lq // block_q)
+    segmented = q_segments is not None
+    bhld = hp > 1
     # index maps use `i * 0` (not the literal 0) so the constant inherits the
     # i32 index dtype — a literal traces as i64 under jax_enable_x64 and
     # Mosaic rejects the mixed-width index tuple
+    if bhld:
+        # head-major layout for multi-head programs (g == 1): per-head
+        # tiles [L, D] keep d the full minor dim — lane-aligned at any d
+        args = [
+            jnp.swapaxes(q.reshape(b, lq, num_heads, d), 1, 2),
+            jnp.swapaxes(k.reshape(b, lk, num_kv_heads, d), 1, 2),
+            jnp.swapaxes(v.reshape(b, lk, num_kv_heads, d), 1, 2),
+        ]
+        in_specs = [
+            pl.BlockSpec((1, hp, block_q, d),
+                         lambda bi, ci, i: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, lk, d),
+                         lambda bi, ci, i: (bi, ci, i * 0, i * 0)),
+            pl.BlockSpec((1, hp, lk, d),
+                         lambda bi, ci, i: (bi, ci, i * 0, i * 0)),
+        ]
+        out_spec0 = pl.BlockSpec((1, hp, block_q, d),
+                                 lambda bi, ci, i: (bi, ci, i, i * 0))
+        out_shape0 = jax.ShapeDtypeStruct((b, num_heads, lq, d), q.dtype)
+    else:
+        args = [q, k, v]
+        in_specs = [
+            pl.BlockSpec((1, block_q, hp * g * d),
+                         lambda bi, ci, i: (bi, i, ci)),
+            pl.BlockSpec((1, lk, hp * d), lambda bi, ci, i: (bi, i * 0, ci)),
+            pl.BlockSpec((1, lk, hp * d), lambda bi, ci, i: (bi, i * 0, ci)),
+        ]
+        out_spec0 = pl.BlockSpec((1, block_q, hp * g * d),
+                                 lambda bi, ci, i: (bi, i, ci))
+        out_shape0 = jax.ShapeDtypeStruct((b, lq, num_heads * d), q.dtype)
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, 1, 8, block_q * g),
+                         lambda bi, ci, i: (bi, i * 0, i * 0, i)),
+            pl.BlockSpec((1, 1, 8, lk),
+                         lambda bi, ci, i: (bi, i * 0, i * 0, i * 0)),
+        ]
+        args += [_seg_rows(q_segments, g), _seg_rows(k_segments, 1)]
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
-            group=g, head_dim=d, q_offset=lk - lq,
+            group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
+            hp=hp,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, g * d), lambda bi, ci, i: (bi, i, ci)),
-            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (bi, i * 0, ci)),
-            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (bi, i * 0, ci)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, g * d), lambda bi, ci, i: (bi, i, ci)),
-            pl.BlockSpec((1, 1, 8, block_q * g),
+            out_spec0,
+            pl.BlockSpec((1, hp, 8, block_q * g),
                          lambda bi, ci, i: (bi, ci, i * 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, lq, num_heads * d), q.dtype),
+            out_shape0,
             jax.ShapeDtypeStruct((b, num_kv_heads, 8, lq * g), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
+    if bhld:
+        out = jnp.swapaxes(out, 1, 2).reshape(b, lq, num_heads * d)
+    if segmented:
+        # padding rows (negative segment id) emit zeros — the q_segments
+        # convention shared with blockwise_attention
+        out = jnp.where(
+            (jnp.asarray(q_segments, jnp.int32) >= 0)[:, :, None], out, 0)
     return out, lse
 
 
@@ -260,11 +409,12 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
 # cross-program reduction.
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, causal: bool,
-                    scale: float, group: int, head_dim: int, q_offset: int):
-    """One (batch, kv-head, k-block, q-block) program: this q block's
-    contribution to dk/dv of this k block.
+def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
+                    head_dim: int, q_offset: int, segmented: bool = False,
+                    hp: int = 1):
+    """One (batch, kv-head-block, k-block, q-block) program: this q block's
+    contribution to dk/dv of this k block, for hp kv heads (unrolled loop —
+    see _fwd_kernel).
 
     q blocks are streamed by the GRID's innermost dim (not an in-kernel loop
     over a resident full-Lq block — 2 x 2MB x double-buffering of q/do blew
@@ -272,12 +422,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     blocks have q-independent index maps, so Pallas keeps them resident in
     VMEM across the q sweep and writes back once (fp32, cast by the caller).
 
-    q_ref/do_ref [1, block_q, G*D]; k_ref/v_ref [1, block_k, D];
-    lse_ref/delta_ref [1, 1, 8, block_q*G]; dk_ref/dv_ref [1, block_k, D] f32.
+    q_ref/do_ref [1, block_q, hp*G*D]; k_ref/v_ref [1, block_k, hp*D];
+    lse_ref/delta_ref [1, hp, 8, block_q*G]; dk_ref/dv_ref
+    [1, block_k, hp*D] f32.  ``segmented`` adds qseg_ref
+    [1, 1, 8, block_q*G] / kseg_ref [1, 1, 8, block_k] after delta_ref; the
+    caller zeroes padding rows of ``do`` so dead-row lse garbage cannot
+    contaminate dk/dv.
     """
-    block_k = k_ref.shape[1]
-    block_q = q_ref.shape[1]
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dk_ref, dv_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
+    block_k = k_ref.shape[2] if k_ref.ndim == 4 else k_ref.shape[1]
+    block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
+    gd = group * head_dim
     ki = pl.program_id(2)
     qb = pl.program_id(3)
 
@@ -296,38 +456,61 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         live, full = True, True
 
+    bhld = q_ref.ndim == 4  # head-major multi-head layout (see _fwd_kernel)
+
     def compute(masked):
-        k = k_ref[0]  # [block_k, D]
-        v = v_ref[0]
-        q = q_ref[0].reshape(rows, head_dim)
-        do = do_ref[0].reshape(rows, head_dim)
-        lse = lse_ref[0, 0, 0]                             # [rows]
-        delta = delta_ref[0, 0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                          # [rows, block_k]
-        if masked:
-            q_idx = q_offset + qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, group, block_k), 0
-            ).reshape(rows, block_k)
-            k_idx = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (rows, block_k), 1
+        for j in range(hp):
+            ds_ = slice(j * head_dim, (j + 1) * head_dim)
+            gs = slice(j * gd, (j + 1) * gd)
+            if bhld:
+                k = k_ref[0, j]  # [block_k, D]
+                v = v_ref[0, j]
+                q = q_ref[0, j]  # [block_q, D] (g == 1)
+                do = do_ref[0, j]
+            else:
+                k = k_ref[0, :, ds_]  # [block_k, D]
+                v = v_ref[0, :, ds_]
+                q = q_ref[0, :, gs].reshape(rows, head_dim)
+                do = do_ref[0, :, gs].reshape(rows, head_dim)
+            lse = lse_ref[0, j, 0]                         # [rows]
+            delta = delta_ref[0, j, 0]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32
+            ) * scale                                      # [rows, block_k]
+            if segmented:
+                qseg = qseg_ref[0, 0, 0]                   # [rows]
+                kseg = kseg_ref[0, 0, 0]                   # [block_k]
+                s = jnp.where(qseg[:, None] == kseg[None, :], s,
+                              jnp.float32(_NEG_INF))
+            if masked:
+                q_idx = q_offset + qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, group, block_k), 0
+                ).reshape(rows, block_k)
+                k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (rows, block_k), 1
+                )
+                s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - lse[:, None])                  # [rows, block_k]
+            dv_upd = jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
-        p = jnp.exp(s - lse[:, None])                      # [rows, block_k]
-        dv_ref[0] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                                  # [rows, block_k]
-        ds = p * (dp - delta[:, None]) * scale
-        dk_ref[0] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                              # [rows, block_k]
+            ds = p * (dp - delta[:, None]) * scale
+            dk_upd = jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if bhld:
+                dv_ref[0, j] += dv_upd
+                dk_ref[0, j] += dk_upd
+            else:
+                dv_ref[0, :, ds_] += dv_upd
+                dk_ref[0, :, ds_] += dk_upd
 
     if causal:
         @pl.when(full)
@@ -341,76 +524,108 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         compute(False)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   block_k: int, causal: bool, scale: float, group: int,
-                   head_dim: int, q_offset: int):
-    """One (batch, kv-head, q-block) program: dq for this q block.
+def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
+                   group: int, head_dim: int, q_offset: int,
+                   segmented: bool = False, hp: int = 1):
+    """One (batch, kv-head-block, q-block) program: dq for this q block,
+    for hp kv heads (unrolled loop — see _fwd_kernel).
 
-    q_ref/do_ref/dq_ref [1, block_q, G*D]; k_ref/v_ref [1, Lk, D];
-    lse_ref/delta_ref [1, 1, 8, block_q*G].
+    q_ref/do_ref/dq_ref [1, block_q, hp*G*D]; k_ref/v_ref [1, Lk, hp*D];
+    lse_ref/delta_ref [1, hp, 8, block_q*G].  ``segmented`` adds qseg_ref
+    [1, 1, 8, block_q*G] / kseg_ref [1, 1, 8, Lk] after delta_ref.
     """
-    block_q = q_ref.shape[1]
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+    block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
-    lk = k_ref.shape[1]
+    gd = group * head_dim
+    lk = k_ref.shape[2] if k_ref.ndim == 4 else k_ref.shape[1]
     num_k_blocks = lk // block_k
     qi = pl.program_id(2)
+    qseg = qseg_ref[0, 0, 0] if segmented else None
+    bhld = q_ref.ndim == 4  # head-major multi-head layout (see _fwd_kernel)
 
-    q = q_ref[0].reshape(rows, head_dim)
-    do = do_ref[0].reshape(rows, head_dim)
-    lse = lse_ref[0, 0, 0]
-    delta = delta_ref[0, 0, 0]
+    for j in range(hp):
+        gs = slice(j * gd, (j + 1) * gd)
+        ds_ = slice(j * head_dim, (j + 1) * head_dim)
+        if bhld:
+            q = q_ref[0, j]
+            do = do_ref[0, j]
+        else:
+            q = q_ref[0, :, gs].reshape(rows, head_dim)
+            do = do_ref[0, :, gs].reshape(rows, head_dim)
+        lse = lse_ref[0, j, 0]
+        delta = delta_ref[0, j, 0]
 
-    def make_body(masked):
-        def body(kb, dq):
-            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32
-            ) * scale
-            if masked:
-                q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, group, block_k), 0
-                ).reshape(rows, block_k)
-                k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (rows, block_k), 1
+        def make_body(masked, q=q, do=do, lse=lse, delta=delta, ds_=ds_,
+                      j=j):
+            def body(kb, dq):
+                if bhld:
+                    k = k_ref[0, j, pl.ds(kb * block_k, block_k), :]
+                    v = v_ref[0, j, pl.ds(kb * block_k, block_k), :]
+                else:
+                    k = k_ref[0, pl.ds(kb * block_k, block_k), ds_]
+                    v = v_ref[0, pl.ds(kb * block_k, block_k), ds_]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32
+                ) * scale
+                if segmented:
+                    kseg = kseg_ref[0, 0, 0, pl.ds(kb * block_k, block_k)]
+                    s = jnp.where(qseg[:, None] == kseg[None, :], s,
+                                  jnp.float32(_NEG_INF))
+                if masked:
+                    q_idx = (q_offset + qi * block_q
+                             + jax.lax.broadcasted_iota(
+                                 jnp.int32, (block_q, group, block_k), 0
+                             ).reshape(rows, block_k))
+                    k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (rows, block_k), 1
+                    )
+                    s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+                p = jnp.exp(s - lse[:, None])
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32
                 )
-                s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
-            p = jnp.exp(s - lse[:, None])
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32
-            )
-            ds = p * (dp - delta[:, None]) * scale
-            return dq + jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        return body
+                ds = p * (dp - delta[:, None]) * scale
+                return dq + jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            return body
 
-    dq0 = jnp.zeros((rows, head_dim), jnp.float32)
-    if causal:
-        # two-phase: mask-free full blocks, masked diagonal band, skip the
-        # rest (all-i32 dynamic bounds, clamped >= 0 — see _fwd_kernel)
-        q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
-        lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
-        hi = jnp.maximum(
-            (q_min + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k),
-            jnp.int32(0))
-        dq = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), dq0)
-        dq = jax.lax.fori_loop(lo, hi, make_body(True), dq)
-    else:
-        dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
-                               make_body(False), dq0,
-                               unroll=num_k_blocks <= 8)
-    dq_ref[0] = dq.reshape(block_q, group * head_dim).astype(dq_ref.dtype)
+        dq0 = jnp.zeros((rows, head_dim), jnp.float32)
+        if causal:
+            # two-phase: mask-free full blocks, masked diagonal band, skip
+            # the rest (all-i32 dynamic bounds, clamped >= 0 — see
+            # _fwd_kernel)
+            q_min = jnp.int32(q_offset) + qi * jnp.int32(block_q)
+            lo = jnp.maximum(q_min // jnp.int32(block_k), jnp.int32(0))
+            hi = jnp.maximum(
+                (q_min + jnp.int32(block_q + block_k - 1))
+                // jnp.int32(block_k), jnp.int32(0))
+            dq = jax.lax.fori_loop(jnp.int32(0), lo, make_body(False), dq0)
+            dq = jax.lax.fori_loop(lo, hi, make_body(True), dq)
+        else:
+            dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
+                                   make_body(False), dq0,
+                                   unroll=num_k_blocks <= 8)
+        if bhld:
+            dq_ref[0, j] = dq.astype(dq_ref.dtype)
+        else:
+            dq_ref[0, :, gs] = dq.reshape(block_q, gd).astype(dq_ref.dtype)
 
 
 @functools.partial(
     jax.jit, static_argnames=("num_heads", "num_kv_heads", "causal", "scale",
                               "interpret"))
 def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
-                      causal=False, scale=None, interpret=False):
+                      causal=False, scale=None, interpret=False,
+                      q_segments=None, k_segments=None):
     """Packed layout in/out; lse [B, Hkv, 8, Lq*G] from the forward kernel."""
     b, lq, _ = q.shape
     lk = k.shape[1]
@@ -418,6 +633,12 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
     d = (q.shape[2]) // num_heads
     g = validate_gqa(num_heads, num_kv_heads, "flash_attention backward")
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    segmented = q_segments is not None
+    if segmented:
+        # padding rows carry garbage lse (their p reconstructs nonzero);
+        # zeroing their do kills every dk/dv/dq contribution in one pass
+        do = jnp.where(
+            (jnp.asarray(q_segments, jnp.int32) >= 0)[:, :, None], do, 0)
     # delta = rowsum(do ∘ o) per (position, head): one cheap elementwise pass
     # fused by XLA; regrouped to the kernels' (kv-head, pos*G+g) row order and
     # replicated over 8 sublanes to match the lse tiling
@@ -433,84 +654,234 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
     # q blocks stream via the innermost GRID dim; dk/dv blocks (index maps
     # q-independent) stay resident in VMEM across the q sweep and accumulate
     # in fp32, written back once and cast below
+    hp = _heads_per_program(num_kv_heads, g, d, lk)
+    if hp == 0:
+        raise ValueError(
+            f"flash_attention backward: no legal TPU tiling for head_dim="
+            f"{d}, kv_heads={num_kv_heads}")
+    bhld = hp > 1  # layout decision: head-major whenever multi-head programs
+    if bhld:
+        # the backward holds dk/dv f32 resident PLUS streamed k/v/q/do per
+        # head — heavier than the forward.  In the head-major layout any hp
+        # tiles legally (d is the full minor dim, even hp=1), so shrink hp
+        # until the scoped-vmem estimate fits (hp=12 measured 21.4M > the
+        # 16M limit on v5e; the 2x factor matches the compiler's
+        # double-buffered accounting).
+        block_q_est = _row_blocks(lq, g)
+        while hp > 1:
+            est = 2 * hp * (4 * lk * d * 6 + 4 * block_q_est * d * 2)
+            if est <= 14 * 1024 * 1024 and num_kv_heads % hp == 0:
+                break
+            hp -= 1
+    if bhld:
+        # head-major layout for multi-head programs (see _flash_fwd_pallas)
+        q_in = jnp.swapaxes(q.reshape(b, lq, num_heads, d), 1, 2)
+        k_in = jnp.swapaxes(k.reshape(b, lk, num_kv_heads, d), 1, 2)
+        v_in = jnp.swapaxes(v.reshape(b, lk, num_kv_heads, d), 1, 2)
+        do_in = jnp.swapaxes(do.reshape(b, lq, num_heads, d), 1, 2)
+        dkv_specs = [
+            pl.BlockSpec((1, hp, block_q, d),
+                         lambda bi, ci, i, qb: (bi, ci, qb, i * 0)),
+            pl.BlockSpec((1, hp, block_k, d),
+                         lambda bi, ci, i, qb: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, block_k, d),
+                         lambda bi, ci, i, qb: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, block_q, d),
+                         lambda bi, ci, i, qb: (bi, ci, qb, i * 0)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
+        ]
+        dkv_args = [q_in, k_in, v_in, do_in, lse, delta]
+        dkv_out_specs = [
+            pl.BlockSpec((1, hp, block_k, d),
+                         lambda bi, ci, i, qb: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, block_k, d),
+                         lambda bi, ci, i, qb: (bi, ci, i, i * 0)),
+        ]
+        dkv_out_shape = [
+            jax.ShapeDtypeStruct((b, num_kv_heads, lk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, num_kv_heads, lk, d), jnp.float32),
+        ]
+    else:
+        dkv_specs = [
+            pl.BlockSpec((1, block_q, hp * g * d),
+                         lambda bi, ci, i, qb: (bi, qb, ci)),
+            pl.BlockSpec((1, block_k, hp * d),
+                         lambda bi, ci, i, qb: (bi, i, ci)),
+            pl.BlockSpec((1, block_k, hp * d),
+                         lambda bi, ci, i, qb: (bi, i, ci)),
+            pl.BlockSpec((1, block_q, hp * g * d),
+                         lambda bi, ci, i, qb: (bi, qb, ci)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
+        ]
+        dkv_args = [q, k, v, do, lse, delta]
+        dkv_out_specs = [
+            pl.BlockSpec((1, block_k, hp * d),
+                         lambda bi, ci, i, qb: (bi, i, ci)),
+            pl.BlockSpec((1, block_k, hp * d),
+                         lambda bi, ci, i, qb: (bi, i, ci)),
+        ]
+        dkv_out_shape = [
+            jax.ShapeDtypeStruct(k.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
+        ]
+    if segmented:
+        qseg_rows = _seg_rows(q_segments, g)
+        kseg_rows = _seg_rows(k_segments, 1)
+        dkv_specs += [
+            pl.BlockSpec((1, 1, 8, block_q * g),
+                         lambda bi, ci, i, qb: (bi, i * 0, i * 0, qb)),
+            pl.BlockSpec((1, 1, 8, block_k),
+                         lambda bi, ci, i, qb: (bi, i * 0, i * 0, i)),
+        ]
+        dkv_args += [qseg_rows, kseg_rows]
     dk32, dv32 = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale,
-            group=g, head_dim=d, q_offset=lk - lq,
+            group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
+            hp=hp,
         ),
-        grid=(b, num_kv_heads, lk // block_k, lq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, g * d),
-                         lambda bi, ci, i, qb: (bi, qb, ci)),
-            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, qb: (bi, i, ci)),
-            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, qb: (bi, i, ci)),
-            pl.BlockSpec((1, block_q, g * d),
-                         lambda bi, ci, i, qb: (bi, qb, ci)),
-            pl.BlockSpec((1, 1, 8, block_q * g),
-                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
-            pl.BlockSpec((1, 1, 8, block_q * g),
-                         lambda bi, ci, i, qb: (bi, ci, i * 0, qb)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, qb: (bi, i, ci)),
-            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, qb: (bi, i, ci)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(k.shape, jnp.float32),
-            jax.ShapeDtypeStruct(v.shape, jnp.float32),
-        ],
+        grid=(b, num_kv_heads // hp, lk // block_k, lq // block_q),
+        in_specs=dkv_specs,
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shape,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
+    if bhld:
+        dk32 = jnp.swapaxes(dk32, 1, 2).reshape(b, lk, num_kv_heads * d)
+        dv32 = jnp.swapaxes(dv32, 1, 2).reshape(b, lk, num_kv_heads * d)
     dk = dk32.astype(k.dtype)
     dv = dv32.astype(v.dtype)
 
+    if bhld:
+        dq_specs = [
+            pl.BlockSpec((1, hp, block_q, d),
+                         lambda bi, ci, i: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, lk, d),
+                         lambda bi, ci, i: (bi, ci, i * 0, i * 0)),
+            pl.BlockSpec((1, hp, lk, d),
+                         lambda bi, ci, i: (bi, ci, i * 0, i * 0)),
+            pl.BlockSpec((1, hp, block_q, d),
+                         lambda bi, ci, i: (bi, ci, i, i * 0)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i: (bi, ci, i * 0, i)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i: (bi, ci, i * 0, i)),
+        ]
+        dq_args = [q_in, k_in, v_in, do_in, lse, delta]
+        dq_out_spec = pl.BlockSpec((1, hp, block_q, d),
+                                   lambda bi, ci, i: (bi, ci, i, i * 0))
+        dq_out_shape = jax.ShapeDtypeStruct((b, num_heads, lq, d), q.dtype)
+    else:
+        dq_specs = [
+            pl.BlockSpec((1, block_q, hp * g * d),
+                         lambda bi, ci, i: (bi, i, ci)),
+            pl.BlockSpec((1, lk, hp * d), lambda bi, ci, i: (bi, i * 0, ci)),
+            pl.BlockSpec((1, lk, hp * d), lambda bi, ci, i: (bi, i * 0, ci)),
+            pl.BlockSpec((1, block_q, hp * g * d),
+                         lambda bi, ci, i: (bi, i, ci)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i: (bi, ci, i * 0, i)),
+            pl.BlockSpec((1, hp, 8, block_q * g),
+                         lambda bi, ci, i: (bi, ci, i * 0, i)),
+        ]
+        dq_args = [q, k, v, do, lse, delta]
+        dq_out_spec = pl.BlockSpec((1, block_q, hp * g * d),
+                                   lambda bi, ci, i: (bi, i, ci))
+        dq_out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if segmented:
+        dq_specs += [
+            pl.BlockSpec((1, 1, 8, block_q * g),
+                         lambda bi, ci, i: (bi, i * 0, i * 0, i)),
+            pl.BlockSpec((1, 1, 8, lk),
+                         lambda bi, ci, i: (bi, i * 0, i * 0, i * 0)),
+        ]
+        dq_args += [qseg_rows, kseg_rows]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
-            group=g, head_dim=d, q_offset=lk - lq,
+            group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
+            hp=hp,
         ),
-        grid=(b, num_kv_heads, lq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, g * d), lambda bi, ci, i: (bi, i, ci)),
-            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (bi, i * 0, ci)),
-            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (bi, i * 0, ci)),
-            pl.BlockSpec((1, block_q, g * d), lambda bi, ci, i: (bi, i, ci)),
-            pl.BlockSpec((1, 1, 8, block_q * g),
-                         lambda bi, ci, i: (bi, ci, i * 0, i)),
-            pl.BlockSpec((1, 1, 8, block_q * g),
-                         lambda bi, ci, i: (bi, ci, i * 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, g * d),
-                               lambda bi, ci, i: (bi, i, ci)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, num_kv_heads // hp, lq // block_q),
+        in_specs=dq_specs,
+        out_specs=dq_out_spec,
+        out_shape=dq_out_shape,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
+    if bhld:
+        dq = jnp.swapaxes(dq, 1, 2).reshape(b, lq, num_heads * d)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------- packed entry
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_packed(q, k, v, num_heads, num_kv_heads, causal=False,
-                           scale=None):
+                           scale=None, interpret=False):
     """GQA flash attention in the projection layout: q [B, L, H*D],
     k/v [B, L, Hkv*D] -> [B, L, H*D].  H % Hkv == 0."""
     return _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=causal,
-                             scale=scale)[0]
+                             scale=scale, interpret=interpret)[0]
 
 
-def _fap_fwd(q, k, v, num_heads, num_kv_heads, causal, scale):
+def _fap_fwd(q, k, v, num_heads, num_kv_heads, causal, scale, interpret):
     out, lse = _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads,
-                                 causal=causal, scale=scale)
+                                 causal=causal, scale=scale,
+                                 interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _fap_bwd(num_heads, num_kv_heads, causal, scale, res, g):
+def _fap_bwd(num_heads, num_kv_heads, causal, scale, interpret, res, g):
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, out, lse, g, num_heads, num_kv_heads,
-                             causal=causal, scale=scale)
+                             causal=causal, scale=scale, interpret=interpret)
 
 
 flash_attention_packed.defvjp(_fap_fwd, _fap_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_packed_segmented(q, k, v, q_segments, k_segments,
+                                     num_heads, num_kv_heads, causal=False,
+                                     scale=None, interpret=False):
+    """Segment-masked (padding/varlen) GQA flash attention, projection
+    layout.  q_segments [B, Lq] / k_segments [B, Lk] i32: attention is
+    restricted to equal-segment pairs; negative q segments are padding rows
+    (zero output, zero grads).  Reference parity:
+    python/paddle/nn/functional/flash_attention.py flash_attn_unpadded /
+    the padding-mask path of scaled_dot_product_attention."""
+    return _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=causal,
+                             scale=scale, interpret=interpret,
+                             q_segments=q_segments, k_segments=k_segments)[0]
+
+
+def _faps_fwd(q, k, v, q_segments, k_segments, num_heads, num_kv_heads,
+              causal, scale, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads,
+                                 causal=causal, scale=scale,
+                                 interpret=interpret, q_segments=q_segments,
+                                 k_segments=k_segments)
+    return out, (q, k, v, q_segments, k_segments, out, lse)
+
+
+def _faps_bwd(num_heads, num_kv_heads, causal, scale, interpret, res, g):
+    import numpy as _np
+
+    q, k, v, q_segments, k_segments, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, g, num_heads, num_kv_heads, causal=causal,
+        scale=scale, interpret=interpret, q_segments=q_segments,
+        k_segments=k_segments)
+    f0 = lambda x: _np.zeros(x.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, f0(q_segments), f0(k_segments)
+
+
+flash_attention_packed_segmented.defvjp(_faps_fwd, _faps_bwd)
 
 
 # ------------------------------------------------------------------- blockwise (jnp)
@@ -628,26 +999,41 @@ def available(q_shape, k_shape=None, causal=False) -> bool:
             return False
         if causal and q_shape[1] > k_shape[1]:
             return False
-    # packed-layout q blocks slice (H/Hkv)*D lanes out of H*D: the minor dim
-    # must be a 128-multiple (d=64 MHA, e.g. BERT-base, takes the XLA path)
-    if (h // hkv) * d % 128:
+    # packed-layout q blocks slice (H/Hkv)*D lanes out of H*D: the minor
+    # dim must be a 128-multiple — or a multi-head program block must make
+    # it one (BERT-shaped d=64 MHA packs hp kv heads per program; see
+    # _heads_per_program).  The lk used in the vmem guard is k_shape's when
+    # given, else l (self-attention).
+    lk = k_shape[1] if k_shape is not None else l
+    if _heads_per_program(hkv, h // hkv, d, lk) == 0:
         return False
     return _on_tpu() and d in (64, 128, 256) and l >= 128 and l % 128 == 0
 
 
-def flash_attention_blhd(q, k, v, causal=False, scale=None):
+def flash_attention_blhd(q, k, v, causal=False, scale=None, q_segments=None,
+                         k_segments=None, interpret=False):
     """Flash attention, [batch, seq, heads, head_dim]; k/v may carry fewer
     (kv) heads than q (GQA/MQA).  Thin packing wrapper over
     ``flash_attention_packed`` — the [B,L,H,D] <-> [B,L,H*D] reshapes are
-    contiguous, i.e. free."""
+    contiguous, i.e. free.  Optional q_segments/k_segments [B, Lq]/[B, Lk]
+    route through the segment-masked kernels (padding/varlen masks).
+    Small head dims (BERT-base d=64 MHA) are handled inside the kernels by
+    multi-head program blocks (_heads_per_program) — still zero
+    transposes."""
     b, lq, h, d = q.shape
+    lk = k.shape[1]
     hkv = k.shape[2]
-    out = flash_attention_packed(
-        q.reshape(b, lq, h * d),
-        k.reshape(b, k.shape[1], hkv * d),
-        v.reshape(b, v.shape[1], hkv * d),
-        h, hkv, causal, scale,
-    )
+    validate_gqa(h, hkv, "flash_attention_blhd")
+    qp = q.reshape(b, lq, h * d)
+    kp = k.reshape(b, lk, hkv * d)
+    vp = v.reshape(b, lk, hkv * d)
+    if q_segments is None:
+        out = flash_attention_packed(qp, kp, vp, h, hkv, causal, scale,
+                                     interpret)
+    else:
+        out = flash_attention_packed_segmented(
+            qp, kp, vp, q_segments, k_segments, h, hkv, causal, scale,
+            interpret)
     return out.reshape(b, lq, h, d)
 
 

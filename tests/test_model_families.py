@@ -52,6 +52,36 @@ class TestBertErnie:
         l2.backward()
         assert list(lg.shape) == [2, 4]
 
+    def test_chunked_mlm_loss_matches_dense(self):
+        """return_logits=False (the bench fast path) computes the SAME loss
+        and grads as the dense full-vocab cross-entropy path."""
+        cfg = BertConfig.tiny()
+        mlm = BertForMaskedLM(cfg)
+        ids = _ids()
+        labels_np = np.random.default_rng(3).integers(0, 256, (2, 16))
+        labels_np[0, :8] = -100  # ignore_index positions
+        labels = paddle.to_tensor(labels_np, dtype="int64")
+
+        dense_loss, _ = mlm(ids, labels=labels)
+        chunked_loss, lg = mlm(ids, labels=labels, return_logits=False)
+        assert lg is None
+        np.testing.assert_allclose(float(dense_loss), float(chunked_loss),
+                                   rtol=1e-5)
+        dense_loss.backward()
+        g_dense = {n: np.array(p.grad.numpy())
+                   for n, p in mlm.named_parameters() if p.grad is not None}
+        mlm.clear_gradients()
+        chunked_loss2, _ = mlm(ids, labels=labels, return_logits=False)
+        chunked_loss2.backward()
+        checked = 0
+        for n, p in mlm.named_parameters():
+            if p.grad is not None and n in g_dense:
+                np.testing.assert_allclose(
+                    np.array(p.grad.numpy()), g_dense[n], rtol=2e-4,
+                    atol=2e-5, err_msg=n)
+                checked += 1
+        assert checked > 10
+
     def test_attention_mask_effect(self):
         cfg = BertConfig.tiny()
         m = ErnieModel(cfg)

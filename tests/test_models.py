@@ -226,6 +226,108 @@ class TestFlashAttention:
         np.testing.assert_allclose(sd[:, LQ - LK:], np.asarray(live_ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("hkv,d", [(2, 128), (4, 64)])  # GQA-128 / MHA-64
+    def test_pallas_segmented_matches_dense_padding(self, hkv, d):
+        """Segment-masked kernels (padding masks on the flash path, VERDICT
+        r4 next-round #3): values AND grads match the dense fallback with a
+        key-padding mask.  (4, 64) exercises the BERT-shaped MHA head-fold
+        ([B,L,H,D] -> [B*H,L,D]) whose packed minor dim isn't a
+        128-multiple."""
+        from paddle_tpu.ops.flash_attention import flash_attention_blhd
+
+        h = 4
+        B, L = 2, 256
+        ks = jax.random.split(jax.random.PRNGKey(5), 4)
+        q = jax.random.normal(ks[0], (B, L, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (B, L, hkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (B, L, hkv, d), jnp.float32)
+        lengths = np.array([192, 250])  # per-example live prefix
+        keymask = np.arange(L)[None, :] < lengths[:, None]  # [B, L] bool
+        kseg = jnp.asarray(np.where(keymask, 0, -2), jnp.int32)
+        qseg = jnp.zeros((B, L), jnp.int32)  # all query rows live
+
+        def f_flash(q_, k_, v_):
+            return flash_attention_blhd(q_, k_, v_, q_segments=qseg,
+                                        k_segments=kseg, interpret=True)
+
+        def f_dense(q_, k_, v_):
+            d_ = q_.shape[-1]
+            if k_.shape[2] != q_.shape[2]:
+                rep = q_.shape[2] // k_.shape[2]
+                k_ = jnp.repeat(k_, rep, axis=2)
+                v_ = jnp.repeat(v_, rep, axis=2)
+            qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q_, k_, v_))
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d_)
+            s = jnp.where(jnp.asarray(keymask)[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+        out = f_flash(q, k, v)
+        ref = f_dense(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        do = jax.random.normal(ks[3], q.shape, jnp.float32)
+        gf = jax.grad(lambda *a: jnp.vdot(f_flash(*a), do),
+                      argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda *a: jnp.vdot(f_dense(*a), do),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_pallas_segmented_padding_rows_zero(self):
+        """Padding QUERY rows (negative segment id) emit zeros and
+        contribute zero grads — the varlen convention shared with
+        blockwise_attention."""
+        from paddle_tpu.ops.flash_attention import flash_attention_blhd
+
+        B, L, h, d = 1, 256, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(6), 3)
+        q = jax.random.normal(ks[0], (B, L, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (B, L, h, d), jnp.float32)
+        v = jax.random.normal(ks[2], (B, L, h, d), jnp.float32)
+        live = 192
+        seg = jnp.asarray(
+            np.where(np.arange(L) < live, 0, -1), jnp.int32)[None, :]
+        kseg = jnp.asarray(
+            np.where(np.arange(L) < live, 0, -2), jnp.int32)[None, :]
+        out = flash_attention_blhd(q, k, v, q_segments=seg, k_segments=kseg,
+                                   interpret=True)
+        assert np.all(np.asarray(out)[:, live:] == 0.0)
+        gk = jax.grad(
+            lambda k_: flash_attention_blhd(
+                q, k_, v, q_segments=seg, k_segments=kseg,
+                interpret=True).sum())(k)
+        assert np.all(np.asarray(gk)[:, live:] == 0.0)  # padded keys: no grad
+
+    def test_mha_fold_matches_dense(self):
+        """BERT-shaped MHA (h=12, d=64) through the head-fold path, causal
+        and not, values + grads."""
+        from paddle_tpu.ops.flash_attention import flash_attention_blhd
+
+        B, L, h, d = 2, 256, 12, 64
+        ks = jax.random.split(jax.random.PRNGKey(7), 4)
+        q = jax.random.normal(ks[0], (B, L, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (B, L, h, d), jnp.float32)
+        v = jax.random.normal(ks[2], (B, L, h, d), jnp.float32)
+        do = jax.random.normal(ks[3], q.shape, jnp.float32)
+        for causal in (False, True):
+            out = flash_attention_blhd(q, k, v, causal=causal,
+                                       interpret=True)
+            ref = self._dense(q, k, v, causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+            gf = jax.grad(
+                lambda *a: jnp.vdot(flash_attention_blhd(
+                    *a, causal=causal, interpret=True), do),
+                argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(
+                lambda *a: jnp.vdot(self._dense(*a, causal), do),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b_ in zip(gf, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           rtol=2e-4, atol=2e-4)
+
     @staticmethod
     def _dense(q, k, v, causal):
         d = q.shape[-1]
