@@ -135,6 +135,48 @@ def bench_resnet50(iters=10, batch=128):
             "resnet50_step_ms": round(dt * 1000, 1)}
 
 
+def bench_decode(ctx=2048, new_tokens=64):
+    """Incremental decode tokens/sec over a static KV cache (VERDICT r4
+    next-round #6 — the inference half of the LLM story).  Greedy-decodes
+    ``new_tokens`` after a ``ctx - new_tokens`` prompt on the flagship bench
+    config at batch 1 and 8; the whole loop (prefill + lax.scan decode +
+    argmax) is ONE compiled program (models/llama_decode.py), so the number
+    measures the chip, not the host dispatch path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama_decode import decode_greedy
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=ctx, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    prompt = ctx - new_tokens
+    rng = np.random.default_rng(0)
+    out = {}
+    for batch in (1, 8):
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (batch, prompt)), dtype="int64")
+        # warm (compile); a short and a long call so the decode-only rate
+        # can be separated from the one-off prefill
+        np.asarray(decode_greedy(model, ids, max_new_tokens=4, max_len=ctx))
+        np.asarray(decode_greedy(model, ids, max_new_tokens=new_tokens,
+                                 max_len=ctx))
+        t0 = time.perf_counter()
+        np.asarray(decode_greedy(model, ids, max_new_tokens=4, max_len=ctx))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(decode_greedy(model, ids, max_new_tokens=new_tokens,
+                                 max_len=ctx))
+        t_long = time.perf_counter() - t0
+        per_tok = (t_long - t_short) / (new_tokens - 4)
+        out[f"decode_tok_per_sec_b{batch}"] = round(batch / per_tok, 1)
+    out["decode_ctx"] = ctx
+    return out
+
+
 def bench_bert(iters=10, batch=64, seq=512):
     """BERT-base MLM pretraining samples/sec (BASELINE.md ERNIE/BERT north
     star; reference: PaddleNLP pretraining configs on Fleet DP)."""
@@ -306,8 +348,8 @@ def main():
 
     secondary = {}
     if os.environ.get("BENCH_PRIMARY_ONLY") != "1":
-        for fn in (bench_resnet50, bench_bert, bench_moe, bench_eager,
-                   bench_collectives):
+        for fn in (bench_resnet50, bench_bert, bench_moe, bench_decode,
+                   bench_eager, bench_collectives):
             try:
                 secondary.update(fn())
             except Exception as e:

@@ -1,5 +1,7 @@
 from paddle_tpu.distributed.passes.pass_base import (
-    PassBase, PassContext, PassManager, new_pass, register_pass,
+    PassBase, PassContext, PassManager, TrainProgram, new_pass,
+    register_pass,
 )
 
-__all__ = ['new_pass', 'PassManager', 'PassContext', 'PassBase', 'register_pass']
+__all__ = ['new_pass', 'PassManager', 'PassContext', 'PassBase',
+           'register_pass', 'TrainProgram']

@@ -446,11 +446,106 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     return apply("fused_moe", f, *args)
 
 
-def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None, **kw):
-    raise NotImplementedError(
-        "masked_multihead_attention is a GPU decoding kernel; use "
-        "paddle.nn.functional.scaled_dot_product_attention with cache on TPU."
-    )
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Fused single-token decoding attention over a preallocated KV cache
+    (reference: python/paddle/incubate/nn/functional/
+    masked_multihead_attention.py over the phi fused kernel
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    TPU-native: built on ops/decode_attention.py — static shapes, one
+    compiled append-and-attend program; the cache layout
+    ``[2, B, H, max_seq_len, D]`` is consumed directly (no per-step
+    transpose).
+
+    x [B, 3*H*D] — one decode step's packed qkv; cache_kv
+    [2, B, H, Lmax, D]; bias [3*H*D] or [3, H, D]; src_mask
+    [B, 1, 1, S] additive scores bias whose trailing length S fixes the
+    timestep (S = cur_len + 1, the reference's convention) unless
+    ``sequence_lengths [B(,1)]`` gives per-batch cache lengths.  Returns
+    (out [B, H*D], cache_kv_out).
+
+    Not supported on TPU (loud raise, no silent fallback): beam search
+    offsets, cum_offsets, int8 quant in/out scales, and rotary_tensor —
+    rope on TPU is applied in the model before the cache write
+    (models/llama_decode.py), matching this framework's decode design.
+    """
+    for name, val in (("beam_cache_offset", beam_cache_offset),
+                      ("cum_offsets", cum_offsets),
+                      ("rotary_tensor", rotary_tensor),
+                      ("qkv_out_scale", qkv_out_scale),
+                      ("out_shift", out_shift), ("out_smooth", out_smooth)):
+        if val is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name} is not supported on "
+                "TPU (beam/quant/fused-rope live outside the decode op "
+                "here; apply rope in the model, see models/llama_decode.py)")
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: int8 out_scale quantization is "
+            "not supported on TPU")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if src_mask is None and sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention: need src_mask (its trailing dim "
+            "fixes the timestep) or sequence_lengths")
+
+    from paddle_tpu.ops.decode_attention import decode_attention
+
+    def f(xa, cache, *rest):
+        i = 0
+        b_ = rest[i] if bias is not None else None
+        i += bias is not None
+        mask = rest[i] if src_mask is not None else None
+        i += src_mask is not None
+        seqlens = rest[i] if sequence_lengths is not None else None
+
+        b, three_hd = xa.shape
+        h = cache.shape[2]
+        d = cache.shape[4]
+        lmax = cache.shape[3]
+        if three_hd != 3 * h * d:
+            raise ValueError(
+                f"masked_multihead_attention: x width {three_hd} != "
+                f"3*H*D = {3 * h * d} from cache_kv {cache.shape}")
+        if b_ is not None:
+            xa = xa + b_.reshape(three_hd).astype(xa.dtype)
+        q, k, v = jnp.split(xa.reshape(b, 3, h, d), 3, axis=1)
+        q = q.reshape(b, 1, h, d)
+        k = k.reshape(b, 1, h, d)
+        v = v.reshape(b, 1, h, d)
+        if seqlens is not None:
+            lengths = seqlens.reshape(b).astype(jnp.int32)
+        else:
+            lengths = jnp.full((b,), mask.shape[-1] - 1, jnp.int32)
+        attn_bias = None
+        if mask is not None:
+            # additive mask over [0, S); pad to Lmax (positions >= S are
+            # causally dead anyway)
+            s = mask.reshape(b, 1, 1, mask.shape[-1]).astype(jnp.float32)
+            attn_bias = jnp.pad(s, ((0, 0), (0, 0), (0, 0),
+                                    (0, lmax - mask.shape[-1])))
+        out, kc, vc, _ = decode_attention(
+            q, k, v, cache[0], cache[1], lengths, layout="bhld",
+            attn_bias=attn_bias)
+        return out.reshape(b, h * d), jnp.stack([kc, vc])
+
+    args = [_t(x), _t(cache_kv)]
+    if bias is not None:
+        args.append(_t(bias))
+    if src_mask is not None:
+        args.append(_t(src_mask))
+    if sequence_lengths is not None:
+        args.append(_t(sequence_lengths))
+    return apply("masked_multihead_attention", f, *args)
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,

@@ -1,0 +1,180 @@
+"""Compiled greedy decoding for LlamaForCausalLM over a static KV cache.
+
+The eager ``generate`` path grows its cache by concatenation — every step
+changes shapes, so XLA recompiles per token and the whole loop runs at
+python-dispatch speed.  This module is the TPU-native decode story
+(VERDICT r4 next-round #6):
+
+* **Static shapes end to end.**  The KV cache is preallocated at
+  ``[B, Lmax, Hkv, D]`` (ops/decode_attention.py) and the WHOLE decode loop
+  — embedding, every layer, argmax sampling, cache append — runs inside one
+  ``lax.scan`` under one ``jax.jit``: one compile, zero host round-trips per
+  token.
+* **Functional params.**  The Layer tree's weights are pulled into a plain
+  pytree once (``extract_decode_params``); the step math mirrors
+  LlamaDecoderLayer exactly and is parity-tested against the eager
+  ``generate`` (tests/test_models.py).
+* **GQA-native.**  kv projections keep Hkv heads; decode_attention consumes
+  them directly.
+
+Reference parity: the phi fused decoding ops the reference reaches through
+masked_multihead_attention / fused_transformer inference
+(paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu); the
+incubate functional is built on the same decode_attention op.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.decode_attention import decode_attention, init_kv_cache
+
+__all__ = ["extract_decode_params", "decode_greedy"]
+
+
+def extract_decode_params(model):
+    """Pull the LlamaForCausalLM weights into a plain pytree of jax arrays
+    (one device copy; reused across every decode call)."""
+    def arr(p):
+        return p.data
+
+    layers = []
+    for blk in model.llama.layers:
+        a, m = blk.self_attn, blk.mlp
+        layers.append({
+            "ln1": arr(blk.input_layernorm.weight),
+            "ln2": arr(blk.post_attention_layernorm.weight),
+            "wq": arr(a.q_proj.weight), "wk": arr(a.k_proj.weight),
+            "wv": arr(a.v_proj.weight), "wo": arr(a.o_proj.weight),
+            "gate": arr(m.gate_proj.weight), "up": arr(m.up_proj.weight),
+            "down": arr(m.down_proj.weight),
+        })
+    p = {
+        "embed": arr(model.llama.embed_tokens.weight),
+        "norm": arr(model.llama.norm.weight),
+        "layers": layers,
+    }
+    if not model.config.tie_word_embeddings:
+        p["lm_head"] = arr(model.lm_head.weight)
+    return p
+
+
+def _rmsnorm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope_tables(lmax, d, theta, dtype):
+    pos = jnp.arange(lmax, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(pos, inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [Lmax, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rope_at(q, k, cos_t, sin_t, positions):
+    """Per-batch rope: positions [B, T] index the precomputed tables
+    (matches models/llama._apply_rope's half-rotate convention)."""
+    cos = cos_t[positions][:, :, None, :]  # [B, T, 1, D]
+    sin = sin_t[positions][:, :, None, :]
+
+    def rot_half(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    return q * cos + rot_half(q) * sin, k * cos + rot_half(k) * sin
+
+
+def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t):
+    """One decoder layer over T new tokens with the static cache.
+    h [B, T, hidden] -> (h', k_cache', v_cache')."""
+    b, t, hidden = h.shape
+    nh, nkv, hd, eps = cfg
+    x = _rmsnorm(h, lp["ln1"], eps)
+    q = (x @ lp["wq"]).reshape(b, t, nh, hd)
+    k = (x @ lp["wk"]).reshape(b, t, nkv, hd)
+    v = (x @ lp["wv"]).reshape(b, t, nkv, hd)
+    positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q, k = _rope_at(q, k, cos_t, sin_t, positions)
+    out, k_cache, v_cache, _ = decode_attention(
+        q, k, v, k_cache, v_cache, lengths)
+    h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
+    x2 = _rmsnorm(h, lp["ln2"], eps)
+    h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
+    return h, k_cache, v_cache
+
+
+def _forward_step(params, cfg, tokens, caches, lengths):
+    """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
+    h = params["embed"][tokens]  # [B, T, hidden]
+    new_caches = []
+    cos_t, sin_t = params["_rope"]
+    for lp, (kc, vc) in zip(params["layers"], caches):
+        h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t)
+        new_caches.append((kc, vc))
+    h = _rmsnorm(h, params["norm"], cfg[3])
+    last = h[:, -1]  # [B, hidden]
+    if "lm_head" in params:
+        logits = last @ params["lm_head"]
+    else:
+        logits = last @ params["embed"].T.astype(last.dtype)
+    return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "lmax"))
+def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax):
+    b, prompt_len = input_ids.shape
+    nh, nkv, hd, eps = cfg
+    dtype = params["embed"].dtype
+    caches = [init_kv_cache(b, lmax, nkv, hd, dtype)
+              for _ in params["layers"]]
+    lengths = jnp.zeros((b,), jnp.int32)
+    # prefill: all prompt tokens in one pass (causal inside decode_attention)
+    logits, caches, lengths = _forward_step(
+        params, cfg, input_ids, caches, lengths)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+
+    def body(carry, _):
+        tok, caches, lengths = carry
+        logits, caches, lengths = _forward_step(
+            params, cfg, tok[:, None], caches, lengths)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, caches, lengths), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        body, (first, caches, lengths), None, length=max_new_tokens - 1)
+    return jnp.concatenate([first[None], rest], 0).T  # [B, new_tokens]
+
+
+def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None):
+    """Greedy-decode ``max_new_tokens`` tokens in ONE compiled program.
+
+    input_ids: [B, prompt_len] int array (prompts assumed same length —
+    pad + mask upstream for ragged prompts).  Returns [B, max_new_tokens]
+    int32.  The compiled program is cached per (shape, max_new_tokens)."""
+    cfg = model.config
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    prompt_len = int(input_ids.shape[1])
+    lmax = int(max_len if max_len is not None
+               else prompt_len + max_new_tokens)
+    # cache the extracted pytree + rope tables on the model: a serving loop
+    # calling decode_greedy per request must not re-walk the Layer tree or
+    # rebuild the cos/sin tables each call (review r5).  Invalidated when
+    # parameters are replaced (id of the first weight changes) or lmax grows.
+    cache_key = (id(model.llama.embed_tokens.weight.data), lmax)
+    cached = getattr(model, "_decode_cache", None)
+    if cached is not None and cached[0] == cache_key:
+        params = cached[1]
+    else:
+        params = dict(extract_decode_params(model))
+        params["_rope"] = _rope_tables(lmax, hd, cfg.rope_theta,
+                                       params["embed"].dtype)
+        model._decode_cache = (cache_key, params)
+    key = (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
+           cfg.rms_norm_eps)
+    ids = jnp.asarray(getattr(input_ids, "data", input_ids), jnp.int32)
+    return _decode_jit(params, key, ids, int(max_new_tokens), lmax)

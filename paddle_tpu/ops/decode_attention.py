@@ -1,0 +1,119 @@
+"""Incremental-decode attention over a preallocated KV cache (TPU-native).
+
+Reference parity: the phi fused ``masked_multihead_attention`` decoding op
+(paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu) — one
+fused append-new-kv + attend-over-cache step per generated token.
+
+TPU-first design choices:
+
+* **Static shapes.**  The cache is preallocated at ``[B, Lmax, Hkv, D]`` and
+  every decode step runs the SAME compiled program regardless of the current
+  length — position masking (``k_idx <= cur_len``) replaces dynamic slicing.
+  The reference's CUDA kernel reads exactly ``cur_len`` keys; on TPU a
+  masked full-length read is one fused bandwidth-bound pass with no
+  recompilation, which is what wins on XLA (SURVEY §3: jit traces once).
+* **GQA-native.**  kv heads are consumed directly (``[B, Hkv, G, ...]``
+  einsums) — no ``repeat`` materialization, KV reads are 1/G of expanded
+  heads.  Decode is HBM-bandwidth-bound (a GEMV per head against the cache),
+  so KV bytes ARE the step time.
+* **Per-batch lengths.**  ``lengths [B]`` supports ragged batches (the
+  reference's ``sequence_lengths``); appends use a vmapped
+  ``dynamic_update_slice`` (lowers to one scatter).
+* Differentiability is not a goal (decode is inference); everything here is
+  plain jnp under jit.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_kv_cache", "decode_attention"]
+
+_NEG_INF = -1e30
+
+
+def init_kv_cache(batch, max_len, num_kv_heads, head_dim, dtype="bfloat16"):
+    """Preallocate a (k, v) cache pair [B, Lmax, Hkv, D]."""
+    shape = (batch, max_len, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _append(cache, new, lengths, layout):
+    """Write ``new [B, T, Hkv, D]`` into the cache at per-batch offsets
+    ``lengths [B]`` (vmapped indexed scatter — no reallocation).
+    ``layout``: "blhd" cache [B, Lmax, Hkv, D] or "bhld" cache
+    [B, Hkv, Lmax, D] (the reference's cache_kv layout).
+
+    Writes past the preallocated capacity are DROPPED (scatter
+    mode="drop"), never clamped: a dynamic_update_slice would silently
+    clamp the offset and overwrite the most recent valid entries (review
+    r5).  Callers must still bound their decode loops by Lmax - prompt_len
+    — an overflowing step simply does not extend the cache."""
+
+    def one(c, n, off):
+        # n is [T, Hkv, D] per batch entry in either cache layout
+        idx = off + jnp.arange(n.shape[0], dtype=jnp.int32)
+        if layout == "blhd":
+            return c.at[idx].set(n.astype(c.dtype), mode="drop")
+        return c.at[:, idx].set(jnp.swapaxes(n, 0, 1).astype(c.dtype),
+                                mode="drop")
+
+    return jax.vmap(one)(cache, new, lengths.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "layout"))
+def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
+                     layout="blhd", attn_bias=None):
+    """One decode step: append new kv, attend causally over the cache.
+
+    q [B, T, H, D] (T = tokens this step, usually 1); k_new/v_new
+    [B, T, Hkv, D]; k_cache/v_cache per ``layout`` ("blhd"
+    [B, Lmax, Hkv, D] — the model projection order — or "bhld"
+    [B, Hkv, Lmax, D] — the reference cache_kv order); lengths [B] — number
+    of valid cache positions BEFORE this step.  ``attn_bias`` (optional,
+    broadcastable to [B, 1, T, Lmax] fp) is added to the scores (the
+    reference's src_mask).  Returns (out [B, T, H, D], k_cache', v_cache',
+    lengths + T).
+
+    Query token t (global position lengths+t) attends to cache positions
+    <= lengths+t: bottom-right-aligned causality, same convention as the
+    flash kernels' cached prefill.
+    """
+    b, t, h, d = q.shape
+    hkv = k_new.shape[2]
+    lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+    if hkv <= 0 or h % hkv:
+        raise ValueError(
+            f"decode_attention: query heads ({h}) must be an integer "
+            f"multiple of kv heads ({hkv})")
+    g = h // hkv
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    lengths = lengths.astype(jnp.int32)
+
+    k_cache = _append(k_cache, k_new, lengths, layout)
+    v_cache = _append(v_cache, v_new, lengths, layout)
+    k_eq = "blkd" if layout == "blhd" else "bkld"
+
+    # [B, Hkv, G, T, D] x cache -> [B, Hkv, G, T, Lmax]
+    qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 3, 1, 4)
+    s = jnp.einsum(
+        f"bkgtd,{k_eq}->bkgtl", qg.astype(jnp.float32),
+        k_cache.astype(jnp.float32), preferred_element_type=jnp.float32,
+    ) * scale
+    if attn_bias is not None:
+        bias = jnp.asarray(attn_bias, jnp.float32)
+        bias = jnp.broadcast_to(bias, (b, 1, t, lmax))
+        s = s + bias[:, :, None, :, :]
+    k_idx = jnp.arange(lmax, dtype=jnp.int32)
+    q_pos = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
+    live = k_idx[None, None, :] <= q_pos[:, :, None]                    # [B,T,L]
+    s = jnp.where(live[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        f"bkgtl,{k_eq}->bkgtd", p, v_cache.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d).astype(q.dtype)
+    return out, k_cache, v_cache, lengths + t

@@ -1,0 +1,193 @@
+"""Incremental-decode attention + KV cache + compiled greedy decoding.
+
+Covers VERDICT r4 next-round #6: ops/decode_attention.py,
+incubate masked_multihead_attention, and models/llama_decode.decode_greedy
+(parity against full-attention recompute / the eager generate loop).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+def _dense_ref(q_all, k_all, v_all, scale=None):
+    """Dense causal attention over the FULL sequence (GQA expanded)."""
+    d = q_all.shape[-1]
+    if k_all.shape[2] != q_all.shape[2]:
+        rep = q_all.shape[2] // k_all.shape[2]
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q_all, k_all, v_all))
+    sc = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    lq, lk = sc.shape[-2], sc.shape[-1]
+    sc = jnp.where(jnp.tril(jnp.ones((lq, lk), bool), lk - lq), sc, -1e30)
+    p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(q_all.dtype)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("hkv", [4, 2])  # MHA / GQA
+    def test_stepwise_matches_full_recompute(self, hkv):
+        """Prefill + N single-token decode steps == dense causal attention
+        over the whole sequence."""
+        from paddle_tpu.ops.decode_attention import (decode_attention,
+                                                     init_kv_cache)
+
+        B, P, N, h, d = 2, 12, 5, 4, 16
+        L = P + N
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q_all = jax.random.normal(ks[0], (B, L, h, d), jnp.float32)
+        k_all = jax.random.normal(ks[1], (B, L, hkv, d), jnp.float32)
+        v_all = jax.random.normal(ks[2], (B, L, hkv, d), jnp.float32)
+
+        kc, vc = init_kv_cache(B, L, hkv, d, "float32")
+        lengths = jnp.zeros((B,), jnp.int32)
+        outs = []
+        out, kc, vc, lengths = decode_attention(
+            q_all[:, :P], k_all[:, :P], v_all[:, :P], kc, vc, lengths)
+        outs.append(out)
+        for t in range(P, L):
+            out, kc, vc, lengths = decode_attention(
+                q_all[:, t:t + 1], k_all[:, t:t + 1], v_all[:, t:t + 1],
+                kc, vc, lengths)
+            outs.append(out)
+        got = jnp.concatenate(outs, axis=1)
+        ref = _dense_ref(q_all, k_all, v_all)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert np.all(np.asarray(lengths) == L)
+
+    def test_ragged_lengths(self):
+        """Per-batch lengths: each example attends to its own prefix only."""
+        from paddle_tpu.ops.decode_attention import (decode_attention,
+                                                     init_kv_cache)
+
+        B, Lmax, h, d = 2, 16, 2, 8
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        k_all = jax.random.normal(ks[1], (B, Lmax, h, d), jnp.float32)
+        v_all = jax.random.normal(ks[2], (B, Lmax, h, d), jnp.float32)
+        kc, vc = init_kv_cache(B, Lmax, h, d, "float32")
+        lens = np.array([5, 9])
+        # prime each row's cache with its own prefix (uniform write then
+        # per-batch lengths for the probe step)
+        for b in range(B):
+            kc = kc.at[b, :lens[b]].set(k_all[b, :lens[b]])
+            vc = vc.at[b, :lens[b]].set(v_all[b, :lens[b]])
+        q = jax.random.normal(ks[0], (B, 1, h, d), jnp.float32)
+        knew = k_all[:, 10:11]
+        vnew = v_all[:, 10:11]
+        out, kc2, vc2, newlen = decode_attention(
+            q, knew, vnew, kc, vc, jnp.asarray(lens, jnp.int32))
+        assert np.all(np.asarray(newlen) == lens + 1)
+        for b in range(B):
+            # reference: prefix + the new token
+            kk = jnp.concatenate([k_all[b:b + 1, :lens[b]], knew[b:b + 1]], 1)
+            vv = jnp.concatenate([v_all[b:b + 1, :lens[b]], vnew[b:b + 1]], 1)
+            ref = _dense_ref(q[b:b + 1], kk, vv)
+            np.testing.assert_allclose(np.asarray(out[b:b + 1]),
+                                       np.asarray(ref), rtol=2e-5, atol=2e-5)
+            # cache got the new token at position lens[b]
+            np.testing.assert_array_equal(np.asarray(kc2[b, lens[b]]),
+                                          np.asarray(knew[b, 0]))
+
+    def test_overflow_writes_dropped(self):
+        """Writes past Lmax are DROPPED, not clamped onto valid entries."""
+        from paddle_tpu.ops.decode_attention import (decode_attention,
+                                                     init_kv_cache)
+
+        B, Lmax, h, d = 1, 4, 1, 8
+        kc, vc = init_kv_cache(B, Lmax, h, d, "float32")
+        k1 = jnp.ones((B, 1, h, d))
+        q = jnp.ones((B, 1, h, d))
+        _, kc, vc, lengths = decode_attention(
+            q, k1, k1, kc, vc, jnp.asarray([Lmax], jnp.int32))
+        assert np.all(np.asarray(kc) == 0.0)  # nothing overwritten
+
+
+class TestMaskedMultiheadAttention:
+    def test_matches_dense_with_mask_and_bias(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        B, H, D, Lmax, cur = 2, 4, 16, 12, 6
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((B, 3 * H * D)).astype("float32")
+        bias = rng.standard_normal((3, H, D)).astype("float32")
+        cache = np.zeros((2, B, H, Lmax, D), "float32")
+        k_prev = rng.standard_normal((B, cur, H, D)).astype("float32")
+        v_prev = rng.standard_normal((B, cur, H, D)).astype("float32")
+        cache[0, :, :, :cur] = k_prev.transpose(0, 2, 1, 3)
+        cache[1, :, :, :cur] = v_prev.transpose(0, 2, 1, 3)
+        mask = rng.standard_normal((B, 1, 1, cur + 1)).astype("float32")
+
+        out, cache_out = IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            bias=paddle.to_tensor(bias), src_mask=paddle.to_tensor(mask))
+
+        xb = x + bias.reshape(-1)
+        q, k, v = np.split(xb.reshape(B, 3, H, D), 3, axis=1)
+        scale = 1.0 / np.sqrt(D)
+        ref_rows = []
+        for b in range(B):
+            kk = np.concatenate([k_prev[b], k[b]], 0)  # [cur+1, H, D]
+            vv = np.concatenate([v_prev[b], v[b]], 0)
+            s = np.einsum("ohd,khd->hk", q[b], kk) * scale
+            s = s + mask[b, 0, 0][None, :]
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref_rows.append(np.einsum("hk,khd->hd", p, vv).reshape(H * D))
+        np.testing.assert_allclose(out.numpy(), np.stack(ref_rows),
+                                   rtol=2e-5, atol=2e-5)
+        # cache updated at position cur in the reference layout
+        co = cache_out.numpy()
+        np.testing.assert_allclose(co[0, :, :, cur],
+                                   k[:, 0], rtol=1e-6, atol=1e-6)
+        assert co.shape == cache.shape
+
+    def test_sequence_lengths_and_unsupported(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        B, H, D, Lmax = 2, 2, 8, 8
+        x = paddle.to_tensor(np.random.randn(B, 3 * H * D).astype("float32"))
+        cache = paddle.to_tensor(np.zeros((2, B, H, Lmax, D), "float32"))
+        seqlens = paddle.to_tensor(np.array([[0], [3]], dtype="int32"))
+        out, cache_out = IF.masked_multihead_attention(
+            x, cache_kv=cache, sequence_lengths=seqlens)
+        assert list(out.shape) == [B, H * D]
+        with pytest.raises(NotImplementedError):
+            IF.masked_multihead_attention(
+                x, cache_kv=cache, sequence_lengths=seqlens,
+                beam_cache_offset=paddle.to_tensor(np.zeros((1,), "int32")))
+        with pytest.raises(ValueError):
+            IF.masked_multihead_attention(x, cache_kv=cache)
+
+
+class TestCompiledDecode:
+    def test_decode_greedy_matches_eager_generate(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_decode import decode_greedy
+
+        cfg = LlamaConfig.tiny(dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 256, (2, 7)), dtype="int64")
+        eager = model.generate(ids, max_new_tokens=6).numpy()
+        compiled = np.asarray(decode_greedy(model, ids, max_new_tokens=6))
+        np.testing.assert_array_equal(compiled, eager)
+
+    def test_decode_greedy_tied_embeddings(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_decode import decode_greedy
+
+        cfg = LlamaConfig.tiny(dtype="float32", tie_word_embeddings=True)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, 256, (1, 5)), dtype="int64")
+        eager = model.generate(ids, max_new_tokens=4).numpy()
+        compiled = np.asarray(decode_greedy(model, ids, max_new_tokens=4))
+        np.testing.assert_array_equal(compiled, eager)
