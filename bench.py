@@ -177,6 +177,71 @@ def bench_decode(ctx=2048, new_tokens=64):
     return out
 
 
+def bench_longseq(seqs=(16384, 32768), iters=3):
+    """Long-context flash attention (VERDICT r4 next-round #7): causal
+    fwd+bwd MFU of the streamed-KV Pallas kernels at 16k/32k tokens on one
+    chip (GQA 16h/4kv, d=128, bf16 — the flagship head geometry).  MFU here
+    is attention-matmul FLOPs (causal half, bwd counted 2.5x fwd) over
+    wall-clock; the blockwise jnp fallback at 16k is recorded alongside as
+    the non-Pallas baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.flash_attention import (blockwise_attention,
+                                                flash_attention_blhd)
+
+    B, H, HKV, D = 1, 16, 4, 128
+    out = {}
+    peak = _peak_tflops()
+
+    def measure(fn, L, backward=True):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, L, HKV, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, L, HKV, D), jnp.bfloat16)
+        if backward:
+            g = jax.grad(
+                lambda a, b, c: fn(a, b, c).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))
+
+            @jax.jit
+            def chain(q, k, v):
+                def body(i, c):
+                    qq, kk, vv = c
+                    dq, dk, dv = g(qq, kk, vv)
+                    e = 1e-6
+                    return ((qq + dq * e).astype(q.dtype),
+                            (kk + dk * e).astype(q.dtype),
+                            (vv + dv * e).astype(q.dtype))
+                o = jax.lax.fori_loop(0, iters, body, (q, k, v))
+                return o[0].sum() + o[1].sum() + o[2].sum()
+        else:
+            @jax.jit
+            def chain(q, k, v):
+                def body(i, qq):
+                    return fn(qq, k, v).astype(q.dtype)
+                return jax.lax.fori_loop(0, iters, body, q).sum()
+
+        np.asarray(chain(q, k, v))
+        t0 = time.perf_counter()
+        np.asarray(chain(q, k, v))
+        dt = (time.perf_counter() - t0) / iters
+        # causal fwd matmul FLOPs; fwd+bwd counted as fwd + 2.5x fwd
+        flops = 2 * B * H * L * L * D * (3.5 if backward else 1.0)
+        return flops / dt / 1e12 / peak
+
+    for L in seqs:
+        out[f"flash_{L//1024}k_attn_mfu"] = round(measure(
+            lambda a, b, c: flash_attention_blhd(a, b, c, causal=True), L), 4)
+    # the jnp fallback is FORWARD-only at 16k: its backward is plain
+    # autodiff through the scan, whose saved residuals exceed HBM at this
+    # length — exactly why the Pallas kernels carry a custom backward
+    out["blockwise_16k_fwd_attn_mfu"] = round(measure(
+        lambda a, b, c: blockwise_attention(a, b, c, causal=True), 16384,
+        backward=False), 4)
+    return out
+
+
 def bench_bert(iters=10, batch=64, seq=512):
     """BERT-base MLM pretraining samples/sec (BASELINE.md ERNIE/BERT north
     star; reference: PaddleNLP pretraining configs on Fleet DP)."""
@@ -349,7 +414,7 @@ def main():
     secondary = {}
     if os.environ.get("BENCH_PRIMARY_ONLY") != "1":
         for fn in (bench_resnet50, bench_bert, bench_moe, bench_decode,
-                   bench_eager, bench_collectives):
+                   bench_longseq, bench_eager, bench_collectives):
             try:
                 secondary.update(fn())
             except Exception as e:

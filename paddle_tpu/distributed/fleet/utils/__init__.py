@@ -2,4 +2,8 @@
 from paddle_tpu.distributed.fleet.recompute import (  # noqa: F401
     recompute, recompute_sequential,
 )
+from paddle_tpu.distributed.fleet.utils import fs  # noqa: F401
 from paddle_tpu.distributed.fleet.utils import pp_parallel_adaptor  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.fs import (  # noqa: F401
+    HDFSClient, LocalFS,
+)

@@ -1,0 +1,250 @@
+"""Classified disposition of EVERY reference runtime flag.
+
+The reference exports 182 ``FLAGS_*`` via PHI_DEFINE_EXPORTED_* in
+``paddle/common/flags.cc``.  This table classifies each one for the TPU
+runtime (VERDICT r4 gap #5 closure):
+
+* ``consumed`` — read by this framework; grep the name for the consumer.
+* ``mapped``  — the CONCERN exists on TPU but is owned by a named
+  component of the XLA/PJRT/jax stack (or by a subsystem of this repo with
+  its own API); setting the flag is accepted and documented as a no-op.
+* ``na``      — CUDA/cuDNN/CINN/GPU-PS plumbing with no TPU counterpart;
+  accepted for script compatibility, documented N/A.
+
+``tests/test_strategy_flags.py`` parses flags.cc at test time and asserts
+every exported flag appears here — the table cannot silently rot.
+"""
+from __future__ import annotations
+
+CONSUMED = {
+    "check_nan_inf": "autograd chokepoint nan/inf screen (engine.apply)",
+    "check_nan_inf_level": "nan screen severity (framework/flags.py)",
+    "low_precision_op_list": "amp.debugging op-list collection",
+    "benchmark": "profiler step timing annotations",
+    "enable_pir_api": "selects the StableHLO program surface (always on)",
+    "jit_engine_type": "inference Predictor wrapper tag",
+    "call_stack_level": "error-report verbosity (framework/flags.py)",
+}
+
+# concern exists on TPU; the named owner covers it
+MAPPED = {
+    # -- compiler (the reference's CINN; XLA here) --------------------------
+    "use_cinn": "XLA is the compiler on TPU (jit traces compile whole)",
+    "allow_cinn_ops": "XLA fusion heuristics own op selection",
+    "deny_cinn_ops": "XLA fusion heuristics own op selection",
+    "enable_cinn_accuracy_check": "decomposition parity suite owns checks",
+    "enable_cinn_auto_tune": "XLA autotuner (XLA_FLAGS) owns tuning",
+    "enable_cinn_compile_cache": "jax persistent compilation cache",
+    "cinn_compile_thread_num": "XLA compile parallelism (XLA_FLAGS)",
+    "cinn_subgraph_graphviz_dir": "XLA HLO dumps (XLA_FLAGS=--xla_dump_to)",
+    "cinn_specify_input_dynamic_dim": "jax shape polymorphism owns dyn dims",
+    "cinn_input_dynamic_dim_spec_file": "jax shape polymorphism",
+    "disable_dyshape_in_train": "static shapes are the TPU default here",
+    "check_infer_symbolic": "jax.eval_shape is the shape oracle",
+    "enable_fusion_fallback": "XLA fusion never falls back per-op",
+    "enable_interpretercore_launch_cinn": "one executable per step already",
+    "enable_fuse_parallel_matmul_pass": "XLA dot merger pass",
+    "enable_auto_layout_pass": "XLA layout assignment",
+    "enable_adjust_op_order": "XLA scheduler owns op order",
+    "enable_cse_in_dy2st": "XLA CSE pass",
+    "cse_max_count": "XLA CSE pass",
+    "enable_append_iters_in_fusion": "XLA loop fusion internals",
+    "enable_reuse_iters_in_fusion": "XLA loop fusion internals",
+    "enable_transpose_iters_in_fusion": "XLA loop fusion internals",
+    # -- IR / debugging dumps ----------------------------------------------
+    "print_ir": "jitted HLO via jax .lower().as_text() / XLA_FLAGS dumps",
+    "pir_debug": "StableHLO text dumps own IR debugging",
+    "logging_pir_py_code_dir": "StableHLO dumps",
+    "logging_pir_py_code_dump_symbolic_dims": "StableHLO dumps",
+    "logging_pir_py_code_int_tensor_element_limit": "StableHLO dumps",
+    "logging_trunc_pir_py_code": "StableHLO dumps",
+    "pir_subgraph_saving_dir": "StableHLO dumps",
+    "pir_apply_inplace_pass": "XLA buffer donation owns in-place",
+    "pir_apply_shape_optimization_pass": "XLA shape inference",
+    "pir_broadcast_tree_limit": "XLA broadcast handling",
+    "enable_pir_in_executor": "StableHLO is the only executor IR",
+    "enable_pir_in_executor_trace_run": "StableHLO executor",
+    "enable_pir_with_pt_in_dy2st": "dy2static traces jax directly",
+    "ir_inplace_kernel_blacklist": "XLA buffer donation",
+    # -- prim / decomposition ----------------------------------------------
+    "prim_check_ops": "decomposition/ rules parity suite",
+    "prim_enable_dynamic": "decomposition handles traced shapes natively",
+    "prim_forward_blacklist": "core.set_prim_forward_blacklist API",
+    "prim_skip_dynamic": "decomposition handles traced shapes natively",
+    # -- memory / allocator (PJRT owns HBM) --------------------------------
+    "allocator_strategy": "PJRT BFC allocator",
+    "auto_growth_chunk_size_in_mb": "PJRT allocator growth policy",
+    "eager_delete_tensor_gb": "PJRT buffer lifetime",
+    "eager_delete_scope": "python GC + PJRT buffer lifetime",
+    "fraction_of_gpu_memory_to_use": "TPU HBM is whole-chip under PJRT",
+    "fraction_of_cpu_memory_to_use": "host allocations via numpy/jax",
+    "fraction_of_cuda_pinned_memory_to_use": "PJRT pins host staging",
+    "initial_cpu_memory_in_mb": "host allocator",
+    "initial_gpu_memory_in_mb": "PJRT preallocation env",
+    "reallocate_gpu_memory_in_mb": "PJRT allocator",
+    "memory_fraction_of_eager_deletion": "PJRT buffer lifetime",
+    "fast_eager_deletion_mode": "PJRT buffer lifetime",
+    "gpu_memory_limit_mb": "PJRT memory limit env",
+    "log_memory_stats": "device.cuda.memory_* stats API",
+    "free_idle_chunk": "PJRT allocator",
+    "free_when_no_cache_hit": "PJRT allocator",
+    "use_system_allocator": "PJRT owns device allocation",
+    "use_pinned_memory": "PJRT host staging",
+    "use_auto_growth_pinned_allocator": "PJRT host staging",
+    "pinned_memory_as_cpu_backend": "jax host arrays",
+    "use_shm_cache": "io/ shm rings own worker transport",
+    "dataloader_use_file_descriptor": "io/ shm rings own worker transport",
+    "alloc_fill_value": "XLA deterministic init; nan screen covers debug",
+    "init_allocated_mem": "XLA deterministic init",
+    "sync_after_alloc": "PJRT allocation is synchronous to the program",
+    "custom_device_mem_record": "profiler memory events",
+    "enable_record_memory": "profiler.export memory section",
+    # -- executor / dispatch ------------------------------------------------
+    "new_executor_serial_run": "XLA schedules the compiled program",
+    "new_executor_sequential_run": "XLA schedules the compiled program",
+    "executor_log_deps_every_microseconds": "XLA scheduling",
+    "local_exe_sub_scope_limit": "no scopes; functional state instead",
+    "cache_inference_while_scope": "compiled programs carry no scopes",
+    "max_inplace_grad_add": "XLA fuses gradient accumulation",
+    "sort_sum_gradient": "autograd ready-queue orders accumulation",
+    "use_stride_kernel": "jax views are lazily strided",
+    "set_to_1d": "0-d tensors are native",
+    "convert_all_blocks": "single-IR design",
+    "apply_pass_to_program": "inference pass pipeline API",
+    "tensor_operants_mode": "one dispatch path (engine.apply)",
+    "enable_api_kernel_fallback": "single backend; nothing to fall to",
+    "paddle_num_threads": "host threading is jax/XLA's",
+    "inner_op_parallelism": "XLA intra-op parallelism",
+    "cpu_deterministic": "XLA determinism flags",
+    "embedding_deterministic": "XLA scatter determinism",
+    "cudnn_deterministic": "XLA determinism flags",
+    "enable_auto_parallel_align_mode": "auto_parallel Engine owns alignment",
+    "use_autotune": "XLA autotuner",
+    "use_fast_math": "XLA exactness flags (xla_allow_excess_precision)",
+    "einsum_opt": "jnp.einsum optimizes contraction order always",
+    "search_cache_max_number": "dispatch cache sizing (autograd engine)",
+    "save_cf_stack_op": "lax control flow carries state explicitly",
+    "save_static_runtime_data": "jit.save StableHLO artifacts",
+    "static_runtime_data_save_path": "jit.save StableHLO artifacts",
+    "print_allocator_trace_info": "profiler memory events",
+    "benchmark_nccl": "fleet.collective_perf micro-bench",
+    "reader_queue_speed_test_mode": "io DataLoader profiling",
+    "enable_exit_when_partial_worker": "elastic controller owns exits",
+    "host_trace_level": "profiler host tracer",
+    "enable_async_trace": "jax async dispatch + profiler",
+    "async_trace_count": "profiler",
+    "multiple_of_cupti_buffer_size": "jax.profiler owns device tracing",
+    # -- distributed (XLA collectives / this repo's fleet) ------------------
+    "sync_nccl_allreduce": "XLA collectives are in-program (no streams)",
+    "nccl_blocking_wait": "comm watchdog owns timeouts",
+    "allreduce_record_one_event": "in-program collectives need no events",
+    "dynamic_static_unified_comm": "one CommContext design already",
+    "eager_communication_connection": "mesh formation at init_parallel_env",
+    "enable_all2all_use_fp16": "dtype explicit in shard_map programs",
+    "distributed_deep_ep": "moe all-to-all path is explicit",
+    "communicator_max_merge_var_num": "ps service batches pushes",
+    "communicator_send_queue_size": "ps service socket queue",
+    "communicator_is_sgd_optimizer": "ps optimizer config",
+    "dist_threadpool_size": "ps service thread pool",
+    "get_host_by_name_time": "launch rendezvous timeout env",
+    "query_dest_rank_by_multi_node": "mesh topology owns rank mapping",
+    "enable_auto_detect_gpu_topo": "mesh topology is explicit",
+    "enable_auto_rdma_trans": "ICI/DCN transport is XLA's",
+    "apply_pass_to_program_startup": "n/a placeholder",  # pruned by test
+}
+
+# no TPU counterpart at all: CUDA/cuDNN library plumbing, GPU-PS graph
+# engine, vendor-specific kernels
+NA = {
+    # CUDA library discovery paths
+    "cublas_dir": "CUDA library path",
+    "cudnn_dir": "CUDA library path",
+    "cupti_dir": "CUDA library path",
+    "curand_dir": "CUDA library path",
+    "cusolver_dir": "CUDA library path",
+    "cusparse_dir": "CUDA library path",
+    "cusparselt_dir": "CUDA library path",
+    "lapack_dir": "CPU LAPACK discovery (jax ships its own)",
+    "mkl_dir": "oneDNN/MKL path",
+    "mklml_dir": "oneDNN/MKL path",
+    "nccl_dir": "NCCL path",
+    "nvidia_package_dir": "CUDA wheel path",
+    "op_dir": "custom CUDA op path (custom-device plugin host instead)",
+    "win_cuda_bin_dir": "Windows CUDA path",
+    # cuDNN / cuBLAS behavior knobs
+    "cudnn_exhaustive_search": "cuDNN autotune",
+    "cudnn_exhaustive_search_times": "cuDNN autotune",
+    "cudnn_cache_saturation_count": "cuDNN autotune",
+    "cudnn_batchnorm_spatial_persistent": "cuDNN batchnorm",
+    "conv2d_disable_cudnn": "cuDNN conv",
+    "conv_workspace_size_limit": "cuDNN workspace",
+    "enable_cudnn_frontend": "cuDNN frontend",
+    "enable_cublas_tensor_op_math": "cuBLAS tensor cores",
+    "cublaslt_device_best_config": "cuBLASLt tuning",
+    "cublaslt_exhaustive_search_times": "cuBLASLt tuning",
+    "enable_blaslt_global_search": "cuBLASLt tuning",
+    "gemm_use_half_precision_compute_type": "cuBLAS compute type",
+    "batch_norm_use_miopen": "ROCm MIOpen",
+    "use_cuda_malloc_async_allocator": "CUDA async allocator",
+    "cuda_malloc_async_pool_memory_throttle_ratio": "CUDA async allocator",
+    "auto_free_cudagraph_allocations_on_launch": "CUDA graphs",
+    "new_executor_use_cuda_graph": "CUDA graphs (jit IS graph capture)",
+    "manually_trans_conv_filter": "cuDNN filter layout",
+    "selected_gpus": "CUDA device selection (jax devices API)",
+    "run_kp_kernel": "XPU kernel-primitive path",
+    "npu_storage_format": "Ascend NPU private format",
+    "tracer_onednn_ops_on": "oneDNN tracer",
+    "tracer_onednn_ops_off": "oneDNN tracer",
+    "use_mkldnn": "oneDNN",
+    "trt_ibuilder_cache": "TensorRT",
+    "trt_min_group_size": "TensorRT",
+    "enable_collect_shape": "TensorRT shape collection",
+    "multi_block_attention_min_partition_size": "CUDA decoding kernel",
+    "fused_multi_transformer_op_use_mbfmha": "CUDA fused transformer",
+    "use_xqa_optim": "CUDA XQA decoding",
+    "accuracy_check_atol_fp32": "CINN-vs-CUDA accuracy harness",
+    "accuracy_check_rtol_fp32": "CINN-vs-CUDA accuracy harness",
+    "accuracy_check_atol_fp16": "CINN-vs-CUDA accuracy harness",
+    "accuracy_check_rtol_fp16": "CINN-vs-CUDA accuracy harness",
+    "accuracy_check_atol_bf16": "CINN-vs-CUDA accuracy harness",
+    "accuracy_check_rtol_bf16": "CINN-vs-CUDA accuracy harness",
+    "check_kernel_launch": "CUDA launch check",
+    # GPU-PS graph engine (gpugraph) — the SSD/graph PS tables here are
+    # host-side (ps/table.py); the CUDA graph engine has no TPU analog
+    "gpugraph_debug_gpu_memory": "GPU-PS graph engine",
+    "gpugraph_dedup_pull_push_mode": "GPU-PS graph engine",
+    "gpugraph_enable_gpu_direct_access": "GPU-PS graph engine",
+    "gpugraph_enable_hbm_table_collision_stat": "GPU-PS graph engine",
+    "gpugraph_enable_segment_merge_grads": "GPU-PS graph engine",
+    "gpugraph_hbm_table_load_factor": "GPU-PS graph engine",
+    "gpugraph_load_node_list_into_hbm": "GPU-PS graph engine",
+    "gpugraph_merge_grads_segment_size": "GPU-PS graph engine",
+    "gpugraph_slot_feasign_max_num": "GPU-PS graph engine",
+    "gpugraph_sparse_table_storage_mode": "GPU-PS graph engine",
+    "gpugraph_storage_mode": "GPU-PS graph engine",
+    "graph_embedding_split_infer_mode": "GPU-PS graph engine",
+    "graph_get_neighbor_id": "GPU-PS graph engine",
+    "graph_load_in_parallel": "GPU-PS graph engine",
+    "graph_metapath_split_opt": "GPU-PS graph engine",
+    "graph_neighbor_size_percent": "GPU-PS graph engine",
+    "enable_graph_multi_node_sampling": "GPU-PS graph engine",
+    "enable_neighbor_list_use_uva": "CUDA UVA",
+    "enable_opt_get_features": "GPU-PS graph engine",
+    "enable_sparse_inner_gather": "GPU-PS sparse",
+    "enable_tracker_all2all": "GPU-PS tracker",
+    "multi_node_sample_use_gpu_table": "GPU-PS graph engine",
+}
+
+MAPPED.pop("apply_pass_to_program_startup", None)  # placeholder removed
+
+
+def classification():
+    """{flag_name: (category, reason)} over every classified flag."""
+    out = {}
+    for name, why in CONSUMED.items():
+        out[name] = ("consumed", why)
+    for name, why in MAPPED.items():
+        out[name] = ("mapped", why)
+    for name, why in NA.items():
+        out[name] = ("na", why)
+    return out

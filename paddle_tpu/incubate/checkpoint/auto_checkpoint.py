@@ -67,20 +67,75 @@ def _load_epoch(path):
     return epoch
 
 
-def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, checkpoint_dir=None):
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1,
+                      checkpoint_dir=None, fs=None):
     """Yield the epochs still to be trained, checkpointing behind the scenes.
 
     for epoch in train_epoch_range(10):   # resumes mid-range after a crash
         train_one_epoch(...)
+
+    ``fs`` (optional): a ``fleet.utils.fs`` client (LocalFS / HDFSClient —
+    the reference's hdfs-backed auto checkpointer rides the same
+    abstraction).  A client whose ``need_upload_download()`` is True treats
+    ``checkpoint_dir`` as a REMOTE path: epochs are written to a local
+    staging dir and uploaded atomically (delete + upload), and resume
+    downloads the remote state first.
     """
     if checkpoint_dir is not None:
         _STATE["dir"] = checkpoint_dir
     path = _ckpt_dir()
+    remote = fs is not None and fs.need_upload_download() and path
+    stage = None
+    if remote:
+        import tempfile
+
+        stage = tempfile.mkdtemp(prefix="auto_ckpt_stage_")
+        # recover from a crash mid-swap: persist() renames the previous
+        # checkpoint to <path>._old before moving the new one in; if only
+        # the ._old survives, it IS the last complete checkpoint
+        _old = f"{path}._old"
+        if not fs.is_exist(path) and fs.is_exist(_old):
+            fs.mv(_old, path)
+        if fs.is_exist(path):
+            fs.download(path, os.path.join(stage, "dl"))
+            local_path = os.path.join(stage, "dl")
+        else:
+            local_path = os.path.join(stage, "dl")
+            os.makedirs(local_path, exist_ok=True)
+    else:
+        local_path = path
+
+    def persist(epoch):
+        _save_epoch(local_path, epoch)
+        if remote:
+            # upload to a fresh temp name, then mv into place — a crash
+            # between delete and upload must never strand the job with NO
+            # remote checkpoint (the exact failure auto-checkpoint exists
+            # to survive).  fs.mv is a metadata rename on HDFS.
+            tmp = f"{path}._uploading_{epoch}"
+            if fs.is_exist(tmp):
+                fs.delete(tmp)
+            fs.upload(local_path, tmp)
+            old = f"{path}._old"
+            if fs.is_exist(old):
+                fs.delete(old)
+            if fs.is_exist(path):
+                fs.mv(path, old)
+            fs.mv(tmp, path)
+            if fs.is_exist(old):
+                fs.delete(old)
+
     start = 0
-    if path:
-        start = _load_epoch(path) + 1
-    for epoch in range(start, int(max_epoch_num)):
-        yield epoch
-        if path and (epoch % max(int(save_checkpoint_inter), 1) == 0
-                     or epoch == max_epoch_num - 1):
-            _save_epoch(path, epoch)
+    if local_path:
+        start = _load_epoch(local_path) + 1
+    try:
+        for epoch in range(start, int(max_epoch_num)):
+            yield epoch
+            if local_path and (epoch % max(int(save_checkpoint_inter), 1) == 0
+                               or epoch == max_epoch_num - 1):
+                persist(epoch)
+    finally:
+        if stage is not None:
+            import shutil
+
+            shutil.rmtree(stage, ignore_errors=True)

@@ -163,17 +163,20 @@ def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None):
                else prompt_len + max_new_tokens)
     # cache the extracted pytree + rope tables on the model: a serving loop
     # calling decode_greedy per request must not re-walk the Layer tree or
-    # rebuild the cos/sin tables each call (review r5).  Invalidated when
-    # parameters are replaced (id of the first weight changes) or lmax grows.
-    cache_key = (id(model.llama.embed_tokens.weight.data), lmax)
+    # rebuild the cos/sin tables each call (review r5).  Validity is an
+    # `is` check against the live embedding array (NOT id() — the cache
+    # holds a strong reference to the cached array, so a replaced weight
+    # can never alias a recycled id); invalidated when weights are swapped
+    # (set_state_dict) or lmax changes.
+    live_w = model.llama.embed_tokens.weight.data
     cached = getattr(model, "_decode_cache", None)
-    if cached is not None and cached[0] == cache_key:
-        params = cached[1]
+    if cached is not None and cached[0] is live_w and cached[1] == lmax:
+        params = cached[2]
     else:
         params = dict(extract_decode_params(model))
         params["_rope"] = _rope_tables(lmax, hd, cfg.rope_theta,
                                        params["embed"].dtype)
-        model._decode_cache = (cache_key, params)
+        model._decode_cache = (live_w, lmax, params)
     key = (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
            cfg.rms_norm_eps)
     ids = jnp.asarray(getattr(input_ids, "data", input_ids), jnp.int32)

@@ -205,6 +205,181 @@ def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
         lse_ref[0, j] = jnp.broadcast_to(m + jnp.log(l_safe), (8, rows))
 
 
+# ------------------------------------------------------------- streamed fwd
+# Long-context variants: the resident kernels above hold the FULL K/V in
+# VMEM per program (fast at 2k: one HBM fetch per q-block program), which
+# overflows the 16MB scoped budget past ~12k tokens at d=128.  The streamed
+# kernels move the k loop into the innermost GRID dimension: k/v arrive as
+# [block_k] tiles, the online-softmax state lives in VMEM scratch across
+# the k sweep (q/o blocks have k-independent index maps, so they stay
+# resident), and outputs are written on the last k step.  Same math, same
+# lse layout — the backward's dkv kernel already streams and works at any
+# L.  hp == 1 only (the long-context target is the GQA d=128 family).
+
+
+def _fwd_kernel_streamed(*refs, causal: bool, scale: float, group: int,
+                         head_dim: int, q_offset: int,
+                         segmented: bool = False):
+    """Grid (b, kv_head, q_block, k_block); scratch carries (acc, m, l)."""
+    if segmented:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    block_q = q_ref.shape[1]
+    rows = block_q * group
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nkb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    if causal:
+        # block classes relative to the bottom-right-aligned diagonal
+        live = (qi + 1) * block_q + q_offset > kb * block_k
+        full = q_offset + qi * block_q >= (kb + 1) * block_k
+    else:
+        live, full = True, True
+
+    def compute(masked):
+        q = q_ref[0].reshape(rows, head_dim)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if segmented:
+            qseg = qseg_ref[0, 0, 0]
+            kseg = kseg_ref[0, 0, 0]
+            s = jnp.where(qseg[:, None] == kseg[None, :], s,
+                          jnp.float32(_NEG_INF))
+        if masked:
+            q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, group, block_k), 0
+            ).reshape(rows, block_k)
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+        m = m_ref[0]  # [rows] row 0 of the (8, rows) sublane-replicated state
+        l = l_ref[0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        @pl.when(full)
+        def _full():
+            compute(False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _band():
+            compute(True)
+    else:
+        compute(False)
+
+    @pl.when(kb == nkb - 1)
+    def _fin():
+        l_safe = jnp.maximum(l_ref[0], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).reshape(
+            block_q, group * head_dim).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[0] + jnp.log(l_safe), (8, rows))
+
+
+def _bwd_dq_kernel_streamed(*refs, causal: bool, scale: float, group: int,
+                            head_dim: int, q_offset: int,
+                            segmented: bool = False):
+    """Grid (b, kv_head, q_block, k_block); dq accumulates in scratch."""
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dq_ref, dqacc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dqacc_ref) = refs
+    block_q = q_ref.shape[1]
+    rows = block_q * group
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nkb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dqacc_ref[...] = jnp.zeros_like(dqacc_ref)
+
+    if causal:
+        live = (qi + 1) * block_q + q_offset > kb * block_k
+        full = q_offset + qi * block_q >= (kb + 1) * block_k
+    else:
+        live, full = True, True
+
+    def compute(masked):
+        q = q_ref[0].reshape(rows, head_dim)
+        do = do_ref[0].reshape(rows, head_dim)
+        lse = lse_ref[0, 0, 0]
+        delta = delta_ref[0, 0, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if segmented:
+            qseg = qseg_ref[0, 0, 0]
+            kseg = kseg_ref[0, 0, 0]
+            s = jnp.where(qseg[:, None] == kseg[None, :], s,
+                          jnp.float32(_NEG_INF))
+        if masked:
+            q_idx = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, group, block_k), 0
+            ).reshape(rows, block_k)
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dqacc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(full)
+        def _full():
+            compute(False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _band():
+            compute(True)
+    else:
+        compute(False)
+
+    @pl.when(kb == nkb - 1)
+    def _fin():
+        dq_ref[0] = dqacc_ref[...].reshape(
+            block_q, group * head_dim).astype(dq_ref.dtype)
+
+
+def _stream_kv(lk: int, hp: int, d: int) -> bool:
+    """True when full-K/V VMEM residency would blow the scoped budget: the
+    resident kernels hold k+v (double-buffered) = 8*lk*hp*d bytes; past
+    ~12MB the streamed grid variants take over (measured: 16k at d=128
+    fails at 17.1M against the 16M limit)."""
+    return 8 * lk * hp * d > 12 * 1024 * 1024
+
+
 def _pick_block(n: int, preferred: int, kind: str = "") -> int:
     """Largest power-of-two-ish divisor of ``n`` at most ``preferred``.
 
@@ -328,8 +503,58 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
             f"flash_attention: no legal TPU tiling for head_dim={d}, "
             f"kv_heads={num_kv_heads} (minor dim not a 128-multiple); "
             "use blockwise_attention or the dense path")
-    grid = (b, num_kv_heads // hp, lq // block_q)
     segmented = q_segments is not None
+    if hp == 1 and _stream_kv(lk, hp, d):
+        # long-context: stream k/v via the grid (full residency would blow
+        # scoped vmem); scratch carries the online-softmax state
+        from jax.experimental.pallas import tpu as pltpu
+
+        rows = block_q * g
+        in_specs = [
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i, kb: (bi, i, ci)),
+            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, kb: (bi, kb, ci)),
+            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, kb: (bi, kb, ci)),
+        ]
+        args = [q, k, v]
+        if segmented:
+            in_specs += [
+                pl.BlockSpec((1, 1, 8, block_q * g),
+                             lambda bi, ci, i, kb: (bi, i * 0, i * 0, i)),
+                pl.BlockSpec((1, 1, 8, block_k),
+                             lambda bi, ci, i, kb: (bi, i * 0, i * 0, kb)),
+            ]
+            args += [_seg_rows(q_segments, g), _seg_rows(k_segments, 1)]
+        out, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_streamed, causal=causal, scale=scale, group=g,
+                head_dim=d, q_offset=lk - lq, segmented=segmented),
+            grid=(b, num_kv_heads, lq // block_q, lk // block_k),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, g * d),
+                             lambda bi, ci, i, kb: (bi, i, ci)),
+                pl.BlockSpec((1, 1, 8, block_q * g),
+                             lambda bi, ci, i, kb: (bi, ci, i * 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, lq, num_heads * d), q.dtype),
+                jax.ShapeDtypeStruct((b, num_kv_heads, 8, lq * g),
+                                     jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((rows, d), jnp.float32),
+                pltpu.VMEM((8, rows), jnp.float32),
+                pltpu.VMEM((8, rows), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+        if segmented:
+            out = jnp.where(
+                (jnp.asarray(q_segments, jnp.int32) >= 0)[:, :, None],
+                out, 0)
+        return out, lse
+    grid = (b, num_kv_heads // hp, lq // block_q)
     bhld = hp > 1
     # index maps use `i * 0` (not the literal 0) so the constant inherits the
     # i32 index dtype — a literal traces as i64 under jax_enable_x64 and
@@ -763,6 +988,45 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
     dk = dk32.astype(k.dtype)
     dv = dv32.astype(v.dtype)
 
+    if hp == 1 and _stream_kv(lk, hp, d):
+        # long-context dq: stream k/v via the grid, accumulate in scratch
+        from jax.experimental.pallas import tpu as pltpu
+
+        rows = block_q * g
+        dq_specs = [
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i, kb: (bi, i, ci)),
+            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, kb: (bi, kb, ci)),
+            pl.BlockSpec((1, block_k, d), lambda bi, ci, i, kb: (bi, kb, ci)),
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i, kb: (bi, i, ci)),
+            pl.BlockSpec((1, 1, 8, block_q * g),
+                         lambda bi, ci, i, kb: (bi, ci, i * 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q * g),
+                         lambda bi, ci, i, kb: (bi, ci, i * 0, i)),
+        ]
+        dq_args = [q, k, v, do, lse, delta]
+        if segmented:
+            dq_specs += [
+                pl.BlockSpec((1, 1, 8, block_q * g),
+                             lambda bi, ci, i, kb: (bi, i * 0, i * 0, i)),
+                pl.BlockSpec((1, 1, 8, block_k),
+                             lambda bi, ci, i, kb: (bi, i * 0, i * 0, kb)),
+            ]
+            dq_args += [qseg_rows, kseg_rows]
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel_streamed, causal=causal, scale=scale,
+                group=g, head_dim=d, q_offset=lk - lq, segmented=segmented),
+            grid=(b, num_kv_heads, lq // block_q, lk // block_k),
+            in_specs=dq_specs,
+            out_specs=pl.BlockSpec((1, block_q, g * d),
+                                   lambda bi, ci, i, kb: (bi, i, ci)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((rows, d), jnp.float32)],
+            interpret=interpret,
+        )(*dq_args)
+        return dq, dk, dv
     if bhld:
         dq_specs = [
             pl.BlockSpec((1, hp, block_q, d),

@@ -19,7 +19,7 @@ import numpy as np
 from paddle_tpu.io.dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -121,6 +121,104 @@ class Cifar100(Cifar10):
     _batches_test = ["test"]
     _key_prefix = "cifar-100-python"
     _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Oxford Flowers102 from local files (reference
+    python/paddle/vision/datasets/flowers.py:54): ``data_file`` is the
+    102flowers .tgz of jpgs, ``label_file``/``setid_file`` the .mat
+    annotation files (parsed via scipy.io.loadmat, like the reference).
+    No auto-download (this framework's local-file dataset policy)."""
+
+    # the reference DELIBERATELY swaps trnid/tstid (flowers.py:48-51: the
+    # official "test" split is the larger one, so it serves as train)
+    _flag = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend="cv2"):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        _need_file(data_file, type(self).__name__)
+        _need_file(label_file, type(self).__name__)
+        _need_file(setid_file, type(self).__name__)
+        import scipy.io as scio
+
+        self.transform = transform
+        self.backend = backend
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[
+            self._flag[mode.lower()]][0]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]], dtype=np.int64)
+        name = "jpg/image_%05d.jpg" % index
+        raw = self._tar.extractfile(self._members[name]).read()
+        image = Image.open(_io.BytesIO(raw))
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs from the local VOCtrainval tar
+    (reference python/paddle/vision/datasets/voc2012.py:54): image jpg +
+    label png streamed straight out of the archive, segmentation split
+    lists from ImageSets/Segmentation/{train,trainval,val}.txt."""
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    # reference voc2012.py:51: 'train' is the trainval union, 'test' the
+    # train list (the real test annotations are not in the archive)
+    _flag = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        _need_file(data_file, type(self).__name__)
+        self.transform = transform
+        self.backend = backend
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        flag = self._flag[mode.lower()]
+        sets = self._tar.extractfile(self._members[self._SET.format(flag)])
+        self.data, self.labels = [], []
+        for line in sets:
+            name = line.strip().decode("utf-8")
+            if not name:
+                continue
+            self.data.append(self._DATA.format(name))
+            self.labels.append(self._LABEL.format(name))
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        img = Image.open(_io.BytesIO(
+            self._tar.extractfile(self._members[self.data[idx]]).read()))
+        label = Image.open(_io.BytesIO(
+            self._tar.extractfile(self._members[self.labels[idx]]).read()))
+        if self.backend == "cv2":
+            img = np.array(img)
+            label = np.array(label)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
 
 
 _IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")

@@ -292,3 +292,107 @@ class TestConll05st:
 
         with pytest.raises(RuntimeError, match="zero-egress"):
             Conll05st()
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="PNG")
+    return b.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="JPEG")
+    return b.getvalue()
+
+
+class TestFlowers:
+    def _make(self, tmp_path, n=6):
+        import scipy.io as scio
+
+        rng = np.random.default_rng(0)
+        tgz = str(tmp_path / "102flowers.tgz")
+        with tarfile.open(tgz, "w:gz") as tf:
+            for i in range(1, n + 1):
+                img = rng.integers(0, 255, (8, 10, 3), dtype=np.uint8)
+                _tar_add(tf, "jpg/image_%05d.jpg" % i, _jpg_bytes(img))
+        labels = str(tmp_path / "imagelabels.mat")
+        scio.savemat(labels,
+                     {"labels": np.arange(1, n + 1).reshape(1, -1)})
+        setid = str(tmp_path / "setid.mat")
+        # reference-swapped semantics (flowers.py:48-51): mode="train" reads
+        # tstid (the larger official split), mode="test" reads trnid
+        scio.savemat(setid, {
+            "tstid": np.array([[1, 2, 3, 4]]),
+            "trnid": np.array([[5]]),
+            "valid": np.array([[6]]),
+        })
+        return tgz, labels, setid
+
+    def test_parse_splits(self, tmp_path):
+        from paddle_tpu.vision.datasets import Flowers
+
+        tgz, labels, setid = self._make(tmp_path)
+        tr = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="train")
+        te = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="test")
+        assert len(tr) == 4 and len(te) == 1
+        img, label = tr[2]
+        assert img.shape == (8, 10, 3) and int(label[0]) == 3
+        img2, label2 = te[0]
+        assert int(label2[0]) == 5
+
+    def test_raises_without_files(self):
+        from paddle_tpu.vision.datasets import Flowers
+
+        with pytest.raises(ValueError):
+            Flowers()
+
+
+class TestVOC2012:
+    def _make(self, tmp_path):
+        rng = np.random.default_rng(1)
+        tar = str(tmp_path / "VOCtrainval.tar")
+        names = ["2007_000001", "2007_000002", "2007_000003"]
+        with tarfile.open(tar, "w") as tf:
+            # reference split map (voc2012.py:51): train->trainval,
+            # valid->val, test->train
+            _tar_add(tf,
+                     "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                     ("\n".join(names) + "\n").encode())
+            _tar_add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                     ("\n".join(names[:2]) + "\n").encode())
+            _tar_add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                     (names[2] + "\n").encode())
+            for nm in names:
+                img = rng.integers(0, 255, (6, 9, 3), dtype=np.uint8)
+                seg = rng.integers(0, 20, (6, 9), dtype=np.uint8)
+                _tar_add(tf, f"VOCdevkit/VOC2012/JPEGImages/{nm}.jpg",
+                         _jpg_bytes(img))
+                _tar_add(tf,
+                         f"VOCdevkit/VOC2012/SegmentationClass/{nm}.png",
+                         _png_bytes(seg))
+        return tar
+
+    def test_parse_pairs(self, tmp_path):
+        from paddle_tpu.vision.datasets import VOC2012
+
+        tar = self._make(tmp_path)
+        tr = VOC2012(data_file=tar, mode="train")
+        va = VOC2012(data_file=tar, mode="valid")
+        te = VOC2012(data_file=tar, mode="test")
+        assert len(tr) == 3 and len(va) == 1 and len(te) == 2
+        img, label = tr[0]
+        assert img.shape == (6, 9, 3) and label.shape == (6, 9)
+        assert label.max() < 21  # png segmentation classes survive intact
+
+    def test_raises_without_file(self):
+        from paddle_tpu.vision.datasets import VOC2012
+
+        with pytest.raises(ValueError):
+            VOC2012()

@@ -6,6 +6,7 @@ python/paddle/incubate/checkpoint/auto_checkpoint.py.
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -127,3 +128,96 @@ class TestAutoCheckpoint:
         ac.reset()
         ac._STATE["dir"] = None
         assert list(ac.train_epoch_range(3)) == [0, 1, 2]
+
+
+class TestFleetFS:
+    """fleet.utils.fs LocalFS/HDFSClient (reference fs.py:134/:474)."""
+
+    def test_localfs_contract(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.fs import (FSFileExistsError,
+                                                           FSFileNotExistsError,
+                                                           LocalFS)
+
+        fs = LocalFS()
+        root = str(tmp_path / "root")
+        fs.mkdirs(root)
+        assert fs.is_dir(root) and not fs.is_file(root)
+        f = os.path.join(root, "a.txt")
+        fs.touch(f)
+        assert fs.is_file(f) and fs.is_exist(f)
+        with open(f, "w") as fh:
+            fh.write("hello\n")
+        assert fs.cat(f) == "hello"
+        sub = os.path.join(root, "sub")
+        fs.mkdirs(sub)
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        assert fs.list_dirs(root) == ["sub"]
+        dst = os.path.join(root, "b.txt")
+        fs.mv(f, dst)
+        assert fs.is_file(dst) and not fs.is_exist(f)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(f, dst)
+        fs.touch(f)
+        with pytest.raises(FSFileExistsError):
+            fs.mv(f, dst)
+        fs.mv(f, dst, overwrite=True)
+        up = str(tmp_path / "up")
+        fs.upload(root, up)  # local upload == copy
+        assert fs.is_dir(up) and fs.is_file(os.path.join(up, "b.txt"))
+        fs.delete(up)
+        assert not fs.is_exist(up)
+        assert fs.need_upload_download() is False
+
+    def test_hdfs_client_requires_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+
+        with pytest.raises(RuntimeError, match="hadoop"):
+            HDFSClient("/nonexistent/hadoop_home")
+
+    def test_hdfs_split_files(self, tmp_path):
+        """The deterministic trainer file split is pure logic — test it via
+        a client whose hadoop binary is a stub script."""
+        import stat
+
+        from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+
+        home = tmp_path / "hadoop"
+        (home / "bin").mkdir(parents=True)
+        exe = home / "bin" / "hadoop"
+        exe.write_text("#!/bin/sh\nexit 0\n")
+        exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+        c = HDFSClient(str(home))
+        files = [f"f{i}" for i in range(7)]
+        got = [c._split_files(files, t, 3) for t in range(3)]
+        assert [len(g) for g in got] == [3, 2, 2]
+        assert sum(got, []) == files
+        assert c.need_upload_download() is True
+
+    def test_auto_checkpoint_rides_fs(self, tmp_path):
+        """train_epoch_range persists through an upload/download fs client
+        (the reference's hdfs-backed auto checkpointer pattern) — here a
+        LocalFS subclass forced into remote mode."""
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        from paddle_tpu.incubate import checkpoint as ckpt
+
+        ac = ckpt.auto_checkpoint
+
+        class RemoteishFS(LocalFS):
+            def need_upload_download(self):
+                return True
+
+        remote = str(tmp_path / "remote_ckpt")
+        fs = RemoteishFS()
+        ac.reset()
+        done = []
+        for epoch in ac.train_epoch_range(5, checkpoint_dir=remote, fs=fs):
+            done.append(epoch)
+            if epoch == 2:
+                break  # simulated crash after epoch 2 was persisted? no —
+                # persistence happens after the yield returns; epoch 2 is
+                # NOT saved, 0 and 1 are
+        assert fs.is_exist(remote)
+        ac.reset()
+        resumed = list(ac.train_epoch_range(5, checkpoint_dir=remote, fs=fs))
+        assert resumed == [2, 3, 4]
