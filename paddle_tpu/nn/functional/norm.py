@@ -2,6 +2,8 @@
 python/paddle/nn/functional/norm.py)."""
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 
@@ -11,6 +13,76 @@ from paddle_tpu.tensor.tensor import Tensor
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(x)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train(a, w, b, axes, channel_axis, epsilon):
+    """Training-mode batch norm with a hand-written one-pass backward.
+
+    Forward: stats computed ONCE (f32 mean + centered variance) and shared
+    with the running-buffer update — the pre-r5 code ran a second no_grad
+    stats pass for the buffers (the r5 ResNet profile showed ~23 ms/step of
+    stat/grad reduce passes).  Backward: the textbook formulation needs
+    only (sum_dy, sum_dy*xhat) — one dual-reduce traversal — where
+    autodiff through mean/var derives 2-3 separate reduce passes.
+
+    Returns (y, batch_mean_f32, batch_var_f32) — stats ride out so the
+    running-buffer update reuses this pass."""
+    y, m32, v32, _ = _bn_train_fwd_impl(a, w, b, axes, channel_axis, epsilon)
+    return y, m32.reshape(-1), v32.reshape(-1)
+
+
+def _bn_train_fwd_impl(a, w, b, axes, channel_axis, epsilon):
+    m32 = jnp.mean(a, axis=axes, keepdims=True, dtype=jnp.float32)
+    # centered second pass (jnp.var semantics), NOT E[x^2]-E[x]^2: the
+    # one-pass form catastrophically cancels in f32 when |mean| >> std
+    # (review r5 — raw un-normalized features into a first BN layer).  The
+    # r5 saving comes from eliminating the DUPLICATE no_grad stats pass and
+    # the autodiff backward's extra reduces, not from this reduce.
+    v32 = jnp.mean(
+        jnp.square(a.astype(jnp.float32) - m32), axis=axes, keepdims=True)
+    rstd = jax.lax.rsqrt(v32 + epsilon)
+    shape = [1] * a.ndim
+    shape[channel_axis] = -1
+    xhat = (a - m32.astype(a.dtype)) * rstd.astype(a.dtype)
+    y = xhat
+    if w is not None:
+        y = y * w.reshape(shape)
+    if b is not None:
+        y = y + b.reshape(shape)
+    return y, m32, v32, (a, w, b, m32, rstd)
+
+
+def _bn_train_fwd(a, w, b, axes, channel_axis, epsilon):
+    y, m32, v32, res = _bn_train_fwd_impl(a, w, b, axes, channel_axis,
+                                          epsilon)
+    return (y, m32.reshape(-1), v32.reshape(-1)), res
+
+
+def _bn_train_bwd(axes, channel_axis, epsilon, res, cts):
+    a, w, b, m32, rstd = res
+    gy = cts[0]  # cotangents for the stats outputs are dropped: the
+    # running-buffer update consumes them under no_grad
+    shape = [1] * a.ndim
+    shape[channel_axis] = -1
+    n = 1
+    for ax in axes:
+        n *= a.shape[ax]
+    gyf = gy.astype(jnp.float32)
+    af = a.astype(jnp.float32)
+    xhat = (af - m32) * rstd
+    # ONE dual-reduce traversal over (gy, gy*xhat)
+    s1 = jnp.sum(gyf, axis=axes, keepdims=True)
+    s2 = jnp.sum(gyf * xhat, axis=axes, keepdims=True)
+    wf = (w.reshape(shape).astype(jnp.float32)
+          if w is not None else jnp.float32(1.0))
+    ga = (wf * rstd * (gyf - s1 / n - xhat * (s2 / n))).astype(a.dtype)
+    gw = None if w is None else s2.reshape(-1).astype(w.dtype)
+    gb = None if b is None else s1.reshape(-1).astype(b.dtype)
+    return ga, gw, gb
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 def batch_norm(
@@ -33,26 +105,19 @@ def batch_norm(
     axes = tuple(i for i in range(x.ndim) if i != channel_axis)
     use_batch = training and not use_global_stats
 
-    if use_batch:
-        with no_grad():
-            bm = jnp.mean(x.data, axis=axes)
-            bv = jnp.var(x.data, axis=axes)
-            running_mean._data = (momentum * running_mean.data + (1 - momentum) * bm).astype(running_mean.dtype)
-            running_var._data = (momentum * running_var.data + (1 - momentum) * bv).astype(running_var.dtype)
-
     def f(a, *rest):
         it = iter(rest)
-        if use_batch:
-            m = jnp.mean(a, axis=axes, keepdims=True)
-            v = jnp.var(a, axis=axes, keepdims=True)
-        else:
-            shape = [1] * a.ndim
-            shape[channel_axis] = -1
-            m = next(it).reshape(shape)
-            v = next(it).reshape(shape)
-        y = (a - m) * jax.lax.rsqrt(v + epsilon)
         shape = [1] * a.ndim
         shape[channel_axis] = -1
+        if use_batch:
+            w = next(it) if weight is not None else None
+            b = next(it) if bias is not None else None
+            return _bn_train(a, w, b, tuple(axes), channel_axis,
+                             float(epsilon))
+        m = next(it).reshape(shape)
+        v = next(it).reshape(shape)
+        y = (a - m) * jax.lax.rsqrt(v.astype(jnp.float32) + epsilon).astype(
+            a.dtype)
         if weight is not None:
             y = y * next(it).reshape(shape)
         if bias is not None:
@@ -66,7 +131,18 @@ def batch_norm(
         args.append(_t(weight))
     if bias is not None:
         args.append(_t(bias))
-    return apply("batch_norm", f, *args)
+    out = apply("batch_norm", f, *args)
+    if use_batch:
+        y, bm, bv = out
+        with no_grad():
+            running_mean._data = (
+                momentum * running_mean.data
+                + (1 - momentum) * bm.data.astype(running_mean.dtype))
+            running_var._data = (
+                momentum * running_var.data
+                + (1 - momentum) * bv.data.astype(running_var.dtype))
+        return y
+    return out
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
@@ -94,16 +170,55 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     return apply("layer_norm", f, *args)
 
 
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_weighted(a, w, epsilon):
+    v = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = (a.astype(jnp.float32) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+    return y * w
+
+
+def _rmsw_fwd(a, w, epsilon):
+    af = a.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(af), axis=-1, keepdims=True)
+                      + epsilon)
+    y = (af * r).astype(a.dtype) * w
+    # residuals: the bf16 input + the per-row rstd (tiny) — NOT the f32
+    # normalized tensor.  Plain autodiff materialized a full-size f32 copy
+    # per call (16 x 1.45 ms convert_multiply fusions in the r5 profile);
+    # the backward recomputes af with one fused cast instead.
+    return y, (a, w, r)
+
+
+def _rmsw_bwd(epsilon, res, gy):
+    a, w, r = res
+    af = a.astype(jnp.float32)
+    gyf = gy.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    n = af * r                                   # normalized rows
+    gn = gyf * wf
+    h = af.shape[-1]
+    s = jnp.sum(gn * af, axis=-1, keepdims=True)
+    ga = (r * gn - n * (r * r) * (s / h)).astype(a.dtype)
+    gw = jnp.sum(gyf * n,
+                 axis=tuple(range(gy.ndim - 1))).astype(w.dtype)
+    return ga, gw
+
+
+_rms_norm_weighted.defvjp(_rmsw_fwd, _rmsw_bwd)
+
+
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (paddle.incubate.nn.functional.fused_rms_norm analog) — the LLM-stack
-    hot op; fused by XLA, with a Pallas kernel in ops/pallas for long rows."""
+    hot op.  The weighted form carries a custom vjp whose residuals are the
+    bf16 input + per-row rstd only (the f32 normalized tensor is recomputed
+    in backward — one fused cast instead of a hidden-sized f32 residual)."""
 
     def f(a, *rest):
-        v = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
-        y = (a.astype(jnp.float32) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
         if rest:
-            y = y * rest[0]
-        return y
+            return _rms_norm_weighted(a, rest[0], float(epsilon))
+        v = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (a.astype(jnp.float32)
+                * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
 
     args = [_t(x)]
     if weight is not None:

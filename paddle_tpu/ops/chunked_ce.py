@@ -62,6 +62,11 @@ def chunked_token_ce_fn(chunk_size: int, vh_weight: bool = False,
 
         chunk_loss = jax.checkpoint(chunk_loss)
 
+        # NOTE: stay on lax.scan.  An unrolled python loop over the chunks
+        # was A/B-tested on v5e (r5): it LOSES ~70 ms/step — XLA schedules
+        # the scan's chunk matmuls better than the unrolled graph, and the
+        # backward's dynamic-update-slice stack (~31 ms) comes back cheaper
+        # than the unrolled version's concatenated cotangents.
         def body(acc, xs):
             s, k = chunk_loss(*xs)
             return (acc[0] + s, acc[1] + k), None

@@ -1,0 +1,125 @@
+"""Fused int8-moment AdamW update as ONE Pallas pass (TPU).
+
+The jnp formulation of the 8-bit-Adam update runs as several XLA passes
+over HBM: int8→f32 moment decode, the elementwise update, a separate
+blockwise-absmax reduce, and the re-quantize (the r5 profile shows
+pad_maximum ~29 ms + round/convert ~17 ms + the decode converts on a
+0.85B-param step).  This kernel does decode → AdamW → encode for one tile
+in VMEM, so every state tensor is read and written exactly once per step.
+
+Layout contract (matches Optimizer._q8_encode): the flat parameter is
+viewed as ``[nb, 256]`` — each ROW is one quantization block with one f32
+absmax scale.  A kernel tile is ``[rows, 256]`` with the scales as a
+``[rows, 1]`` column (broadcasts over lanes natively).
+
+Reference bar: the fused adamw CUDA kernel
+(paddle/phi/kernels/gpu/adamw_kernel.cu) — same single-pass idea, plus the
+8-bit moment layout the reference does not have.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_Q8_BLOCK = 256
+
+
+def _kernel(sc_ref, p_ref, g_ref, m_ref, s_ref, v_ref, *outs,
+            out_dtype, has_master: bool):
+    """sc_ref [1, 16] f32 scalars: b1, b2, eps, lr, c1, c2, wd_factor, _,
+    (1-b1), (1-b2), padding...
+    p_ref [rows, 256] master f32 (or the raw low-precision param when no
+    master exists — cast in-kernel); g_ref [rows, 256] f32|bf16;
+    m_ref int8 codes; s_ref [rows, 1] f32 scales; v_ref bf16 moment2.
+    outs = ([p32_out,] pw_out, m_out, s_out, v_out)."""
+    if has_master:
+        p_out, pw_out, m_out, s_out, v_out = outs
+    else:
+        pw_out, m_out, s_out, v_out = outs
+    sc = sc_ref[0]
+    b1, b2, eps, lr = sc[0], sc[1], sc[2], sc[3]
+    c1, c2, wd_factor = sc[4], sc[5], sc[6]
+    # (1-beta) factors are HOST-computed (scalars[8], scalars[9]) so the
+    # fused path is bit-identical to the jnp path's python-float constants
+    # — an in-kernel f32(1)-f32(0.9) differs by ~2e-7 and can flip int8
+    # codes at rounding boundaries (review r5)
+    one_m_b1, one_m_b2 = sc[8], sc[9]
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32) * s_ref[...]
+    v = v_ref[...].astype(jnp.float32)
+    m_new = b1 * m + one_m_b1 * g
+    v_new = b2 * v + one_m_b2 * g * g
+    upd = lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+    p_new = p_ref[...].astype(jnp.float32) * wd_factor - upd
+    if has_master:
+        p_out[...] = p_new
+    pw_out[...] = p_new.astype(out_dtype)
+    s_new = jnp.max(jnp.abs(m_new), axis=1, keepdims=True) / 127.0
+    m_out[...] = jnp.round(
+        m_new / jnp.maximum(s_new, 1e-30)).astype(jnp.int8)
+    s_out[...] = s_new
+    v_out[...] = v_new.astype(v_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("out_dtype", "has_master", "interpret"))
+def fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
+                   out_dtype=jnp.bfloat16, has_master=True,
+                   interpret=False):
+    """One fused update step over a FLAT parameter whose size divides 256.
+
+    p [n]: the f32 master when ``has_master``, else the raw low-precision
+    parameter (cast to f32 inside the kernel — no f32 HBM copy is
+    materialized); g [n] f32|bf16 grad; m_codes [n] int8; scales [n/256]
+    f32; v_bf16 [n] bf16; scalars [8] f32 = (beta1, beta2, eps, lr,
+    1-beta1^t, 1-beta2^t, 1-lr*decay, 0).  Returns
+    ([p32'] p_cast', m_codes', scales', v') — p32' only with a master.
+    """
+    n = p.size
+    nb = n // _Q8_BLOCK
+    # tile rows: biggest power-of-two chunk <= 512 that divides nb
+    tr = min(512, nb)
+    while nb % tr:
+        tr //= 2
+    tr = max(tr, 1)
+    grid = (nb // tr,)
+    shape2 = (nb, _Q8_BLOCK)
+    args = [
+        jnp.asarray(scalars, jnp.float32).reshape(1, 16),
+        p.reshape(shape2),
+        g.reshape(shape2),
+        m_codes.reshape(shape2),
+        scales.reshape(nb, 1),
+        v_bf16.reshape(shape2),
+    ]
+    full = pl.BlockSpec((tr, _Q8_BLOCK), lambda i: (i, i * 0))
+    col = pl.BlockSpec((tr, 1), lambda i: (i, i * 0))
+    in_specs = [pl.BlockSpec((1, 16), lambda i: (i * 0, i * 0)),
+                full, full, full, col, full]
+    out_specs = [full, full, col, full]
+    out_shape = [
+        jax.ShapeDtypeStruct(shape2, out_dtype),
+        jax.ShapeDtypeStruct(shape2, jnp.int8),
+        jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+        jax.ShapeDtypeStruct(shape2, v_bf16.dtype),
+    ]
+    if has_master:
+        out_specs = [full] + out_specs
+        out_shape = [jax.ShapeDtypeStruct(shape2, jnp.float32)] + out_shape
+    outs = pl.pallas_call(
+        functools.partial(_kernel, out_dtype=out_dtype,
+                          has_master=has_master),
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret,
+    )(*args)
+    if has_master:
+        p32_new, p_cast, m_new, s_new, v_new = outs
+        return (p32_new.reshape(p.shape), p_cast.reshape(p.shape),
+                m_new.reshape(p.shape), s_new.reshape(scales.shape),
+                v_new.reshape(p.shape))
+    p_cast, m_new, s_new, v_new = outs
+    return (p_cast.reshape(p.shape), m_new.reshape(p.shape),
+            s_new.reshape(scales.shape), v_new.reshape(p.shape))

@@ -257,6 +257,18 @@ class Optimizer:
             if self._l2_coeff and not getattr(self, "_decoupled", False):
                 g = g + self._l2_coeff * (
                     masters[k] if k in masters else p_arr).astype(g.dtype)
+            fused = getattr(self, "_try_fused_q8", None)
+            if fused is not None:
+                res = fused(k, p_arr, g, states, masters, lr)
+                if res is not None:
+                    new_p, new_master, mq, sq, vq = res
+                    new_params[k] = new_p
+                    if new_master is not None:
+                        new_states["master_weight"][k] = new_master
+                    new_states["moment1"][k] = mq
+                    new_states["moment1@scale"][k] = sq
+                    new_states["moment2"][k] = vq
+                    continue
             holder = _ArrayParam(masters.get(k, p_arr), name=k)
             st = {}
             for n in self._accum_names:

@@ -82,6 +82,70 @@ class Adam(Optimizer):
         upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
         return p.data.astype(jnp.float32) - upd, new_state
 
+    def _try_fused_q8(self, k, p_arr, g, states, masters, lr):
+        """int8-moment params take the fused Pallas update (one HBM pass for
+        decode + AdamW + re-encode — ops/fused_adamw.py; the jnp formulation
+        cost ~45 ms/step of pad/round/convert fusions at the r5 bench
+        shapes).  Returns None when the pattern doesn't apply (jnp path)."""
+        import os
+
+        if self._amsgrad:
+            return None
+        force = os.environ.get("PADDLE_FUSED_ADAM_Q8")  # "0" off, "interpret"
+        if force == "0":
+            return None
+        interpret = force == "interpret"
+        if not interpret and jax.default_backend() != "tpu":
+            return None
+        m = states.get("moment1", {}).get(k)
+        v = states.get("moment2", {}).get(k)
+        sc = states.get("moment1@scale", {}).get(k)
+        if m is None or v is None or sc is None:
+            return None
+        if m.dtype != jnp.int8 or v.dtype != jnp.bfloat16:
+            return None
+        n = int(np.prod(p_arr.shape))
+        if n % 256 or n // 256 != int(sc.shape[0]):
+            return None
+        decay = 0.0
+        if getattr(self, "_decoupled", False):
+            if self._lr_ratio is not None:
+                return None
+            decay = self._coeff
+            if (self._apply_decay_param_fun is not None
+                    and not self._apply_decay_param_fun(k)):
+                decay = 0.0
+        from paddle_tpu.ops.fused_adamw import fused_adamw_q8
+
+        t = self._global_step
+        lrf = jnp.asarray(lr, jnp.float32)
+        z = jnp.float32(0.0)
+        scalars = jnp.stack([
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), lrf,
+            1.0 - _pow_t(self._beta1, t), 1.0 - _pow_t(self._beta2, t),
+            1.0 - lrf * jnp.float32(decay), z,
+            # host-computed (1-beta) keeps the kernel bit-identical to the
+            # jnp path's folded python-float constants (review r5)
+            jnp.float32(1.0 - self._beta1), jnp.float32(1.0 - self._beta2),
+            z, z, z, z, z, z,
+        ])
+        has_master = k in masters
+        p_in = (masters[k] if has_master else p_arr).reshape(-1)
+        outs = fused_adamw_q8(
+            p_in, g.reshape(-1), m.reshape(-1), sc, v.reshape(-1), scalars,
+            out_dtype=p_arr.dtype, has_master=has_master,
+            interpret=interpret)
+        if has_master:
+            p32, p_cast, mq, sq, vq = outs
+            new_master = p32.reshape(p_arr.shape)
+        else:
+            p_cast, mq, sq, vq = outs
+            new_master = None
+        return (p_cast.reshape(p_arr.shape), new_master,
+                mq.reshape(p_arr.shape), sq.reshape(sc.shape),
+                vq.reshape(p_arr.shape))
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: paddle/phi/kernels/gpu/adamw_kernel.cu)."""

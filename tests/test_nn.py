@@ -489,3 +489,92 @@ class TestExtendedNN:
         out = F.gather_tree(ids, parents).numpy()
         # beam 0 at final step came from parent 1 → path follows beam 1's tokens
         assert out.shape == (3, 1, 2)
+
+
+class TestRMSNormCustomVJP:
+    def test_grads_match_plain_autodiff(self):
+        """The memory-light custom vjp (bf16 input + rstd residuals only)
+        computes the same grads as plain autodiff of the textbook formula."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.norm import _rms_norm_weighted
+
+        eps = 1e-6
+
+        def plain(a, w):
+            v = jnp.mean(jnp.square(a.astype(jnp.float32)), -1, keepdims=True)
+            y = (a.astype(jnp.float32)
+                 * jax.lax.rsqrt(v + eps)).astype(a.dtype)
+            return y * w
+
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal((4, 16, 64)).astype("float32"))
+        w = jnp.asarray(rng.standard_normal((64,)).astype("float32"))
+        gy = jnp.asarray(rng.standard_normal((4, 16, 64)).astype("float32"))
+
+        np.testing.assert_allclose(
+            np.asarray(_rms_norm_weighted(a, w, eps)),
+            np.asarray(plain(a, w)), rtol=1e-6, atol=1e-6)
+        g1 = jax.grad(lambda a_, w_: jnp.vdot(
+            _rms_norm_weighted(a_, w_, eps), gy), argnums=(0, 1))(a, w)
+        g2 = jax.grad(lambda a_, w_: jnp.vdot(plain(a_, w_), gy),
+                      argnums=(0, 1))(a, w)
+        for x, y in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestBatchNormCustomVJP:
+    def test_bn_train_grads_match_autodiff(self):
+        """The one-pass BN backward (_bn_train) matches plain autodiff of
+        the textbook formulation, values and grads, with and without
+        affine."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.norm import _bn_train
+
+        eps = 1e-5
+        axes = (0, 2, 3)
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal((4, 8, 5, 6)).astype("float32"))
+        w = jnp.asarray(rng.standard_normal((8,)).astype("float32"))
+        b = jnp.asarray(rng.standard_normal((8,)).astype("float32"))
+        gy = jnp.asarray(rng.standard_normal(a.shape).astype("float32"))
+
+        def plain(a_, w_, b_):
+            m = jnp.mean(a_, axis=axes, keepdims=True)
+            v = jnp.var(a_, axis=axes, keepdims=True)
+            y = (a_ - m) * jax.lax.rsqrt(v + eps)
+            if w_ is not None:
+                y = y * w_.reshape(1, -1, 1, 1)
+            if b_ is not None:
+                y = y + b_.reshape(1, -1, 1, 1)
+            return y
+
+        y, bm, bv = _bn_train(a, w, b, axes, 1, eps)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(plain(a, w, b)),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(bm), np.asarray(jnp.mean(a, axis=axes)), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(bv), np.asarray(jnp.var(a, axis=axes)),
+            rtol=1e-4, atol=1e-6)
+        g1 = jax.grad(lambda *xs: jnp.vdot(_bn_train(*xs, axes, 1, eps)[0],
+                                           gy), argnums=(0, 1, 2))(a, w, b)
+        g2 = jax.grad(lambda *xs: jnp.vdot(plain(*xs), gy),
+                      argnums=(0, 1, 2))(a, w, b)
+        for x, yv in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(yv),
+                                       rtol=1e-4, atol=1e-4)
+        # no-affine form
+        y2, _, _ = _bn_train(a, None, None, axes, 1, eps)
+        np.testing.assert_allclose(np.asarray(y2),
+                                   np.asarray(plain(a, None, None)),
+                                   rtol=1e-5, atol=1e-5)
+        ga1 = jax.grad(lambda a_: jnp.vdot(
+            _bn_train(a_, None, None, axes, 1, eps)[0], gy))(a)
+        ga2 = jax.grad(lambda a_: jnp.vdot(plain(a_, None, None), gy))(a)
+        np.testing.assert_allclose(np.asarray(ga1), np.asarray(ga2),
+                                   rtol=1e-4, atol=1e-4)
