@@ -158,10 +158,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     q/k/v: [total_tokens, num_heads, head_dim]; cu_seqlens_*: [batch+1] int32
     prefix sums of sequence lengths.  TPU-native: tokens are tagged with their
     sequence index (searchsorted over the prefix sums) and attention runs as
-    one segment-masked blockwise pass — no [total, total] score matrix, no
-    unpacking; cross-sequence pairs are masked inside the online softmax, and
-    ``causal`` composes with the segment mask to give per-sequence causality
-    (positions are monotone inside each packed sequence).
+    one segment-masked pass — the Pallas segmented flash kernels when the
+    shape qualifies (r5), else the blockwise jnp fallback — no
+    [total, total] score matrix, no unpacking; cross-sequence pairs are
+    masked inside the online softmax, and ``causal`` composes with the
+    segment mask to give per-sequence causality (positions are monotone
+    inside each packed sequence).
 
     ``causal`` assumes self-attention lengths (cu_seqlens_q == cu_seqlens_k),
     the reference's primary varlen mode.  Returns (out, softmax) with softmax
@@ -189,10 +191,18 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         seg_q = jnp.where(pos_q < cuq[-1].astype(jnp.int32), seg_q, -1)
         seg_k = jnp.where(pos_k < cuk[-1].astype(jnp.int32), seg_k, -2)
         # global causal ∧ same-segment == per-sequence causal: packed
-        # positions are monotone inside each sequence, so the blockwise
-        # kernel's global index comparison is exactly per-sequence order
+        # positions are monotone inside each sequence, so the kernels'
+        # global index comparison is exactly per-sequence order
+        from paddle_tpu.ops.flash_attention import (available,
+                                                    flash_attention_blhd)
+
+        q1, k1, v1 = qa[None], ka[None], va[None]
+        if available(q1.shape, k1.shape, causal=causal):
+            return flash_attention_blhd(
+                q1, k1, v1, causal=causal, scale=scale,
+                q_segments=seg_q[None], k_segments=seg_k[None])[0]
         out = blockwise_attention(
-            qa[None], ka[None], va[None], causal=causal, scale=scale,
+            q1, k1, v1, causal=causal, scale=scale,
             q_segments=seg_q[None], k_segments=seg_k[None])
         return out[0]
 

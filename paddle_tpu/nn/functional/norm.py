@@ -50,6 +50,8 @@ def _bn_train_fwd_impl(a, w, b, axes, channel_axis, epsilon):
         y = y * w.reshape(shape)
     if b is not None:
         y = y + b.reshape(shape)
+    # b rides in the residuals ONLY for its None-ness and dtype (the bias
+    # grad is s1 alone); it is a [C] vector, so the pin is negligible
     return y, m32, v32, (a, w, b, m32, rstd)
 
 

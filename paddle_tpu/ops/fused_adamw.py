@@ -74,17 +74,20 @@ def fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
     p [n]: the f32 master when ``has_master``, else the raw low-precision
     parameter (cast to f32 inside the kernel — no f32 HBM copy is
     materialized); g [n] f32|bf16 grad; m_codes [n] int8; scales [n/256]
-    f32; v_bf16 [n] bf16; scalars [8] f32 = (beta1, beta2, eps, lr,
-    1-beta1^t, 1-beta2^t, 1-lr*decay, 0).  Returns
+    f32; v_bf16 [n] bf16; scalars [16] f32 =
+    (beta1, beta2, eps, lr, 1-beta1^t, 1-beta2^t, 1-lr*decay, unused,
+    1-beta1, 1-beta2, 6 unused) — slots 8-9 are the HOST-computed
+    (1-beta) factors the kernel's moment update reads (zero-padding them
+    would silently freeze the moments).  Returns
     ([p32'] p_cast', m_codes', scales', v') — p32' only with a master.
     """
     n = p.size
     nb = n // _Q8_BLOCK
     # tile rows: biggest power-of-two chunk <= 512 that divides nb
+    # (terminates at tr == 1: everything divides 1)
     tr = min(512, nb)
     while nb % tr:
         tr //= 2
-    tr = max(tr, 1)
     grid = (nb // tr,)
     shape2 = (nb, _Q8_BLOCK)
     args = [

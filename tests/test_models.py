@@ -275,6 +275,30 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=2e-4, atol=2e-4)
 
+    def test_pallas_segmented_causal_varlen(self):
+        """causal ∧ segments (the flash_attn_unpadded packed-varlen route,
+        r5): per-sequence causality matches a per-sequence dense loop."""
+        from paddle_tpu.ops.flash_attention import flash_attention_blhd
+
+        h, d, L = 2, 128, 256
+        lens = [100, 156]
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (1, L, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (1, L, h, d), jnp.float32)
+        v = jax.random.normal(ks[2], (1, L, h, d), jnp.float32)
+        seg = np.concatenate([np.full(n, i) for i, n in enumerate(lens)])
+        seg = jnp.asarray(seg, jnp.int32)[None]
+        out = flash_attention_blhd(q, k, v, causal=True, q_segments=seg,
+                                   k_segments=seg, interpret=True)
+        start = 0
+        for n in lens:
+            sl = slice(start, start + n)
+            ref = self._dense(q[:, sl], k[:, sl], v[:, sl], True)
+            np.testing.assert_allclose(np.asarray(out[:, sl]),
+                                       np.asarray(ref), rtol=2e-5,
+                                       atol=2e-5)
+            start += n
+
     def test_pallas_segmented_padding_rows_zero(self):
         """Padding QUERY rows (negative segment id) emit zeros and
         contribute zero grads — the varlen convention shared with
