@@ -124,38 +124,63 @@ def _forward_step(params, cfg, tokens, caches, lengths):
     return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
 
 
+def _pick(logits, key, temperature, top_k, sample):
+    """Next-token choice from [B, V] f32 logits.  ``sample`` (static)
+    selects greedy vs sampling; ``temperature`` is a TRACED scalar so a
+    serving loop with per-request temperatures reuses one compiled program
+    (review r5); top_k > 0 (static) restricts sampling to the k best (the
+    reference generate()'s sampling decode)."""
+    if not sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_new_tokens", "lmax"))
-def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax):
+                   static_argnames=("cfg", "max_new_tokens", "lmax",
+                                    "top_k", "sample"))
+def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax,
+                temperature=0.0, top_k=0, seed=0, sample=False):
     b, prompt_len = input_ids.shape
     nh, nkv, hd, eps = cfg
     dtype = params["embed"].dtype
     caches = [init_kv_cache(b, lmax, nkv, hd, dtype)
               for _ in params["layers"]]
     lengths = jnp.zeros((b,), jnp.int32)
+    key = jax.random.PRNGKey(seed)
     # prefill: all prompt tokens in one pass (causal inside decode_attention)
     logits, caches, lengths = _forward_step(
         params, cfg, input_ids, caches, lengths)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+    first = _pick(logits, jax.random.fold_in(key, 0), temperature, top_k,
+                  sample)
 
-    def body(carry, _):
+    def body(carry, i):
         tok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
             params, cfg, tok[:, None], caches, lengths)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = _pick(logits, jax.random.fold_in(key, i), temperature, top_k,
+                    sample)
         return (nxt, caches, lengths), nxt
 
     (_, _, _), rest = jax.lax.scan(
-        body, (first, caches, lengths), None, length=max_new_tokens - 1)
+        body, (first, caches, lengths),
+        jnp.arange(1, max_new_tokens, dtype=jnp.int32))
     return jnp.concatenate([first[None], rest], 0).T  # [B, new_tokens]
 
 
-def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None):
-    """Greedy-decode ``max_new_tokens`` tokens in ONE compiled program.
+def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None,
+                  temperature=0.0, top_k=0, seed=0):
+    """Decode ``max_new_tokens`` tokens in ONE compiled program.
 
-    input_ids: [B, prompt_len] int array (prompts assumed same length —
-    pad + mask upstream for ragged prompts).  Returns [B, max_new_tokens]
-    int32.  The compiled program is cached per (shape, max_new_tokens)."""
+    Greedy by default; ``temperature > 0`` samples (optionally top-k
+    restricted — the reference generate()'s sampling strategies) with the
+    whole loop still inside one jit.  input_ids: [B, prompt_len] int array
+    (prompts assumed same length — pad + mask upstream for ragged
+    prompts).  Returns [B, max_new_tokens] int32.  The compiled program is
+    cached per (shape, max_new_tokens, sampling config)."""
     cfg = model.config
     hd = cfg.hidden_size // cfg.num_attention_heads
     prompt_len = int(input_ids.shape[1])
@@ -180,4 +205,12 @@ def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None):
     key = (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
            cfg.rms_norm_eps)
     ids = jnp.asarray(getattr(input_ids, "data", input_ids), jnp.int32)
-    return _decode_jit(params, key, ids, int(max_new_tokens), lmax)
+    sample = float(temperature) > 0.0
+    vk = int(top_k)
+    if sample and vk > 0:
+        # clamp to the vocab: lax.top_k raises when k > V (review r5)
+        vk = min(vk, int(cfg.vocab_size))
+    return _decode_jit(params, key, ids, int(max_new_tokens), lmax,
+                       temperature=jnp.float32(max(float(temperature),
+                                                   1e-6)),
+                       top_k=vk, seed=seed, sample=sample)

