@@ -147,6 +147,55 @@ def batch_norm(
     return out
 
 
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_affine(a, w, b, axes, epsilon):
+    """LayerNorm with a hand-written backward (same treatment as _bn_train /
+    _rms_norm_weighted): residuals are the input + per-row mean/rstd, and
+    the backward needs ONE dual-reduce traversal (sum_gn, sum_gn*xhat)
+    where autodiff through mean/var derives several — the r5 BERT profile
+    put ~35 ms/step in LN subtract/convert reduce fusions."""
+    return _ln_affine_fwd(a, w, b, axes, epsilon)[0]
+
+
+def _ln_affine_fwd(a, w, b, axes, epsilon):
+    # trailing-contiguous axes ONLY: the w/b broadcast and the gw/gb token
+    # reduction in the backward both assume the normalized dims are the
+    # last len(axes) dims (which is what paddle's layer_norm normalizes)
+    assert axes == tuple(range(a.ndim - len(axes), a.ndim)), axes
+    m = jnp.mean(a, axis=axes, keepdims=True, dtype=jnp.float32)
+    v = jnp.mean(jnp.square(a.astype(jnp.float32) - m), axis=axes,
+                 keepdims=True)
+    rstd = jax.lax.rsqrt(v + epsilon)
+    xhat = ((a.astype(jnp.float32) - m) * rstd).astype(a.dtype)
+    y = xhat
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y, (a, w, b, m, rstd)
+
+
+def _ln_affine_bwd(axes, epsilon, res, gy):
+    a, w, b, m, rstd = res
+    gyf = gy.astype(jnp.float32)
+    xhat = (a.astype(jnp.float32) - m) * rstd
+    n = 1
+    for ax in axes:
+        n *= a.shape[ax]
+    red = tuple(range(0, a.ndim - len(axes)))  # token dims for gw/gb
+    gw = None if w is None else jnp.sum(
+        gyf * xhat, axis=red).astype(w.dtype)
+    gb = None if b is None else jnp.sum(gyf, axis=red).astype(b.dtype)
+    gn = gyf * (w.astype(jnp.float32) if w is not None else 1.0)
+    s1 = jnp.sum(gn, axis=axes, keepdims=True)
+    s2 = jnp.sum(gn * xhat, axis=axes, keepdims=True)
+    ga = (rstd * (gn - s1 / n - xhat * (s2 / n))).astype(a.dtype)
+    return ga, gw, gb
+
+
+_ln_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
@@ -154,15 +203,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
 
     def f(a, *rest):
         axes = tuple(range(a.ndim - n, a.ndim))
-        m = jnp.mean(a, axis=axes, keepdims=True)
-        v = jnp.var(a, axis=axes, keepdims=True)
-        y = (a - m) * jax.lax.rsqrt(v + epsilon)
         it = iter(rest)
-        if weight is not None:
-            y = y * next(it)
-        if bias is not None:
-            y = y + next(it)
-        return y
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        return _ln_affine(a, w, b, axes, float(epsilon))
 
     args = [_t(x)]
     if weight is not None:

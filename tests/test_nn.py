@@ -578,3 +578,54 @@ class TestBatchNormCustomVJP:
         ga2 = jax.grad(lambda a_: jnp.vdot(plain(a_, None, None), gy))(a)
         np.testing.assert_allclose(np.asarray(ga1), np.asarray(ga2),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestLayerNormCustomVJP:
+    def test_ln_grads_match_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.norm import _ln_affine
+
+        eps = 1e-5
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal((3, 7, 32)).astype("float32"))
+        w = jnp.asarray(rng.standard_normal((32,)).astype("float32"))
+        b = jnp.asarray(rng.standard_normal((32,)).astype("float32"))
+        gy = jnp.asarray(rng.standard_normal(a.shape).astype("float32"))
+        axes = (2,)
+
+        def plain(a_, w_, b_):
+            m = jnp.mean(a_, axis=axes, keepdims=True)
+            v = jnp.var(a_, axis=axes, keepdims=True)
+            y = (a_ - m) * jax.lax.rsqrt(v + eps)
+            if w_ is not None:
+                y = y * w_
+            if b_ is not None:
+                y = y + b_
+            return y
+
+        np.testing.assert_allclose(
+            np.asarray(_ln_affine(a, w, b, axes, eps)),
+            np.asarray(plain(a, w, b)), rtol=1e-5, atol=1e-5)
+        g1 = jax.grad(lambda *xs: jnp.vdot(_ln_affine(*xs, axes, eps), gy),
+                      argnums=(0, 1, 2))(a, w, b)
+        g2 = jax.grad(lambda *xs: jnp.vdot(plain(*xs), gy),
+                      argnums=(0, 1, 2))(a, w, b)
+        for x, yv in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(yv),
+                                       rtol=1e-4, atol=1e-4)
+        # no-affine and multi-axis forms
+        np.testing.assert_allclose(
+            np.asarray(_ln_affine(a, None, None, axes, eps)),
+            np.asarray(plain(a, None, None)), rtol=1e-5, atol=1e-5)
+        axes2 = (1, 2)
+        ga1 = jax.grad(lambda a_: jnp.vdot(
+            _ln_affine(a_, None, None, axes2, eps), gy))(a)
+        def plain2(a_):
+            m = jnp.mean(a_, axis=axes2, keepdims=True)
+            v = jnp.var(a_, axis=axes2, keepdims=True)
+            return (a_ - m) * jax.lax.rsqrt(v + eps)
+        ga2 = jax.grad(lambda a_: jnp.vdot(plain2(a_), gy))(a)
+        np.testing.assert_allclose(np.asarray(ga1), np.asarray(ga2),
+                                   rtol=1e-4, atol=1e-4)
