@@ -151,14 +151,32 @@ class LlamaAttention(Layer):
         b, l = hidden_states.shape[0], hidden_states.shape[1]
         nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, \
             self.head_dim
-        q = M.reshape(self.q_proj(hidden_states), [b, l, nh, hd])
-        k = M.reshape(self.k_proj(hidden_states), [b, l, nkv, hd])
+        qp = self.q_proj(hidden_states)
+        kp = self.k_proj(hidden_states)
         v = M.reshape(self.v_proj(hidden_states), [b, l, nkv, hd])
 
         def rope_fn(qa, ka):
-            return _apply_rope(qa, ka, cfg.rope_theta, position_offset)
+            # Fast path: one Pallas pass rotates q and k straight off the
+            # PACKED projections — the textbook split/negate/concat chain
+            # materializes 5+ full-tensor XLA passes per call and forces
+            # the layout copies the r5 profile priced at ~110 ms/step
+            # (ops/fused_rope.py).
+            from paddle_tpu.ops import fused_rope as _frope
 
-        q, k = apply("rope", rope_fn, q, k)
+            if _frope.available(qa.shape, ka.shape, nh, nkv):
+                cos, sin = _rope_cos_sin(
+                    position_offset + l, hd, cfg.rope_theta, qa.dtype)
+                return _frope.fused_rope(
+                    qa, ka, cos[position_offset:], sin[position_offset:],
+                    nh, nkv)
+            q4, k4 = _apply_rope(
+                qa.reshape(b, l, nh, hd), ka.reshape(b, l, nkv, hd),
+                cfg.rope_theta, position_offset)
+            return q4.reshape(qa.shape), k4.reshape(ka.shape)
+
+        qp, kp = apply("rope", rope_fn, qp, kp)
+        q = M.reshape(qp, [b, l, nh, hd])
+        k = M.reshape(kp, [b, l, nkv, hd])
 
         new_cache = None
         if cache is not None:
