@@ -639,6 +639,40 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
 # cross-program reduction.
 
 
+def _delta_kernel(do_ref, o_ref, delta_ref, *, group: int, head_dim: int):
+    """delta block for one (batch, kv-head, q-block) program: the packed
+    [1, bl, G*D] do/o tiles reduce over d into [1, 1, 8, bl*G]
+    sublane-replicated rows — the exact operand layout of the bwd kernels."""
+    bl = do_ref.shape[1]
+    rows = bl * group
+    x = do_ref[0].astype(jnp.float32).reshape(rows, head_dim)
+    y = o_ref[0].astype(jnp.float32).reshape(rows, head_dim)
+    s = jnp.sum(x * y, axis=1)
+    delta_ref[0, 0] = jnp.broadcast_to(s[None, :], (8, rows))
+
+
+def _delta_pallas(do, out, num_kv_heads, g, d, interpret=False):
+    """rowsum(do ∘ o) per (position, head) in the bwd kernels' consumer
+    layout [B, Hkv, 8, Lq*G] f32 (sublane-replicated like lse)."""
+    b, lq, _ = do.shape
+    bl = _row_blocks(lq, g)
+    if (bl * g) % 128:
+        bl = lq  # full-dim minor block: legal at any size
+    return pl.pallas_call(
+        functools.partial(_delta_kernel, group=g, head_dim=d),
+        grid=(b, num_kv_heads, lq // bl),
+        in_specs=[
+            pl.BlockSpec((1, bl, g * d), lambda bi, ci, i: (bi, i, ci)),
+            pl.BlockSpec((1, bl, g * d), lambda bi, ci, i: (bi, i, ci)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 8, bl * g),
+                               lambda bi, ci, i: (bi, ci, i * 0, i)),
+        out_shape=jax.ShapeDtypeStruct(
+            (b, num_kv_heads, 8, lq * g), jnp.float32),
+        interpret=interpret,
+    )(do, out)
+
+
 def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
                     head_dim: int, q_offset: int, segmented: bool = False,
                     hp: int = 1):
@@ -869,18 +903,27 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
         # zeroing their do kills every dk/dv/dq contribution in one pass
         do = jnp.where(
             (jnp.asarray(q_segments, jnp.int32) >= 0)[:, :, None], do, 0)
-    # delta = rowsum(do ∘ o) per (position, head), f32-accumulated via an
-    # einsum contraction over d: the converts fuse INTO the reduce pass.
-    # (An explicit .astype(f32) product materialized a full [B,L,H,D] f32
-    # tensor per layer whose layout fought the reduce — 76 x 0.83 ms of
-    # pure layout copies in the r5 profile.)
-    delta = jnp.einsum(
-        "blhd,blhd->blh",
-        do.reshape(b, lq, num_heads, d), out.reshape(b, lq, num_heads, d),
-        preferred_element_type=jnp.float32)
-    delta = delta.reshape(b, lq, num_kv_heads, g).transpose(0, 2, 1, 3)
-    delta = jnp.broadcast_to(
-        delta.reshape(b, num_kv_heads, 1, lq * g), lse.shape)
+    # delta = rowsum(do ∘ o) per (position, head), f32-accumulated, in the
+    # bwd kernels' [B, Hkv, 8, Lq*G] row layout.  A dedicated Pallas pass
+    # when the packed tile is legal: the XLA einsum formulation converted
+    # do/o to f32 [B,L,H,D], layout-copied the 268MB intermediate
+    # ({3,1,2,0}→{3,2,1,0}), and ran a separate reduce — ~40 ms/step at
+    # the r5 bench shapes; the kernel reads the packed bf16 operands once
+    # and writes delta directly in the consumer layout.
+    if (g * d) % 128 == 0 and lq % 8 == 0:
+        delta = _delta_pallas(do, out, num_kv_heads, g, d,
+                              interpret=interpret)
+    else:
+        # small-head (hp>1 / BERT-shaped) fallback: einsum contraction
+        # whose converts fuse into the reduce pass
+        delta = jnp.einsum(
+            "blhd,blhd->blh",
+            do.reshape(b, lq, num_heads, d),
+            out.reshape(b, lq, num_heads, d),
+            preferred_element_type=jnp.float32)
+        delta = delta.reshape(b, lq, num_kv_heads, g).transpose(0, 2, 1, 3)
+        delta = jnp.broadcast_to(
+            delta.reshape(b, num_kv_heads, 1, lq * g), lse.shape)
     block_q = _row_blocks(lq, g)
     block_k = _pick_block(lk, 512, "k")
 
