@@ -26,15 +26,21 @@ from paddle_tpu.distributed.launch.controllers.collective import (
 
 class PSController(CollectiveController):
     def __init__(self, script, script_args=None, server_num=1, trainer_num=1,
-                 master=None, job_id="default", log_dir=None, env=None):
+                 master=None, job_id="default", log_dir=None, env=None,
+                 heter_worker_num=0):
         super().__init__(script, script_args,
-                         nproc_per_node=server_num + trainer_num,
+                         nproc_per_node=(server_num + trainer_num
+                                         + heter_worker_num),
                          master=master, job_id=job_id, log_dir=log_dir,
                          env=env)
         self.server_num = int(server_num)
         self.trainer_num = int(trainer_num)
+        # heter tier (reference heter_client/server: CPU-host workers that
+        # front the PS for the trainers; ps/heter.py HeterWorker role)
+        self.heter_num = int(heter_worker_num)
         self.server_procs = []
         self.trainer_procs = []
+        self.heter_procs = []
         self._ports = None  # probe-bound free ports, assigned in run()
 
     @staticmethod
@@ -75,20 +81,26 @@ class PSController(CollectiveController):
         return ports
 
     def _port_of(self, role, idx):
-        return self._ports[idx if role == "PSERVER"
-                           else self.server_num + idx]
+        if role == "PSERVER":
+            return self._ports[idx]
+        if role == "HETER_TRAINER":
+            return self._ports[self.server_num + idx]
+        return self._ports[self.server_num + self.heter_num + idx]
 
     # --------------------------------------------------------------- env
     def _ps_env(self, role, idx, host, port):
         """Reference ps.py env contract (controllers/ps.py _build_pod_*)."""
         world = self.trainer_num
         if self._ports is None:
-            self._ports = self._alloc_ports(self.server_num + world,
-                                            port + 1)
+            self._ports = self._alloc_ports(
+                self.server_num + self.heter_num + world, port + 1)
         server_eps = ",".join(
             f"{host}:{self._ports[s]}" for s in range(self.server_num))
+        heter_eps = ",".join(
+            f"{host}:{self._ports[self.server_num + h]}"
+            for h in range(self.heter_num))
         trainer_eps = ",".join(
-            f"{host}:{self._ports[self.server_num + t]}"
+            f"{host}:{self._ports[self.server_num + self.heter_num + t]}"
             for t in range(world))
         env = dict(self.base_env)
         env.update({
@@ -100,7 +112,21 @@ class PSController(CollectiveController):
             "PADDLE_PSERVER_NUM": str(self.server_num),
             "PADDLE_RESTART_COUNT": str(self.restart_count),
         })
-        if role == "PSERVER":
+        if self.heter_num:
+            # reference env names (fleet/base/role_maker.py heter path)
+            env.update({
+                "PADDLE_ALL_HETER_TRAINER_IP_PORT_LIST": heter_eps,
+                "PADDLE_HETER_TRAINER_NUM": str(self.heter_num),
+            })
+        if role == "HETER_TRAINER":
+            ep = f"{host}:{self._port_of('HETER_TRAINER', idx)}"
+            env.update({
+                "TRAINING_ROLE": "HETER_TRAINER",
+                "PADDLE_ROLE": "HETER_TRAINER",
+                "PADDLE_HETER_TRAINER_ID": str(idx),
+                "PADDLE_CURRENT_ENDPOINT": ep,
+            })
+        elif role == "PSERVER":
             ep = f"{host}:{self._port_of('PSERVER', idx)}"
             env.update({
                 "TRAINING_ROLE": "PSERVER",
@@ -123,10 +149,9 @@ class PSController(CollectiveController):
     def _spawn(self, role, idx, host, port):
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
-            f = open(os.path.join(
-                self.log_dir,
-                f"{'serverlog' if role == 'PSERVER' else 'workerlog'}.{idx}"),
-                "ab")
+            stem = {"PSERVER": "serverlog",
+                    "HETER_TRAINER": "heterlog"}.get(role, "workerlog")
+            f = open(os.path.join(self.log_dir, f"{stem}.{idx}"), "ab")
             self._log_files.append(f)
             out = err = f
         else:
@@ -148,10 +173,14 @@ class PSController(CollectiveController):
             self.server_procs = [
                 self._spawn("PSERVER", s, host, port)
                 for s in range(self.server_num)]
+            self.heter_procs = [
+                self._spawn("HETER_TRAINER", h, host, port)
+                for h in range(self.heter_num)]
             self.trainer_procs = [
                 self._spawn("TRAINER", t, host, port)
                 for t in range(self.trainer_num)]
-            self.procs = self.server_procs + self.trainer_procs
+            self.procs = (self.server_procs + self.heter_procs
+                          + self.trainer_procs)
             while True:
                 states = [p.poll() for p in self.trainer_procs]
                 if all(s == 0 for s in states):
@@ -160,9 +189,9 @@ class PSController(CollectiveController):
                 if bad:
                     return bad[0]
                 dead_servers = [
-                    p.poll() for p in self.server_procs
+                    p.poll() for p in self.server_procs + self.heter_procs
                     if p.poll() is not None]
-                if dead_servers:  # a server died under live trainers
+                if dead_servers:  # a server/heter died under live trainers
                     return dead_servers[0] or 1
                 if deadline is not None and time.time() > deadline:
                     raise TimeoutError("PS job did not finish in time")

@@ -27,7 +27,8 @@ _ENV_FLAGS = {
 _KNOWN_FLAGS = set(_ENV_FLAGS) | {
     "--nproc_per_node", "--devices", "--log_dir", "--ips", "--gpus", "--xpus",
     "--run_mode", "--max_restarts", "--elastic_level", "--server_num",
-    "--trainer_num", "--servers", "--trainers",
+    "--trainer_num", "--servers", "--trainers", "--heter_worker_num",
+    "--heter_workers",
 }
 
 
@@ -76,7 +77,7 @@ def launch():
             or opts.get("--trainers")):
         from paddle_tpu.distributed.launch.controllers import PSController
 
-        for flag in ("--servers", "--trainers"):
+        for flag in ("--servers", "--trainers", "--heter_workers"):
             eps = opts.get(flag)
             if eps and any(
                     not ep.split(":")[0] in ("127.0.0.1", "localhost", "")
@@ -89,9 +90,12 @@ def launch():
                          or len((opts.get("--servers") or "x").split(",")))
         trainer_num = int(opts.get("--trainer_num")
                           or len((opts.get("--trainers") or "x").split(",")))
+        heter_num = int(opts.get("--heter_worker_num")
+                        or (len(opts["--heter_workers"].split(","))
+                            if opts.get("--heter_workers") else 0))
         ctl = PSController(
             script, script_args, server_num=server_num,
-            trainer_num=trainer_num,
+            trainer_num=trainer_num, heter_worker_num=heter_num,
             master=opts.get("--master") or os.environ.get("PADDLE_MASTER"),
             job_id=opts.get("--job_id",
                             os.environ.get("PADDLE_JOB_ID", "default")),

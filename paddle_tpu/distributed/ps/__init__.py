@@ -11,6 +11,9 @@ the heter-PS pattern (SURVEY.md §2.6)."""
 from paddle_tpu.distributed.ps.table import DenseTable, SparseTable
 from paddle_tpu.distributed.ps.the_one_ps import PsServer, PsWorker, TheOnePSRuntime
 from paddle_tpu.distributed.ps.embedding import DistributedEmbedding
+from paddle_tpu.distributed.ps.heter import (HeterClient, HeterWorker,
+                                             PsDeviceCache)
 
 __all__ = ['SparseTable', 'DenseTable', 'PsServer', 'PsWorker',
-           'TheOnePSRuntime', 'DistributedEmbedding']
+           'TheOnePSRuntime', 'DistributedEmbedding', 'HeterClient',
+           'HeterWorker', 'PsDeviceCache']

@@ -1,0 +1,203 @@
+"""Heterogeneous parameter-server tiers (the last §2.6 inventory row).
+
+Reference:
+- HeterClient (/root/reference/paddle/fluid/distributed/ps/service/
+  heter_client.h:83): trainers on accelerator hosts do NOT talk to the PS
+  tier directly — sparse traffic routes through CPU-host *heter workers*
+  that own the host-side half of the model (the big embedding lookups),
+  so the accelerator host never blocks on table-shard fan-out.
+- PSGPUWrapper (/root/reference/paddle/fluid/framework/fleet/
+  ps_gpu_wrapper.h:221): GPU-PS — per *pass*, the working set of embedding
+  rows is gathered from the PS into a device-resident cache; minibatches
+  train against device memory and aggregated gradients flush back once.
+
+TPU-native design: the transport tier reuses the repo's rpc/PsWorker
+service (sockets + TCPStore discovery) — a heter worker is an rpc role
+holding its own ``PsWorker`` fan-out client, and ``HeterClient`` is the
+trainer-side stub that round-robins pulls across heter workers.  The
+GPU-PS idea maps cleanly onto XLA's static-shape world as
+``PsDeviceCache``: ``begin_pass`` pulls the pass's unique rows into ONE
+[n, dim] jax array (device-resident on TPU), ``lookup``/``accumulate``
+are pure gathers/scatter-adds jit-able inside the train step, and
+``end_pass`` flushes the summed gradients in one push.  What the
+reference implements as a CUDA hashmap (HeterPs/HashTable) is here a
+host-side id→slot dict + device gather — the MXU-friendly formulation.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+# ------------------------------------------------------------------ server side
+# state of THIS process when it plays the heter-worker role
+_HETER_STATE = {}
+
+
+def _heter_init(servers):
+    """Executed ON the heter worker: build its PS fan-out client."""
+    from paddle_tpu.distributed.ps.the_one_ps import PsWorker
+
+    _HETER_STATE["ps"] = PsWorker(servers)
+    return True
+
+
+def _heter_create_table(name, dim, accessor, kwargs):
+    return _HETER_STATE["ps"].create_sparse_table(name, dim, accessor,
+                                                  **kwargs)
+
+
+def _heter_pull(name, ids):
+    return _HETER_STATE["ps"].pull_sparse(name, ids)
+
+
+def _heter_push(name, ids, grads):
+    return _HETER_STATE["ps"].push_sparse(name, ids, grads)
+
+
+def _heter_table_size(name):
+    return _HETER_STATE["ps"].table_size(name)
+
+
+class HeterWorker:
+    """The CPU-host intermediary role (reference heter_client.h's peer,
+    heter_server.h): joins the rpc world under ``name`` and serves sparse
+    pull/push against the PS tier on behalf of trainers.  ``run()`` is
+    passive — the repo's rpc serves in-thread, matching PsServer."""
+
+    def __init__(self, name, servers=("ps0",)):
+        from paddle_tpu.distributed import rpc
+
+        self.name = name
+        if rpc.get_current_worker_info() is None:
+            rpc.init_rpc(name)
+        _heter_init(list(servers))
+
+    def run(self):
+        return self
+
+
+class HeterClient:
+    """Trainer-side stub (reference heter_client.h:83 SendAndRecvAsync):
+    sparse ops route through the heter tier, round-robin over workers.
+    API mirrors PsWorker so DistributedEmbedding/PsDeviceCache can ride
+    either transport unchanged."""
+
+    def __init__(self, heter_workers):
+        from paddle_tpu.distributed import rpc
+
+        self.workers = (list(heter_workers)
+                        if isinstance(heter_workers, (list, tuple))
+                        else [heter_workers])
+        self._rr = itertools.cycle(range(len(self.workers)))
+        self._rpc = rpc
+
+    def _next(self):
+        return self.workers[next(self._rr)]
+
+    def create_sparse_table(self, name, dim, accessor="sgd", **kwargs):
+        return self._rpc.rpc_sync(
+            self.workers[0], _heter_create_table,
+            args=(name, dim, accessor, kwargs))
+
+    def pull_sparse(self, name, ids):
+        return self._rpc.rpc_sync(
+            self._next(), _heter_pull,
+            args=(name, np.asarray(ids, np.int64).reshape(-1)))
+
+    def push_sparse(self, name, ids, grads):
+        return self._rpc.rpc_sync(
+            self._next(), _heter_push,
+            args=(name, np.asarray(ids, np.int64).reshape(-1),
+                  np.asarray(grads, np.float32)))
+
+    def push_sparse_async(self, name, ids, grads):
+        return [self._rpc.rpc_async(
+            self._next(), _heter_push,
+            args=(name, np.asarray(ids, np.int64).reshape(-1),
+                  np.asarray(grads, np.float32)))]
+
+    def table_size(self, name):
+        return self._rpc.rpc_sync(self.workers[0], _heter_table_size,
+                                  args=(name,))
+
+
+# ----------------------------------------------------------------- device cache
+class PsDeviceCache:
+    """Pass-scoped device-resident embedding cache (PSGPUWrapper analog).
+
+    ``puller`` is anything with pull_sparse/push_sparse (PsWorker,
+    HeterClient, DistributedEmbedding's client).  One *pass* =
+    begin_pass(working-set ids) → N minibatches of lookup()/accumulate()
+    against device memory → end_pass() flushing ONE aggregated push.
+
+    lookup/accumulate take SLOT indices (host-mapped once per minibatch
+    via ``slots()``) and run eagerly between jitted steps: lookup is a
+    device gather, accumulate a device scatter-add onto the pass
+    accumulator.  To fuse them INTO a jitted train step, pass
+    ``cache.cache`` as a step operand and ``jnp.take`` / ``.at[].add``
+    the slot indices there — ``accumulate`` itself stores its result on
+    the object (pass state), so calling it under an active trace would
+    leak the tracer.  Gradients for a row touched twice in a pass sum — the
+    same semantics as pushing per-minibatch (linear accessors: sgd), and
+    the reference's build_pull/push_gpups aggregation behavior.
+    """
+
+    def __init__(self, puller, table, dim):
+        self.puller = puller
+        self.table = table
+        self.dim = int(dim)
+        self._slot_of = None
+        self._ids = None
+        self.cache = None       # [n, dim] device rows
+        self.grad = None        # [n, dim] device grad accumulator
+
+    # ---------------------------------------------------------------- pass API
+    def begin_pass(self, ids):
+        import jax.numpy as jnp
+
+        if self._slot_of is not None:
+            raise RuntimeError("begin_pass: previous pass not ended")
+        uniq = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        rows = self.puller.pull_sparse(self.table, uniq)
+        self._ids = uniq
+        self._slot_of = {int(k): i for i, k in enumerate(uniq.tolist())}
+        self.cache = jnp.asarray(np.asarray(rows, np.float32))
+        self.grad = jnp.zeros_like(self.cache)
+        return len(uniq)
+
+    def slots(self, ids):
+        """Host-side id → cache-slot mapping for one minibatch."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        try:
+            return np.fromiter((self._slot_of[int(k)] for k in ids),
+                               np.int32, len(ids))
+        except KeyError as e:  # pragma: no cover - usage error
+            raise KeyError(
+                f"id {e} not in this pass's working set; include every "
+                "minibatch's ids in begin_pass") from None
+
+    def lookup(self, slot_idx):
+        """[m] slots → [m, dim] rows; pure device gather (jit-able)."""
+        return self.cache[np.asarray(slot_idx)]
+
+    def accumulate(self, slot_idx, grads):
+        """Scatter-add one minibatch's row grads into the device
+        accumulator (duplicate slots in one call sum, jnp .at semantics)."""
+        import jax.numpy as jnp
+
+        self.grad = self.grad.at[np.asarray(slot_idx)].add(
+            jnp.asarray(grads, self.grad.dtype))
+
+    def end_pass(self):
+        """One aggregated push of the whole pass's gradients."""
+        if self._slot_of is None:
+            raise RuntimeError("end_pass before begin_pass")
+        g = np.asarray(self.grad, np.float32)
+        live = np.any(g != 0.0, axis=1)
+        if live.any():
+            self.puller.push_sparse(self.table, self._ids[live], g[live])
+        self._slot_of = None
+        self._ids = None
+        self.cache = None
+        self.grad = None
