@@ -246,6 +246,45 @@ def bench_longseq(seqs=(16384, 32768), iters=3):
     return out
 
 
+def bench_llama_long(iters=3, batch=1, seq=16384):
+    """Model-level long-context training (SURVEY §5.7, the exceed-the-
+    reference axis): the SAME flagship llama config at a 16k sequence —
+    fused-RoPE + streamed-KV flash kernels end-to-end, full remat.  The
+    attention share of the step grows quadratically, so blended MFU sits
+    between the 2k train row and the 16k attention-kernel row."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.static.functionalize import build_train_step
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=seq, dtype="bfloat16", recompute=True,
+        loss_chunk_size=8192, recompute_layers=16)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01, moment_dtype="int8")
+    step = build_train_step(model, None, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                           dtype="int64")
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                              dtype="int64")
+    step(ids, labels).numpy()
+    step(ids, labels).numpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / iters
+    tok = batch * seq / dt
+    fpt = 6 * n_params + 6 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    return {"llama_16k_train_mfu": round(fpt * tok / 1e12 / _peak_tflops(), 4),
+            "llama_16k_tokens_per_sec": round(tok, 1)}
+
+
 def bench_bert(iters=10, batch=64, seq=512):
     """BERT-base MLM pretraining samples/sec (BASELINE.md ERNIE/BERT north
     star; reference: PaddleNLP pretraining configs on Fleet DP)."""
@@ -425,7 +464,8 @@ def main():
     secondary = {}
     if os.environ.get("BENCH_PRIMARY_ONLY") != "1":
         for fn in (bench_resnet50, bench_bert, bench_moe, bench_decode,
-                   bench_longseq, bench_eager, bench_collectives):
+                   bench_longseq, bench_llama_long, bench_eager,
+                   bench_collectives):
             try:
                 secondary.update(fn())
             except Exception as e:

@@ -74,7 +74,8 @@ def launch():
     # any server/trainer count/list argument
     if (run_mode == "ps" or opts.get("--server_num")
             or opts.get("--trainer_num") or opts.get("--servers")
-            or opts.get("--trainers")):
+            or opts.get("--trainers") or opts.get("--heter_worker_num")
+            or opts.get("--heter_workers")):
         from paddle_tpu.distributed.launch.controllers import PSController
 
         for flag in ("--servers", "--trainers", "--heter_workers"):

@@ -238,7 +238,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         va = rest[i] if v is not None else None
         i += v is not None
         if sin is not None:
-            sin_t, cos_t = rest[i], rest[i + 1]
+            # the reference contract: outputs carry q's dtype (its docstring:
+            # "has same shape and data type as q") — cast user tables up
+            # front so the jnp fallback and the Pallas fast path agree
+            sin_t = rest[i].astype(qa.dtype)
+            cos_t = rest[i + 1].astype(qa.dtype)
             i += 2
         else:
             inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
@@ -258,6 +262,25 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 sin_t = sin_t[:, :, None, :]
                 cos_t = cos_t[:, :, None, :]
         else:
+            # TPU fast path for the common case (half-split style, shared
+            # tables, q+k, batch-major, v unrotated): one Pallas pass in
+            # the packed layout (ops/fused_rope.py) instead of the 5+
+            # XLA passes of the textbook chain
+            if (use_neox_rotary_style and not time_major and va is None
+                    and ka is not None and qa.ndim == 4):
+                from paddle_tpu.ops import fused_rope as _frope
+
+                bb, ll, nh, dd = qa.shape
+                nkv = ka.shape[2]
+                c2 = jnp.squeeze(cos_t)
+                s2 = jnp.squeeze(sin_t)
+                if (c2.shape == (ll, dd)
+                        and _frope.available((bb, ll, nh * dd),
+                                             (bb, ll, nkv * dd), nh, nkv)):
+                    rq, rk = _frope.fused_rope(
+                        qa.reshape(bb, ll, nh * dd),
+                        ka.reshape(bb, ll, nkv * dd), c2, s2, nh, nkv)
+                    return (rq.reshape(qa.shape), rk.reshape(ka.shape))
             sin_t = jnp.squeeze(sin_t).reshape(1, seq_len, 1, dim) if not time_major else jnp.squeeze(sin_t).reshape(seq_len, 1, 1, dim)
             cos_t = jnp.squeeze(cos_t).reshape(1, seq_len, 1, dim) if not time_major else jnp.squeeze(cos_t).reshape(seq_len, 1, 1, dim)
         outs = [rot(qa, cos_t, sin_t)]

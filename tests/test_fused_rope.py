@@ -110,3 +110,58 @@ def test_available_gating():
     # the bench shapes pass exactly when on TPU
     assert available((16, 2048, 16 * 128), (16, 2048, 4 * 128), 16, 4) \
         == on_tpu
+
+
+def test_incubate_api_routes_onto_kernel(monkeypatch):
+    """incubate.nn.functional.fused_rotary_position_embedding's common case
+    (neox style, q+k, batch-major) rides the Pallas kernel; kernel-vs-jnp
+    parity through the public API."""
+    import functools
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+    from paddle_tpu.ops import fused_rope as FR
+
+    b, l, nh, nkv, d = 2, 32, 4, 2, 16
+    rng = np.random.default_rng(5)
+    q = paddle.to_tensor(rng.standard_normal((b, l, nh, d)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((b, l, nkv, d)).astype("float32"))
+
+    ref_q, ref_k, _ = IF.fused_rotary_position_embedding(q, k)
+
+    calls = []
+    real = FR.fused_rope
+    monkeypatch.setattr(FR, "available", lambda *a, **kw: True)
+    monkeypatch.setattr(
+        FR, "fused_rope",
+        lambda *a, **kw: calls.append(1) or real(*a[:6], True))
+    fast_q, fast_k, _ = IF.fused_rotary_position_embedding(q, k)
+    assert calls, "fast path was not taken"
+    np.testing.assert_allclose(np.asarray(fast_q.numpy()),
+                               np.asarray(ref_q.numpy()), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fast_k.numpy()),
+                               np.asarray(ref_k.numpy()), atol=1e-6)
+
+
+def test_incubate_api_dtype_contract():
+    """Reference contract: outputs carry q's dtype even when user sin/cos
+    are wider (review r5) — on both the jnp fallback and the fast path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    b, l, nh, d = 1, 16, 2, 8
+    rng = np.random.default_rng(6)
+    q = paddle.to_tensor(
+        rng.standard_normal((b, l, nh, d)).astype(np.float32)).astype(
+        "bfloat16")
+    k = paddle.to_tensor(
+        rng.standard_normal((b, l, nh, d)).astype(np.float32)).astype(
+        "bfloat16")
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, np.float32) / d))
+    freqs = np.outer(np.arange(l, dtype=np.float32), inv)
+    emb = np.concatenate([freqs, freqs], -1)
+    sin = paddle.to_tensor(np.sin(emb).astype(np.float32))
+    cos = paddle.to_tensor(np.cos(emb).astype(np.float32))
+    oq, ok, _ = IF.fused_rotary_position_embedding(q, k, sin=sin, cos=cos)
+    assert str(oq.dtype).endswith("bfloat16"), oq.dtype
+    assert str(ok.dtype).endswith("bfloat16"), ok.dtype
