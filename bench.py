@@ -358,15 +358,20 @@ def bench_moe(iters=10, batch_tokens=16384, d_model=2048, n_experts=8):
     class Block(nn.Layer):
         def __init__(self):
             super().__init__()
+            # gather = GShard capacity dispatch (r5): experts process only
+            # their routed tokens — 4x fewer expert FLOPs than the dense
+            # all-tokens formulation at top-2-of-8 (parity-tested)
             self.moe = MoELayer(d_model, [Expert() for _ in range(n_experts)],
-                                gate={"type": "gshard", "top_k": 2})
+                                gate={"type": "gshard", "top_k": 2},
+                                dispatch="gather")
 
         def forward(self, x):
             return self.moe(x)
 
     model = Block()
     model.to(dtype="bfloat16")
-    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype="int8")  # the fused q8 kernel, as bench_llama
     step = build_train_step(model, paddle.nn.MSELoss(), opt)
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(

@@ -19,8 +19,26 @@ from paddle_tpu.nn.layer.container import LayerList
 
 
 class MoELayer(Layer):
+    """``dispatch`` selects the single-chip routing formulation:
+
+    - "dense" (default, the r3 path): every expert runs over every token,
+      outputs scaled by the combine weight (zero for unrouted).  Simple,
+      dropless, but top-k/E of the expert FLOPs are wasted — 4x at the
+      bench's top-2-of-8.
+    - "gather": GShard capacity dispatch.  Token-expert pairs are sorted
+      by expert (stable argsort), each expert processes only its first
+      ``capacity`` routed tokens gathered into a [E, c, d] bucket, and a
+      scatter-add combines weighted expert outputs.  Pairs beyond
+      capacity are DROPPED (the GShard paper's overflow semantics — the
+      token keeps its other expert's contribution).  All shapes static;
+      gather/scatter differentiate as scatter/gather.  c =
+      ceil(capacity_factor * n * top_k / E), capacity_factor defaulting
+      to the gate's train factor (GShardGate.capacity[0], 1.2).
+    """
+
     def __init__(self, d_model, experts, gate=None, moe_group=None, mp_group=None,
-                 recompute_interval=0, recompute_ctx=None):
+                 recompute_interval=0, recompute_ctx=None, dispatch="dense",
+                 capacity_factor=None):
         super().__init__()
         self.d_model = d_model
         if isinstance(experts, (list, tuple)):
@@ -29,6 +47,10 @@ class MoELayer(Layer):
         self.num_expert = len(experts)
         self.moe_group = moe_group
         self.world_size = moe_group.nranks if moe_group is not None else 1
+        if dispatch not in ("dense", "gather"):
+            raise ValueError(f"unknown dispatch {dispatch!r}")
+        self.dispatch = dispatch
+        self.capacity_factor = capacity_factor
 
         if gate is None:
             gate = {"type": "gshard", "top_k": 2}
@@ -56,6 +78,9 @@ class MoELayer(Layer):
         d = orig_shape[-1]
         inp2 = inp.reshape([-1, d])
         value, gate_idx = self.gate(inp2)
+        if self.dispatch == "gather":
+            out = self._forward_gather(inp2, gate_idx, value)
+            return out.reshape(orig_shape)
 
         # run every expert over every token's routed subset, gathered densely:
         # expert_in[e] = tokens routed to e (zeros elsewhere) via one-hot combine
@@ -81,3 +106,63 @@ class MoELayer(Layer):
         for o in outs[1:]:
             total = apply("add", jnp.add, total, o)
         return total.reshape(orig_shape)
+
+    # ------------------------------------------------- GShard capacity dispatch
+    def _capacity(self, n):
+        import math
+
+        factor = self.capacity_factor
+        if factor is None:
+            cap = getattr(self.gate, "capacity", None)
+            factor = cap[0] if cap else 1.2
+        c = int(math.ceil(factor * n * self.top_k / self.num_expert))
+        return min(c, n * self.top_k)
+
+    def _forward_gather(self, inp2, gate_idx, value):
+        n = inp2.shape[0]
+        k, E = self.top_k, self.num_expert
+        c = self._capacity(int(n))
+
+        def route(idx, val):
+            # pair p = (token p//k, choice p%k); sort pairs by expert so each
+            # expert's first c pairs claim its bucket slots (stable sort =
+            # lower token index wins a contested slot, GShard's order)
+            w = jax.nn.softmax(val, -1).reshape(-1)              # [n*k]
+            flat_e = idx.reshape(-1).astype(jnp.int32)
+            order = jnp.argsort(flat_e, stable=True).astype(jnp.int32)
+            sorted_e = flat_e[order]
+            start = jnp.searchsorted(sorted_e,
+                                     jnp.arange(E, dtype=jnp.int32))
+            pos = jnp.arange(n * k, dtype=jnp.int32) - \
+                start[sorted_e].astype(jnp.int32)
+            keep = pos < c
+            # slot E*c is a scratch entry: dropped pairs write/read there
+            slot = jnp.where(keep, sorted_e * c + pos, E * c)
+            token = (order // k).astype(jnp.int32)
+            # src[slot] = token feeding it; empty slots point at the zeros
+            # row n appended to x
+            src = jnp.full((E * c + 1,), n, jnp.int32).at[slot].set(
+                jnp.where(keep, token, n))[:E * c]
+            return src, slot, token, w[order]
+
+        src, slot, token, w_sorted = apply("moe_route", route, gate_idx, value)
+
+        def gather_in(x, src):
+            xpad = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+            return xpad[src]                                     # [E*c, d]
+
+        xe = apply("moe_gather", gather_in, inp2, src)
+        ye = []
+        for e, expert in enumerate(self.experts):
+            xe_e = apply("moe_bucket", lambda a, e=e: a[e * c:(e + 1) * c], xe)
+            ye.append(expert(xe_e))
+
+        def combine(token, slot, w_sorted, *outs):
+            yflat = jnp.concatenate(list(outs) +
+                                    [jnp.zeros((1, outs[0].shape[1]),
+                                               outs[0].dtype)])
+            contrib = yflat[slot] * w_sorted[:, None].astype(outs[0].dtype)
+            return jnp.zeros((n, outs[0].shape[1]), outs[0].dtype
+                             ).at[token].add(contrib)
+
+        return apply("moe_combine", combine, token, slot, w_sorted, *ye)

@@ -262,12 +262,16 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 sin_t = sin_t[:, :, None, :]
                 cos_t = cos_t[:, :, None, :]
         else:
-            # TPU fast path for the common case (half-split style, shared
-            # tables, q+k, batch-major, v unrotated): one Pallas pass in
-            # the packed layout (ops/fused_rope.py) instead of the 5+
-            # XLA passes of the textbook chain
-            if (use_neox_rotary_style and not time_major and va is None
-                    and ka is not None and qa.ndim == 4):
+            # TPU fast path for the common case (half-split style,
+            # INTERNALLY-computed tables, q+k, batch-major, v unrotated):
+            # one Pallas pass in the packed layout (ops/fused_rope.py)
+            # instead of the 5+ XLA passes of the textbook chain.
+            # User-PROVIDED sin/cos stay on the jnp path: the kernel's vjp
+            # treats the tables as positional constants (zero cotangent),
+            # which would silently kill gradients to trainable tables
+            # (review r5)
+            if (sin is None and use_neox_rotary_style and not time_major
+                    and va is None and ka is not None and qa.ndim == 4):
                 from paddle_tpu.ops import fused_rope as _frope
 
                 bb, ll, nh, dd = qa.shape

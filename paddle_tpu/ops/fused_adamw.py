@@ -28,17 +28,41 @@ _Q8_BLOCK = 256
 
 
 def _kernel(sc_ref, p_ref, g_ref, m_ref, s_ref, v_ref, *outs,
-            out_dtype, has_master: bool):
+            out_dtype, has_master: bool, chunks: int = 1):
     """sc_ref [1, 16] f32 scalars: b1, b2, eps, lr, c1, c2, wd_factor, _,
     (1-b1), (1-b2), padding...
     p_ref [rows, 256] master f32 (or the raw low-precision param when no
     master exists — cast in-kernel); g_ref [rows, 256] f32|bf16;
     m_ref int8 codes; s_ref [rows, 1] f32 scales; v_ref bf16 moment2.
-    outs = ([p32_out,] pw_out, m_out, s_out, v_out)."""
+    outs = ([p32_out,] pw_out, m_out, s_out, v_out).
+
+    ``chunks`` > 1 = NATIVE-shape tiles: refs arrive [br, chunks*256]
+    (s_ref [br, chunks]) in the parameter's own 2-D layout, and the
+    [rows, 256] quantization-block view happens HERE, in VMEM — the
+    flat-layout formulation made XLA retile every state tensor in HBM
+    (~13 ms/step on the MoE bench's 8x 16.8M-param experts).  Row-major
+    contiguity makes the view exactly the flat path's block order."""
     if has_master:
         p_out, pw_out, m_out, s_out, v_out = outs
     else:
         pw_out, m_out, s_out, v_out = outs
+    br = p_ref.shape[0]
+    if chunks > 1:
+        # native tiles: work in [br, chunks, 256] — every reshape splits or
+        # merges MINOR dims only (a [br*chunks, 256] canonical view would
+        # cross the sublane dim, which Mosaic refuses for the [br, chunks]
+        # scales); the scale of block (r, c) broadcasts over its 256 lanes
+        blk = lambda ref: ref[...].reshape(br, chunks, _Q8_BLOCK)
+        s_in = s_ref[...][:, :, None]                 # [br, chunks, 1]
+        unblk = lambda x: x.reshape(br, chunks * _Q8_BLOCK)
+        s_store = lambda s: s.reshape(br, chunks)
+        red_axis = 2
+    else:
+        blk = lambda ref: ref[...]
+        s_in = s_ref[...]                             # [rows, 1]
+        unblk = lambda x: x
+        s_store = lambda s: s
+        red_axis = 1
     sc = sc_ref[0]
     b1, b2, eps, lr = sc[0], sc[1], sc[2], sc[3]
     c1, c2, wd_factor = sc[4], sc[5], sc[6]
@@ -47,28 +71,43 @@ def _kernel(sc_ref, p_ref, g_ref, m_ref, s_ref, v_ref, *outs,
     # — an in-kernel f32(1)-f32(0.9) differs by ~2e-7 and can flip int8
     # codes at rounding boundaries (review r5)
     one_m_b1, one_m_b2 = sc[8], sc[9]
-    g = g_ref[...].astype(jnp.float32)
-    m = m_ref[...].astype(jnp.float32) * s_ref[...]
-    v = v_ref[...].astype(jnp.float32)
+    g = blk(g_ref).astype(jnp.float32)
+    m = blk(m_ref).astype(jnp.float32) * s_in
+    v = blk(v_ref).astype(jnp.float32)
     m_new = b1 * m + one_m_b1 * g
     v_new = b2 * v + one_m_b2 * g * g
     upd = lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
-    p_new = p_ref[...].astype(jnp.float32) * wd_factor - upd
+    p_new = blk(p_ref).astype(jnp.float32) * wd_factor - upd
     if has_master:
-        p_out[...] = p_new
-    pw_out[...] = p_new.astype(out_dtype)
-    s_new = jnp.max(jnp.abs(m_new), axis=1, keepdims=True) / 127.0
-    m_out[...] = jnp.round(
-        m_new / jnp.maximum(s_new, 1e-30)).astype(jnp.int8)
-    s_out[...] = s_new
-    v_out[...] = v_new.astype(v_ref.dtype)
+        p_out[...] = unblk(p_new)
+    pw_out[...] = unblk(p_new.astype(out_dtype))
+    s_new = jnp.max(jnp.abs(m_new), axis=red_axis, keepdims=True) / 127.0
+    m_out[...] = unblk(jnp.round(
+        m_new / jnp.maximum(s_new, 1e-30)).astype(jnp.int8))
+    s_out[...] = s_store(s_new)
+    v_out[...] = unblk(v_new.astype(v_ref.dtype))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("out_dtype", "has_master", "interpret"))
 def fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
                    out_dtype=jnp.bfloat16, has_master=True,
                    interpret=False):
+    """Entry: reads the PADDLE_Q8_NATIVE opt-out at CALL time (an env read
+    inside the jitted body would be baked in at trace time and silently
+    ignored once the shape is cached — review r5)."""
+    import os
+
+    native_ok = os.environ.get("PADDLE_Q8_NATIVE", "1") != "0"
+    return _fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
+                           out_dtype=out_dtype, has_master=has_master,
+                           interpret=interpret, native_ok=native_ok)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("out_dtype", "has_master", "interpret",
+                                    "native_ok"))
+def _fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
+                    out_dtype=jnp.bfloat16, has_master=True,
+                    interpret=False, native_ok=True):
     """One fused update step over a FLAT parameter whose size divides 256.
 
     p [n]: the f32 master when ``has_master``, else the raw low-precision
@@ -83,6 +122,63 @@ def fused_adamw_q8(p, g, m_codes, scales, v_bf16, scalars,
     """
     n = p.size
     nb = n // _Q8_BLOCK
+    # NATIVE-2-D path: a [R, C] parameter with a 256-multiple minor dim
+    # keeps its own layout end to end (the quantization-block view happens
+    # inside the kernel tile) — the flat view below made XLA physically
+    # retile every state tensor to the [nb, 256] tiling and back
+    if (p.ndim == 2 and p.shape[1] % (8 * _Q8_BLOCK) == 0
+            and p.shape[0] % 8 == 0 and p.shape[1] <= 8192
+            and native_ok):
+        # C % 2048 == 0: the [br, chunks, 256] view tiles cleanly only when
+        # chunks is a sublane multiple — chunks=22 ([2048,5632] llama MLP)
+        # measured ~8 ms/step SLOWER than the flat path's retiles, while
+        # chunks=8/32 (the MoE experts) measured ~8 ms FASTER
+        R, C = p.shape
+        chunks = C // _Q8_BLOCK
+        # row block: ~256KB of f32 per operand tile — HALF the flat path's
+        # budget, because the [br, chunks, 256] views materialize extra
+        # VMEM intermediates (512KB tiles measured 18.3M scoped > the 16M
+        # limit on the [2048, 512] k-proj).  The C <= 8192 gate keeps the
+        # 8-row minimum inside budget; wider params (the 32k-vocab lm
+        # head) take the flat path below
+        br = min(R, (65536 // C) // 8 * 8)
+        while R % br:
+            br -= 8
+        if br >= 8 and R % br == 0:
+            grid = (R // br,)
+            full = pl.BlockSpec((br, C), lambda i: (i, i * 0))
+            col = pl.BlockSpec((br, chunks), lambda i: (i, i * 0))
+            args = [
+                jnp.asarray(scalars, jnp.float32).reshape(1, 16),
+                p, g.reshape(R, C), m_codes.reshape(R, C),
+                scales.reshape(R, chunks), v_bf16.reshape(R, C),
+            ]
+            in_specs = [pl.BlockSpec((1, 16), lambda i: (i * 0, i * 0)),
+                        full, full, full, col, full]
+            out_specs = [full, full, col, full]
+            out_shape = [
+                jax.ShapeDtypeStruct((R, C), out_dtype),
+                jax.ShapeDtypeStruct((R, C), jnp.int8),
+                jax.ShapeDtypeStruct((R, chunks), jnp.float32),
+                jax.ShapeDtypeStruct((R, C), v_bf16.dtype),
+            ]
+            if has_master:
+                out_specs = [full] + out_specs
+                out_shape = [jax.ShapeDtypeStruct((R, C), jnp.float32)] \
+                    + out_shape
+            outs = pl.pallas_call(
+                functools.partial(_kernel, out_dtype=out_dtype,
+                                  has_master=has_master, chunks=chunks),
+                grid=grid, in_specs=in_specs, out_specs=out_specs,
+                out_shape=out_shape, interpret=interpret,
+            )(*args)
+            outs = list(outs)
+            s_i = 2 if has_master else 1
+            outs[s_i + 1] = outs[s_i + 1].reshape(scales.shape)
+            return tuple(
+                o if i == s_i + 1 else o.reshape(p.shape)
+                for i, o in enumerate(outs))
+    # flat path: any shape whose size divides 256
     # tile rows: biggest power-of-two chunk <= 512 that divides nb
     # (terminates at tr == 1: everything divides 1)
     tr = min(512, nb)

@@ -131,9 +131,11 @@ class Adam(Optimizer):
             z, z, z, z, z, z,
         ])
         has_master = k in masters
-        p_in = (masters[k] if has_master else p_arr).reshape(-1)
+        # NATIVE shapes: 2-D params with a 256-multiple minor dim keep
+        # their own tiling through the kernel (no HBM retile passes)
+        p_in = masters[k] if has_master else p_arr
         outs = fused_adamw_q8(
-            p_in, g.reshape(-1), m.reshape(-1), sc, v.reshape(-1), scalars,
+            p_in, g, m, sc, v, scalars,
             out_dtype=p_arr.dtype, has_master=has_master,
             interpret=interpret)
         if has_master:

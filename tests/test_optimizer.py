@@ -343,15 +343,21 @@ class TestTrainStepGradClip:
 
 
 class TestFusedAdamQ8:
-    def test_fused_matches_jnp_path(self, monkeypatch):
+    @pytest.mark.parametrize("shape", [
+        (8, 2048),   # native 2-D path, chunks=8 (in-VMEM block view)
+        (8, 512),    # chunks=2: NOT sublane-aligned -> flat path
+        (2048,),     # 1-D: the flat [nb, 256] path
+        (12, 256),   # rows not a multiple of 8: flat path
+    ])
+    def test_fused_matches_jnp_path(self, monkeypatch, shape):
         """The one-pass Pallas int8-AdamW update (ops/fused_adamw.py) is
-        step-identical to the jnp decode/update/encode formulation."""
+        step-identical to the jnp decode/update/encode formulation — on
+        the native-2-D tile path and the flat-view path alike."""
         import jax.numpy as jnp
 
         from paddle_tpu.optimizer import AdamW
 
         rng = np.random.default_rng(0)
-        shape = (8, 256)  # n = 2048, divides the 256 q8 block
         params = {"w": jnp.asarray(
             rng.standard_normal(shape).astype(np.float32)).astype(
                 jnp.bfloat16)}
