@@ -153,7 +153,16 @@ class LlamaAttention(Layer):
             self.head_dim
         qp = self.q_proj(hidden_states)
         kp = self.k_proj(hidden_states)
-        v = M.reshape(self.v_proj(hidden_states), [b, l, nkv, hd])
+        vp = self.v_proj(hidden_states)
+
+        # NOTE: rope fused INTO the flash kernels exists
+        # (ops/flash_attention.py::flash_attention_packed_rope, parity-
+        # tested) but is NOT routed here: at the bench shapes it measured
+        # ~11 ms/step SLOWER than the standalone rope kernel + flash —
+        # the attention kernels are VPU-bound, so in-kernel rotation
+        # extends their critical path by more than the bandwidth-bound
+        # standalone pass costs (2x A/B, BENCH_NOTES r5).
+        v = M.reshape(vp, [b, l, nkv, hd])
 
         def rope_fn(qa, ka):
             # Fast path: one Pallas pass rotates q and k straight off the

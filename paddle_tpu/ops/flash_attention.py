@@ -61,10 +61,30 @@ def _reject_causal_lq_gt_lk(lq: int, lk: int, causal: bool, name: str):
             "keys. Use the dense fallback for this shape.")
 
 
+def signed_sin(sin):
+    """Fold rot_half's sign into the sin table once: concat(-sin_half,
+    sin_half).  THE one source of the sign convention — _rot_tile consumes
+    its output; ops/fused_rope.py imports both so the standalone and
+    in-kernel rotations cannot drift apart."""
+    d2 = sin.shape[-1] // 2
+    return jnp.concatenate([-sin[..., :d2], sin[..., d2:]], axis=-1)
+
+
+def _rot_tile(x, c, s):
+    """Half-split rotary rotation of a [rows, d] tile: x*c + swap(x)*s,
+    swap = concat(x[d/2:], x[:d/2]); ``s`` is the SIGNED sin table
+    (signed_sin) so the swap is a plain lane concat.  The inverse rotation
+    is the same call with ``-s`` (R^T = R(-θ)) — shared with
+    ops/fused_rope.py, here applied on tiles already resident in VMEM."""
+    d2 = x.shape[-1] // 2
+    swapped = jnp.concatenate([x[:, d2:], x[:, :d2]], axis=1)
+    return x * c + swapped * s
+
+
 # --------------------------------------------------------------------------- pallas fwd
 def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
                 head_dim: int, q_offset: int, segmented: bool = False,
-                hp: int = 1):
+                hp: int = 1, rope: bool = False):
     """One (batch, kv-head-block, q-block) program: online softmax over k
     blocks, for ``hp`` kv heads per program (unrolled in-kernel loop).
 
@@ -91,10 +111,18 @@ def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
     the caller; self-attention guarantees every non-padding row matches its
     own position.
     """
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    if rope:
+        # rope tables (packed hp==1 path only): q tables blocked like q
+        # ([1, block_q, G*D], g-tiled minor), k tables like k ([1, Lk, D]);
+        # sin pre-signed by the wrapper
+        qcos_ref, qsin_ref, kcos_ref, ksin_ref = refs[i:i + 4]
+        i += 4
     if segmented:
-        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        qseg_ref, kseg_ref = refs[i:i + 2]
+        i += 2
+    o_ref, lse_ref = refs[i:i + 2]
     # 4-D refs = head-major bhld layout ([1, hp, L, D]); 3-D = packed
     block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
@@ -116,6 +144,9 @@ def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
         else:
             # [block_q, G*D] -> [block_q*G, D]: contiguous, free
             q = q_ref[0, :, j * gd:(j + 1) * gd].reshape(rows, head_dim)
+        if rope:
+            q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
+                          qsin_ref[0].reshape(rows, head_dim))
 
         def make_body(masked, q=q, j=j):
             def body(kb, carry):
@@ -128,6 +159,10 @@ def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
                               j * head_dim:(j + 1) * head_dim]  # [block_k, D]
                     v = v_ref[0, pl.ds(kb * block_k, block_k),
                               j * head_dim:(j + 1) * head_dim]
+                if rope:
+                    k = _rot_tile(
+                        k, kcos_ref[0, pl.ds(kb * block_k, block_k), :],
+                        ksin_ref[0, pl.ds(kb * block_k, block_k), :])
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32
@@ -483,12 +518,15 @@ def _seg_rows(segments, g):
                               "interpret"))
 def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
                       scale=None, interpret=False, q_segments=None,
-                      k_segments=None):
+                      k_segments=None, rope_tables=None):
     """q [B, Lq, H*D], k/v [B, Lk, Hkv*D] — the projection layout, consumed
     without any transpose.  Returns (out [B, Lq, H*D],
     lse [B, Hkv, 8, Lq*G]).  Optional q_segments/k_segments [B, Lq]/[B, Lk]
     i32 restrict attention to same-segment pairs (padding/varlen); rows with
-    a negative segment id are zeroed."""
+    a negative segment id are zeroed.  ``rope_tables`` = (qcos, qsin, kcos,
+    ksin) pre-tiled signed tables (flash_attention_packed_rope): q/k rotate
+    IN-KERNEL on tiles already in VMEM — the standalone rope pass and its
+    HBM round-trip disappear.  Resident packed (hp==1) path only."""
     b, lq, hd_packed = q.shape
     lk = k.shape[1]
     _reject_causal_lq_gt_lk(lq, lk, causal, "flash_attention")
@@ -503,6 +541,11 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
             f"flash_attention: no legal TPU tiling for head_dim={d}, "
             f"kv_heads={num_kv_heads} (minor dim not a 128-multiple); "
             "use blockwise_attention or the dense path")
+    rope = rope_tables is not None
+    if rope and (hp != 1 or _stream_kv(lk, hp, d)):
+        raise ValueError(
+            "rope_tables: in-kernel rotation is only wired for the resident "
+            "packed (hp==1) kernels — gate with rope_fusable()")
     segmented = q_segments is not None
     if hp == 1 and _stream_kv(lk, hp, d):
         # long-context: stream k/v via the grid (full residency would blow
@@ -589,6 +632,16 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
         out_spec0 = pl.BlockSpec((1, block_q, hp * g * d),
                                  lambda bi, ci, i: (bi, i, ci))
         out_shape0 = jax.ShapeDtypeStruct((b, lq, num_heads * d), q.dtype)
+    if rope:
+        in_specs += [
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i: (i * 0, i, i * 0)),
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i: (i * 0, i, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
+        ]
+        args += list(rope_tables)
     if segmented:
         in_specs += [
             pl.BlockSpec((1, 1, 8, block_q * g),
@@ -601,7 +654,7 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
         functools.partial(
             _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
             group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
-            hp=hp,
+            hp=hp, rope=rope,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -675,7 +728,7 @@ def _delta_pallas(do, out, num_kv_heads, g, d, interpret=False):
 
 def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
                     head_dim: int, q_offset: int, segmented: bool = False,
-                    hp: int = 1):
+                    hp: int = 1, rope: bool = False):
     """One (batch, kv-head-block, k-block, q-block) program: this q block's
     contribution to dk/dv of this k block, for hp kv heads (unrolled loop —
     see _fwd_kernel).
@@ -693,11 +746,15 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
     caller zeroes padding rows of ``do`` so dead-row lse garbage cannot
     contaminate dk/dv.
     """
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    if rope:
+        qcos_ref, qsin_ref, kcos_ref, ksin_ref = refs[i:i + 4]
+        i += 4
     if segmented:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
-         kseg_ref, dk_ref, dv_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
+        qseg_ref, kseg_ref = refs[i:i + 2]
+        i += 2
+    dk_ref, dv_ref = refs[i:i + 2]
     block_k = k_ref.shape[2] if k_ref.ndim == 4 else k_ref.shape[1]
     block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
@@ -736,6 +793,11 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
                 v = v_ref[0, :, ds_]
                 q = q_ref[0, :, gs].reshape(rows, head_dim)
                 do = do_ref[0, :, gs].reshape(rows, head_dim)
+            if rope:
+                # recompute rotated q/k from the raw residuals (hp == 1)
+                q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
+                              qsin_ref[0].reshape(rows, head_dim))
+                k = _rot_tile(k, kcos_ref[0], ksin_ref[0])
             lse = lse_ref[0, j, 0]                         # [rows]
             delta = delta_ref[0, j, 0]
             s = jax.lax.dot_general(
@@ -787,10 +849,20 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
     else:
         compute(False)
 
+    if rope:
+        # dk accumulated in ROTATED space across the q sweep; the raw-space
+        # cotangent is R^T dk̂ = rotation with -sin, applied once at the
+        # final q step on the resident f32 accumulator
+        @pl.when(qb == pl.num_programs(3) - 1)
+        def _unrotate_dk():
+            dk_ref[0] = _rot_tile(
+                dk_ref[0], kcos_ref[0].astype(jnp.float32),
+                -ksin_ref[0].astype(jnp.float32))
+
 
 def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
                    group: int, head_dim: int, q_offset: int,
-                   segmented: bool = False, hp: int = 1):
+                   segmented: bool = False, hp: int = 1, rope: bool = False):
     """One (batch, kv-head-block, q-block) program: dq for this q block,
     for hp kv heads (unrolled loop — see _fwd_kernel).
 
@@ -798,11 +870,15 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
     lse_ref/delta_ref [1, hp, 8, block_q*G].  ``segmented`` adds qseg_ref
     [1, 1, 8, block_q*G] / kseg_ref [1, 1, 8, Lk] after delta_ref.
     """
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    if rope:
+        qcos_ref, qsin_ref, kcos_ref, ksin_ref = refs[i:i + 4]
+        i += 4
     if segmented:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
-         kseg_ref, dq_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        qseg_ref, kseg_ref = refs[i:i + 2]
+        i += 2
+    dq_ref = refs[i]
     block_q = q_ref.shape[2] if q_ref.ndim == 4 else q_ref.shape[1]
     rows = block_q * group
     gd = group * head_dim
@@ -821,6 +897,9 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
         else:
             q = q_ref[0, :, gs].reshape(rows, head_dim)
             do = do_ref[0, :, gs].reshape(rows, head_dim)
+        if rope:
+            q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
+                          qsin_ref[0].reshape(rows, head_dim))
         lse = lse_ref[0, j, 0]
         delta = delta_ref[0, j, 0]
 
@@ -833,6 +912,10 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
                 else:
                     k = k_ref[0, pl.ds(kb * block_k, block_k), ds_]
                     v = v_ref[0, pl.ds(kb * block_k, block_k), ds_]
+                if rope:
+                    k = _rot_tile(
+                        k, kcos_ref[0, pl.ds(kb * block_k, block_k), :],
+                        ksin_ref[0, pl.ds(kb * block_k, block_k), :])
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32
@@ -878,6 +961,12 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
             dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_k_blocks),
                                    make_body(False), dq0,
                                    unroll=num_k_blocks <= 8)
+        if rope:
+            # dq accumulated in rotated space; raw-space cotangent = R^T dq̂
+            dq = _rot_tile(dq, qcos_ref[0].reshape(rows, head_dim
+                                                   ).astype(jnp.float32),
+                           -qsin_ref[0].reshape(rows, head_dim
+                                                ).astype(jnp.float32))
         if bhld:
             dq_ref[0, j] = dq.astype(dq_ref.dtype)
         else:
@@ -889,8 +978,11 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
                               "interpret"))
 def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
                       causal=False, scale=None, interpret=False,
-                      q_segments=None, k_segments=None):
-    """Packed layout in/out; lse [B, Hkv, 8, Lq*G] from the forward kernel."""
+                      q_segments=None, k_segments=None, rope_tables=None):
+    """Packed layout in/out; lse [B, Hkv, 8, Lq*G] from the forward kernel.
+    With ``rope_tables``, q/k arrive RAW: the kernels re-rotate them on
+    load and the returned dq/dk are raw-space cotangents (inverse rotation
+    applied in-kernel before the store)."""
     b, lq, _ = q.shape
     lk = k.shape[1]
     _reject_causal_lq_gt_lk(lq, lk, causal, "flash_attention backward")
@@ -1006,6 +1098,23 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
             jax.ShapeDtypeStruct(k.shape, jnp.float32),
             jax.ShapeDtypeStruct(v.shape, jnp.float32),
         ]
+    rope = rope_tables is not None
+    if rope:
+        if hp != 1 or bhld or (hp == 1 and _stream_kv(lk, hp, d)):
+            raise ValueError(
+                "rope_tables: in-kernel rotation is only wired for the "
+                "resident packed (hp==1) kernels")
+        dkv_specs += [
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i, qb: (i * 0, qb, i * 0)),
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i, qb: (i * 0, qb, i * 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bi, ci, i, qb: (i * 0, i, i * 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bi, ci, i, qb: (i * 0, i, i * 0)),
+        ]
+        dkv_args += list(rope_tables)
     if segmented:
         qseg_rows = _seg_rows(q_segments, g)
         kseg_rows = _seg_rows(k_segments, 1)
@@ -1020,7 +1129,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale,
             group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
-            hp=hp,
+            hp=hp, rope=rope,
         ),
         grid=(b, num_kv_heads // hp, lk // block_k, lq // block_q),
         in_specs=dkv_specs,
@@ -1109,6 +1218,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
         dq_out_spec = pl.BlockSpec((1, block_q, hp * g * d),
                                    lambda bi, ci, i: (bi, i, ci))
         dq_out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if rope:
+        dq_specs += [
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i: (i * 0, i, i * 0)),
+            pl.BlockSpec((1, block_q, g * d),
+                         lambda bi, ci, i: (i * 0, i, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
+            pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
+        ]
+        dq_args += list(rope_tables)
     if segmented:
         dq_specs += [
             pl.BlockSpec((1, 1, 8, block_q * g),
@@ -1121,7 +1240,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
             group=g, head_dim=d, q_offset=lk - lq, segmented=segmented,
-            hp=hp,
+            hp=hp, rope=rope,
         ),
         grid=(b, num_kv_heads // hp, lq // block_q),
         in_specs=dq_specs,
@@ -1197,6 +1316,91 @@ def _faps_bwd(num_heads, num_kv_heads, causal, scale, interpret, res, g):
 
 
 flash_attention_packed_segmented.defvjp(_faps_fwd, _faps_bwd)
+
+
+# ----------------------------------------------------- fused-rope packed entry
+def _rope_kernel_tables(cos, sin, g, lq, lk, dtype):
+    """Raw [Lk, D] tables -> the kernels' operand layout: q tables g-tiled
+    [1, Lq, G*D] (aligned to the LAST lq positions — cached-prefill
+    bottom-right convention), k tables [1, Lk, D]; sin pre-signed
+    (concat(-sin_half, sin_half)) so the in-kernel swap is a plain lane
+    concat (see ops/fused_rope.py)."""
+    cos = cos.astype(dtype)
+    sin_s = signed_sin(sin).astype(dtype)
+    qcos = jnp.tile(cos[lk - lq:], (1, g))[None]
+    qsin = jnp.tile(sin_s[lk - lq:], (1, g))[None]
+    return qcos, qsin, cos[None], sin_s[None]
+
+
+def rope_fusable(q_shape, k_shape, num_heads, num_kv_heads) -> bool:
+    """Gate for flash_attention_packed_rope: TPU, resident packed (hp==1)
+    kernels, lane-aligned head dim.  Everything else applies rope outside
+    (ops/fused_rope.py standalone kernel or the jnp chain)."""
+    if not _on_tpu():
+        return False
+    b, lq, qd = q_shape
+    lk = k_shape[1]
+    d = qd // num_heads
+    if d * num_heads != qd or d % 128:
+        return False
+    g = num_heads // num_kv_heads
+    if g * num_kv_heads != num_heads or (g * d) % 128:
+        return False
+    # rope adds resident kcos+ksin ([Lk, D] each, double-buffered) to the
+    # kernels' k/v residency — budget them like an extra k+v pair so a
+    # shape that barely fit WITHOUT rope doesn't blow scoped vmem with it
+    # (review r5): 8*(lk*d + lk*d) bytes vs the 12MB streaming threshold
+    if _stream_kv(2 * lk, 1, d):      # long-context streamed kernels
+        return False
+    return lq % 128 == 0 and lk % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_packed_rope(q, k, v, cos, sin, num_heads, num_kv_heads,
+                                causal=False, scale=None, interpret=False):
+    """GQA flash attention with rotary embedding FUSED INTO the kernels:
+    q/k arrive RAW in the projection layout and rotate on tiles already in
+    VMEM — the standalone rope pass (read+rotate+write of q and k per
+    layer, plus its backward) disappears from the step.  cos/sin are the
+    standard half-duplicated tables [Lk, D] (positions are the caller's —
+    slice for cached prefill); they are treated as positional constants
+    (zero cotangent), matching the reference fused kernel
+    (paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu) whose tables are
+    not differentiable either.  Gate with ``rope_fusable``."""
+    b, lq, _ = q.shape
+    lk = k.shape[1]
+    g = num_heads // num_kv_heads
+    tables = _rope_kernel_tables(cos, sin, g, lq, lk, q.dtype)
+    return _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=causal,
+                             scale=scale, interpret=interpret,
+                             rope_tables=tables)[0]
+
+
+def _fapr_fwd(q, k, v, cos, sin, num_heads, num_kv_heads, causal, scale,
+              interpret):
+    b, lq, _ = q.shape
+    lk = k.shape[1]
+    g = num_heads // num_kv_heads
+    tables = _rope_kernel_tables(cos, sin, g, lq, lk, q.dtype)
+    out, lse = _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads,
+                                 causal=causal, scale=scale,
+                                 interpret=interpret, rope_tables=tables)
+    return out, (q, k, v, out, lse, cos, sin)
+
+
+def _fapr_bwd(num_heads, num_kv_heads, causal, scale, interpret, res, gct):
+    q, k, v, out, lse, cos, sin = res
+    b, lq, _ = q.shape
+    lk = k.shape[1]
+    g = num_heads // num_kv_heads
+    tables = _rope_kernel_tables(cos, sin, g, lq, lk, q.dtype)
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, gct, num_heads, num_kv_heads, causal=causal,
+        scale=scale, interpret=interpret, rope_tables=tables)
+    return dq, dk, dv, jnp.zeros_like(cos), jnp.zeros_like(sin)
+
+
+flash_attention_packed_rope.defvjp(_fapr_fwd, _fapr_bwd)
 
 
 # ------------------------------------------------------------------- blockwise (jnp)

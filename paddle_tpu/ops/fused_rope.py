@@ -36,7 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from paddle_tpu.ops.flash_attention import _on_tpu, _pick_block
+from paddle_tpu.ops.flash_attention import (_on_tpu, _pick_block, _rot_tile,
+                                            signed_sin)
 
 
 def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref, *,
@@ -54,14 +55,14 @@ def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref, *,
     sin = sin_ref[...]
     if neg:
         sin = -sin
-    d2 = d // 2
 
     def rot(ref, oref, h):
         x = ref[0].reshape(bl * h, d)
         c = jnp.broadcast_to(cos[:, None, :], (bl, h, d)).reshape(bl * h, d)
         s = jnp.broadcast_to(sin[:, None, :], (bl, h, d)).reshape(bl * h, d)
-        swapped = jnp.concatenate([x[:, d2:], x[:, :d2]], axis=1)
-        oref[0] = (x * c + swapped * s).reshape(bl, h * d).astype(oref.dtype)
+        # shared rotation math (flash_attention._rot_tile) — one source of
+        # the swap/sign convention across the standalone and in-kernel ropes
+        oref[0] = _rot_tile(x, c, s).reshape(bl, h * d).astype(oref.dtype)
 
     rot(q_ref, oq_ref, nh)
     rot(k_ref, ok_ref, nkv)
@@ -72,10 +73,10 @@ def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref, *,
 def _rope_pallas(q, k, cos, sin, nh, nkv, neg=False, interpret=False):
     b, l, qd = q.shape
     d = qd // nh
-    d2 = d // 2
     cos = cos.astype(q.dtype)
-    # fold rot_half's sign into the sin table once ([L, D], tiny)
-    sin = jnp.concatenate([-sin[:, :d2], sin[:, d2:]], axis=1).astype(q.dtype)
+    # fold rot_half's sign into the sin table once ([L, D], tiny) — shared
+    # convention source: flash_attention.signed_sin
+    sin = signed_sin(sin).astype(q.dtype)
     bl = _pick_block(l, 256)
     # index maps use `i * 0` (not the literal 0): a literal traces as i64
     # under the package's jax_enable_x64 and Mosaic rejects the mixed-width

@@ -165,3 +165,41 @@ def test_incubate_api_dtype_contract():
     oq, ok, _ = IF.fused_rotary_position_embedding(q, k, sin=sin, cos=cos)
     assert str(oq.dtype).endswith("bfloat16"), oq.dtype
     assert str(ok.dtype).endswith("bfloat16"), ok.dtype
+
+
+def test_flash_attention_packed_rope_parity():
+    """Rope fused INTO the flash kernels (q/k rotate on VMEM tiles, bwd
+    re-rotates from raw residuals and inverse-rotates dq/dk in-kernel):
+    values + grads match rotate-then-attend.  Not routed by the model at
+    bench shapes (measured slower there — BENCH_NOTES r5); parity keeps
+    the op usable where the tradeoff inverts."""
+    from paddle_tpu.ops.flash_attention import (flash_attention_packed,
+                                                flash_attention_packed_rope)
+
+    B, L, NH, NKV, D = 2, 256, 4, 2, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, L, NH * D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, NKV * D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, NKV * D)), jnp.float32)
+    cos, sin = _rope_cos_sin(L, D, 10000.0, jnp.float32)
+
+    def ref(q, k, v):
+        rq, rk = _apply_rope(q.reshape(B, L, NH, D),
+                             k.reshape(B, L, NKV, D), 10000.0)
+        return flash_attention_packed(rq.reshape(B, L, -1),
+                                      rk.reshape(B, L, -1), v,
+                                      NH, NKV, True, None, True)
+
+    def fused(q, k, v):
+        return flash_attention_packed_rope(q, k, v, cos, sin, NH, NKV,
+                                           True, None, True)
+
+    np.testing.assert_allclose(fused(q, k, v), ref(q, k, v), atol=1e-5)
+
+    def loss(f):
+        return lambda *a: (f(*a) * jnp.sin(f(*a))).sum()
+
+    gr = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss(fused), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4)
