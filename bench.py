@@ -261,7 +261,9 @@ def bench_llama_long(iters=3, batch=1, seq=16384):
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
         max_position_embeddings=seq, dtype="bfloat16", recompute=True,
-        loss_chunk_size=8192, recompute_layers=16)
+        loss_chunk_size=8192, recompute_layers=0)
+    # rl0 (no remat): at B1 the HBM freed by batch=1 buys back every
+    # recompute FLOP — swept rl16/12/8/4/0 = 1846/1719/1615/1520/1437 ms
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),

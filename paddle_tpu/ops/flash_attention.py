@@ -145,8 +145,8 @@ def _fwd_kernel(*refs, block_k: int, causal: bool, scale: float, group: int,
             # [block_q, G*D] -> [block_q*G, D]: contiguous, free
             q = q_ref[0, :, j * gd:(j + 1) * gd].reshape(rows, head_dim)
         if rope:
-            q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
-                          qsin_ref[0].reshape(rows, head_dim))
+            q = _rot_tile(q, _rope_q_tile(qcos_ref, block_q, group, head_dim),
+                          _rope_q_tile(qsin_ref, block_q, group, head_dim))
 
         def make_body(masked, q=q, j=j):
             def body(kb, carry):
@@ -634,9 +634,9 @@ def _flash_fwd_pallas(q, k, v, num_heads, num_kv_heads, causal=False,
         out_shape0 = jax.ShapeDtypeStruct((b, lq, num_heads * d), q.dtype)
     if rope:
         in_specs += [
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i: (i * 0, i, i * 0)),
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i: (i * 0, i, i * 0)),
             pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
             pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
@@ -795,8 +795,9 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, group: int,
                 do = do_ref[0, :, gs].reshape(rows, head_dim)
             if rope:
                 # recompute rotated q/k from the raw residuals (hp == 1)
-                q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
-                              qsin_ref[0].reshape(rows, head_dim))
+                q = _rot_tile(
+                    q, _rope_q_tile(qcos_ref, block_q, group, head_dim),
+                    _rope_q_tile(qsin_ref, block_q, group, head_dim))
                 k = _rot_tile(k, kcos_ref[0], ksin_ref[0])
             lse = lse_ref[0, j, 0]                         # [rows]
             delta = delta_ref[0, j, 0]
@@ -898,8 +899,8 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
             q = q_ref[0, :, gs].reshape(rows, head_dim)
             do = do_ref[0, :, gs].reshape(rows, head_dim)
         if rope:
-            q = _rot_tile(q, qcos_ref[0].reshape(rows, head_dim),
-                          qsin_ref[0].reshape(rows, head_dim))
+            q = _rot_tile(q, _rope_q_tile(qcos_ref, block_q, group, head_dim),
+                          _rope_q_tile(qsin_ref, block_q, group, head_dim))
         lse = lse_ref[0, j, 0]
         delta = delta_ref[0, j, 0]
 
@@ -963,10 +964,12 @@ def _bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
                                    unroll=num_k_blocks <= 8)
         if rope:
             # dq accumulated in rotated space; raw-space cotangent = R^T dq̂
-            dq = _rot_tile(dq, qcos_ref[0].reshape(rows, head_dim
-                                                   ).astype(jnp.float32),
-                           -qsin_ref[0].reshape(rows, head_dim
-                                                ).astype(jnp.float32))
+            dq = _rot_tile(
+                dq,
+                _rope_q_tile(qcos_ref, block_q, group,
+                             head_dim).astype(jnp.float32),
+                -_rope_q_tile(qsin_ref, block_q, group,
+                              head_dim).astype(jnp.float32))
         if bhld:
             dq_ref[0, j] = dq.astype(dq_ref.dtype)
         else:
@@ -1105,9 +1108,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
                 "rope_tables: in-kernel rotation is only wired for the "
                 "resident packed (hp==1) kernels")
         dkv_specs += [
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i, qb: (i * 0, qb, i * 0)),
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i, qb: (i * 0, qb, i * 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda bi, ci, i, qb: (i * 0, i, i * 0)),
@@ -1220,9 +1223,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, num_heads, num_kv_heads,
         dq_out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
     if rope:
         dq_specs += [
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i: (i * 0, i, i * 0)),
-            pl.BlockSpec((1, block_q, g * d),
+            pl.BlockSpec((1, block_q, d),
                          lambda bi, ci, i: (i * 0, i, i * 0)),
             pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
             pl.BlockSpec((1, lk, d), lambda bi, ci, i: (i * 0, i * 0, i * 0)),
@@ -1320,16 +1323,24 @@ flash_attention_packed_segmented.defvjp(_faps_fwd, _faps_bwd)
 
 # ----------------------------------------------------- fused-rope packed entry
 def _rope_kernel_tables(cos, sin, g, lq, lk, dtype):
-    """Raw [Lk, D] tables -> the kernels' operand layout: q tables g-tiled
-    [1, Lq, G*D] (aligned to the LAST lq positions — cached-prefill
-    bottom-right convention), k tables [1, Lk, D]; sin pre-signed
-    (concat(-sin_half, sin_half)) so the in-kernel swap is a plain lane
-    concat (see ops/fused_rope.py)."""
+    """Raw [Lk, D] tables -> kernel operands: q tables [1, Lq, D] (aligned
+    to the LAST lq positions — cached-prefill bottom-right convention), k
+    tables [1, Lk, D]; sin pre-signed (signed_sin) so the in-kernel swap
+    is a plain lane concat.  The per-group broadcast happens IN-KERNEL
+    (_rope_q_tile) — a g-tiled [Lq, G*D] operand would stream g× the
+    table bytes through every program (review r5)."""
     cos = cos.astype(dtype)
     sin_s = signed_sin(sin).astype(dtype)
-    qcos = jnp.tile(cos[lk - lq:], (1, g))[None]
-    qsin = jnp.tile(sin_s[lk - lq:], (1, g))[None]
-    return qcos, qsin, cos[None], sin_s[None]
+    return cos[lk - lq:][None], sin_s[lk - lq:][None], cos[None], sin_s[None]
+
+
+def _rope_q_tile(t_ref, block_q, group, d):
+    """[1, block_q, D] table block -> the packed q tile's row order
+    ([block_q*G, D], position-major group-minor) via in-VMEM broadcast —
+    the same pattern as ops/fused_rope.py's kernel."""
+    t = t_ref[0]
+    return jnp.broadcast_to(t[:, None, :], (block_q, group, d)
+                            ).reshape(block_q * group, d)
 
 
 def rope_fusable(q_shape, k_shape, num_heads, num_kv_heads) -> bool:
