@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.ops.decode_attention import decode_attention, init_kv_cache
 
-__all__ = ["extract_decode_params", "decode_greedy"]
+__all__ = ["extract_decode_params", "decode_greedy", "decode_speculative"]
 
 
 def extract_decode_params(model):
@@ -107,8 +107,11 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t):
     return h, k_cache, v_cache
 
 
-def _forward_step(params, cfg, tokens, caches, lengths):
-    """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
+def _forward(params, cfg, tokens, caches, lengths, last_only):
+    """Shared decode forward: tokens [B, T] -> (logits, caches',
+    lengths + T).  ``last_only`` projects just the final position
+    ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
+    speculative verification)."""
     h = params["embed"][tokens]  # [B, T, hidden]
     new_caches = []
     cos_t, sin_t = params["_rope"]
@@ -116,12 +119,25 @@ def _forward_step(params, cfg, tokens, caches, lengths):
         h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
-    last = h[:, -1]  # [B, hidden]
+    if last_only:
+        h = h[:, -1]  # [B, hidden]
     if "lm_head" in params:
-        logits = last @ params["lm_head"]
+        logits = h @ params["lm_head"]
     else:
-        logits = last @ params["embed"].T.astype(last.dtype)
+        logits = h @ params["embed"].T.astype(h.dtype)
     return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
+
+
+def _forward_step(params, cfg, tokens, caches, lengths):
+    """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
+    return _forward(params, cfg, tokens, caches, lengths, last_only=True)
+
+
+def _forward_step_all(params, cfg, tokens, caches, lengths):
+    """Logits for EVERY input position [B, T, V] — the verification pass
+    of speculative decoding needs the target's next-token distribution
+    after each drafted token."""
+    return _forward(params, cfg, tokens, caches, lengths, last_only=False)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -171,6 +187,145 @@ def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax,
     return jnp.concatenate([first[None], rest], 0).T  # [B, new_tokens]
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "dcfg", "max_new_tokens", "lmax",
+                                    "spec_k"))
+def _spec_jit(params, dparams, cfg, dcfg, input_ids, max_new_tokens, lmax,
+              spec_k=4):
+    """Speculative greedy decoding, whole loop in ONE compiled program.
+
+    Per iteration: the draft model decodes ``spec_k`` tokens sequentially
+    (plus one discarded step so its cache covers the full-acceptance
+    case), the target runs ONE forward over (cur, d1..dk) and greedy-picks
+    at every position; the longest matched draft prefix (length j) is
+    accepted and the target's own pick at the first mismatch is emitted —
+    j+1 tokens per target forward, byte-identical to plain greedy (the
+    lossless-speculative property).  Rejection is FREE with the static
+    caches: both models' per-batch ``lengths`` simply rewind to the
+    accepted prefix — stale cache rows beyond ``lengths`` are invisible
+    to decode_attention's position masking and get overwritten next
+    iteration.  All shapes static; per-batch acceptance is independent
+    (ragged lengths throughout)."""
+    b, _ = input_ids.shape
+    nh, nkv, hd, eps = cfg
+    dnh, dnkv, dhd, deps = dcfg
+    dtype = params["embed"].dtype
+    caches = [init_kv_cache(b, lmax, nkv, hd, dtype)
+              for _ in params["layers"]]
+    dcaches = [init_kv_cache(b, lmax, dnkv, dhd, dparams["embed"].dtype)
+               for _ in dparams["layers"]]
+    lengths = jnp.zeros((b,), jnp.int32)
+    dlengths = jnp.zeros((b,), jnp.int32)
+
+    # prefill BOTH models on the prompt; out[0] is the target's greedy pick
+    logits, caches, lengths = _forward_step(
+        params, cfg, input_ids, caches, lengths)
+    _, dcaches, dlengths = _forward_step(
+        dparams, dcfg, input_ids, dcaches, dlengths)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    out = jnp.zeros((b, max_new_tokens), jnp.int32)
+    out = out.at[:, 0].set(first)
+    n_out = jnp.ones((b,), jnp.int32)
+
+    def cond(carry):
+        return jnp.any(carry[0] < max_new_tokens)
+
+    def body(carry):
+        n_out, out, cur, caches, lengths, dcaches, dlengths = carry
+        # ---- draft: k+1 sequential steps (last one only fills the cache)
+        def dbody(c, _):
+            tok, dcaches, dlengths = c
+            dl, dcaches, dlengths = _forward_step(
+                dparams, dcfg, tok[:, None], dcaches, dlengths)
+            nxt = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            return (nxt, dcaches, dlengths), nxt
+        (_, dcaches, dlengths), drafts = jax.lax.scan(
+            dbody, (cur, dcaches, dlengths), None, length=spec_k + 1)
+        drafts = drafts[:spec_k].T                       # [B, k]
+        # ---- verify: one target forward over (cur, d1..dk)
+        toks = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        logits, caches, lengths = _forward_step_all(
+            params, cfg, toks, caches, lengths)
+        picks = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k+1]
+        match = picks[:, :spec_k] == drafts                      # [B, k]
+        # [B] 0..k; i32 reduction dtype: integer .sum() promotes to i64
+        # under the package's x64 mode and poisons the while carry
+        j = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(
+            1, dtype=jnp.int32)
+        # emitted this iteration: d1..dj then the target's pick at j
+        emit = jnp.where(
+            jnp.arange(spec_k + 1)[None, :] < j[:, None],
+            jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], 1),
+            jnp.take_along_axis(picks, j[:, None], axis=1))     # [B, k+1]
+        cols = n_out[:, None] + jnp.arange(spec_k + 1)[None, :]
+        valid = (jnp.arange(spec_k + 1)[None, :] <= j[:, None]) \
+            & (cols < max_new_tokens)
+        out = out.at[jnp.arange(b)[:, None],
+                     jnp.where(valid, cols, max_new_tokens)].set(
+            jnp.where(valid, emit, 0), mode="drop")
+        cur = jnp.take_along_axis(picks, j[:, None], axis=1)[:, 0]
+        # rewind to the accepted prefix (cur + j drafts processed);
+        # -(k+1) + (j+1) = j - k.  All-i32 arithmetic: a bare python int
+        # promotes the carry to i64 under the package's x64 mode
+        lengths = lengths + j - jnp.int32(spec_k)
+        dlengths = dlengths + j - jnp.int32(spec_k)
+        return (n_out + j + jnp.int32(1), out, cur, caches, lengths,
+                dcaches, dlengths)
+
+    carry = (n_out, out, first, caches, lengths, dcaches, dlengths)
+    n_out, out, *_ = jax.lax.while_loop(cond, body, carry)
+    return out
+
+
+def _decode_params_of(model, lmax):
+    cfg = model.config
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    live_w = model.llama.embed_tokens.weight.data
+    cached = getattr(model, "_decode_cache", None)
+    if cached is not None and cached[0] is live_w and cached[1] == lmax:
+        params = cached[2]
+    else:
+        params = dict(extract_decode_params(model))
+        params["_rope"] = _rope_tables(lmax, hd, cfg.rope_theta,
+                                       params["embed"].dtype)
+        model._decode_cache = (live_w, lmax, params)
+    return params, (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
+                    cfg.rms_norm_eps)
+
+
+def decode_speculative(model, draft_model, input_ids, max_new_tokens=32,
+                       max_len=None, spec_k=4):
+    """Lossless speculative greedy decoding: ``draft_model`` (same vocab,
+    any smaller config) proposes ``spec_k`` tokens per round; the target
+    verifies them in one forward and keeps the longest matching prefix.
+    Output is BYTE-IDENTICAL to ``decode_greedy(model, ...)`` for any
+    draft — a bad draft only costs speed, never correctness
+    (parity-tested).  The reference has no speculative decoding in-tree;
+    this is the TPU-native exceed item on the inference axis, built
+    entirely on the static-cache machinery (rejection = rewinding the
+    per-batch ``lengths``)."""
+    if model.config.vocab_size != draft_model.config.vocab_size:
+        raise ValueError("speculative decoding requires a shared vocabulary")
+    prompt_len = int(input_ids.shape[1])
+    need = prompt_len + int(max_new_tokens) + int(spec_k) + 1
+    if max_len is not None and int(max_len) < need:
+        # the verify forward writes spec_k+1 cache rows BEFORE rewinding,
+        # so the peak position exceeds decode_greedy's bound by spec_k;
+        # an undersized cache silently drops writes and breaks the
+        # byte-identical-to-greedy guarantee (review r5)
+        raise ValueError(
+            f"decode_speculative: max_len={max_len} < {need} "
+            f"(prompt + max_new_tokens + spec_k + 1); the verification "
+            "forward needs spec_k+1 rows of headroom past the last token")
+    lmax = int(max_len if max_len is not None else need + 1)
+    params, cfg = _decode_params_of(model, lmax)
+    dparams, dcfg = _decode_params_of(draft_model, lmax)
+    ids = jnp.asarray(getattr(input_ids, "data", input_ids), jnp.int32)
+    return _spec_jit(params, dparams, cfg, dcfg, ids, int(max_new_tokens),
+                     lmax, spec_k=int(spec_k))
+
+
 def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None,
                   temperature=0.0, top_k=0, seed=0):
     """Decode ``max_new_tokens`` tokens in ONE compiled program.
@@ -182,28 +337,16 @@ def decode_greedy(model, input_ids, max_new_tokens=32, max_len=None,
     prompts).  Returns [B, max_new_tokens] int32.  The compiled program is
     cached per (shape, max_new_tokens, sampling config)."""
     cfg = model.config
-    hd = cfg.hidden_size // cfg.num_attention_heads
     prompt_len = int(input_ids.shape[1])
     lmax = int(max_len if max_len is not None
                else prompt_len + max_new_tokens)
-    # cache the extracted pytree + rope tables on the model: a serving loop
-    # calling decode_greedy per request must not re-walk the Layer tree or
-    # rebuild the cos/sin tables each call (review r5).  Validity is an
-    # `is` check against the live embedding array (NOT id() — the cache
-    # holds a strong reference to the cached array, so a replaced weight
-    # can never alias a recycled id); invalidated when weights are swapped
-    # (set_state_dict) or lmax changes.
-    live_w = model.llama.embed_tokens.weight.data
-    cached = getattr(model, "_decode_cache", None)
-    if cached is not None and cached[0] is live_w and cached[1] == lmax:
-        params = cached[2]
-    else:
-        params = dict(extract_decode_params(model))
-        params["_rope"] = _rope_tables(lmax, hd, cfg.rope_theta,
-                                       params["embed"].dtype)
-        model._decode_cache = (live_w, lmax, params)
-    key = (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
-           cfg.rms_norm_eps)
+    # _decode_params_of caches the extracted pytree + rope tables on the
+    # model: a serving loop must not re-walk the Layer tree or rebuild the
+    # cos/sin tables per call (review r5).  Validity is an `is` check
+    # against the live embedding array (NOT id() — the cache holds a
+    # strong reference, so a replaced weight can never alias a recycled
+    # id); invalidated when weights are swapped or lmax changes.
+    params, key = _decode_params_of(model, lmax)
     ids = jnp.asarray(getattr(input_ids, "data", input_ids), jnp.int32)
     sample = float(temperature) > 0.0
     vk = int(top_k)

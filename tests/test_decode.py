@@ -218,3 +218,78 @@ class TestSampledDecode:
         greedy = np.asarray(decode_greedy(model, ids, max_new_tokens=8))
         eager = model.generate(ids, max_new_tokens=8).numpy()
         np.testing.assert_array_equal(greedy, eager)
+
+
+class TestSpeculativeDecode:
+    """decode_speculative (the r5 exceed-the-reference inference item): the
+    LOSSLESS property — output byte-identical to plain greedy for ANY
+    draft (a bad draft only costs speed, never correctness)."""
+
+    def _make(self, layers, hidden, seed):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(seed)
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=hidden, intermediate_size=hidden * 2,
+            num_hidden_layers=layers, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            dtype="float32")
+        return LlamaForCausalLM(cfg)
+
+    def test_lossless_for_any_draft(self):
+        from paddle_tpu.models.llama_decode import (decode_greedy,
+                                                    decode_speculative)
+
+        target = self._make(3, 64, 0)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 128, (2, 8)), dtype="int64")
+        ref = np.asarray(decode_greedy(target, ids, max_new_tokens=24))
+
+        # random draft: near-zero acceptance -> every round exercises the
+        # rejection/rewind path
+        bad_draft = self._make(1, 32, 7)
+        spec = np.asarray(decode_speculative(target, bad_draft, ids,
+                                             max_new_tokens=24, spec_k=3))
+        np.testing.assert_array_equal(spec, ref)
+
+        # self-draft: full acceptance -> every round takes the bonus-token
+        # (j == k) path; equality also proves cache rollback bookkeeping
+        spec_self = np.asarray(decode_speculative(target, target, ids,
+                                                  max_new_tokens=24,
+                                                  spec_k=3))
+        np.testing.assert_array_equal(spec_self, ref)
+
+    def test_spec_k_sweep_and_vocab_guard(self):
+        from paddle_tpu.models.llama_decode import (decode_greedy,
+                                                    decode_speculative)
+
+        target = self._make(2, 64, 1)
+        draft = self._make(1, 64, 2)
+        ids = paddle.to_tensor(
+            np.random.default_rng(3).integers(0, 128, (1, 5)), dtype="int64")
+        ref = np.asarray(decode_greedy(target, ids, max_new_tokens=11))
+        for k in (1, 2, 5):
+            spec = np.asarray(decode_speculative(target, draft, ids,
+                                                 max_new_tokens=11,
+                                                 spec_k=k))
+            np.testing.assert_array_equal(spec, ref)
+
+        class _V:
+            class config:
+                vocab_size = 999
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            decode_speculative(target, _V(), ids)
+
+    def test_undersized_max_len_rejected(self):
+        from paddle_tpu.models.llama_decode import decode_speculative
+
+        target = self._make(2, 64, 1)
+        draft = self._make(1, 64, 2)
+        ids = paddle.to_tensor(
+            np.random.default_rng(4).integers(0, 128, (1, 5)), dtype="int64")
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="headroom"):
+            # the value that works for decode_greedy (prompt + max_new)
+            decode_speculative(target, draft, ids, max_new_tokens=8,
+                               max_len=13, spec_k=3)
