@@ -178,6 +178,31 @@ def bench_decode(ctx=2048, new_tokens=64):
         per_tok = (t_long - t_short) / (new_tokens - 4)
         out[f"decode_tok_per_sec_b{batch}"] = round(batch / per_tok, 1)
     out["decode_ctx"] = ctx
+
+    # lossless speculative decoding with model-free prompt-lookup drafting
+    # (r5 exceed item): repetitive prompt = the lookup-friendly regime.
+    # Greedy comparator measured on the SAME prompt/shape.
+    from paddle_tpu.models.llama_decode import decode_speculative
+
+    rep = paddle.to_tensor(
+        np.tile(rng.integers(0, cfg.vocab_size, (1, 32)), (1, 8)),
+        dtype="int64")
+    spec_new, k = 128, 8
+    lmax = 256 + spec_new + k + 2
+    np.asarray(decode_greedy(model, rep, max_new_tokens=spec_new,
+                             max_len=lmax))
+    t0 = time.perf_counter()
+    np.asarray(decode_greedy(model, rep, max_new_tokens=spec_new,
+                             max_len=lmax))
+    t_greedy = time.perf_counter() - t0
+    np.asarray(decode_speculative(model, None, rep, max_new_tokens=spec_new,
+                                  max_len=lmax, spec_k=k))
+    t0 = time.perf_counter()
+    np.asarray(decode_speculative(model, None, rep, max_new_tokens=spec_new,
+                                  max_len=lmax, spec_k=k))
+    t_spec = time.perf_counter() - t0
+    out["decode_spec_ngram_tok_per_sec"] = round(spec_new / t_spec, 1)
+    out["decode_spec_ngram_speedup"] = round(t_greedy / t_spec, 2)
     return out
 
 

@@ -293,3 +293,33 @@ class TestSpeculativeDecode:
             # the value that works for decode_greedy (prompt + max_new)
             decode_speculative(target, draft, ids, max_new_tokens=8,
                                max_len=13, spec_k=3)
+
+    def test_ngram_prompt_lookup_lossless(self):
+        """draft_model=None: model-free prompt-lookup drafting — lossless
+        on random AND repetitive prompts (the lookup-friendly regime where
+        acceptance is high and the bonus path runs repeatedly)."""
+        from paddle_tpu.models.llama_decode import (decode_greedy,
+                                                    decode_speculative)
+
+        target = self._make(3, 64, 0)
+        rng = np.random.default_rng(0)
+        for prompt in (rng.integers(0, 128, (2, 8)),
+                       np.tile(rng.integers(0, 128, (1, 8)), (2, 4))):
+            ids = paddle.to_tensor(prompt, dtype="int64")
+            ref = np.asarray(decode_greedy(target, ids, max_new_tokens=24))
+            spec = np.asarray(decode_speculative(
+                target, None, ids, max_new_tokens=24, spec_k=4))
+            np.testing.assert_array_equal(spec, ref)
+
+    def test_misuse_errors_are_actionable(self):
+        from paddle_tpu.models.llama_decode import decode_speculative
+
+        target = self._make(2, 64, 1)
+        ids = paddle.to_tensor(
+            np.random.default_rng(5).integers(0, 128, (1, 5)), dtype="int64")
+        import pytest as _pytest
+        # decode_greedy-style call: ids lands in the draft_model slot
+        with _pytest.raises(TypeError, match="draft_model must be"):
+            decode_speculative(target, ids)
+        with _pytest.raises(ValueError, match="input_ids is required"):
+            decode_speculative(target, None)
