@@ -190,7 +190,16 @@ class PsDeviceCache:
             jnp.asarray(grads, self.grad.dtype))
 
     def end_pass(self):
-        """One aggregated push of the whole pass's gradients."""
+        """One aggregated push of the whole pass's gradients.
+
+        SGD-ONLY ASSUMPTION: rows whose accumulated gradient is exactly
+        zero are skipped from the push.  That is a no-op only for LINEAR
+        accessors (sgd: ``w -= lr * g`` leaves w unchanged at g=0).  A
+        stateful server accessor (adagrad/adam-style) updates its slot
+        state — moment estimates, show/click counters — on every push,
+        including explicit zeros, so skipping would diverge from pushing
+        the full working set.  If the server side grows a stateful
+        accessor, push ``self._ids`` unfiltered instead of ``live``."""
         if self._slot_of is None:
             raise RuntimeError("end_pass before begin_pass")
         g = np.asarray(self.grad, np.float32)

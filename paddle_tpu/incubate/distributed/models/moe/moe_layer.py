@@ -33,7 +33,8 @@ class MoELayer(Layer):
       token keeps its other expert's contribution).  All shapes static;
       gather/scatter differentiate as scatter/gather.  c =
       ceil(capacity_factor * n * top_k / E), capacity_factor defaulting
-      to the gate's train factor (GShardGate.capacity[0], 1.2).
+      to the gate's (train, eval) factor pair selected by the layer's
+      ``training`` flag (GShardGate.capacity: 1.2 train / 2.4 eval).
     """
 
     def __init__(self, d_model, experts, gate=None, moe_group=None, mp_group=None,
@@ -114,7 +115,12 @@ class MoELayer(Layer):
         factor = self.capacity_factor
         if factor is None:
             cap = getattr(self.gate, "capacity", None)
-            factor = cap[0] if cap else 1.2
+            if cap:
+                # reference GShard semantics: capacity is a (train, eval)
+                # factor pair — eval uses the larger factor (fewer drops)
+                factor = cap[0] if self.training else cap[1]
+            else:
+                factor = 1.2
         c = int(math.ceil(factor * n * self.top_k / self.num_expert))
         return min(c, n * self.top_k)
 

@@ -31,7 +31,9 @@ import jax.numpy as jnp
 
 from paddle_tpu.ops.decode_attention import decode_attention, init_kv_cache
 
-__all__ = ["extract_decode_params", "decode_greedy", "decode_speculative"]
+__all__ = ["extract_decode_params", "decode_greedy", "decode_speculative",
+           "serving_prefill_slot", "serving_decode_steps",
+           "serving_spec_step"]
 
 
 def extract_decode_params(model):
@@ -107,11 +109,13 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t):
     return h, k_cache, v_cache
 
 
-def _forward(params, cfg, tokens, caches, lengths, last_only):
+def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
-    speculative verification)."""
+    speculative verification).  ``last_idx`` [B] projects one PER-BATCH
+    position instead ([B, V]) — the ragged-prefill path, where each
+    slot's prompt ends at a different column of the padded block."""
     h = params["embed"][tokens]  # [B, T, hidden]
     new_caches = []
     cos_t, sin_t = params["_rope"]
@@ -119,7 +123,9 @@ def _forward(params, cfg, tokens, caches, lengths, last_only):
         h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
-    if last_only:
+    if last_idx is not None:
+        h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+    elif last_only:
         h = h[:, -1]  # [B, hidden]
     if "lm_head" in params:
         logits = h @ params["lm_head"]
@@ -287,6 +293,23 @@ def _spec_jit(params, dparams, cfg, dcfg, input_ids, max_new_tokens, lmax,
     return out
 
 
+def _ngram_draft(hist, hist_len, cur, spec_k):
+    """Model-free prompt-lookup draft: the ``spec_k`` tokens that followed
+    the most recent earlier occurrence of ``cur`` in each row's history
+    (``hist [B, lmax]`` valid to ``hist_len [B]``).  Shared by the
+    compiled while-loop (_spec_ngram_jit) and the serving step
+    (serving_spec_step); a miss drafts from position 0 — a bad draft only
+    costs speed, never correctness."""
+    lmax = hist.shape[1]
+    pos = jnp.arange(lmax, dtype=jnp.int32)[None, :]
+    eq = (hist == cur[:, None]) & (pos < (hist_len - 1)[:, None])
+    m = jnp.max(jnp.where(eq, pos, -1), axis=1)              # [B], -1 none
+    start = jnp.where(m >= 0, m + 1, 0)
+    return jnp.take_along_axis(
+        hist, jnp.clip(start[:, None] + jnp.arange(spec_k)[None, :],
+                       0, lmax - 1), axis=1)                 # [B, k]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_tokens", "lmax",
                                     "spec_k"))
@@ -316,20 +339,14 @@ def _spec_ngram_jit(params, cfg, input_ids, max_new_tokens, lmax, spec_k=4):
     out = jnp.zeros((b, max_new_tokens), jnp.int32)
     out = out.at[:, 0].set(first)
     n_out = jnp.ones((b,), jnp.int32)
-    pos = jnp.arange(lmax, dtype=jnp.int32)[None, :]
 
     def cond(carry):
         return jnp.any(carry[0] < max_new_tokens)
 
     def body(carry):
         n_out, out, cur, caches, lengths, hist, hist_len = carry
-        # ---- draft by lookup: most recent earlier occurrence of cur
-        eq = (hist == cur[:, None]) & (pos < (hist_len - 1)[:, None])
-        m = jnp.max(jnp.where(eq, pos, -1), axis=1)          # [B], -1 none
-        start = jnp.where(m >= 0, m + 1, 0)
-        drafts = jnp.take_along_axis(
-            hist, jnp.clip(start[:, None] + jnp.arange(spec_k)[None, :],
-                           0, lmax - 1), axis=1)             # [B, k]
+        # ---- draft by lookup (shared helper with serving_spec_step)
+        drafts = _ngram_draft(hist, hist_len, cur, spec_k)
         # ---- verify (shared helper with _spec_jit)
         toks = jnp.concatenate([cur[:, None], drafts], axis=1)
         logits, caches, lengths = _forward_step_all(
@@ -349,6 +366,121 @@ def _spec_ngram_jit(params, cfg, input_ids, max_new_tokens, lmax, spec_k=4):
     carry = (n_out, out, first, caches, lengths, hist, hist_len)
     n_out, out, *_ = jax.lax.while_loop(cond, body, carry)
     return out
+
+
+# --------------------------------------------------------------------------
+# Step-wise serving API (paddle_tpu/serving): the decode loop EXTRACTED from
+# the compiled while_loop so a host-side scheduler can retire and admit
+# requests between compiled steps (continuous batching).  Every function runs
+# at the engine's fixed batch B with static shapes; per-slot liveness is
+# carried entirely in the ``lengths`` operand (ops.decode_attention.
+# masked_lengths): a dead slot's offset is lmax, so its cache writes drop and
+# its state survives the step untouched.
+
+@functools.partial(jax.jit, static_argnames=("cfg", "with_hist"),
+                   donate_argnames=("caches", "hist"))
+def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
+                         hist=None, hist_len=None, with_hist=False):
+    """Admit ONE request: prefill its prompt, insert into the batch cache.
+
+    ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
+    bucket), ``prompt_len [1]`` its true length, ``slot`` a traced scalar
+    (one compile per bucket, not per slot).  The forward runs against
+    fresh [1, Tpad] mini caches, so admission costs the PROMPT's tokens —
+    independent of the serving batch B (a batched-prefill admission would
+    burn B×Tpad token-forwards to fill one slot, swamping the scheduling
+    win).  Each layer's rows are then inserted into the batch cache at
+    ``slot`` — the ragged cache's per-slot reset: rows past the prompt are
+    stale pads, invisible to decode_attention's position masking and
+    overwritten as the slot decodes.  Returns the slot's first greedy
+    token (logit at its last prompt column; pad columns are causally
+    invisible to it) and the updated caches; with ``with_hist`` the slot's
+    prompt-lookup history row is rebuilt in the same program."""
+    t = tokens.shape[1]
+    nh, nkv, hd, eps = cfg
+    dtype = params["embed"].dtype
+    mini = [init_kv_cache(1, t, nkv, hd, dtype)
+            for _ in params["layers"]]
+    logits, mini, _ = _forward(
+        params, cfg, tokens, mini, jnp.zeros((1,), jnp.int32),
+        last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1))
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [1]
+    slot = slot.astype(jnp.int32)
+    zero = jnp.int32(0)
+    new_caches = []
+    for (kc, vc), (mk, mv) in zip(caches, mini):
+        kc = jax.lax.dynamic_update_slice(kc, mk.astype(kc.dtype),
+                                          (slot, zero, zero, zero))
+        vc = jax.lax.dynamic_update_slice(vc, mv.astype(vc.dtype),
+                                          (slot, zero, zero, zero))
+        new_caches.append((kc, vc))
+    if with_hist:
+        lmax = hist.shape[1]
+        row = jax.lax.dynamic_update_slice(
+            jnp.zeros((1, lmax), jnp.int32), tokens.astype(jnp.int32),
+            (0, 0))
+        row = row.at[0, jnp.clip(prompt_len[0], 0, lmax - 1)].set(first[0])
+        hist = jax.lax.dynamic_update_slice(hist, row, (slot, zero))
+        hist_len = hist_len.at[slot].set(prompt_len[0] + 1)
+    return first, new_caches, hist, hist_len
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
+                   donate_argnames=("caches",))
+def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1):
+    """``n_steps`` greedy tokens for every slot in ONE compiled program
+    (an inner lax.scan amortizes the host dispatch; the scheduler trades
+    admission latency against dispatch overhead via ``sync_every``).
+    Dead slots (offset lmax) drop every cache write at every inner step —
+    lmax + i only moves further past capacity.  Returns (tokens
+    [B, n_steps], caches')."""
+    def body(carry, _):
+        tok, caches, lengths = carry
+        logits, caches, lengths = _forward_step(
+            params, cfg, tok[:, None], caches, lengths)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, caches, lengths), nxt
+
+    (_, caches, _), toks = jax.lax.scan(
+        body, (cur, caches, dev_lengths.astype(jnp.int32)), None,
+        length=n_steps)
+    return toks.T, caches
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "spec_k"))
+def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
+                      active, spec_k=4):
+    """One prompt-lookup speculative round per slot: draft ``spec_k``
+    tokens from the history, verify in one target forward, accept the
+    longest matched prefix — the SAME _ngram_draft/_verify_and_emit
+    machinery as the compiled while-loop, so serving speculation emits
+    exactly the verify forward's own greedy picks (lossless; agreement
+    with the 1-token-step program holds up to floating-point near-ties
+    between the two program shapes — a random-init tiny model on
+    degenerate repetitive input can flip a near-tied argmax, trained
+    models in practice do not).  Returns (emitted [B, k+1] — the
+    j+1 accepted tokens, zero-padded —, j [B], cur' [B], caches', hist',
+    hist_len').  The host rewinds its length mirror to +j+1; dead slots
+    (``active`` False) drop cache AND history writes."""
+    b = cur.shape[0]
+    lmax = hist.shape[1]
+    drafts = _ngram_draft(hist, hist_len, cur, spec_k)
+    toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
+    logits, caches, _ = _forward_step_all(
+        params, cfg, toks, caches, dev_lengths)
+    # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
+    # out IS the accepted-prefix block for this round
+    emitted, cur, j, emit = _verify_and_emit(
+        logits, drafts, jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b, spec_k + 1), jnp.int32), spec_k + 1, spec_k)
+    hcols = hist_len[:, None] + jnp.arange(spec_k + 1)[None, :]
+    hvalid = (jnp.arange(spec_k + 1)[None, :] <= j[:, None]) \
+        & (hcols < lmax) & active[:, None]
+    hist = hist.at[jnp.arange(b)[:, None],
+                   jnp.where(hvalid, hcols, lmax)].set(
+        jnp.where(hvalid, emit, 0), mode="drop")
+    hist_len = hist_len + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
+    return emitted, j, cur, caches, hist, hist_len
 
 
 def _decode_params_of(model, lmax):

@@ -29,7 +29,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_kv_cache", "decode_attention"]
+__all__ = ["init_kv_cache", "decode_attention", "masked_lengths"]
 
 _NEG_INF = -1e30
 
@@ -38,6 +38,24 @@ def init_kv_cache(batch, max_len, num_kv_heads, head_dim, dtype="bfloat16"):
     """Preallocate a (k, v) cache pair [B, Lmax, Hkv, D]."""
     shape = (batch, max_len, num_kv_heads, head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def masked_lengths(lengths, live, lmax):
+    """Per-slot write gating for continuous-batching serving.
+
+    A serving engine runs ONE compiled step at fixed batch B while slots
+    retire and are re-admitted independently.  Slots where ``live`` is
+    False get offset ``lmax``: every ``_append`` index lands past the
+    cache capacity so the scatter DROPS the write (mode="drop"), and the
+    slot's cache/length state survives the step byte-for-byte untouched.
+    Its attention output is garbage — the scheduler ignores it.
+
+    Admission reuses the same trick with ``lengths = 0``: a prefill over
+    the full batch writes ONLY the admitted slots (everyone else drops),
+    so a retired slot is recycled without a reshape, a cache copy, or a
+    recompile — the static-shape admission constraint on TPU.
+    """
+    return jnp.where(live, lengths.astype(jnp.int32), jnp.int32(lmax))
 
 
 def _append(cache, new, lengths, layout):

@@ -1,0 +1,5 @@
+"""Continuous-batching serving engine (Orca-style iteration-level
+scheduling) over the compiled static-cache decode path."""
+from paddle_tpu.serving.engine import Request, ServingEngine
+
+__all__ = ["Request", "ServingEngine"]

@@ -1,0 +1,279 @@
+"""Continuous-batching serving engine on the ragged decode path.
+
+The compiled decode step (models/llama_decode.py) already supports ragged
+per-batch lengths and rewind, but a run-to-completion batch leaves finished
+slots idling while the longest request drags the step.  This engine closes
+that gap with Orca-style *iteration-level scheduling* — the technique behind
+vLLM-class serving throughput — under the TPU constraint that every device
+program keeps ONE static compiled shape:
+
+* The device runs a fixed-batch-B step; a host-side scheduler retires
+  finished slots (EOS / max-new-tokens) and admits queued requests into
+  them *between* compiled steps.
+* Admission prefills the incoming prompt against fresh [1, bucket] mini
+  caches — cost proportional to the PROMPT, not B×bucket — and inserts
+  the rows into the batch cache at the freed slot: the ragged cache's
+  per-slot reset.  Retired slots stay parked via
+  ``ops.decode_attention.masked_lengths``: their write offset is lmax so
+  every decode-step cache write DROPS — recycling needs no reshape,
+  copy-out, or recompile.  Prompts are right-padded to a small set of
+  power-of-two buckets, bounding the compile count; the slot's first
+  token is picked from the logit at its own last prompt column (pad
+  columns are causally invisible to it).
+* Decode runs either mode behind one ``ServingEngine.step()``: greedy
+  (``sync_every`` tokens per dispatch via an inner lax.scan) or model-free
+  prompt-lookup speculative drafting (serving_spec_step — the same
+  _verify_and_emit verify/rewind machinery as the compiled while-loop, so
+  speculation composes with mixed-length slots and emits exactly the
+  verify forward's greedy picks; agreement with the 1-token-step program
+  holds up to floating-point near-ties between the two program shapes).
+* ``policy="gang"`` disables mid-run admission (a batch is admitted only
+  when every slot is free and runs to completion) — the sequential
+  baseline for the bench A/B, sharing the exact same compiled programs so
+  the measured win is pure scheduling.
+
+The per-slot state the scheduler owns host-side: token history, a length
+mirror of the device cache, and the speculative rewind offset (folded into
+the length mirror as ``+ j + 1`` per accepted round).
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from collections import deque
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_decode import (
+    _decode_params_of, serving_decode_steps, serving_prefill_slot,
+    serving_spec_step,
+)
+from paddle_tpu.ops.decode_attention import init_kv_cache, masked_lengths
+
+# the serving step/prefill programs donate their cache buffers (in-place
+# update on TPU instead of a full-cache copy per dispatch); CPU has no
+# donation support and warns per program — harmless here, silence it
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+__all__ = ["Request", "ServingEngine"]
+
+
+class Request:
+    """One generation request.
+
+    ``prompt_ids``: 1-D int token ids.  ``eos_token_id`` retires the slot
+    when emitted (the EOS itself is kept in ``output_ids``).  ``stream_cb``
+    (optional ``cb(request, new_ids)``) fires per emission batch — the
+    streaming hook; with an engine ``detokenizer`` the accumulated text is
+    kept current in ``.text``.  Timing (perf_counter): ``t_submit`` /
+    ``t_first`` (first token) / ``t_done``.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens, eos_token_id=None,
+                 stream_cb=None, rid=None):
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("Request: empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("Request: max_new_tokens must be >= 1")
+        self.eos_token_id = eos_token_id
+        self.stream_cb = stream_cb
+        self.rid = rid
+        self.output_ids = []
+        self.text = ""
+        self.done = False
+        self.t_submit = None
+        self.t_first = None
+        self.t_done = None
+
+    @property
+    def latency(self):
+        """submit -> completion seconds (None until done)."""
+        if self.t_done is None or self.t_submit is None:
+            return None
+        return self.t_done - self.t_submit
+
+
+class ServingEngine:
+    """Fixed-batch continuous-batching engine over one causal LM.
+
+    ``mode``: "greedy" or "spec" (model-free prompt-lookup speculative
+    drafting, lossless — per-slot outputs byte-identical to greedy).
+    ``sync_every``: greedy tokens decoded per host dispatch (inner scan);
+    retirement/admission latency is bounded by it.  ``policy``:
+    "continuous" (admit into any free slot between steps) or "gang"
+    (run-to-completion baseline).  ``prompt_buckets``: padded prefill
+    widths (default: powers of two up to ``max_len``).
+    ``detokenizer``: optional ``ids -> str`` for streamed ``.text``.
+    """
+
+    def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
+                 spec_k=8, sync_every=1, policy="continuous",
+                 prompt_buckets=None, detokenizer=None):
+        if mode not in ("greedy", "spec"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if policy not in ("continuous", "gang"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self._B = int(batch_size)
+        self._lmax = int(max_len)
+        self._mode = mode
+        self._spec_k = int(spec_k)
+        self._sync = max(1, int(sync_every))
+        self._policy = policy
+        self._detok = detokenizer
+        self._params, self._cfg = _decode_params_of(model, self._lmax)
+        nh, nkv, hd, eps = self._cfg
+        dtype = self._params["embed"].dtype
+        self._caches = [init_kv_cache(self._B, self._lmax, nkv, hd, dtype)
+                        for _ in self._params["layers"]]
+        if prompt_buckets is None:
+            prompt_buckets = []
+            b = 16
+            while b < self._lmax:
+                prompt_buckets.append(b)
+                b *= 2
+        self._buckets = sorted(int(b) for b in prompt_buckets)
+        if not self._buckets or self._buckets[-1] > self._lmax:
+            raise ValueError("prompt_buckets must be non-empty and <= max_len")
+        # host mirrors of per-slot device state
+        self._len = np.zeros((self._B,), np.int32)
+        self._cur = np.zeros((self._B,), np.int32)
+        self._reqs = [None] * self._B
+        if mode == "spec":
+            self._hist = jnp.zeros((self._B, self._lmax), jnp.int32)
+            self._hist_len = jnp.zeros((self._B,), jnp.int32)
+        else:
+            self._hist = self._hist_len = None
+        self._queue = deque()
+        self._finished = []
+        self._next_rid = 0
+
+    # ------------------------------------------------------------- scheduling
+    @property
+    def has_work(self):
+        return bool(self._queue) or any(r is not None for r in self._reqs)
+
+    def _headroom(self):
+        # greedy may overshoot a retiring slot by < sync_every cache rows;
+        # spec's verify forward writes spec_k+1 rows before the rewind
+        return self._spec_k + 1 if self._mode == "spec" else self._sync
+
+    def submit(self, request):
+        p = int(request.prompt_ids.size)
+        bucket = next((b for b in self._buckets if b >= p), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {p} exceeds the largest prompt bucket "
+                f"{self._buckets[-1]}")
+        need = p + request.max_new_tokens + self._headroom()
+        if need > self._lmax:
+            raise ValueError(
+                f"request needs {need} cache rows (prompt {p} + "
+                f"max_new {request.max_new_tokens} + headroom "
+                f"{self._headroom()}) > max_len {self._lmax}")
+        request._bucket = bucket
+        if request.rid is None:
+            request.rid = self._next_rid
+        self._next_rid += 1
+        request.t_submit = time.perf_counter()
+        self._queue.append(request)
+        return request
+
+    def _admit(self):
+        free = [i for i in range(self._B) if self._reqs[i] is None]
+        if not free or not self._queue:
+            return
+        if self._policy == "gang" and len(free) < self._B:
+            return  # run-to-completion: wait for the whole batch to drain
+        while free and self._queue:
+            r = self._queue.popleft()
+            slot = free.pop(0)
+            self._reqs[slot] = r
+            p = r.prompt_ids.size
+            tokens = np.zeros((1, r._bucket), np.int32)
+            tokens[0, :p] = r.prompt_ids
+            first, self._caches, hist, hist_len = serving_prefill_slot(
+                self._params, self._cfg, jnp.asarray(tokens),
+                jnp.asarray(np.array([p], np.int32)), self._caches,
+                jnp.asarray(slot, jnp.int32),
+                hist=self._hist, hist_len=self._hist_len,
+                with_hist=self._mode == "spec")
+            if self._mode == "spec":
+                self._hist, self._hist_len = hist, hist_len
+            self._len[slot] = p
+            first = int(np.asarray(first)[0])
+            self._cur[slot] = first
+            self._emit(slot, [first])
+
+    def _emit(self, slot, toks):
+        """Append emitted tokens to the slot's request, truncating at EOS /
+        max_new_tokens; retires the slot when the request completes.
+        Returns the number of tokens actually consumed."""
+        r = self._reqs[slot]
+        took = 0
+        for t in toks:
+            if r.done:
+                break
+            r.output_ids.append(int(t))
+            took += 1
+            if r.t_first is None:
+                r.t_first = time.perf_counter()
+            if len(r.output_ids) >= r.max_new_tokens or (
+                    r.eos_token_id is not None
+                    and int(t) == int(r.eos_token_id)):
+                r.done = True
+        if took:
+            if self._detok is not None:
+                r.text = self._detok(list(r.output_ids))
+            if r.stream_cb is not None:
+                r.stream_cb(r, r.output_ids[-took:])
+        if r.done:
+            r.t_done = time.perf_counter()
+            self._reqs[slot] = None
+            self._finished.append(r)
+        return took
+
+    # ------------------------------------------------------------ step / run
+    def step(self):
+        """One scheduler iteration: retire/admit, then one compiled decode
+        dispatch over every live slot.  Returns tokens emitted."""
+        self._admit()
+        live = [i for i in range(self._B) if self._reqs[i] is not None]
+        if not live:
+            return 0
+        active = np.array([r is not None for r in self._reqs])
+        dev_len = masked_lengths(jnp.asarray(self._len), jnp.asarray(active),
+                                 self._lmax)
+        emitted = 0
+        if self._mode == "greedy":
+            toks, self._caches = serving_decode_steps(
+                self._params, self._cfg, jnp.asarray(self._cur),
+                self._caches, dev_len, n_steps=self._sync)
+            toks = np.asarray(toks)
+            for i in live:
+                emitted += self._emit(i, toks[i].tolist())
+                self._len[i] += self._sync
+                self._cur[i] = toks[i, -1]
+        else:
+            blk, j, cur, self._caches, self._hist, self._hist_len = \
+                serving_spec_step(
+                    self._params, self._cfg, jnp.asarray(self._cur),
+                    self._caches, dev_len, self._hist, self._hist_len,
+                    jnp.asarray(active), spec_k=self._spec_k)
+            blk, j, cur = np.asarray(blk), np.asarray(j), np.asarray(cur)
+            for i in live:
+                emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
+                self._len[i] += int(j[i]) + 1
+                self._cur[i] = cur[i]
+        return emitted
+
+    def run(self):
+        """Drive ``step()`` until the queue and every slot drain; returns
+        the finished requests in completion order."""
+        while self.has_work:
+            self.step()
+        return self._finished

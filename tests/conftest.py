@@ -14,13 +14,27 @@ import jax
 
 if os.environ.get("PADDLE_TPU_TEST_REAL", "0") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax (< 0.5) spells it via XLA_FLAGS; the flag is read at
+        # backend init, which is still pending at conftest-import time
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 # numeric tests compare against float64 numpy references; keep MXU-passes at highest
 # precision (the per-op tolerance policy: bench/perf paths use bf16 explicitly).
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running workloads (serving mixed-length runs, bench-"
+        "shaped tests) excluded from tier-1 via -m 'not slow'")
 
 
 @pytest.fixture(autouse=True)

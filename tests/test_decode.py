@@ -107,6 +107,40 @@ class TestDecodeAttention:
             q, k1, k1, kc, vc, jnp.asarray([Lmax], jnp.int32))
         assert np.all(np.asarray(kc) == 0.0)  # nothing overwritten
 
+    def test_masked_lengths_gates_slot_writes(self):
+        """masked_lengths: dead slots' cache writes drop (state preserved
+        byte-for-byte), live slots append normally — the serving engine's
+        admission/retirement primitive."""
+        from paddle_tpu.ops.decode_attention import (decode_attention,
+                                                     init_kv_cache,
+                                                     masked_lengths)
+
+        B, Lmax, h, d = 3, 8, 1, 4
+        rng = np.random.default_rng(0)
+        kc, vc = init_kv_cache(B, Lmax, h, d, "float32")
+        seeded = jnp.asarray(rng.standard_normal((B, Lmax, h, d)),
+                             jnp.float32)
+        kc = kc + seeded
+        vc = vc + seeded
+        live = jnp.asarray([True, False, True])
+        lens = masked_lengths(jnp.asarray([2, 5, 7], jnp.int32), live, Lmax)
+        np.testing.assert_array_equal(np.asarray(lens), [2, Lmax, 7])
+        q = jnp.ones((B, 1, h, d), jnp.float32)
+        knew = jnp.full((B, 1, h, d), 9.0, jnp.float32)
+        _, kc2, vc2, _ = decode_attention(q, knew, knew, kc, vc, lens)
+        # dead slot 1: untouched
+        np.testing.assert_array_equal(np.asarray(kc2[1]), np.asarray(kc[1]))
+        np.testing.assert_array_equal(np.asarray(vc2[1]), np.asarray(vc[1]))
+        # live slots appended at their offsets
+        np.testing.assert_array_equal(np.asarray(kc2[0, 2]),
+                                      np.asarray(knew[0, 0]))
+        np.testing.assert_array_equal(np.asarray(kc2[2, 7]),
+                                      np.asarray(knew[2, 0]))
+        # admission form: offsets 0 for admitted, Lmax for everyone else
+        admit = masked_lengths(jnp.zeros((B,), jnp.int32),
+                               jnp.asarray([False, True, False]), Lmax)
+        np.testing.assert_array_equal(np.asarray(admit), [Lmax, 0, Lmax])
+
 
 class TestMaskedMultiheadAttention:
     def test_matches_dense_with_mask_and_bias(self):

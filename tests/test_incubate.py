@@ -219,6 +219,37 @@ class TestMoELayer:
         out.sum().backward()
         assert np.isfinite(x2.grad.numpy()).all()
 
+    def test_gather_capacity_train_vs_eval(self):
+        """The gather dispatch's capacity follows the layer's training
+        flag: GShardGate.capacity = (1.2 train, 2.4 eval) — reference
+        GShard eval semantics (more headroom, fewer drops at eval)."""
+        import math
+
+        moe = incubate.distributed.models.moe.MoELayer(
+            8, [self._expert() for _ in range(4)],
+            gate={"type": "gshard", "top_k": 2}, dispatch="gather")
+        n = 64
+        c_train = int(math.ceil(1.2 * n * 2 / 4))
+        c_eval = int(math.ceil(2.4 * n * 2 / 4))
+        assert moe._capacity(n) == c_train
+        moe.eval()
+        assert moe._capacity(n) == c_eval
+        # eval forward runs (and stays finite) at the eval capacity
+        out = moe(paddle.to_tensor(
+            np.random.rand(1, n, 8).astype("float32")))
+        assert np.isfinite(out.numpy()).all()
+        moe.train()
+        assert moe._capacity(n) == c_train
+        # an explicit capacity_factor overrides both modes
+        fixed = incubate.distributed.models.moe.MoELayer(
+            8, [self._expert() for _ in range(4)],
+            gate={"type": "gshard", "top_k": 2}, dispatch="gather",
+            capacity_factor=0.5)
+        c_fixed = int(math.ceil(0.5 * n * 2 / 4))
+        assert fixed._capacity(n) == c_fixed
+        fixed.eval()
+        assert fixed._capacity(n) == c_fixed
+
     def test_global_scatter_gather(self):
         toks = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
         lc = paddle.to_tensor(np.array([2, 1, 3]))

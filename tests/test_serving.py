@@ -1,0 +1,187 @@
+"""Continuous-batching serving engine (paddle_tpu/serving).
+
+The load-bearing property on the CPU mesh at f32: iteration-level
+scheduling — retiring finished slots and admitting new prompts into them
+between compiled steps — leaves every other slot's greedy continuation
+BYTE-IDENTICAL to an uninterrupted run, and every request's output
+byte-identical to a standalone ``decode_greedy`` of its own prompt.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.llama_decode import decode_greedy
+from paddle_tpu.serving import Request, ServingEngine
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _run(model, prompts, new_lens, **kw):
+    eng = ServingEngine(model, **kw)
+    for p, n in zip(prompts, new_lens):
+        eng.submit(Request(p, int(n)))
+    done = eng.run()
+    assert not eng.has_work
+    return {r.rid: r for r in done}
+
+
+class TestServingSmoke:
+    """Fast tier-1 smoke: B2, 4 tiny requests through the full scheduler
+    (two fit at once, two admitted into retired slots)."""
+
+    def test_b2_four_requests_match_decode_greedy(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, (p,)) for p in (5, 9, 6, 12)]
+        new_lens = [6, 4, 8, 5]
+        outs = _run(model, prompts, new_lens, batch_size=2, max_len=64)
+        for i, (p, n) in enumerate(zip(prompts, new_lens)):
+            ref = np.asarray(decode_greedy(
+                model, paddle.to_tensor(p[None], dtype="int64"),
+                max_new_tokens=n, max_len=64))[0]
+            r = outs[i]
+            np.testing.assert_array_equal(np.array(r.output_ids), ref)
+            assert r.done and r.t_done >= r.t_first >= r.t_submit
+
+    def test_streaming_and_detokenizer(self):
+        model = _tiny_model()
+        got = []
+        eng = ServingEngine(model, batch_size=2, max_len=64,
+                            detokenizer=lambda ids: " ".join(map(str, ids)))
+        r = eng.submit(Request(np.arange(1, 6), 5,
+                               stream_cb=lambda r, ids: got.extend(ids)))
+        eng.run()
+        assert got == r.output_ids and len(got) == 5
+        assert r.text == " ".join(map(str, r.output_ids))
+
+    def test_submit_validation(self):
+        model = _tiny_model()
+        eng = ServingEngine(model, batch_size=2, max_len=32)
+        with pytest.raises(ValueError, match="cache rows"):
+            eng.submit(Request(np.arange(16), 32))
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(Request(np.arange(40), 4))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request(np.arange(4), 0)
+        with pytest.raises(ValueError):
+            ServingEngine(model, mode="beam")
+        with pytest.raises(ValueError):
+            ServingEngine(model, policy="fifo")
+
+
+class TestAdmissionInvariance:
+    """The acceptance property: writing a new prompt into a retired slot
+    leaves every other slot's greedy continuation byte-identical to an
+    uninterrupted run (CPU mesh, f32)."""
+
+    def test_admission_leaves_other_slots_byte_identical(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(2)
+        # slot 0's request retires after 3 tokens; r1/r2 keep decoding
+        prompts = [rng.integers(0, 256, (p,)) for p in (6, 10, 8)]
+        late = rng.integers(0, 256, (7,))
+
+        kw = dict(batch_size=3, max_len=64, sync_every=1)
+        # run A: r3 queued -> admitted into r0's slot mid-flight
+        a = _run(model, prompts + [late], [3, 20, 20, 10], **kw)
+        # run B: uninterrupted — no admission ever happens
+        b = _run(model, prompts, [3, 20, 20], **kw)
+        for i in (1, 2):
+            np.testing.assert_array_equal(a[i].output_ids, b[i].output_ids)
+        # and the admitted request is itself byte-identical to a fresh run
+        c = _run(model, [late], [10], **kw)
+        np.testing.assert_array_equal(a[3].output_ids, c[0].output_ids)
+
+    def test_spec_admission_matches_greedy(self):
+        """Speculative serving composes with mixed-length slots and
+        admission: lossless vs the greedy engine on the same workload."""
+        model = _tiny_model()
+        rng = np.random.default_rng(3)
+        # repetitive prompts = the lookup-friendly regime (bonus path runs)
+        prompts = [np.tile(rng.integers(0, 256, (4,)), r)
+                   for r in (2, 3, 2, 4, 3)]
+        new_lens = [10, 16, 8, 12, 14]
+        kw = dict(batch_size=3, max_len=64)
+        g = _run(model, prompts, new_lens, mode="greedy", **kw)
+        s = _run(model, prompts, new_lens, mode="spec", spec_k=4, **kw)
+        for i in g:
+            np.testing.assert_array_equal(s[i].output_ids, g[i].output_ids)
+
+    def test_gang_policy_matches_continuous_outputs(self):
+        """The run-to-completion baseline produces identical per-request
+        outputs — only the schedule (and the wall-clock) differs."""
+        model = _tiny_model()
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, 256, (p,)) for p in (5, 11, 7, 9)]
+        new_lens = [4, 9, 6, 11]
+        kw = dict(batch_size=2, max_len=64)
+        cont = _run(model, prompts, new_lens, policy="continuous", **kw)
+        gang = _run(model, prompts, new_lens, policy="gang", **kw)
+        for i in cont:
+            np.testing.assert_array_equal(gang[i].output_ids,
+                                          cont[i].output_ids)
+
+
+class TestRetirement:
+    def test_eos_truncates_and_frees_slot(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, 256, (6,))
+        full = _run(model, [prompt], [8], batch_size=2, max_len=64)[0]
+        eos = full.output_ids[2]
+        # same prompt with that EOS: stops at (and includes) token 3; the
+        # freed slot then serves the queued second request
+        eng = ServingEngine(model, batch_size=1, max_len=64)
+        r0 = eng.submit(Request(prompt, 8, eos_token_id=eos))
+        r1 = eng.submit(Request(prompt, 4))
+        eng.run()
+        assert r0.output_ids == full.output_ids[:3]
+        assert r0.done and r1.done
+        np.testing.assert_array_equal(r1.output_ids, full.output_ids[:4])
+
+    def test_sync_every_amortized_dispatch_is_exact(self):
+        """sync_every > 1 (inner-scan token blocks) changes dispatch
+        granularity only — outputs stay byte-identical."""
+        model = _tiny_model()
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 256, (p,)) for p in (5, 8, 11)]
+        new_lens = [7, 13, 5]
+        kw = dict(batch_size=2, max_len=64)
+        one = _run(model, prompts, new_lens, sync_every=1, **kw)
+        four = _run(model, prompts, new_lens, sync_every=4, **kw)
+        for i in one:
+            np.testing.assert_array_equal(four[i].output_ids,
+                                          one[i].output_ids)
+
+
+@pytest.mark.slow
+class TestServingMixedWorkload:
+    """Long mixed-length workload (the bench_serving shape in miniature):
+    every request completes, outputs are byte-identical across the
+    continuous scheduler, the gang baseline, and speculative serving."""
+
+    def test_mixed_lengths_all_policies_agree(self):
+        model = _tiny_model(seed=7)
+        rng = np.random.default_rng(7)
+        n_req = 16
+        plens = rng.integers(8, 49, n_req)
+        olens = rng.integers(8, 33, n_req)
+        prompts = [rng.integers(0, 256, (p,)) for p in plens]
+        kw = dict(batch_size=4, max_len=128)
+        cont = _run(model, prompts, olens, sync_every=2, **kw)
+        gang = _run(model, prompts, olens, policy="gang", **kw)
+        spec = _run(model, prompts, olens, mode="spec", spec_k=4, **kw)
+        assert len(cont) == n_req
+        for i in range(n_req):
+            assert len(cont[i].output_ids) == olens[i]
+            np.testing.assert_array_equal(gang[i].output_ids,
+                                          cont[i].output_ids)
+            np.testing.assert_array_equal(spec[i].output_ids,
+                                          cont[i].output_ids)
