@@ -227,8 +227,16 @@ def bench_serving(n_requests=64, batch=8):
     is the full engine win ``serving_spec_speedup`` — scheduling composed
     with speculation.  Prompts are tiled 32-token segments (the
     lookup-friendly regime, matching the decode_spec row; greedy cost is
-    content-independent so the scheduling A/B is unaffected)."""
+    content-independent so the scheduling A/B is unaffected).
+
+    Latency columns come FROM THE METRICS REGISTRY (paddle_tpu/
+    observability): each run feeds a private registry, and TTFT/TPOT
+    p50/p95 are read back off the engine's own log2-bucketed histograms —
+    the same series a production scrape would see, so the bench exercises
+    the observability path end-to-end (bucket-interpolated percentiles,
+    accurate to within one log2 bucket)."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import MetricsRegistry
     from paddle_tpu.serving import Request, ServingEngine
 
     cfg = LlamaConfig(
@@ -247,22 +255,39 @@ def bench_serving(n_requests=64, batch=8):
     total_new = int(olens.sum())
 
     def run(policy, mode):
+        reg = MetricsRegistry()  # isolated per run: clean percentiles
         eng = ServingEngine(model, batch_size=batch, max_len=2048,
-                            mode=mode, sync_every=4, spec_k=8, policy=policy)
+                            mode=mode, sync_every=4, spec_k=8, policy=policy,
+                            registry=reg)
         for p, o in zip(prompts, olens):
             eng.submit(Request(p, int(o)))
         t0 = time.perf_counter()
         done = eng.run()
         dt = time.perf_counter() - t0
         lats = np.array([r.t_done - t0 for r in done])
-        return dt, lats
+        return dt, lats, reg
+
+    def lat_cols(reg, policy, prefix):
+        cols = {}
+        for series, key in (("serving_ttft_seconds", "ttft"),
+                            ("serving_tpot_seconds", "tpot")):
+            h = reg.get(series).labels(policy=policy)
+            for p in (50, 95):
+                cols[f"{prefix}_{key}_p{p}_ms"] = round(
+                    h.percentile(p) * 1e3, 1)
+        return cols
 
     run("continuous", "greedy")  # warm: every prefill bucket + the step
-    dt_c, lats_c = run("continuous", "greedy")
-    dt_g, lats_g = run("gang", "greedy")
+    dt_c, lats_c, reg_c = run("continuous", "greedy")
+    dt_g, lats_g, reg_g = run("gang", "greedy")
     run("continuous", "spec")    # warm the spec step
-    dt_s, _ = run("continuous", "spec")
+    dt_s, _, reg_s = run("continuous", "spec")
+    spec_child = reg_s.get("serving_spec_accept_rate").labels(
+        policy="continuous")
     return {
+        **lat_cols(reg_c, "continuous", "serving"),
+        **lat_cols(reg_g, "gang", "serving_baseline"),
+        "serving_spec_accept_rate": round(spec_child.value, 3),
         "serving_req_per_sec": round(n_requests / dt_c, 2),
         "serving_tok_per_sec": round(total_new / dt_c, 1),
         "serving_p50_ms": round(float(np.percentile(lats_c, 50)) * 1e3, 1),

@@ -25,15 +25,25 @@ incubate functional is built on the same decode_attention op.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.observability.compilecache import CompileCacheMonitor
 from paddle_tpu.ops.decode_attention import decode_attention, init_kv_cache
 
 __all__ = ["extract_decode_params", "decode_greedy", "decode_speculative",
            "serving_prefill_slot", "serving_decode_steps",
            "serving_spec_step"]
+
+# compile-cache visibility (paddle_tpu/observability): each jitted program
+# marks its traces from inside the traced body (host python there runs once
+# per compile), and the module-level entry points are re-exported through
+# ``_mon.wrap`` so every dispatch lands in compile_cache_{hits,misses}_total
+# {cache="llama_decode"} and compile_seconds — a serving bucket-set blowup
+# or shape churn shows up as a recompile storm in one scrape.
+_mon = CompileCacheMonitor("llama_decode")
 
 
 def extract_decode_params(model):
@@ -166,6 +176,7 @@ def _pick(logits, key, temperature, top_k, sample):
                                     "top_k", "sample"))
 def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax,
                 temperature=0.0, top_k=0, seed=0, sample=False):
+    _mon.mark_trace("decode")
     b, prompt_len = input_ids.shape
     nh, nkv, hd, eps = cfg
     dtype = params["embed"].dtype
@@ -191,6 +202,9 @@ def _decode_jit(params, cfg, input_ids, max_new_tokens, lmax,
         body, (first, caches, lengths),
         jnp.arange(1, max_new_tokens, dtype=jnp.int32))
     return jnp.concatenate([first[None], rest], 0).T  # [B, new_tokens]
+
+
+_decode_jit = _mon.wrap("decode", _decode_jit)
 
 
 def _verify_and_emit(logits, drafts, n_out, out, max_new_tokens, spec_k):
@@ -237,6 +251,7 @@ def _spec_jit(params, dparams, cfg, dcfg, input_ids, max_new_tokens, lmax,
     to decode_attention's position masking and get overwritten next
     iteration.  All shapes static; per-batch acceptance is independent
     (ragged lengths throughout)."""
+    _mon.mark_trace("spec_decode")
     b, _ = input_ids.shape
     nh, nkv, hd, eps = cfg
     dnh, dnkv, dhd, deps = dcfg
@@ -293,6 +308,9 @@ def _spec_jit(params, dparams, cfg, dcfg, input_ids, max_new_tokens, lmax,
     return out
 
 
+_spec_jit = _mon.wrap("spec_decode", _spec_jit)
+
+
 def _ngram_draft(hist, hist_len, cur, spec_k):
     """Model-free prompt-lookup draft: the ``spec_k`` tokens that followed
     the most recent earlier occurrence of ``cur`` in each row's history
@@ -320,6 +338,7 @@ def _spec_ngram_jit(params, cfg, input_ids, max_new_tokens, lmax, spec_k=4):
     summaries quoting their source, structured data — verifies several
     tokens per target forward with NO draft model at all.  Same lossless
     verify/rewind machinery as _spec_jit."""
+    _mon.mark_trace("spec_ngram_decode")
     b, prompt_len = input_ids.shape
     nh, nkv, hd, eps = cfg
     dtype = params["embed"].dtype
@@ -368,6 +387,9 @@ def _spec_ngram_jit(params, cfg, input_ids, max_new_tokens, lmax, spec_k=4):
     return out
 
 
+_spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
+
+
 # --------------------------------------------------------------------------
 # Step-wise serving API (paddle_tpu/serving): the decode loop EXTRACTED from
 # the compiled while_loop so a host-side scheduler can retire and admit
@@ -396,6 +418,7 @@ def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
     token (logit at its last prompt column; pad columns are causally
     invisible to it) and the updated caches; with ``with_hist`` the slot's
     prompt-lookup history row is rebuilt in the same program."""
+    _mon.mark_trace("serving_prefill_slot")
     t = tokens.shape[1]
     nh, nkv, hd, eps = cfg
     dtype = params["embed"].dtype
@@ -425,6 +448,10 @@ def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
     return first, new_caches, hist, hist_len
 
 
+serving_prefill_slot = _mon.wrap("serving_prefill_slot",
+                                 serving_prefill_slot)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
                    donate_argnames=("caches",))
 def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1):
@@ -434,6 +461,8 @@ def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1):
     Dead slots (offset lmax) drop every cache write at every inner step —
     lmax + i only moves further past capacity.  Returns (tokens
     [B, n_steps], caches')."""
+    _mon.mark_trace("serving_decode_steps")
+
     def body(carry, _):
         tok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
@@ -445,6 +474,10 @@ def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1):
         body, (cur, caches, dev_lengths.astype(jnp.int32)), None,
         length=n_steps)
     return toks.T, caches
+
+
+serving_decode_steps = _mon.wrap("serving_decode_steps",
+                                 serving_decode_steps)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "spec_k"))
@@ -462,6 +495,7 @@ def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
     j+1 accepted tokens, zero-padded —, j [B], cur' [B], caches', hist',
     hist_len').  The host rewinds its length mirror to +j+1; dead slots
     (``active`` False) drop cache AND history writes."""
+    _mon.mark_trace("serving_spec_step")
     b = cur.shape[0]
     lmax = hist.shape[1]
     drafts = _ngram_draft(hist, hist_len, cur, spec_k)
@@ -483,18 +517,27 @@ def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
     return emitted, j, cur, caches, hist, hist_len
 
 
+serving_spec_step = _mon.wrap("serving_spec_step", serving_spec_step)
+
+
 def _decode_params_of(model, lmax):
     cfg = model.config
     hd = cfg.hidden_size // cfg.num_attention_heads
     live_w = model.llama.embed_tokens.weight.data
     cached = getattr(model, "_decode_cache", None)
     if cached is not None and cached[0] is live_w and cached[1] == lmax:
+        _mon.hit("decode_params")
         params = cached[2]
     else:
+        t0 = time.perf_counter()
         params = dict(extract_decode_params(model))
         params["_rope"] = _rope_tables(lmax, hd, cfg.rope_theta,
                                        params["embed"].dtype)
         model._decode_cache = (live_w, lmax, params)
+        # a miss per decode call = the serving loop is re-walking the Layer
+        # tree every dispatch (weight swap or lmax churn) — the exact storm
+        # the review-r5 cache exists to prevent
+        _mon.miss("decode_params", seconds=time.perf_counter() - t0)
     return params, (cfg.num_attention_heads, cfg.num_key_value_heads, hd,
                     cfg.rms_norm_eps)
 

@@ -1,0 +1,35 @@
+"""paddle_tpu.observability — production telemetry for the whole stack.
+
+Three stdlib-only parts (no jax, no third-party deps):
+
+* :mod:`~paddle_tpu.observability.metrics` — a process-wide thread-safe
+  ``MetricsRegistry`` of labeled Counter/Gauge/Histogram series
+  (log2-spaced latency buckets), with ``snapshot()`` plus Prometheus-text
+  and one-line-JSON export.
+* :mod:`~paddle_tpu.observability.exporter` — an opt-in background
+  ``http.server`` thread serving ``/metrics`` and ``/healthz``
+  (``PADDLE_TPU_METRICS_PORT`` or ``MetricsExporter(port=...)``), with
+  deterministic shutdown.
+* :mod:`~paddle_tpu.observability.trace` — ``span()`` context-manager/
+  decorator recording into the registry AND the profiler host tracer, so
+  framework spans appear in ``paddle.profiler`` chrome-trace exports.
+
+The serving engine, the decode/train compile caches and ``TrainStep`` are
+instrumented out of the box; see the README "Observability" section for the
+metric name table.
+"""
+from paddle_tpu.observability.compilecache import CompileCacheMonitor
+from paddle_tpu.observability.exporter import (
+    MetricsExporter, start_default_exporter, stop_default_exporter,
+)
+from paddle_tpu.observability.metrics import (
+    Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram, MetricsRegistry,
+    get_registry,
+)
+from paddle_tpu.observability.trace import span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "DEFAULT_LATENCY_BUCKETS", "MetricsExporter", "start_default_exporter",
+    "stop_default_exporter", "span", "CompileCacheMonitor",
+]

@@ -1,0 +1,89 @@
+"""Compile-cache visibility: hit/miss counters + compile-seconds histogram.
+
+A ``jax.jit`` cache miss (new shape / static-arg combination) silently costs
+seconds of trace+lower+compile on the dispatch path; a recompile storm —
+e.g. a serving bucket set that explodes, or a training loop feeding varying
+shapes — shows up only as mysterious latency.  ``CompileCacheMonitor`` makes
+it a first-class metric:
+
+* ``mark_trace(program)`` is called from INSIDE the jitted function body —
+  host python there runs exactly once per trace, i.e. per cache miss.
+* ``call(program, fn, *args)`` wraps the dispatch: if the call traced, the
+  wall time of that dispatch (trace + compile; execution is async and
+  returns immediately) lands in ``compile_seconds{cache,program}`` and
+  ``compile_cache_misses_total`` increments — otherwise it was a cache hit.
+
+Series (shared names, ``cache``/``program`` labels):
+``compile_cache_hits_total``, ``compile_cache_misses_total``,
+``compile_seconds``.  Host-side memo caches (e.g. the decode-param pytree
+cache) reuse the counters via ``hit()``/``miss()`` with no timing.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+from paddle_tpu.observability.metrics import get_registry
+
+__all__ = ["CompileCacheMonitor"]
+
+_LABELS = ("cache", "program")
+
+
+class CompileCacheMonitor:
+    def __init__(self, cache, registry=None):
+        reg = registry if registry is not None else get_registry()
+        self.cache = cache
+        self._hits = reg.counter(
+            "compile_cache_hits_total",
+            "dispatches served by an already-compiled program",
+            labelnames=_LABELS)
+        self._misses = reg.counter(
+            "compile_cache_misses_total",
+            "dispatches that traced + compiled a new program "
+            "(or rebuilt a host-side cache entry)", labelnames=_LABELS)
+        self._seconds = reg.histogram(
+            "compile_seconds", "wall seconds of dispatches that compiled",
+            labelnames=_LABELS)
+        self._trace_counts = {}
+
+    # ------------------------------------------------- jit-body trace hook
+    def mark_trace(self, program):
+        """Call from inside a jitted function body: runs once per trace."""
+        self._trace_counts[program] = self._trace_counts.get(program, 0) + 1
+
+    def traces(self, program):
+        return self._trace_counts.get(program, 0)
+
+    def call(self, program, fn, *args, **kwargs):
+        """Dispatch ``fn`` and classify it as hit or miss via the trace
+        count (``fn``'s body must ``mark_trace(program)``)."""
+        before = self._trace_counts.get(program, 0)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if self._trace_counts.get(program, 0) > before:
+            self._misses.labels(cache=self.cache, program=program).inc()
+            self._seconds.labels(cache=self.cache, program=program).observe(
+                time.perf_counter() - t0)
+        else:
+            self._hits.labels(cache=self.cache, program=program).inc()
+        return out
+
+    def wrap(self, program, fn):
+        """``fn`` pre-bound through :meth:`call` (module-level jit entry
+        points re-export their instrumented selves)."""
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(program, fn, *args, **kwargs)
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    # -------------------------------------------- host-side memo caches
+    def hit(self, program):
+        self._hits.labels(cache=self.cache, program=program).inc()
+
+    def miss(self, program, seconds=None):
+        self._misses.labels(cache=self.cache, program=program).inc()
+        if seconds is not None:
+            self._seconds.labels(cache=self.cache,
+                                 program=program).observe(seconds)

@@ -1,0 +1,96 @@
+"""Span tracing: one primitive feeding BOTH telemetry sinks.
+
+``span("serving.step")`` is a context manager *and* a decorator.  On exit it
+
+* observes the wall duration into the registry histogram
+  ``span_seconds{name=...}`` (always — metrics are the production sink), and
+* forwards the event to the profiler's host tracer
+  (``paddle_tpu.profiler.profiler._HostTracer``), so when a
+  ``paddle.profiler.Profiler`` session is recording, framework spans appear
+  in the exported chrome trace alongside user ``RecordEvent`` scopes —
+  nested correctly, since both record wall-clock ``perf_counter_ns``
+  intervals on the same thread.
+
+The profiler import is lazy (inside the exit path) to keep this module
+stdlib-only at import time; the tracer no-ops unless a profiler session
+enabled it, so spans cost two clock reads + one histogram observe.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+from paddle_tpu.observability.metrics import get_registry
+
+__all__ = ["span", "span_histogram"]
+
+SPAN_EVENT_TYPE = "Span"
+
+
+def span_histogram(registry=None):
+    """The ``span_seconds`` histogram family in ``registry``."""
+    reg = registry if registry is not None else get_registry()
+    return reg.histogram(
+        "span_seconds", "wall seconds spent inside observability spans",
+        labelnames=("name",))
+
+
+def _host_tracer():
+    # lazy: profiler is a sibling subsystem, not an import-time dependency
+    from paddle_tpu.profiler.profiler import get_host_tracer
+    return get_host_tracer()
+
+
+class span:
+    """``with span("name"): ...`` or ``@span("name")``.
+
+    One instance is reusable AND re-entrant: start stamps live on a
+    thread-local stack, so a cached ``span`` object (the instrumentation
+    sites hold them to skip the registry lookup per iteration) nests with
+    itself and across threads correctly.
+    """
+
+    def __init__(self, name, registry=None, event_type=SPAN_EVENT_TYPE):
+        self.name = name
+        self.event_type = event_type
+        self._hist = span_histogram(registry).labels(name=name)
+        self._local = threading.local()
+
+    def __enter__(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(time.perf_counter_ns())
+        return self
+
+    def __exit__(self, *exc):
+        end_ns = time.perf_counter_ns()
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return False
+        start_ns = stack.pop()
+        self._hist.observe((end_ns - start_ns) / 1e9)
+        tracer = _host_tracer()
+        if tracer.enabled:
+            tracer.add(self.name, start_ns, end_ns,
+                       event_type=self.event_type)
+        return False
+
+    def __call__(self, fn):
+        name, registry_hist, event_type = self.name, self._hist, \
+            self.event_type
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            start_ns = time.perf_counter_ns()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                end_ns = time.perf_counter_ns()
+                registry_hist.observe((end_ns - start_ns) / 1e9)
+                tracer = _host_tracer()
+                if tracer.enabled:
+                    tracer.add(name, start_ns, end_ns,
+                               event_type=event_type)
+        return wrapped

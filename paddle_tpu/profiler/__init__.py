@@ -1,8 +1,8 @@
 """paddle.profiler (reference python/paddle/profiler/__init__.py)."""
 from paddle_tpu.profiler.profiler import (
     Profiler, ProfilerState, ProfilerTarget, RecordEvent, SortedKeys,
-    SummaryView, export_chrome_tracing, export_protobuf, load_profiler_result,
-    make_scheduler,
+    SummaryView, export_chrome_tracing, export_protobuf, get_host_tracer,
+    load_profiler_result, make_scheduler,
 )
 from paddle_tpu.profiler import utils
 

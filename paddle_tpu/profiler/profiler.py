@@ -75,6 +75,13 @@ class _HostTracer:
 _tracer = _HostTracer()
 
 
+def get_host_tracer():
+    """The process-wide host event sink — the forwarding target of
+    paddle_tpu.observability.trace.span, so framework spans land in the
+    same chrome-trace export as user RecordEvent scopes."""
+    return _tracer
+
+
 class RecordEvent:
     """User-scope event (reference python/paddle/profiler/utils.py RecordEvent)."""
 

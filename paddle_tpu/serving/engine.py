@@ -38,6 +38,7 @@ the length mirror as ``+ j + 1`` per accepted round).
 """
 from __future__ import annotations
 
+import contextlib
 import time
 import warnings
 from collections import deque
@@ -50,6 +51,8 @@ from paddle_tpu.models.llama_decode import (
     _decode_params_of, serving_decode_steps, serving_prefill_slot,
     serving_spec_step,
 )
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.observability.trace import span
 from paddle_tpu.ops.decode_attention import init_kv_cache, masked_lengths
 
 # the serving step/prefill programs donate their cache buffers (in-place
@@ -60,6 +63,87 @@ warnings.filterwarnings(
 
 __all__ = ["Request", "ServingEngine"]
 
+_NULL_CTX = contextlib.nullcontext()
+
+
+class _EngineMetrics:
+    """Pre-bound metric children for one engine (observability subsystem).
+
+    The series live in ``registry`` (default: the process-wide one) keyed by
+    a ``policy`` label, so a continuous engine and its gang baseline stay
+    separable in one scrape.  All instrumentation is host-side bookkeeping —
+    the compiled device programs are untouched, which is what keeps the
+    instrumented engine's token outputs byte-identical to an uninstrumented
+    run (tested: tests/test_observability.py).
+    """
+
+    def __init__(self, registry, policy, batch_size):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        L = ("policy",)
+        lbl = {"policy": policy}
+        self.queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting for a slot",
+            L).labels(**lbl)
+        self.slots_occupied = reg.gauge(
+            "serving_slots_occupied", "batch slots holding a live request",
+            L).labels(**lbl)
+        self.slots_total = reg.gauge(
+            "serving_slots_total", "engine batch size", L).labels(**lbl)
+        self.slots_total.set(batch_size)
+        self.admitted = reg.counter(
+            "serving_requests_admitted_total",
+            "requests admitted into a slot", L).labels(**lbl)
+        self.retired = reg.counter(
+            "serving_requests_retired_total",
+            "requests completed (EOS or max_new_tokens)", L).labels(**lbl)
+        self.emitted = reg.counter(
+            "serving_tokens_emitted_total",
+            "tokens delivered to requests", L).labels(**lbl)
+        self.steps = reg.counter(
+            "serving_steps_total", "scheduler iterations", L).labels(**lbl)
+        self._prefills = reg.counter(
+            "serving_prefill_total", "slot prefills by prompt bucket",
+            ("policy", "bucket"))
+        self._policy = policy
+        self.queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit -> slot admission", L).labels(**lbl)
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds", "submit -> first token", L).labels(**lbl)
+        self.tpot = reg.histogram(
+            "serving_tpot_seconds",
+            "mean per-token time after the first", L).labels(**lbl)
+        self.e2e = reg.histogram(
+            "serving_e2e_seconds", "submit -> completion", L).labels(**lbl)
+        self.stream_cb_errors = reg.counter(
+            "serving_stream_cb_errors_total",
+            "stream_cb exceptions swallowed by the scheduler",
+            L).labels(**lbl)
+        self.spec_drafted = reg.counter(
+            "serving_spec_drafted_total",
+            "draft tokens proposed by prompt-lookup", L).labels(**lbl)
+        self.spec_accepted = reg.counter(
+            "serving_spec_accepted_total",
+            "draft tokens accepted by the verify forward", L).labels(**lbl)
+        self.spec_accept_rate = reg.gauge(
+            "serving_spec_accept_rate",
+            "cumulative accepted/drafted ratio", L).labels(**lbl)
+        self.span_step = span("serving.step", registry=reg)
+        self.span_prefill = span("serving.prefill", registry=reg)
+        self.span_decode = span("serving.decode", registry=reg)
+        self.span_spec = span("serving.spec_step", registry=reg)
+
+    def prefill(self, bucket):
+        self._prefills.labels(policy=self._policy, bucket=bucket).inc()
+
+    def spec_round(self, drafted, accepted):
+        self.spec_drafted.inc(drafted)
+        self.spec_accepted.inc(accepted)
+        total = self.spec_drafted.value
+        if total:
+            self.spec_accept_rate.set(self.spec_accepted.value / total)
+
 
 class Request:
     """One generation request.
@@ -68,8 +152,11 @@ class Request:
     when emitted (the EOS itself is kept in ``output_ids``).  ``stream_cb``
     (optional ``cb(request, new_ids)``) fires per emission batch — the
     streaming hook; with an engine ``detokenizer`` the accumulated text is
-    kept current in ``.text``.  Timing (perf_counter): ``t_submit`` /
-    ``t_first`` (first token) / ``t_done``.
+    kept current in ``.text``.  A raising ``stream_cb`` never kills the
+    scheduler: the error is counted (``serving_stream_cb_errors_total``)
+    and decoding continues.  Timing (perf_counter): ``t_submit`` /
+    ``t_first`` (first token) / ``t_done``, with derived ``ttft`` /
+    ``tpot`` / ``latency`` properties (None until available).
     """
 
     def __init__(self, prompt_ids, max_new_tokens, eos_token_id=None,
@@ -97,6 +184,23 @@ class Request:
             return None
         return self.t_done - self.t_submit
 
+    @property
+    def ttft(self):
+        """Time to first token: submit -> first emission seconds (None
+        until the first token lands)."""
+        if self.t_first is None or self.t_submit is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def tpot(self):
+        """Time per output token AFTER the first: (t_done - t_first) /
+        max(1, n_out - 1) seconds (None until done) — the steady-state
+        decode rate, with the prefill-dominated first token excluded."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        return (self.t_done - self.t_first) / max(1, len(self.output_ids) - 1)
+
 
 class ServingEngine:
     """Fixed-batch continuous-batching engine over one causal LM.
@@ -113,11 +217,19 @@ class ServingEngine:
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
                  spec_k=8, sync_every=1, policy="continuous",
-                 prompt_buckets=None, detokenizer=None):
+                 prompt_buckets=None, detokenizer=None, registry=None,
+                 instrument=True):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
             raise ValueError(f"unknown policy {policy!r}")
+        # observability: purely host-side counters/gauges/histograms/spans
+        # keyed by policy (paddle_tpu/observability).  ``registry=None``
+        # feeds the process-wide registry; benches pass private registries
+        # for isolated readings.  ``instrument=False`` removes every metric
+        # touch — token outputs are byte-identical either way (tested).
+        self._m = (_EngineMetrics(registry, policy, int(batch_size))
+                   if instrument else None)
         self._B = int(batch_size)
         self._lmax = int(max_len)
         self._mode = mode
@@ -181,6 +293,8 @@ class ServingEngine:
         self._next_rid += 1
         request.t_submit = time.perf_counter()
         self._queue.append(request)
+        if self._m is not None:
+            self._m.queue_depth.set(len(self._queue))
         return request
 
     def _admit(self):
@@ -189,31 +303,42 @@ class ServingEngine:
             return
         if self._policy == "gang" and len(free) < self._B:
             return  # run-to-completion: wait for the whole batch to drain
+        m = self._m
         while free and self._queue:
             r = self._queue.popleft()
             slot = free.pop(0)
             self._reqs[slot] = r
             p = r.prompt_ids.size
+            if m is not None:
+                m.admitted.inc()
+                m.prefill(r._bucket)
+                m.queue_wait.observe(time.perf_counter() - r.t_submit)
             tokens = np.zeros((1, r._bucket), np.int32)
             tokens[0, :p] = r.prompt_ids
-            first, self._caches, hist, hist_len = serving_prefill_slot(
-                self._params, self._cfg, jnp.asarray(tokens),
-                jnp.asarray(np.array([p], np.int32)), self._caches,
-                jnp.asarray(slot, jnp.int32),
-                hist=self._hist, hist_len=self._hist_len,
-                with_hist=self._mode == "spec")
+            with m.span_prefill if m is not None else _NULL_CTX:
+                first, self._caches, hist, hist_len = serving_prefill_slot(
+                    self._params, self._cfg, jnp.asarray(tokens),
+                    jnp.asarray(np.array([p], np.int32)), self._caches,
+                    jnp.asarray(slot, jnp.int32),
+                    hist=self._hist, hist_len=self._hist_len,
+                    with_hist=self._mode == "spec")
             if self._mode == "spec":
                 self._hist, self._hist_len = hist, hist_len
             self._len[slot] = p
             first = int(np.asarray(first)[0])
             self._cur[slot] = first
             self._emit(slot, [first])
+        if m is not None:
+            m.queue_depth.set(len(self._queue))
+            m.slots_occupied.set(
+                sum(r is not None for r in self._reqs))
 
     def _emit(self, slot, toks):
         """Append emitted tokens to the slot's request, truncating at EOS /
         max_new_tokens; retires the slot when the request completes.
         Returns the number of tokens actually consumed."""
         r = self._reqs[slot]
+        m = self._m
         took = 0
         for t in toks:
             if r.done:
@@ -222,25 +347,51 @@ class ServingEngine:
             took += 1
             if r.t_first is None:
                 r.t_first = time.perf_counter()
+                if m is not None:
+                    m.ttft.observe(r.t_first - r.t_submit)
             if len(r.output_ids) >= r.max_new_tokens or (
                     r.eos_token_id is not None
                     and int(t) == int(r.eos_token_id)):
                 r.done = True
         if took:
+            if m is not None:
+                m.emitted.inc(took)
             if self._detok is not None:
                 r.text = self._detok(list(r.output_ids))
             if r.stream_cb is not None:
-                r.stream_cb(r, r.output_ids[-took:])
+                try:
+                    r.stream_cb(r, r.output_ids[-took:])
+                except Exception:
+                    # a crashing user callback must not kill the scheduler
+                    # loop mid-batch (every other live slot would lose its
+                    # in-flight block): count the drop and keep decoding
+                    if m is not None:
+                        m.stream_cb_errors.inc()
         if r.done:
             r.t_done = time.perf_counter()
             self._reqs[slot] = None
             self._finished.append(r)
+            if m is not None:
+                m.retired.inc()
+                m.e2e.observe(r.t_done - r.t_submit)
+                m.tpot.observe(r.tpot)
+                m.slots_occupied.set(
+                    sum(q is not None for q in self._reqs))
         return took
 
     # ------------------------------------------------------------ step / run
     def step(self):
         """One scheduler iteration: retire/admit, then one compiled decode
         dispatch over every live slot.  Returns tokens emitted."""
+        m = self._m
+        if m is None:
+            return self._step_impl()
+        m.steps.inc()
+        with m.span_step:
+            return self._step_impl()
+
+    def _step_impl(self):
+        m = self._m
         self._admit()
         live = [i for i in range(self._B) if self._reqs[i] is not None]
         if not live:
@@ -250,25 +401,34 @@ class ServingEngine:
                                  self._lmax)
         emitted = 0
         if self._mode == "greedy":
-            toks, self._caches = serving_decode_steps(
-                self._params, self._cfg, jnp.asarray(self._cur),
-                self._caches, dev_len, n_steps=self._sync)
-            toks = np.asarray(toks)
+            with m.span_decode if m is not None else _NULL_CTX:
+                toks, self._caches = serving_decode_steps(
+                    self._params, self._cfg, jnp.asarray(self._cur),
+                    self._caches, dev_len, n_steps=self._sync)
+                toks = np.asarray(toks)
             for i in live:
                 emitted += self._emit(i, toks[i].tolist())
                 self._len[i] += self._sync
                 self._cur[i] = toks[i, -1]
         else:
-            blk, j, cur, self._caches, self._hist, self._hist_len = \
-                serving_spec_step(
-                    self._params, self._cfg, jnp.asarray(self._cur),
-                    self._caches, dev_len, self._hist, self._hist_len,
-                    jnp.asarray(active), spec_k=self._spec_k)
-            blk, j, cur = np.asarray(blk), np.asarray(j), np.asarray(cur)
+            with m.span_spec if m is not None else _NULL_CTX:
+                blk, j, cur, self._caches, self._hist, self._hist_len = \
+                    serving_spec_step(
+                        self._params, self._cfg, jnp.asarray(self._cur),
+                        self._caches, dev_len, self._hist, self._hist_len,
+                        jnp.asarray(active), spec_k=self._spec_k)
+                blk, j, cur = np.asarray(blk), np.asarray(j), np.asarray(cur)
+            accepted = 0
             for i in live:
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
                 self._len[i] += int(j[i]) + 1
                 self._cur[i] = cur[i]
+                accepted += int(j[i])
+            if m is not None:
+                # per verify round each live slot drafts spec_k and accepts
+                # j of them (the +1 bonus token is the verify forward's own
+                # pick, not a draft)
+                m.spec_round(self._spec_k * len(live), accepted)
         return emitted
 
     def run(self):

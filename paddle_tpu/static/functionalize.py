@@ -13,14 +13,35 @@ and full tp/pp/dp/sharding meshes.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.autograd import engine as _engine
+from paddle_tpu.observability.compilecache import CompileCacheMonitor
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.observability.trace import span
 from paddle_tpu.tensor.tensor import Tensor
 
 __all__ = ["TrainStep", "build_train_step", "build_eval_fn"]
+
+# observability: the fused train/eval programs are THE compile cache of the
+# training stack — a retrace per step (shape churn in the data pipeline, a
+# replaced optimizer) is a recompile storm that only shows as wall-clock
+# without these series.  Dispatches land in compile_cache_{hits,misses}_total
+# {cache="functionalize"} + compile_seconds; every step also counts into
+# train_steps_total / train_step_dispatch_seconds and runs under a
+# "train.step" span (visible in paddle.profiler chrome traces).
+_mon = CompileCacheMonitor("functionalize")
+_train_steps = get_registry().counter(
+    "train_steps_total", "fused train-step dispatches")
+_train_dispatch = get_registry().histogram(
+    "train_step_dispatch_seconds",
+    "wall seconds per TrainStep dispatch (async under jax: includes "
+    "trace+compile on a cache miss, excludes device execution unless a "
+    "readback forces it)")
+_train_span = span("train.step")
 
 
 class _ClipStub:
@@ -100,6 +121,7 @@ class TrainStep:
 
     # -- traced once per (shapes, dtypes, shardings) --------------------------------
     def _step_fn(self, params, buffers, states, lr, step, *datas):
+        _mon.mark_trace("train_step")
         network, loss_fn, optimizer = self._network, self._loss_fn, self._optimizer
 
         import contextlib
@@ -209,9 +231,14 @@ class TrainStep:
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         self._step_count += 1
         step = jnp.asarray(self._step_count, jnp.int32)
-        lval, self._params, self._states = self._jitted(
-            self._params, self._buffers, self._states, lr, step, *arrs
-        )
+        _train_steps.inc()
+        t0 = time.perf_counter()
+        with _train_span:
+            lval, self._params, self._states = _mon.call(
+                "train_step", self._jitted,
+                self._params, self._buffers, self._states, lr, step, *arrs
+            )
+        _train_dispatch.observe(time.perf_counter() - t0)
         # FLAGS_check_nan_inf on the fused path: one loss readback per step
         # (per-op checking is impossible inside a compiled program; a
         # non-finite loss is the canonical divergence signal the reference's
@@ -263,6 +290,7 @@ def build_eval_fn(network, loss_fn=None):
 
     @jax.jit
     def eval_fn(params, buffers, *datas):
+        _mon.mark_trace("eval")
         with _engine.no_grad():
             inputs = [Tensor(d) for d in datas]
             if loss_fn is not None:
@@ -278,7 +306,7 @@ def build_eval_fn(network, loss_fn=None):
     def run(*datas):
         arrs = [d.data if isinstance(d, Tensor) else jnp.asarray(d) for d in datas]
         p, b = network.functional_state()
-        out = eval_fn(p, b, *arrs)
+        out = _mon.call("eval", eval_fn, p, b, *arrs)
         return jax.tree_util.tree_map(Tensor, out)
 
     # expose the jitted callable + live state for cost analysis

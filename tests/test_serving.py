@@ -129,6 +129,63 @@ class TestAdmissionInvariance:
                                           cont[i].output_ids)
 
 
+class TestRequestTiming:
+    """ttft / tpot derived properties: None until their stamps exist, then
+    consistent with the recorded perf_counter stamps."""
+
+    def test_properties_none_until_available(self):
+        r = Request(np.arange(1, 5), 8)
+        assert r.ttft is None and r.tpot is None and r.latency is None
+        r.t_submit = 10.0
+        assert r.ttft is None  # submitted but no first token yet
+        r.t_first = 10.25
+        assert r.ttft == pytest.approx(0.25)
+        assert r.tpot is None  # not done yet
+
+    def test_tpot_excludes_first_token(self):
+        r = Request(np.arange(1, 5), 8)
+        r.t_submit, r.t_first, r.t_done = 1.0, 2.0, 5.0
+        r.output_ids = [7, 8, 9, 10]  # 3 tokens after the first, 3 seconds
+        assert r.tpot == pytest.approx(1.0)
+        assert r.latency == pytest.approx(4.0)
+        # single-token output: divisor clamps to 1, never div-by-zero
+        r.output_ids = [7]
+        assert r.tpot == pytest.approx(3.0)
+
+    def test_live_requests_get_monotone_stamps(self):
+        model = _tiny_model()
+        outs = _run(model, [np.arange(1, 7), np.arange(2, 11)], [5, 4],
+                    batch_size=1, max_len=64)
+        for r in outs.values():
+            assert r.ttft is not None and r.ttft >= 0
+            assert r.tpot is not None and r.tpot >= 0
+            assert r.latency >= r.ttft
+
+    def test_crashing_stream_cb_does_not_kill_scheduler(self):
+        """Satellite: a raising stream_cb is swallowed (and counted) — the
+        batch keeps decoding and every request still completes exactly."""
+        from paddle_tpu.observability import MetricsRegistry
+        model = _tiny_model()
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=2, max_len=64, registry=reg)
+
+        def boom(r, ids):
+            raise RuntimeError("user callback bug")
+
+        prompts = [np.arange(1, 6), np.arange(3, 12)]
+        r0 = eng.submit(Request(prompts[0], 5, stream_cb=boom))
+        r1 = eng.submit(Request(prompts[1], 4))
+        done = eng.run()
+        assert len(done) == 2 and r0.done and r1.done
+        for r, p in ((r0, prompts[0]), (r1, prompts[1])):
+            ref = np.asarray(decode_greedy(
+                model, paddle.to_tensor(p[None], dtype="int64"),
+                max_new_tokens=len(r.output_ids), max_len=64))[0]
+            np.testing.assert_array_equal(np.array(r.output_ids), ref)
+        errs = reg.get("serving_stream_cb_errors_total")
+        assert errs.labels(policy="continuous").value == len(r0.output_ids)
+
+
 class TestRetirement:
     def test_eos_truncates_and_frees_slot(self):
         model = _tiny_model()
