@@ -72,7 +72,7 @@ _LAZY = {
     "static", "device", "framework", "hapi", "profiler", "incubate", "sparse",
     "fft", "signal", "text", "audio", "quantization", "distribution", "geometric",
     "utils", "inference", "callbacks", "hub", "onnx", "version", "sysconfig",
-    "base", "observability", "serving",
+    "base", "observability", "serving", "analysis",
 }
 
 

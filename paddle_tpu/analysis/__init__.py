@@ -1,0 +1,51 @@
+"""tpu-lint: trace-hygiene static analysis for the TPU/JAX codebase.
+
+The reference framework ships in-tree pass/verifier infrastructure because
+a two-language framework dies by silent contract violations; our analog
+failure class is trace hygiene — tracer concretization, python branches on
+traced values, compile-cache churn, host syncs on the step loop, impure
+jitted bodies.  This package turns those into CI failures at PR time:
+
+* static pass — ``python -m paddle_tpu.analysis [paths]`` (stdlib ``ast``
+  only; rule IDs PTL0xx; inline ``# tpu-lint: ignore[PTL0xx]`` pragmas;
+  checked-in ``tpu_lint_baseline.json`` so the gate is zero-new-findings)
+* runtime companion — :func:`assert_no_retrace` (over the observability
+  ``CompileCacheMonitor``\\ s) and :func:`assert_no_tracer_leak` (weakref
+  check that no tracer survives its trace).
+
+The static side is importable without jax; the runtime side imports
+lazily.
+"""
+from __future__ import annotations
+
+from paddle_tpu.analysis.baseline import (
+    default_baseline_path, fingerprints, load_baseline, split_findings,
+    write_baseline,
+)
+from paddle_tpu.analysis.linter import (
+    Finding, canonical_path, lint_file, lint_paths, lint_source,
+)
+from paddle_tpu.analysis.report import format_json, format_text
+from paddle_tpu.analysis.rules import RULES, Rule, rule_ids
+
+__all__ = [
+    "Finding", "Rule", "RULES", "rule_ids",
+    "lint_source", "lint_file", "lint_paths", "canonical_path",
+    "fingerprints", "load_baseline", "write_baseline", "split_findings",
+    "default_baseline_path", "format_text", "format_json",
+    # lazy (jax-dependent) runtime companions:
+    "assert_no_retrace", "RetraceError",
+    "assert_no_tracer_leak", "find_tracer_leaks", "TracerLeakError",
+]
+
+_RUNTIME = {"assert_no_retrace", "RetraceError", "assert_no_tracer_leak",
+            "find_tracer_leaks", "TracerLeakError"}
+
+
+def __getattr__(name):
+    if name in _RUNTIME:
+        from paddle_tpu.analysis import runtime as _rt
+
+        return getattr(_rt, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

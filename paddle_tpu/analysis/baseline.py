@@ -1,0 +1,104 @@
+"""Baseline handling: pre-existing debt is checked in, new findings gate.
+
+A baseline entry is a *fingerprint* — sha1 over (rule, canonical path,
+stripped source-line text, occurrence index among identical tuples) — so
+entries survive unrelated edits that shift line numbers.  The checked-in
+file (``tpu_lint_baseline.json`` at the repo root) makes the CI gate
+zero-new-findings from day one; regenerate it with ``--write-baseline``
+after deliberately accepting new debt (prefer inline pragmas for
+point suppressions).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ["fingerprints", "load_baseline", "write_baseline",
+           "split_findings", "default_baseline_path", "BASELINE_NAME",
+           "BASELINE_VERSION"]
+
+BASELINE_NAME = "tpu_lint_baseline.json"
+BASELINE_VERSION = 1
+
+
+def _line_text(finding, cache):
+    lines = cache.get(finding.path)
+    if lines is None:
+        lines = []
+        for base in ("", os.getcwd()):
+            cand = os.path.join(base, finding.path) if base else finding.path
+            if os.path.isfile(cand):
+                with open(cand, encoding="utf-8", errors="replace") as fh:
+                    lines = fh.read().splitlines()
+                break
+        cache[finding.path] = lines
+    if 1 <= finding.line <= len(lines):
+        return lines[finding.line - 1].strip()
+    return ""
+
+
+def fingerprints(findings):
+    """finding -> stable fingerprint, disambiguating identical lines by
+    occurrence order within the file."""
+    cache, seen, out = {}, {}, []
+    for f in findings:
+        text = _line_text(f, cache)
+        key = (f.rule, f.path, text)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        digest = hashlib.sha1(
+            f"{f.rule}::{f.path}::{text}::{n}".encode()).hexdigest()[:16]
+        out.append(digest)
+    return out
+
+
+def default_baseline_path():
+    """cwd first (repo-root invocation), then the directory holding the
+    ``paddle_tpu`` package (so ``python -m paddle_tpu.analysis`` finds the
+    checked-in baseline from anywhere)."""
+    cand = os.path.join(os.getcwd(), BASELINE_NAME)
+    if os.path.isfile(cand):
+        return cand
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cand = os.path.join(pkg_root, BASELINE_NAME)
+    if os.path.isfile(cand):
+        return cand
+    return None
+
+
+def load_baseline(path):
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a tpu-lint baseline file")
+    return set(data["findings"])
+
+
+def write_baseline(path, findings):
+    fps = fingerprints(findings)
+    entries = {}
+    for f, fp in zip(findings, fps):
+        entries[fp] = {"rule": f.rule, "path": f.path, "line": f.line}
+    payload = {
+        "version": BASELINE_VERSION,
+        "tool": "paddle_tpu.analysis",
+        "count": len(entries),
+        # sorted for stable diffs; the values are informational only —
+        # matching is by fingerprint key
+        "findings": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return payload
+
+
+def split_findings(findings, baseline_fps):
+    """(new, baselined) partition of ``findings`` against a fingerprint
+    set."""
+    new, old = [], []
+    for f, fp in zip(findings, fingerprints(findings)):
+        (old if fp in baseline_fps else new).append(f)
+    return new, old

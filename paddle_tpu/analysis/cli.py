@@ -1,0 +1,99 @@
+"""tpu-lint CLI: ``python -m paddle_tpu.analysis [paths] ...``.
+
+Exit codes (CI contract):
+  0 — clean: no findings outside the baseline
+  1 — new findings
+  2 — usage / IO error (unknown rule, unreadable baseline, no such path)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from paddle_tpu.analysis import baseline as _baseline
+from paddle_tpu.analysis import report as _report
+from paddle_tpu.analysis.linter import lint_paths
+from paddle_tpu.analysis.rules import RULES
+
+__all__ = ["main"]
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tpu-lint: TPU/JAX trace-hygiene static analysis")
+    p.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                   help="files or directories to lint "
+                        "(default: paddle_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None, metavar="PTL001,PTL005,...",
+                   help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline JSON (default: auto-discover "
+                        f"{_baseline.BASELINE_NAME} in cwd or repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report every finding as new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline path and "
+                        "exit 0")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print baselined findings (text format)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        print(_report.format_rule_table())
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"tpu-lint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+            return 2
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpu-lint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, rules=rules)
+
+    if args.write_baseline:
+        path = args.baseline or _baseline.default_baseline_path() or \
+            os.path.join(os.getcwd(), _baseline.BASELINE_NAME)
+        payload = _baseline.write_baseline(path, findings)
+        print(f"tpu-lint: wrote {payload['count']} fingerprint(s) to "
+              f"{path}")
+        return 0
+
+    baselined = []
+    if not args.no_baseline:
+        path = args.baseline or _baseline.default_baseline_path()
+        if args.baseline is not None and not os.path.isfile(args.baseline):
+            print(f"tpu-lint: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if path is not None:
+            try:
+                fps = _baseline.load_baseline(path)
+            except (OSError, ValueError) as e:
+                print(f"tpu-lint: bad baseline {path}: {e}",
+                      file=sys.stderr)
+                return 2
+            findings, baselined = _baseline.split_findings(findings, fps)
+
+    if args.format == "json":
+        print(_report.format_json(findings, baselined))
+    else:
+        print(_report.format_text(findings, baselined,
+                                  verbose_baseline=args.show_baselined))
+    return 1 if findings else 0

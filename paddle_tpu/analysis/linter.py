@@ -1,0 +1,683 @@
+"""tpu-lint AST engine (stdlib ``ast`` only — no third-party deps).
+
+One pass per module:
+
+1. **Collect** — import aliases (so ``np``/``jnp``/``from jax import jit``
+   all resolve to canonical dotted names), every function definition, and
+   the set of *jitted* functions: decorated with ``jax.jit``/``pjit``/
+   ``functionalize`` (directly or through ``functools.partial``) or wrapped
+   by a ``x = jax.jit(fn, ...)`` assignment.  Static argument coverage
+   (``static_argnums``/``static_argnames``) is extracted per wrapper, so a
+   jitted function's *traced* parameters are known by name.
+2. **Check** — a context-stack walk emits findings for the rule set in
+   :mod:`paddle_tpu.analysis.rules` (trace-hygiene rules fire only inside
+   jitted bodies; loop/call-site rules fire everywhere else).
+
+Suppression: a finding whose first source line carries
+``# tpu-lint: ignore`` (all rules) or ``# tpu-lint: ignore[PTL001,PTL005]``
+is dropped.  The engine is purely syntactic — no imports are executed, so
+linting the tree is safe from any interpreter.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from paddle_tpu.analysis.rules import RULES
+
+__all__ = ["Finding", "lint_source", "lint_file", "lint_paths",
+           "canonical_path"]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tpu-lint:\s*ignore(?:\[([A-Za-z0-9_,\s]*)\])?")
+
+# wrapper names (canonical last segment) that make a function body traced
+_JIT_LAST = {"jit", "pjit", "functionalize"}
+# predicates whose arguments may inspect a tracer without branching on its
+# VALUE (isinstance guards are the control_flow.py idiom; shape/dtype/len
+# are static under tracing)
+_GUARD_CALLS = {"isinstance", "hasattr", "getattr", "callable", "len",
+                "_is_concrete", "type"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# host-concretizing builtins / numpy entry points (PTL001)
+_CONCRETE_BUILTINS = {"float", "int", "bool", "complex"}
+_CONCRETE_NP_LAST = {"asarray", "array", "float32", "float64", "int32",
+                     "int64", "bool_"}
+_CONCRETE_METHODS = {"item", "tolist"}
+# impure calls inside jit bodies (PTL005)
+_IMPURE_TIME = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.process_time", "time.clock"}
+# host-sync calls inside step loops (PTL004)
+_SYNC_NP = {"numpy.asarray", "numpy.array"}
+_SYNC_METHODS = {"block_until_ready", "item"}
+_STEP_NAME_RE = re.compile(r"(^|_)steps?($|_)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = ""
+    hint: str = ""
+
+    def __post_init__(self):
+        r = RULES.get(self.rule)
+        if r is not None:
+            if not self.severity:
+                self.severity = r.severity
+            if not self.hint:
+                self.hint = r.hint
+
+    def as_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "hint": self.hint}
+
+
+def canonical_path(path):
+    """Repo-stable spelling of ``path`` for reports and baseline
+    fingerprints: the portion from the first ``paddle_tpu``/``tests`` path
+    component onward when present (invocation-directory independent),
+    otherwise the path relative to the current directory."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    for anchor in ("paddle_tpu", "tests"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:  # different drive (windows)
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+# --------------------------------------------------------------------------
+# name resolution
+# --------------------------------------------------------------------------
+
+def _dotted(node):
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Aliases:
+    """local name -> canonical dotted module path."""
+
+    def __init__(self):
+        self.map = {}
+
+    def add_import(self, node):
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            self.map[local] = a.name if a.asname else a.name.split(".")[0]
+
+    def add_import_from(self, node):
+        if node.module is None or node.level:
+            return  # relative imports: keep local names as-is
+        for a in node.names:
+            self.map[a.asname or a.name] = node.module + "." + a.name
+
+    def resolve(self, dotted):
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        head = self.map.get(head, head)
+        return head + "." + rest if rest else head
+
+
+def _is_jit_wrapper(canonical):
+    if canonical is None:
+        return False
+    return canonical.split(".")[-1] in _JIT_LAST
+
+
+def _literal(node, default=None):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return default
+
+
+# --------------------------------------------------------------------------
+# collection pass
+# --------------------------------------------------------------------------
+
+@dataclass
+class _JitInfo:
+    node: object                      # the FunctionDef
+    static_names: set = field(default_factory=set)
+    static_nums: set = field(default_factory=set)
+    arg_offset: int = 0               # 1 when wrapped as a bound method
+
+    def params(self):
+        a = self.node.args
+        return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+    def traced_params(self):
+        ps = self.params()
+        out = []
+        for i, p in enumerate(ps):
+            if p in ("self", "cls"):
+                continue
+            if p in self.static_names:
+                continue
+            # static_argnums are call-argument indices; a bound-method
+            # wrapper (arg_offset=1) shifts them against param indices
+            if (i - self.arg_offset) in self.static_nums:
+                continue
+            out.append(p)
+        va = self.node.args.vararg
+        if va is not None:
+            out.append(va.arg)
+        return set(out)
+
+
+def _static_from_kwargs(keywords, info):
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            v = _literal(kw.value)
+            if isinstance(v, str):
+                info.static_names.add(v)
+            elif isinstance(v, (tuple, list)):
+                info.static_names.update(x for x in v if isinstance(x, str))
+        elif kw.arg == "static_argnums":
+            v = _literal(kw.value)
+            if isinstance(v, int):
+                info.static_nums.add(v)
+            elif isinstance(v, (tuple, list)):
+                info.static_nums.update(x for x in v if isinstance(x, int))
+
+
+class _Collector:
+    def __init__(self):
+        self.aliases = _Aliases()
+        self.defs_by_name = {}        # name -> [FunctionDef]
+        self.jitted = {}              # id(FunctionDef) -> _JitInfo
+        self.module_jitted = {}       # module-level callable name -> _JitInfo
+        self._pending = []            # (Assign node, top_level) — resolved
+        #                               after the walk so `self._j = jax.jit(
+        #                               self._fn)` in __init__ finds methods
+        #                               defined later in the class body
+
+    # defs ---------------------------------------------------------------
+    def _handle_def(self, node, top_level):
+        self.defs_by_name.setdefault(node.name, []).append(node)
+        info = None
+        for dec in node.decorator_list:
+            cand = self._wrapper_info(dec, node)
+            if cand is not None:
+                info = cand
+        if info is not None:
+            self.jitted[id(node)] = info
+            if top_level:
+                self.module_jitted[node.name] = info
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, top_level=False)
+
+    def _wrapper_info(self, dec, node):
+        res = self.aliases.resolve
+        if _is_jit_wrapper(res(_dotted(dec))):
+            return _JitInfo(node)
+        if isinstance(dec, ast.Call):
+            f = res(_dotted(dec.func))
+            if f is not None and f.split(".")[-1] == "partial" and dec.args \
+                    and _is_jit_wrapper(res(_dotted(dec.args[0]))):
+                info = _JitInfo(node)
+                _static_from_kwargs(dec.keywords, info)
+                return info
+            if _is_jit_wrapper(f):
+                info = _JitInfo(node)
+                _static_from_kwargs(dec.keywords, info)
+                return info
+        return None
+
+    # assignments of the form  x = jax.jit(fn, ...) ----------------------
+    def _resolve_assign(self, node, top_level):
+        value = node.value
+        if not isinstance(value, ast.Call) or not value.args:
+            return
+        if not _is_jit_wrapper(self.aliases.resolve(_dotted(value.func))):
+            return
+        wrapped, offset = value.args[0], 0
+        name = None
+        if isinstance(wrapped, ast.Name):
+            name = wrapped.id
+        elif isinstance(wrapped, ast.Attribute) and \
+                isinstance(wrapped.value, ast.Name) and \
+                wrapped.value.id in ("self", "cls"):
+            name, offset = wrapped.attr, 1  # bound method: self drops out
+        if name is None:
+            return
+        info = None
+        for fdef in self.defs_by_name.get(name, ()):
+            info = _JitInfo(fdef, arg_offset=offset)
+            _static_from_kwargs(value.keywords, info)
+            self.jitted[id(fdef)] = info
+        if info is not None and top_level:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.module_jitted[t.id] = info
+
+    # driver -------------------------------------------------------------
+    def _walk(self, node, top_level):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._handle_def(node, top_level)
+            return
+        if isinstance(node, ast.Import):
+            self.aliases.add_import(node)
+        elif isinstance(node, ast.ImportFrom):
+            self.aliases.add_import_from(node)
+        elif isinstance(node, ast.Assign):
+            self._pending.append((node, top_level))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, top_level=top_level and isinstance(
+                node, (ast.Module, ast.If, ast.Try)))
+
+    def run(self, tree):
+        self._walk(tree, top_level=True)
+        for node, top_level in self._pending:
+            self._resolve_assign(node, top_level)
+        return self
+
+
+# --------------------------------------------------------------------------
+# checking pass
+# --------------------------------------------------------------------------
+
+@dataclass
+class _Loop:
+    node: object
+    has_step: bool = False
+    syncs: list = field(default_factory=list)
+
+
+class _Checker:
+    def __init__(self, path, collector, enabled):
+        self.path = path
+        self.c = collector
+        self.enabled = enabled
+        self.findings = []
+        self.jit_stack = []           # [(JitInfo, traced_name_set)]
+        self.loop_stack = []          # [_Loop] — outside jit bodies only
+
+    def emit(self, rule, node, message):
+        if rule in self.enabled:
+            self.findings.append(Finding(
+                rule, self.path, getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0), message))
+
+    def resolve(self, node):
+        return self.c.aliases.resolve(_dotted(node))
+
+    # helpers ------------------------------------------------------------
+    def _traced(self):
+        return self.jit_stack[-1][1] if self.jit_stack else None
+
+    def _names_in(self, node):
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    def _traced_in(self, node):
+        tr = self._traced()
+        if not tr:
+            return set()
+        return self._names_in(node) & tr
+
+    # branch-test offenders: traced names used OUTSIDE guard predicates,
+    # static attrs (.shape/.dtype) and `is None` comparisons
+    def _branch_offenders(self, test):
+        tr = self._traced()
+        if not tr:
+            return []
+        offenders = []
+
+        def walk(node, guarded):
+            if isinstance(node, ast.Name):
+                if not guarded and node.id in tr:
+                    offenders.append(node.id)
+                return
+            if isinstance(node, ast.Call):
+                f = self.resolve(node.func)
+                g = guarded or (f is not None
+                                and f.split(".")[-1] in _GUARD_CALLS)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, g)
+                return
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                walk(node.value, True)
+                return
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, True)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, guarded)
+
+        walk(test, False)
+        return offenders
+
+    # main walk ----------------------------------------------------------
+    def check(self, tree):
+        for node in ast.iter_child_nodes(tree):
+            self.visit(node)
+        return self.findings
+
+    def visit(self, node):
+        handler = getattr(self, "_visit_" + type(node).__name__, None)
+        if handler is not None:
+            handler(node)
+        else:
+            self.generic(node)
+
+    def generic(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    # -- functions -------------------------------------------------------
+    def _visit_FunctionDef(self, node):
+        self._function(node)
+
+    def _visit_AsyncFunctionDef(self, node):
+        self._function(node)
+
+    def _function(self, node):
+        # PTL006: mutable default arguments
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.emit("PTL006", node,
+                          f"`{node.name}` has a mutable default argument")
+                break
+        info = self.c.jitted.get(id(node))
+        pushed = False
+        if info is not None:
+            self.jit_stack.append((info, info.traced_params()))
+            pushed = True
+        elif self.jit_stack:
+            # nested def inside a jitted body: still traced; its own params
+            # shadow any outer traced names they collide with
+            outer = set(self.jit_stack[-1][1])
+            shadow = {p.arg for p in list(node.args.posonlyargs)
+                      + list(node.args.args) + list(node.args.kwonlyargs)}
+            if node.args.vararg:
+                shadow.add(node.args.vararg.arg)
+            self.jit_stack.append((self.jit_stack[-1][0], outer - shadow))
+            pushed = True
+        decorators = set(map(id, node.decorator_list))
+        for child in ast.iter_child_nodes(node):
+            if id(child) in decorators:
+                continue
+            self.visit(child)
+        if pushed:
+            self.jit_stack.pop()
+
+    # -- loops (PTL004 bookkeeping outside jit bodies) -------------------
+    def _visit_For(self, node):
+        self._loop(node)
+
+    def _visit_While(self, node):
+        if self.jit_stack:
+            self._jit_branch(node)
+            self.generic(node)
+        else:
+            self._loop(node)
+
+    def _loop(self, node):
+        if self.jit_stack:
+            # loops inside traced bodies are PTL002's domain (While) /
+            # unrolled (For) — the host-sync rule targets host loops
+            self.generic(node)
+            return
+        rec = _Loop(node)
+        self.loop_stack.append(rec)
+        self.generic(node)
+        self.loop_stack.pop()
+        if rec.has_step:
+            for call, what in rec.syncs:
+                self.emit("PTL004", call,
+                          f"`{what}` inside a loop that dispatches a "
+                          "compiled step forces a host sync every iteration")
+        elif self.loop_stack:
+            self.loop_stack[-1].syncs.extend(rec.syncs)
+
+    def _loop_targets(self):
+        names = set()
+        for rec in self.loop_stack:
+            if isinstance(rec.node, ast.For):
+                names |= self._names_in(rec.node.target)
+        return names
+
+    # -- branches inside jit bodies (PTL002) -----------------------------
+    def _visit_If(self, node):
+        if self.jit_stack:
+            self._jit_branch(node)
+        self.generic(node)
+
+    def _jit_branch(self, node):
+        offenders = self._branch_offenders(node.test)
+        if offenders:
+            kind = "while" if isinstance(node, ast.While) else "if"
+            self.emit("PTL002", node,
+                      f"python `{kind}` on traced argument "
+                      f"`{sorted(offenders)[0]}` inside a jitted body")
+
+    # -- assignments (PTL005 self-mutation) ------------------------------
+    def _visit_Assign(self, node):
+        self._self_mutation(node.targets, node)
+        self.generic(node)
+
+    def _visit_AugAssign(self, node):
+        self._self_mutation([node.target], node)
+        self.generic(node)
+
+    def _self_mutation(self, targets, node):
+        if not self.jit_stack:
+            return
+        for t in targets:
+            base = t
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                    isinstance(base, ast.Name) and base.id == "self":
+                self.emit("PTL005", node,
+                          "attribute mutation on `self` inside a jitted "
+                          "body runs once at trace time, not per step")
+                return
+
+    # -- except handlers (PTL007) ----------------------------------------
+    def _visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.emit("PTL007", node, "bare `except:`")
+        self.generic(node)
+
+    # -- calls -----------------------------------------------------------
+    def _visit_Call(self, node):
+        if self.jit_stack:
+            self._call_in_jit(node)
+        else:
+            self._call_in_host(node)
+        self._call_site(node)
+        self.generic(node)
+
+    def _call_in_jit(self, node):
+        f = self.resolve(node.func)
+        last = f.split(".")[-1] if f else None
+        # PTL001: concretization of traced values
+        hit = None
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _CONCRETE_BUILTINS:
+            hit = node.func.id + "()"
+        elif f is not None and f.startswith("numpy.") and \
+                last in _CONCRETE_NP_LAST:
+            hit = "np." + last + "()"
+        if hit is not None:
+            tr = set()
+            for a in node.args:
+                tr |= self._traced_in(a)
+            if tr:
+                self.emit("PTL001",
+                          node, f"`{hit}` concretizes traced argument "
+                          f"`{sorted(tr)[0]}` inside a jitted body")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONCRETE_METHODS and \
+                self._traced_in(node.func.value):
+            self.emit("PTL001", node,
+                      f"`.{node.func.attr}()` concretizes a traced value "
+                      "inside a jitted body")
+        # PTL005: impure calls
+        if f is not None:
+            if f in _IMPURE_TIME:
+                self.emit("PTL005", node,
+                          f"`{f}()` inside a jitted body is evaluated once "
+                          "at trace time")
+            elif f.startswith("numpy.random.") or f == "numpy.random":
+                self.emit("PTL005", node,
+                          f"global-state `{f.replace('numpy', 'np')}` draw "
+                          "inside a jitted body — not keyed, runs once at "
+                          "trace time")
+            elif f.startswith("random.") and \
+                    not f.startswith("random.Random"):
+                self.emit("PTL005", node,
+                          f"stdlib `{f}()` inside a jitted body — "
+                          "global-state draw at trace time")
+
+    def _call_in_host(self, node):
+        f = self.resolve(node.func)
+        name = None
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        if self.loop_stack:
+            rec = self.loop_stack[-1]
+            if name is not None and (_STEP_NAME_RE.search(name)
+                                     or name in self.c.module_jitted):
+                for r in self.loop_stack:
+                    r.has_step = True
+            sync = None
+            if f in _SYNC_NP:
+                sync = "np." + f.split(".")[-1] + "()"
+            elif f == "jax.device_get":
+                sync = "jax.device_get()"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS:
+                sync = "." + node.func.attr + "()"
+            if sync is not None:
+                rec.syncs.append((node, sync))
+
+    # PTL003: call sites of module-level jitted functions
+    def _call_site(self, node):
+        if not isinstance(node.func, ast.Name):
+            return
+        info = self.c.module_jitted.get(node.func.id)
+        if info is None:
+            return
+        params = info.params()
+        # call-argument index space: static_argnums are already there;
+        # static_argnames map through the param list (minus a bound-method
+        # offset, zero for module-level functions)
+        static_pos = set(info.static_nums)
+        for p in info.static_names:
+            if p in params:
+                static_pos.add(params.index(p) - info.arg_offset)
+        loop_names = self._loop_targets()
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                break  # positions past *args are unknowable
+            pos = i
+            if pos in static_pos:
+                if isinstance(a, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                  ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                    self.emit("PTL003", a,
+                              f"unhashable literal in static position "
+                              f"{pos} of jitted `{node.func.id}` — "
+                              "TypeError at dispatch")
+                elif isinstance(a, ast.Name) and a.id in loop_names:
+                    self.emit("PTL003", a,
+                              f"loop variable `{a.id}` in static position "
+                              f"{pos} of jitted `{node.func.id}` retraces "
+                              "every iteration")
+            elif isinstance(a, (ast.List, ast.ListComp)):
+                self.emit("PTL003", a,
+                          f"inline list as dynamic argument {pos} of "
+                          f"jitted `{node.func.id}` — the pytree length "
+                          "enters the compile-cache key")
+        for kw in node.keywords:
+            if kw.arg in info.static_names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                               ast.SetComp, ast.DictComp)):
+                self.emit("PTL003", kw.value,
+                          f"unhashable literal for static argument "
+                          f"`{kw.arg}` of jitted `{node.func.id}` — "
+                          "TypeError at dispatch")
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def _suppressed(finding, lines):
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    m = _PRAGMA_RE.search(lines[finding.line - 1])
+    if m is None:
+        return False
+    if m.group(1) is None:
+        return True
+    ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+    return finding.rule in ids
+
+
+def lint_source(source, path="<string>", rules=None):
+    """Lint one python source string; returns a list of Findings."""
+    enabled = set(rules) if rules is not None else set(RULES)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        if "PTL000" not in enabled:
+            return []
+        return [Finding("PTL000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    collector = _Collector().run(tree)
+    findings = _Checker(path, collector, enabled).check(tree)
+    lines = source.splitlines()
+    findings = [f for f in findings if not _suppressed(f, lines)]
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path, rules=None):
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        src = fh.read()
+    return lint_source(src, path=canonical_path(path), rules=rules)
+
+
+def lint_paths(paths, rules=None):
+    """Lint files/directories (recursing into ``*.py``); returns findings
+    sorted by (path, line, col, rule)."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        else:
+            files.append(p)
+    findings = []
+    for f in files:
+        findings.extend(lint_file(f, rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

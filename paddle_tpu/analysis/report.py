@@ -1,0 +1,61 @@
+"""Reporters: human text and machine JSON (the CI-consumable shape)."""
+from __future__ import annotations
+
+import json
+
+from paddle_tpu.analysis.rules import RULES
+
+__all__ = ["format_text", "format_json", "format_rule_table"]
+
+
+def format_text(new, baselined=(), verbose_baseline=False):
+    lines = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} "
+                     f"[{f.severity}] {f.message}")
+        if f.hint:
+            lines.append(f"    hint: {f.hint}")
+    if verbose_baseline:
+        for f in baselined:
+            lines.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} "
+                         f"[baselined] {f.message}")
+    n_err = sum(1 for f in new if f.severity == "error")
+    n_warn = len(new) - n_err
+    lines.append(
+        f"tpu-lint: {len(new)} new finding(s) ({n_err} error(s), "
+        f"{n_warn} warning(s)), {len(baselined)} baselined")
+    return "\n".join(lines)
+
+
+def format_json(new, baselined=()):
+    from paddle_tpu.analysis.baseline import fingerprints
+
+    def block(findings):
+        return [dict(f.as_dict(), fingerprint=fp)
+                for f, fp in zip(findings, fingerprints(findings))]
+
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    payload = {
+        "version": 1,
+        "tool": "paddle_tpu.analysis",
+        "new": block(list(new)),
+        "baselined_count": len(baselined),
+        "counts_by_rule": dict(sorted(counts.items())),
+        "summary": {
+            "new": len(new),
+            "errors": sum(1 for f in new if f.severity == "error"),
+            "warnings": sum(1 for f in new if f.severity == "warning"),
+        },
+    }
+    return json.dumps(payload, indent=1)
+
+
+def format_rule_table():
+    lines = ["ID      severity  name                    description"]
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        lines.append(f"{r.id}  {r.severity:<8}  {r.name:<22}  "
+                     f"{r.description.splitlines()[0]}")
+    return "\n".join(lines)

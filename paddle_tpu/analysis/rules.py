@@ -1,0 +1,101 @@
+"""Rule registry for tpu-lint (paddle_tpu.analysis).
+
+Each rule has a stable ID (``PTL0xx``), a severity, a one-line description
+and a fix-it hint.  IDs are append-only: never renumber — baselines and
+inline pragmas (``# tpu-lint: ignore[PTL003]``) reference them.
+
+The launch set targets the trace-hygiene failure class of a jit-compiled
+TPU framework (ROADMAP "fast as the hardware allows"): host concretization
+inside traced bodies, python control flow on tracers, compile-cache churn
+at jit call sites, host syncs on the serving/training hot loop, and
+impure jitted bodies — plus two generic python-correctness rules the
+reference framework's CI also enforces.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["Rule", "RULES", "rule_ids", "ERROR", "WARNING"]
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    description: str
+    hint: str
+
+
+_RULE_LIST = [
+    Rule(
+        "PTL000", "parse-error", ERROR,
+        "file does not parse as python — nothing in it can be analyzed",
+        "fix the syntax error",
+    ),
+    Rule(
+        "PTL001", "concretization-in-jit", ERROR,
+        "float()/int()/bool()/.item()/.tolist()/np.asarray() applied to a "
+        "traced argument inside a jit/pjit/functionalize body — raises "
+        "ConcretizationTypeError at trace time (or silently freezes a "
+        "trace-time constant into the compiled program)",
+        "keep the value on device (jnp ops), or declare the argument in "
+        "static_argnums/static_argnames if it is genuinely compile-time",
+    ),
+    Rule(
+        "PTL002", "traced-python-branch", ERROR,
+        "python if/while on a traced argument inside a jitted body — the "
+        "branch is resolved once at trace time, not per step",
+        "use jax.lax.cond/while_loop or paddle_tpu.static.control_flow "
+        "(cond/while_loop/switch_case), or mark the argument static",
+    ),
+    Rule(
+        "PTL003", "retrace-risk", WARNING,
+        "jit call site that churns the compile cache: an unhashable "
+        "list/dict/set literal in a static position (TypeError at "
+        "dispatch), an inline list literal as a dynamic argument (pytree "
+        "length enters the cache key), or a loop variable fed to a static "
+        "parameter (one retrace per iteration)",
+        "pass tuples for static args; pass arrays (not list literals) as "
+        "dynamic args; hoist loop-varying values out of static positions",
+    ),
+    Rule(
+        "PTL004", "host-sync-in-step-loop", WARNING,
+        "np.asarray/np.array/.item()/.block_until_ready()/jax.device_get "
+        "inside a loop that dispatches a compiled step — each sync stalls "
+        "the host on device completion and serializes the async dispatch "
+        "pipeline (the serving/training hot path)",
+        "batch readbacks outside the loop, or sync once per block "
+        "(sync_every-style) instead of per iteration",
+    ),
+    Rule(
+        "PTL005", "impure-jit-body", ERROR,
+        "side effect inside a jitted body: time.*, np.random.* / random.* "
+        "global-state draws, or attribute mutation on self — all run ONCE "
+        "at trace time and are baked into (or silently dropped from) the "
+        "compiled program",
+        "thread PRNG keys (jax.random) and timestamps in as arguments; "
+        "return new state instead of mutating self",
+    ),
+    Rule(
+        "PTL006", "mutable-default-arg", WARNING,
+        "mutable default argument (list/dict/set literal) — shared across "
+        "calls",
+        "default to None and construct inside the body",
+    ),
+    Rule(
+        "PTL007", "bare-except", WARNING,
+        "bare `except:` — swallows KeyboardInterrupt/SystemExit and masks "
+        "trace-time errors",
+        "catch Exception (or the specific error) instead",
+    ),
+]
+
+RULES = {r.id: r for r in _RULE_LIST}
+
+
+def rule_ids():
+    return sorted(RULES)

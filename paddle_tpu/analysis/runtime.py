@@ -1,0 +1,120 @@
+"""Runtime trace-hygiene companions to the static pass.
+
+Two dynamic checks for the failure modes an AST cannot prove:
+
+* :func:`assert_no_retrace` — a context manager over the observability
+  subsystem's ``CompileCacheMonitor``\\ s (PR 2): snapshot per-program
+  trace counts on entry, raise :class:`RetraceError` on exit if any
+  watched program traced again.  Wrap a steady-state region (the serving
+  loop after warmup, the training loop after step 1) to pin down "this
+  block must be a pure cache hit" as a test assertion instead of a
+  latency mystery.
+
+* :func:`assert_no_tracer_leak` / :func:`find_tracer_leaks` — trace a
+  function once while holding only *weak* references to its argument
+  tracers; after the trace completes (and the jaxpr is dropped), any
+  tracer still alive is retained by user state — the classic "stored a
+  traced value on self / in a global" leak that later explodes with an
+  ``UnexpectedTracerError`` far from the cause.
+"""
+from __future__ import annotations
+
+import contextlib
+import gc
+import weakref
+
+__all__ = ["RetraceError", "assert_no_retrace",
+           "TracerLeakError", "find_tracer_leaks", "assert_no_tracer_leak"]
+
+
+class RetraceError(RuntimeError):
+    """A watched compiled program re-traced inside an assert_no_retrace
+    block."""
+
+    def __init__(self, retraces):
+        self.retraces = retraces  # [(cache, program, n_new_traces)]
+        detail = ", ".join(f"{c}/{p}: +{n}" for c, p, n in retraces)
+        super().__init__(
+            f"unexpected retrace(s) inside assert_no_retrace block: "
+            f"{detail} — a retrace means a new (shape, dtype, static-arg) "
+            "combination hit the jit cache; check input shape churn or "
+            "loop-varying static arguments (tpu-lint PTL003)")
+
+
+@contextlib.contextmanager
+def assert_no_retrace(*monitors, programs=None):
+    """Assert no watched jit program traces inside the ``with`` block.
+
+    ``monitors``: CompileCacheMonitor instances to watch; default = every
+    live monitor in the process (``observability.compilecache``'s weak
+    registry — covers the functionalize train step and the llama decode
+    programs).  ``programs``: optional collection of program names to
+    restrict the check to.
+    """
+    from paddle_tpu.observability.compilecache import all_monitors
+
+    mons = list(monitors) or all_monitors()
+    before = [(m, m.trace_counts()) for m in mons]
+    yield
+    retraces = []
+    for m, b in before:
+        after = m.trace_counts()
+        for prog, n in after.items():
+            if programs is not None and prog not in programs:
+                continue
+            grew = n - b.get(prog, 0)
+            if grew > 0:
+                retraces.append((m.cache, prog, grew))
+    if retraces:
+        raise RetraceError(sorted(retraces))
+
+
+class TracerLeakError(RuntimeError):
+    """A tracer outlived its trace (retained by user state)."""
+
+
+def find_tracer_leaks(fn, *args, **kwargs):
+    """Trace ``fn(*args, **kwargs)`` once (abstractly, via
+    ``jax.make_jaxpr`` — nothing executes on device) and return a list of
+    descriptions of tracers still alive after the trace completed —
+    argument tracers (tracked precisely via weakref) and tracers created
+    *during* the trace (derived values like ``x * 2`` stored on self or a
+    global, found by a gc sweep).  Empty list == no leak."""
+    import jax
+
+    refs = []
+
+    def probe(*a, **kw):
+        for leaf in jax.tree_util.tree_leaves((a, kw)):
+            if isinstance(leaf, jax.core.Tracer):
+                refs.append((weakref.ref(leaf),
+                             f"{type(leaf).__name__}"
+                             f"{getattr(leaf, 'shape', ())}"))
+        return fn(*a, **kw)
+
+    gc.collect()
+    before = {id(o) for o in gc.get_objects()
+              if isinstance(o, jax.core.Tracer)}
+    jaxpr = jax.make_jaxpr(probe)(*args, **kwargs)
+    del jaxpr
+    gc.collect()
+    leaked = [desc for ref, desc in refs if ref() is not None]
+    arg_ids = {id(ref()) for ref, _ in refs if ref() is not None}
+    for obj in gc.get_objects():
+        if (isinstance(obj, jax.core.Tracer)
+                and id(obj) not in before and id(obj) not in arg_ids):
+            leaked.append(f"{type(obj).__name__}{getattr(obj, 'shape', ())}")
+    return leaked
+
+
+def assert_no_tracer_leak(fn, *args, **kwargs):
+    """Raise :class:`TracerLeakError` if tracing ``fn`` leaks any of its
+    argument tracers into surviving state."""
+    leaked = find_tracer_leaks(fn, *args, **kwargs)
+    if leaked:
+        raise TracerLeakError(
+            f"{len(leaked)} tracer(s) outlived the trace of "
+            f"{getattr(fn, '__name__', fn)!r}: {', '.join(leaked)} — a "
+            "jitted body stored a traced value in surviving state (self "
+            "attribute, global, closure cell); thread it through the "
+            "return value instead (tpu-lint PTL005)")

@@ -73,7 +73,9 @@ class Engine:
                         "tensor; pack multiple labels into one structure"
                     )
                 loss = self._train_step(*inputs, *labels)
-                logs = {"epoch": epoch, "step": step, "loss": float(np.asarray(loss.numpy()))}
+                # per-step loss readback is deliberate (history + progress logging)
+                logs = {"epoch": epoch, "step": step,
+                        "loss": float(np.asarray(loss.numpy()))}  # tpu-lint: ignore[PTL004]
                 self.history["loss"].append(logs["loss"])
                 if verbose and step % log_freq == 0:
                     print(f"[AutoParallel Engine] epoch {epoch} step {step} "

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.autograd.engine import apply
 from paddle_tpu.incubate.distributed.models.moe.gate.naive_gate import NaiveGate
@@ -11,12 +12,26 @@ from paddle_tpu.incubate.distributed.models.moe.gate.naive_gate import NaiveGate
 
 class SwitchGate(NaiveGate):
     def __init__(self, d_model, num_expert, world_size, topk=1,
-                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None,
+                 seed=None):
         assert topk == 1, "topk should be 1 in switch"
         super().__init__(d_model, num_expert, world_size, topk=1)
         self.switch_eps = switch_eps
         self.capacity = capacity
         self.group = group
+        # Routing-noise seed: deterministic under paddle.seed() via the
+        # process generator (tensor/random.py) instead of global np.random
+        # state (tpu-lint PTL005 impurity — the old draw made every run's
+        # routing irreproducible).  A per-forward counter is folded in so
+        # each training step still gets fresh noise.
+        if seed is None:
+            from paddle_tpu.tensor.random import default_generator
+
+            seed = int(np.asarray(
+                jax.random.randint(default_generator.next_key(), (),
+                                   0, 2**31 - 1)))
+        self._seed = int(seed)
+        self._route_calls = 0
 
     def forward(self, inp):
         score = self.gate(inp)
@@ -34,9 +49,11 @@ class SwitchGate(NaiveGate):
             loss = jnp.sum(c_e * m_e) * self.tot_expert
             return top1_val, top1_idx.astype(jnp.int64), loss
 
-        import numpy as np
-
-        seed = int(np.random.randint(0, 2**31 - 1))
+        # fold the call counter into the base seed: fresh noise per step,
+        # same sequence for the same paddle.seed()/constructor seed
+        seed = self._seed + self._route_calls
+        if self.training:
+            self._route_calls += 1
         val, idx, loss = apply("switch_route", lambda g: route(g, seed), score)
         self.set_loss(loss)
         return val, idx

@@ -22,18 +22,29 @@ from __future__ import annotations
 
 import functools
 import time
+import weakref
 
 from paddle_tpu.observability.metrics import get_registry
 
-__all__ = ["CompileCacheMonitor"]
+__all__ = ["CompileCacheMonitor", "all_monitors"]
 
 _LABELS = ("cache", "program")
+
+# every live monitor, weakly held — analysis.runtime.assert_no_retrace()
+# watches all of them by default without keeping any alive
+_MONITORS = weakref.WeakSet()
+
+
+def all_monitors():
+    """Snapshot list of every live CompileCacheMonitor in the process."""
+    return list(_MONITORS)
 
 
 class CompileCacheMonitor:
     def __init__(self, cache, registry=None):
         reg = registry if registry is not None else get_registry()
         self.cache = cache
+        _MONITORS.add(self)
         self._hits = reg.counter(
             "compile_cache_hits_total",
             "dispatches served by an already-compiled program",
@@ -54,6 +65,10 @@ class CompileCacheMonitor:
 
     def traces(self, program):
         return self._trace_counts.get(program, 0)
+
+    def trace_counts(self):
+        """Copy of the per-program trace counts (retrace-assert snapshots)."""
+        return dict(self._trace_counts)
 
     def call(self, program, fn, *args, **kwargs):
         """Dispatch ``fn`` and classify it as hit or miss via the trace

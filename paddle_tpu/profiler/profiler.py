@@ -6,6 +6,7 @@ delegated to jax.profiler (XLA's TPU tracer = the CustomTracer plugin hooks of
 device_ext.h:666).  Chrome-trace export + summary tables kept API-compatible."""
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -132,13 +133,27 @@ def _default_state_scheduler(step):
     return ProfilerState.RECORD
 
 
+def _export_path(dir_name, worker_name, suffix):
+    """Collision-proof export path: the second-resolution timestamp alone
+    silently overwrote when two exports landed in the same second (two
+    profiler cycles, or two processes sharing a dir without worker_name) —
+    a pid + process-monotonic sequence number disambiguates both."""
+    name = worker_name or f"host_{os.getpid()}"
+    seq = next(_EXPORT_SEQ)
+    return os.path.join(
+        dir_name,
+        f"{name}_time_{int(time.time())}_{os.getpid()}_{seq}{suffix}")
+
+
+_EXPORT_SEQ = itertools.count()
+
+
 def export_chrome_tracing(dir_name, worker_name=None):
     """on_trace_ready callback factory (reference profiler.py)."""
 
     def handle(prof):
         os.makedirs(dir_name, exist_ok=True)
-        name = worker_name or f"host_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        path = _export_path(dir_name, worker_name, ".paddle_trace.json")
         prof.export(path, "json")
         return path
 
@@ -148,8 +163,7 @@ def export_chrome_tracing(dir_name, worker_name=None):
 def export_protobuf(dir_name, worker_name=None):
     def handle(prof):
         os.makedirs(dir_name, exist_ok=True)
-        name = worker_name or f"host_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.pb")
+        path = _export_path(dir_name, worker_name, ".pb")
         prof.export(path, "pb")
         return path
 

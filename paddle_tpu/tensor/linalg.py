@@ -39,7 +39,7 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     return norm(x, p, axis, keepdim)
 
 
-def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     return apply(
         "matrix_norm",
         lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim),

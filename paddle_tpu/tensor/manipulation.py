@@ -169,7 +169,7 @@ def flip(x, axis, name=None):
     return apply("flip", lambda a: jnp.flip(a, tuple(axes)), _t(x))
 
 
-def rot90(x, k=1, axes=[0, 1], name=None):
+def rot90(x, k=1, axes=(0, 1), name=None):
     return apply("rot90", lambda a: jnp.rot90(a, k, tuple(_ints(axes))), _t(x))
 
 

@@ -128,7 +128,7 @@ def rand(shape, dtype=None, name=None):
     return uniform(shape, dtype, 0.0, 1.0)
 
 
-def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
     return Tensor(

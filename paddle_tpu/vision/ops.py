@@ -455,8 +455,8 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     return apply("box_coder", f, *args)
 
 
-def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
-              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False, steps=[0.0, 0.0],
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False, steps=(0.0, 0.0),
               offset=0.5, min_max_aspect_ratios_order=False, name=None):
     """reference vision/ops.py:438 (SSD prior boxes)."""
     fh, fw = input.shape[2], input.shape[3]

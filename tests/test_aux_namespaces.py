@@ -44,6 +44,29 @@ class TestProfiler:
             data = profiler.load_profiler_result(os.path.join(d, files[0]))
             assert "traceEvents" in data
 
+    def test_export_paths_unique_within_one_second(self, monkeypatch):
+        """Regression: export filenames were keyed on int(time.time()) alone,
+        so two exports in the same second silently overwrote each other.
+        A pid + monotonic-sequence suffix keeps them distinct even with the
+        clock frozen."""
+        import paddle_tpu.profiler as profiler
+        from paddle_tpu.profiler import profiler as profiler_mod
+
+        p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+        p.start()
+        with profiler.RecordEvent("e"):
+            pass
+        p.stop()
+        monkeypatch.setattr(profiler_mod.time, "time", lambda: 1.7e9)
+        with tempfile.TemporaryDirectory() as d:
+            handle = profiler.export_chrome_tracing(d, worker_name="w")
+            paths = [handle(p) for _ in range(3)]
+            assert len(set(paths)) == 3
+            assert sorted(os.listdir(d)) == sorted(
+                os.path.basename(q) for q in paths)
+            for q in paths:
+                assert os.path.basename(q).startswith("w_time_1700000000_")
+
 
 class TestQuantization:
     def _model(self):

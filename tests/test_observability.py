@@ -383,3 +383,43 @@ class TestCompileCacheMetrics:
         # train.step spans recorded in the default registry
         sp = reg.get("span_seconds").labels(name="train.step")
         assert sp.count >= 2
+
+
+# ------------------------------------------------- analysis.runtime guard
+class TestRetraceGuardIntegration:
+    """analysis.assert_no_retrace over the REAL monitors: the no-args form
+    watches every live CompileCacheMonitor through the weak registry in
+    observability.compilecache, so a steady-state train loop passes and a
+    shape-churn step is pinned to the exact cache/program that retraced."""
+
+    def _step(self):
+        from paddle_tpu import nn
+        from paddle_tpu.static.functionalize import build_train_step
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4))
+        opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                                   parameters=net.parameters())
+        return build_train_step(net, nn.MSELoss(), opt)
+
+    def test_steady_state_train_loop_is_retrace_free(self):
+        from paddle_tpu.analysis import assert_no_retrace
+
+        step = self._step()
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        step(x, y)  # warmup: the one legitimate trace
+        with assert_no_retrace():
+            for _ in range(3):
+                step(x, y)
+
+    def test_ragged_batch_retrace_is_caught(self):
+        from paddle_tpu.analysis import RetraceError, assert_no_retrace
+
+        step = self._step()
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        step(x, y)
+        with pytest.raises(RetraceError, match="functionalize/train_step"):
+            with assert_no_retrace():
+                # a ragged final batch: the classic silent recompile
+                step(x[:1], y[:1])

@@ -1,0 +1,529 @@
+"""tpu-lint (paddle_tpu.analysis): per-rule TP/TN fixtures, pragma
+suppression, baseline round-trip, the whole-tree CI gate, CLI smoke
+(JSON shape + exit codes), and the runtime companions
+(assert_no_retrace / tracer-leak detection)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (
+    RULES, fingerprints, lint_paths, lint_source, load_baseline,
+    split_findings, write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path="fix.py")]
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: at least one true positive and one true negative each
+# ---------------------------------------------------------------------------
+
+class TestRuleFixtures:
+    def test_parse_error_tp(self):
+        assert _rules("def f(:\n") == ["PTL000"]
+
+    def test_parse_error_tn(self):
+        assert _rules("x = 1\n") == []
+
+    # PTL001 — concretization-in-jit -----------------------------------
+    def test_concretization_tp_builtin(self):
+        assert _rules("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x) * 2
+        """) == ["PTL001"]
+
+    def test_concretization_tp_item_and_np(self):
+        found = _rules("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x, y):
+                a = np.asarray(x)
+                return a + y.item()
+        """)
+        assert found == ["PTL001", "PTL001"]
+
+    def test_concretization_tn_static_arg(self):
+        # `n` is static — int(n) is legal trace-time host python
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x * int(n)
+        """) == []
+
+    def test_concretization_tn_outside_jit(self):
+        assert _rules("""
+            import numpy as np
+            def f(x):
+                return float(np.asarray(x))
+        """) == []
+
+    def test_concretization_in_jit_assignment_wrapper(self):
+        # x = jax.jit(fn) marks fn's body traced
+        assert _rules("""
+            import jax
+            def f(x):
+                return int(x)
+            g = jax.jit(f)
+        """) == ["PTL001"]
+
+    # PTL002 — traced-python-branch ------------------------------------
+    def test_branch_tp_if(self):
+        assert _rules("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """) == ["PTL002"]
+
+    def test_branch_tp_while(self):
+        assert _rules("""
+            import jax
+            @jax.jit
+            def f(x):
+                while x < 10:
+                    x = x + 1
+                return x
+        """) == ["PTL002"]
+
+    def test_branch_tn_static_and_guards(self):
+        # static arg, shape access, isinstance guard, `is None`: all fine
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, y, mode):
+                if mode == "fast":
+                    x = x * 2
+                if x.shape[0] > 1:
+                    x = x + 1
+                if isinstance(y, jax.core.Tracer):
+                    x = x + 0
+                if y is None:
+                    return x
+                return x + y
+        """) == []
+
+    # PTL003 — retrace-risk --------------------------------------------
+    def test_retrace_tp_unhashable_static(self):
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, cfg):
+                return x
+            def g(x):
+                return f(x, [1, 2])
+        """) == ["PTL003"]
+
+    def test_retrace_tp_loop_var_static(self):
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, k):
+                return x
+            def g(x):
+                for k in range(8):
+                    x = f(x, k)
+                return x
+        """) == ["PTL003"]
+
+    def test_retrace_tp_inline_list_dynamic(self):
+        assert _rules("""
+            import jax
+            @jax.jit
+            def f(xs):
+                return xs
+            def g(a, b):
+                return f([a, b])
+        """) == ["PTL003"]
+
+    def test_retrace_tn(self):
+        # tuple static, array variable dynamic: no churn
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, cfg):
+                return x
+            def g(x):
+                return f(x, (1, 2))
+        """) == []
+
+    # PTL004 — host-sync-in-step-loop ----------------------------------
+    def test_host_sync_tp(self):
+        assert _rules("""
+            import numpy as np
+            def serve(engine, xs):
+                out = []
+                for x in xs:
+                    y = engine.step(x)
+                    out.append(np.asarray(y))
+                return out
+        """) == ["PTL004"]
+
+    def test_host_sync_tp_block_until_ready(self):
+        assert _rules("""
+            def train(step_fn, batches):
+                for b in batches:
+                    loss = step_fn(b)
+                    loss.block_until_ready()
+        """) == ["PTL004"]
+
+    def test_host_sync_tn_outside_loop(self):
+        assert _rules("""
+            import numpy as np
+            def serve(engine, xs):
+                for x in xs:
+                    y = engine.step(x)
+                return np.asarray(y)
+        """) == []
+
+    def test_host_sync_tn_no_step_in_loop(self):
+        assert _rules("""
+            import numpy as np
+            def f(xs):
+                return [np.asarray(x) for x in xs]
+            def g(xs):
+                out = []
+                for x in xs:
+                    out.append(np.asarray(x))
+                return out
+        """) == []
+
+    # PTL005 — impure-jit-body -----------------------------------------
+    def test_impure_tp_time_and_nprandom(self):
+        assert _rules("""
+            import time
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                t = time.time()
+                return x + np.random.randint(0, 3) + t
+        """) == ["PTL005", "PTL005"]
+
+    def test_impure_tp_stdlib_random(self):
+        assert _rules("""
+            import random
+            import jax
+            @jax.jit
+            def f(x):
+                return x * random.random()
+        """) == ["PTL005"]
+
+    def test_impure_tp_self_mutation(self):
+        assert _rules("""
+            import jax
+            class M:
+                def __init__(self):
+                    self._j = jax.jit(self._fn)
+                def _fn(self, x):
+                    self.cache = x
+                    return x
+        """) == ["PTL005"]
+
+    def test_impure_tn_keyed_prng_and_host_time(self):
+        assert _rules("""
+            import time
+            import jax
+            @jax.jit
+            def f(x, key):
+                return x + jax.random.uniform(key, x.shape)
+            def host():
+                return time.time()
+        """) == []
+
+    # PTL006 — mutable-default-arg -------------------------------------
+    def test_mutable_default_tp(self):
+        assert _rules("def f(x, axis=[0, 1]):\n    return x\n") == ["PTL006"]
+
+    def test_mutable_default_tn(self):
+        assert _rules("def f(x, axis=(0, 1), d=None):\n    return x\n") == []
+
+    # PTL007 — bare-except ---------------------------------------------
+    def test_bare_except_tp(self):
+        assert _rules("""
+            def f():
+                try:
+                    return 1
+                except:
+                    return 0
+        """) == ["PTL007"]
+
+    def test_bare_except_tn(self):
+        assert _rules("""
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    return 0
+        """) == []
+
+    # rule filtering ----------------------------------------------------
+    def test_rules_filter(self):
+        src = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x, axis=[0]):
+                return float(x)
+        """)
+        assert [f.rule for f in lint_source(src, rules=["PTL006"])] \
+            == ["PTL006"]
+        assert [f.rule for f in lint_source(src)] == ["PTL006", "PTL001"]
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+class TestPragmas:
+    SRC = textwrap.dedent("""
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x){pragma}
+    """)
+
+    def test_bare_ignore(self):
+        src = self.SRC.format(pragma="  # tpu-lint: ignore")
+        assert lint_source(src) == []
+
+    def test_scoped_ignore(self):
+        src = self.SRC.format(pragma="  # tpu-lint: ignore[PTL001]")
+        assert lint_source(src) == []
+
+    def test_non_matching_id_not_suppressed(self):
+        src = self.SRC.format(pragma="  # tpu-lint: ignore[PTL007]")
+        assert [f.rule for f in lint_source(src)] == ["PTL001"]
+
+    def test_multiple_ids(self):
+        src = self.SRC.format(pragma="  # tpu-lint: ignore[PTL007, PTL001]")
+        assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+DIRTY = "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(DIRTY)
+        findings = lint_paths([str(mod)])
+        assert [f.rule for f in findings] == ["PTL001"]
+
+        bl = tmp_path / "baseline.json"
+        payload = write_baseline(str(bl), findings)
+        assert payload["count"] == 1
+        fps = load_baseline(str(bl))
+        assert fps == set(payload["findings"])
+
+        new, old = split_findings(findings, fps)
+        assert new == [] and len(old) == 1
+
+    def test_baseline_survives_line_shift(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(DIRTY)
+        findings = lint_paths([str(mod)])
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), findings)
+        # unrelated edit above the finding: fingerprint (line-text based)
+        # still matches
+        mod.write_text("# a new comment\n# another\n" + DIRTY)
+        shifted = lint_paths([str(mod)])
+        assert shifted[0].line != findings[0].line
+        new, old = split_findings(shifted, load_baseline(str(bl)))
+        assert new == [] and len(old) == 1
+
+    def test_new_finding_not_masked(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(DIRTY)
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), lint_paths([str(mod)]))
+        mod.write_text(DIRTY + "\n\ndef g(x, d=[1]):\n    return d\n")
+        new, old = split_findings(lint_paths([str(mod)]),
+                                  load_baseline(str(bl)))
+        assert [f.rule for f in new] == ["PTL006"]
+        assert [f.rule for f in old] == ["PTL001"]
+
+    def test_fingerprints_disambiguate_identical_lines(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("def f(a=[1]):\n    return a\n\n"
+                       "def f(a=[1]):\n    return a\n")
+        findings = lint_paths([str(mod)])
+        assert len(findings) == 2
+        assert len(set(fingerprints(findings))) == 2
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: whole paddle_tpu tree must be clean against the baseline
+# ---------------------------------------------------------------------------
+
+class TestTreeGate:
+    def test_tree_has_no_new_findings(self):
+        tree = os.path.join(REPO, "paddle_tpu")
+        baseline = os.path.join(REPO, "tpu_lint_baseline.json")
+        assert os.path.isfile(baseline), "tpu_lint_baseline.json missing"
+        findings = lint_paths([tree])
+        new, _ = split_findings(findings, load_baseline(baseline))
+        msgs = [f"{f.path}:{f.line}: {f.rule} {f.message}" for f in new]
+        assert not new, (
+            "new tpu-lint finding(s) — fix them, add a justified "
+            "`# tpu-lint: ignore[...]` pragma, or (last resort) regenerate "
+            "the baseline with `python -m paddle_tpu.analysis paddle_tpu "
+            "--write-baseline`:\n" + "\n".join(msgs))
+
+    def test_every_rule_has_metadata(self):
+        for rid, rule in RULES.items():
+            assert rule.id == rid and rule.severity in ("error", "warning")
+            assert rule.description and rule.hint and rule.name
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke: exit codes + JSON output shape
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=240)
+
+
+class TestCLI:
+    def test_json_shape_and_exit_1(self, tmp_path):
+        mod = tmp_path / "dirty.py"
+        mod.write_text(DIRTY)
+        r = _run_cli([str(mod), "--format", "json", "--no-baseline"])
+        assert r.returncode == 1, r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["tool"] == "paddle_tpu.analysis"
+        assert payload["summary"]["new"] == 1
+        assert payload["summary"]["errors"] == 1
+        assert payload["counts_by_rule"] == {"PTL001": 1}
+        (entry,) = payload["new"]
+        for key in ("rule", "severity", "path", "line", "col", "message",
+                    "hint", "fingerprint"):
+            assert key in entry
+        assert entry["rule"] == "PTL001" and entry["severity"] == "error"
+
+    def test_clean_file_exit_0(self, tmp_path):
+        mod = tmp_path / "clean.py"
+        mod.write_text("x = 1\n")
+        r = _run_cli([str(mod), "--no-baseline"])
+        assert r.returncode == 0, r.stderr
+        assert "0 new finding(s)" in r.stdout
+
+    def test_usage_errors_exit_2(self, tmp_path):
+        r = _run_cli(["--rules", "PTL999", str(tmp_path)])
+        assert r.returncode == 2 and "unknown rule" in r.stderr
+        r = _run_cli([str(tmp_path / "nope.py")])
+        assert r.returncode == 2 and "no such path" in r.stderr
+
+    def test_list_rules(self):
+        r = _run_cli(["--list-rules"])
+        assert r.returncode == 0
+        for rid in RULES:
+            assert rid in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime companions
+# ---------------------------------------------------------------------------
+
+class TestRuntime:
+    def _monitored(self):
+        from paddle_tpu.observability.compilecache import CompileCacheMonitor
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        mon = CompileCacheMonitor("test", registry=MetricsRegistry())
+
+        @jax.jit
+        def f(x):
+            mon.mark_trace("f")
+            return x * 2
+
+        return mon, f
+
+    def test_assert_no_retrace_passes_on_cache_hit(self):
+        from paddle_tpu.analysis import assert_no_retrace
+
+        mon, f = self._monitored()
+        f(jnp.ones((2,)))  # warmup: first trace happens outside the block
+        with assert_no_retrace(mon):
+            f(jnp.ones((2,)))
+            f(jnp.zeros((2,)))
+
+    def test_assert_no_retrace_raises_on_shape_churn(self):
+        from paddle_tpu.analysis import RetraceError, assert_no_retrace
+
+        mon, f = self._monitored()
+        f(jnp.ones((2,)))
+        with pytest.raises(RetraceError, match=r"test/f: \+1"):
+            with assert_no_retrace(mon):
+                f(jnp.ones((3,)))  # new shape: retrace
+
+    def test_assert_no_retrace_program_filter(self):
+        from paddle_tpu.analysis import assert_no_retrace
+
+        mon, f = self._monitored()
+        f(jnp.ones((2,)))
+        with assert_no_retrace(mon, programs=("other",)):
+            f(jnp.ones((5,)))  # retraces, but `f` is not watched
+
+    def test_tracer_leak_detected(self):
+        from paddle_tpu.analysis import TracerLeakError, assert_no_tracer_leak
+
+        sink = []
+
+        def leaky(x):
+            sink.append(x)  # retains the tracer beyond the trace
+            return x * 2
+
+        with pytest.raises(TracerLeakError, match="outlived the trace"):
+            assert_no_tracer_leak(leaky, jnp.ones((2,)))
+        sink.clear()
+
+    def test_derived_tracer_leak_detected(self):
+        from paddle_tpu.analysis import find_tracer_leaks
+
+        sink = []
+
+        def leaky(x):
+            sink.append(x * 2)  # leaks a tracer CREATED during the trace
+            return x + 1
+
+        assert find_tracer_leaks(leaky, jnp.ones((3,)))
+        sink.clear()
+
+    def test_tracer_leak_clean(self):
+        from paddle_tpu.analysis import find_tracer_leaks
+
+        def clean(x):
+            return x * 2 + 1
+
+        assert find_tracer_leaks(clean, jnp.ones((2,))) == []
