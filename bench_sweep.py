@@ -3,6 +3,15 @@ configs enabled by chunked CE + low-precision moments.  Appends one JSON line
 per variant to bench_sweep.jsonl (order: safe -> risky so OOMs lose nothing).
 
 Run: timeout 3600 python -u bench_sweep.py
+
+Round 9 adds the decode chunk-size sweep behind ``python -u bench_sweep.py
+decode_chunk``: times the compiled serving decode step (serving_decode_steps,
+bench model, batch 8, Lmax=2048) across chunk sizes x two occupancy regimes
+(low ~128-token contexts, high ~1800).  The winner at low occupancy that is
+regression-free at high occupancy becomes ServingEngine's ``decode_chunk``
+default — 256 on the v5e-class chip this grew up on: small enough that a
+128-token batch reads 1/8th of the cache, large enough that the per-chunk
+while_loop overhead stays under the noise floor at full occupancy.
 """
 from __future__ import annotations
 
@@ -64,9 +73,78 @@ def run_variant(name, batch, chunk, md, policy, rl, kv_heads=16, iters=10):
             "compile_s": round(compile_s, 1), "loss": round(lv, 3)}
 
 
+DECODE_CHUNKS = [None, 512, 256, 128, 64]
+
+
+def sweep_decode_chunk(iters=20, n_steps=8):
+    """Chunk-size sweep for the length-adaptive decode read: per-step time
+    of the compiled serving step at each chunk size, in a low-occupancy
+    regime (mean live context ~128 in an Lmax=2048 cache — where chunking
+    pays) and a high-occupancy one (~1800 — where it must not regress).
+    ``None`` is the full [B, Lmax] masked read (the pre-round-9 path)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama_decode import (
+        _decode_params_of, serving_decode_steps)
+    from paddle_tpu.ops.decode_attention import init_kv_cache
+
+    lmax, batch = 2048, 8
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    params, key = _decode_params_of(model, lmax)
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    rng = np.random.default_rng(0)
+    cur = jnp.asarray(rng.integers(0, cfg.vocab_size, batch),
+                      dtype=jnp.int32)
+    regimes = {
+        "low_occ": jnp.asarray(rng.integers(96, 161, batch), jnp.int32),
+        "high_occ": jnp.asarray(rng.integers(1664, 1985, batch), jnp.int32),
+    }
+    rows = []
+    for regime, lengths in regimes.items():
+        for chunk in DECODE_CHUNKS:
+            # caches are donated by the step — rebuild per config, carry
+            # the returned buffers through the timing loop (the fixed
+            # `lengths` keep every iteration's reads/writes identical)
+            caches = [init_kv_cache(batch, lmax, nkv, hd, cfg.dtype)
+                      for _ in range(cfg.num_hidden_layers)]
+            toks, caches = serving_decode_steps(
+                params, key, cur, caches, lengths,
+                n_steps=n_steps, chunk_size=chunk)
+            np.asarray(toks)  # compile + settle
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                toks, caches = serving_decode_steps(
+                    params, key, cur, caches, lengths,
+                    n_steps=n_steps, chunk_size=chunk)
+            np.asarray(toks)
+            dt = (time.perf_counter() - t0) / (iters * n_steps)
+            rows.append({"variant": f"decode_chunk_{regime}_"
+                         f"{'full' if chunk is None else chunk}",
+                         "step_ms": round(dt * 1e3, 3),
+                         "tok_per_sec": round(batch / dt, 1)})
+            del caches
+            gc.collect()
+    return rows
+
+
 def main():
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "bench_sweep.jsonl")
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "decode_chunk":
+        for rec in sweep_decode_chunk():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
     for v in VARIANTS:
         print(f"=== {v[0]} ===", flush=True)
         try:

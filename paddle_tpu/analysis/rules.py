@@ -64,12 +64,15 @@ _RULE_LIST = [
     ),
     Rule(
         "PTL004", "host-sync-in-step-loop", WARNING,
-        "np.asarray/np.array/.item()/.block_until_ready()/jax.device_get "
-        "inside a loop that dispatches a compiled step — each sync stalls "
-        "the host on device completion and serializes the async dispatch "
-        "pipeline (the serving/training hot path)",
-        "batch readbacks outside the loop, or sync once per block "
-        "(sync_every-style) instead of per iteration",
+        "np.asarray/np.array/.item()/.numpy()/.block_until_ready()/"
+        "jax.device_get inside a loop that dispatches a compiled step — "
+        "each sync stalls the host on device completion and serializes the "
+        "async dispatch pipeline (the serving/training hot path).  Calls "
+        "routed through the sanctioned deferred-readback helper "
+        "(host_fetch/_host_fetch, serving/engine.py) are exempt: a "
+        "pipelined drain blocks exactly once per iteration by design",
+        "batch readbacks through _host_fetch outside the loop, or sync "
+        "once per block (sync_every-style) instead of per iteration",
     ),
     Rule(
         "PTL005", "impure-jit-body", ERROR,

@@ -100,9 +100,11 @@ def _rope_at(q, k, cos_t, sin_t, positions):
     return q * cos + rot_half(q) * sin, k * cos + rot_half(k) * sin
 
 
-def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t):
+def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
+                chunk_size=None):
     """One decoder layer over T new tokens with the static cache.
-    h [B, T, hidden] -> (h', k_cache', v_cache')."""
+    h [B, T, hidden] -> (h', k_cache', v_cache').  ``chunk_size`` (static)
+    selects the length-adaptive chunked cache read in decode_attention."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
@@ -112,14 +114,15 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t):
     positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache, _ = decode_attention(
-        q, k, v, k_cache, v_cache, lengths)
+        q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size)
     h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
     return h, k_cache, v_cache
 
 
-def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None):
+def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
+             chunk_size=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
@@ -130,7 +133,8 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None):
     new_caches = []
     cos_t, sin_t = params["_rope"]
     for lp, (kc, vc) in zip(params["layers"], caches):
-        h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t)
+        h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t,
+                                chunk_size=chunk_size)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
     if last_idx is not None:
@@ -144,16 +148,18 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None):
     return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
 
 
-def _forward_step(params, cfg, tokens, caches, lengths):
+def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None):
     """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
-    return _forward(params, cfg, tokens, caches, lengths, last_only=True)
+    return _forward(params, cfg, tokens, caches, lengths, last_only=True,
+                    chunk_size=chunk_size)
 
 
-def _forward_step_all(params, cfg, tokens, caches, lengths):
+def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None):
     """Logits for EVERY input position [B, T, V] — the verification pass
     of speculative decoding needs the target's next-token distribution
     after each drafted token."""
-    return _forward(params, cfg, tokens, caches, lengths, last_only=False)
+    return _forward(params, cfg, tokens, caches, lengths, last_only=False,
+                    chunk_size=chunk_size)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -399,10 +405,12 @@ _spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
 # masked_lengths): a dead slot's offset is lmax, so its cache writes drop and
 # its state survives the step untouched.
 
-@functools.partial(jax.jit, static_argnames=("cfg", "with_hist"),
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "with_hist", "chunk_size"),
                    donate_argnames=("caches", "hist"))
 def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
-                         hist=None, hist_len=None, with_hist=False):
+                         hist=None, hist_len=None, with_hist=False,
+                         chunk_size=None):
     """Admit ONE request: prefill its prompt, insert into the batch cache.
 
     ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
@@ -426,7 +434,8 @@ def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
             for _ in params["layers"]]
     logits, mini, _ = _forward(
         params, cfg, tokens, mini, jnp.zeros((1,), jnp.int32),
-        last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1))
+        last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1),
+        chunk_size=chunk_size)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [1]
     slot = slot.astype(jnp.int32)
     zero = jnp.int32(0)
@@ -452,21 +461,25 @@ serving_prefill_slot = _mon.wrap("serving_prefill_slot",
                                  serving_prefill_slot)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "chunk_size"),
                    donate_argnames=("caches",))
-def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1):
+def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1,
+                         chunk_size=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
     Dead slots (offset lmax) drop every cache write at every inner step —
-    lmax + i only moves further past capacity.  Returns (tokens
-    [B, n_steps], caches')."""
+    lmax + i only moves further past capacity, AND the chunked read's
+    trip count excludes them (ops.decode_attention), so one parked slot
+    never forces full-length reads.  Returns (tokens [B, n_steps],
+    caches')."""
     _mon.mark_trace("serving_decode_steps")
 
     def body(carry, _):
         tok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
-            params, cfg, tok[:, None], caches, lengths)
+            params, cfg, tok[:, None], caches, lengths,
+            chunk_size=chunk_size)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, caches, lengths), nxt
 
@@ -480,9 +493,9 @@ serving_decode_steps = _mon.wrap("serving_decode_steps",
                                  serving_decode_steps)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "spec_k"))
+@functools.partial(jax.jit, static_argnames=("cfg", "spec_k", "chunk_size"))
 def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
-                      active, spec_k=4):
+                      active, spec_k=4, chunk_size=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -492,16 +505,20 @@ def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
     between the two program shapes — a random-init tiny model on
     degenerate repetitive input can flip a near-tied argmax, trained
     models in practice do not).  Returns (emitted [B, k+1] — the
-    j+1 accepted tokens, zero-padded —, j [B], cur' [B], caches', hist',
-    hist_len').  The host rewinds its length mirror to +j+1; dead slots
-    (``active`` False) drop cache AND history writes."""
+    j+1 accepted tokens, zero-padded —, j [B], cur' [B], new_len [B] —
+    the accepted-prefix-advanced device lengths (dev_lengths + j + 1 for
+    live slots, untouched for dead ones), the device-resident carry the
+    pipelined engine feeds straight into the next dispatch without a host
+    sync —, caches', hist', hist_len').  The host rewinds its length
+    mirror to +j+1; dead slots (``active`` False) drop cache AND history
+    writes."""
     _mon.mark_trace("serving_spec_step")
     b = cur.shape[0]
     lmax = hist.shape[1]
     drafts = _ngram_draft(hist, hist_len, cur, spec_k)
     toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
     logits, caches, _ = _forward_step_all(
-        params, cfg, toks, caches, dev_lengths)
+        params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size)
     # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
     # out IS the accepted-prefix block for this round
     emitted, cur, j, emit = _verify_and_emit(
@@ -514,7 +531,9 @@ def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
                    jnp.where(hvalid, hcols, lmax)].set(
         jnp.where(hvalid, emit, 0), mode="drop")
     hist_len = hist_len + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
-    return emitted, j, cur, caches, hist, hist_len
+    new_len = dev_lengths.astype(jnp.int32) \
+        + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
+    return emitted, j, cur, new_len, caches, hist, hist_len
 
 
 serving_spec_step = _mon.wrap("serving_spec_step", serving_spec_step)

@@ -9,13 +9,24 @@ TPU-first design choices:
 * **Static shapes.**  The cache is preallocated at ``[B, Lmax, Hkv, D]`` and
   every decode step runs the SAME compiled program regardless of the current
   length — position masking (``k_idx <= cur_len``) replaces dynamic slicing.
-  The reference's CUDA kernel reads exactly ``cur_len`` keys; on TPU a
-  masked full-length read is one fused bandwidth-bound pass with no
-  recompilation, which is what wins on XLA (SURVEY §3: jit traces once).
+* **Length-adaptive chunked reads.**  Decode is HBM-bandwidth-bound (a GEMV
+  per head against the cache), so KV bytes ARE the step time — and a masked
+  full-length read pays ``Lmax`` bytes for a request at context 200 in an
+  ``Lmax=4096`` engine: 20× the traffic it needs.  ``chunk_size`` switches
+  the attention read to an online-softmax (flash-style running max /
+  denominator) ``lax.while_loop`` over ``[C]``-sized cache chunks whose trip
+  count is ``ceil((max(live lengths) + T) / C)`` computed ON DEVICE — the
+  compiled program is still traced exactly once (the trip count is a traced
+  scalar, not a shape), but fully-masked tail chunks are never read, so HBM
+  traffic per step is proportional to the longest LIVE context in the
+  batch, not ``Lmax``.  Retired serving slots (parked at offset ``lmax`` by
+  ``masked_lengths``) are excluded from the trip-count max, so one parked
+  slot never forces full-length reads.  ``chunk_size=None`` (default) keeps
+  the single fused full-length read — still optimal when contexts sit near
+  ``Lmax`` or the cache is small.
 * **GQA-native.**  kv heads are consumed directly (``[B, Hkv, G, ...]``
   einsums) — no ``repeat`` materialization, KV reads are 1/G of expanded
-  heads.  Decode is HBM-bandwidth-bound (a GEMV per head against the cache),
-  so KV bytes ARE the step time.
+  heads.
 * **Per-batch lengths.**  ``lengths [B]`` supports ragged batches (the
   reference's ``sequence_lengths``); appends use a vmapped
   ``dynamic_update_slice`` (lowers to one scatter).
@@ -81,9 +92,111 @@ def _append(cache, new, lengths, layout):
     return jax.vmap(one)(cache, new, lengths.astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "layout"))
+def _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
+                 attn_bias):
+    """Single fused masked read over the whole [Lmax] cache."""
+    b, hkv, g, t, d = qg.shape
+    lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+    k_eq = "blkd" if layout == "blhd" else "bkld"
+    s = jnp.einsum(
+        f"bkgtd,{k_eq}->bkgtl", qg,
+        k_cache.astype(jnp.float32), preferred_element_type=jnp.float32,
+    ) * scale
+    if attn_bias is not None:
+        bias = jnp.asarray(attn_bias, jnp.float32)
+        bias = jnp.broadcast_to(bias, (b, 1, t, lmax))
+        s = s + bias[:, :, None, :, :]
+    k_idx = jnp.arange(lmax, dtype=jnp.int32)
+    live = k_idx[None, None, :] <= q_pos[:, :, None]                    # [B,T,L]
+    s = jnp.where(live[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        f"bkgtl,{k_eq}->bkgtd", p, v_cache.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+
+
+def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
+                    attn_bias, chunk):
+    """Online-softmax ``lax.while_loop`` over [C]-sized cache chunks.
+
+    Flash-style running (max, denominator, accumulator) carry; exact (not
+    approximate) — the recurrence rescales previous partial sums by
+    ``exp(m_old - m_new)`` so the result equals the full-read softmax up to
+    float reassociation.  The trip count is a TRACED scalar
+    ``ceil((max(live lengths) + T) / C)``: same compiled program every step
+    (no retraces), but chunks past the longest live context are never
+    read — HBM traffic tracks the batch's real context, not Lmax.  Slots
+    parked by ``masked_lengths`` (offset >= lmax) are excluded from the
+    trip-count max; their rows compute garbage (ignored by the scheduler)
+    over whatever chunks DO run, which keeps every row's softmax finite.
+    ``lmax % C != 0`` is handled by clamping the tail chunk's start to
+    ``lmax - C`` and masking the re-read overlap out of the tail pass.
+    """
+    b, hkv, g, t, d = qg.shape
+    lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+    c = int(chunk)
+    n_chunks = -(-lmax // c)
+    bias = None
+    if attn_bias is not None:
+        bias = jnp.broadcast_to(jnp.asarray(attn_bias, jnp.float32),
+                                (b, 1, t, lmax))
+    # highest live position + 1 this step: parked slots (>= lmax) excluded
+    eff = jnp.where(lengths < lmax, lengths, 0)
+    trip = jnp.clip((jnp.max(eff) + t + c - 1) // c, 1, n_chunks)
+    z = jnp.int32(0)
+
+    def body(carry):
+        i, m, l, acc = carry
+        start = jnp.minimum(i * c, lmax - c)  # clamped tail start
+        if layout == "blhd":
+            kb = jax.lax.dynamic_slice(k_cache, (z, start, z, z),
+                                       (b, c, hkv, d))
+            vb = jax.lax.dynamic_slice(v_cache, (z, start, z, z),
+                                       (b, c, hkv, d))
+            kb, vb = jnp.swapaxes(kb, 1, 2), jnp.swapaxes(vb, 1, 2)
+        else:
+            kb = jax.lax.dynamic_slice(k_cache, (z, z, start, z),
+                                       (b, hkv, c, d))
+            vb = jax.lax.dynamic_slice(v_cache, (z, z, start, z),
+                                       (b, hkv, c, d))
+        s = jnp.einsum(
+            "bkgtd,bkcd->bkgtc", qg, kb.astype(jnp.float32),
+            preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            bb = jax.lax.dynamic_slice(bias, (z, z, z, start), (b, 1, t, c))
+            s = s + bb[:, :, None, :, :]
+        k_idx = start + jnp.arange(c, dtype=jnp.int32)            # [C] global
+        # causal AND not already processed (the clamped tail re-reads
+        # [start, i*c) — those positions belong to the previous chunk)
+        live = (k_idx[None, None, :] <= q_pos[:, :, None]) \
+            & (k_idx >= i * c)[None, None, :]                     # [B,T,C]
+        s = jnp.where(live[:, None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # explicit zero on masked lanes: a fully-masked row in an executed
+        # chunk has s == m_new == _NEG_INF and exp(s - m_new) == 1 — the
+        # classic online-softmax pollution bug
+        p = jnp.where(live[:, None, None], jnp.exp(s - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bkgtc,bkcd->bkgtd", p, vb.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return i + jnp.int32(1), m_new, l, acc
+
+    m0 = jnp.full((b, hkv, g, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, t), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, t, d), jnp.float32)
+    _, _, l, acc = jax.lax.while_loop(
+        lambda carry: carry[0] < trip, body, (z, m0, l0, acc0))
+    # l > 0 always: chunk 0 runs unconditionally and position 0 is causally
+    # visible to every query (q_pos >= 0)
+    return acc / l[..., None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "layout", "chunk_size"))
 def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
-                     layout="blhd", attn_bias=None):
+                     layout="blhd", attn_bias=None, chunk_size=None):
     """One decode step: append new kv, attend causally over the cache.
 
     q [B, T, H, D] (T = tokens this step, usually 1); k_new/v_new
@@ -92,8 +205,12 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     [B, Hkv, Lmax, D] — the reference cache_kv order); lengths [B] — number
     of valid cache positions BEFORE this step.  ``attn_bias`` (optional,
     broadcastable to [B, 1, T, Lmax] fp) is added to the scores (the
-    reference's src_mask).  Returns (out [B, T, H, D], k_cache', v_cache',
-    lengths + T).
+    reference's src_mask).  ``chunk_size`` (static) selects the
+    length-adaptive chunked read (see the module docstring): HBM traffic
+    proportional to the longest live context instead of Lmax, allclose-
+    identical to the full read; ``None`` (or >= Lmax) keeps the single
+    fused full-length pass.  Returns (out [B, T, H, D], k_cache',
+    v_cache', lengths + T).
 
     Query token t (global position lengths+t) attends to cache positions
     <= lengths+t: bottom-right-aligned causality, same convention as the
@@ -112,26 +229,15 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
 
     k_cache = _append(k_cache, k_new, lengths, layout)
     v_cache = _append(v_cache, v_new, lengths, layout)
-    k_eq = "blkd" if layout == "blhd" else "bkld"
 
-    # [B, Hkv, G, T, D] x cache -> [B, Hkv, G, T, Lmax]
-    qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 3, 1, 4)
-    s = jnp.einsum(
-        f"bkgtd,{k_eq}->bkgtl", qg.astype(jnp.float32),
-        k_cache.astype(jnp.float32), preferred_element_type=jnp.float32,
-    ) * scale
-    if attn_bias is not None:
-        bias = jnp.asarray(attn_bias, jnp.float32)
-        bias = jnp.broadcast_to(bias, (b, 1, t, lmax))
-        s = s + bias[:, :, None, :, :]
-    k_idx = jnp.arange(lmax, dtype=jnp.int32)
+    qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
+        .astype(jnp.float32)                                # [B,Hkv,G,T,D]
     q_pos = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
-    live = k_idx[None, None, :] <= q_pos[:, :, None]                    # [B,T,L]
-    s = jnp.where(live[:, None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum(
-        f"bkgtl,{k_eq}->bkgtd", p, v_cache.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+    if chunk_size is not None and int(chunk_size) < lmax:
+        out = _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
+                              layout, attn_bias, int(chunk_size))
+    else:
+        out = _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale,
+                           layout, attn_bias)
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d).astype(q.dtype)
     return out, k_cache, v_cache, lengths + t

@@ -31,10 +31,34 @@ program keeps ONE static compiled shape:
   when every slot is free and runs to completion) — the sequential
   baseline for the bench A/B, sharing the exact same compiled programs so
   the measured win is pure scheduling.
+* **Pipelined (double-buffered) dispatch** (``pipeline=True``, default):
+  step N+1 depends only on device-resident state — the carried ``cur``
+  tokens, caches, and lengths — so the engine dispatches it BEFORE
+  syncing step N's tokens to the host.  Host-side emit/detokenize/
+  stream-callback work and admission bookkeeping then overlap device
+  compute; the drain-side block is measured by
+  ``serving_pipeline_stall_seconds`` and the outstanding dispatch by the
+  ``serving_inflight_steps`` gauge.  The ONE device→host sync per
+  iteration goes through ``_host_fetch`` (the sanctioned sync point the
+  tpu-lint PTL004 rule recognizes).  Correctness invariant: retirement
+  and admission take effect ONE STEP LATE — a step dispatched before the
+  scheduler discovers a slot finished still computes that slot, but the
+  stale step is byte-harmless: ``masked_lengths`` gives a freed slot an
+  offset of ``lmax`` at the NEXT dispatch so its writes drop, re-admission
+  prefills are dispatched after the stale step in device program order so
+  they overwrite its rows, rows past a new prompt's length are invisible
+  to decode_attention's position masking, and the drain discards tokens
+  whose slot no longer holds the same Request object.  The extra
+  inflight dispatch is why ``_headroom`` doubles under pipelining.
+  ``pipeline=False`` restores the fully synchronous loop (the A/B
+  baseline) — token streams are byte-identical either way (tested).
 
 The per-slot state the scheduler owns host-side: token history, a length
 mirror of the device cache, and the speculative rewind offset (folded into
-the length mirror as ``+ j + 1`` per accepted round).
+the length mirror as ``+ j + 1`` per accepted round).  Decode-side cache
+reads are length-adaptive: ``decode_chunk`` is forwarded to the chunked
+online-softmax path in ops/decode_attention.py, so per-step HBM traffic
+tracks the longest LIVE context instead of ``max_len``.
 """
 from __future__ import annotations
 
@@ -64,6 +88,16 @@ warnings.filterwarnings(
 __all__ = ["Request", "ServingEngine"]
 
 _NULL_CTX = contextlib.nullcontext()
+
+
+def _host_fetch(*arrays):
+    """The engine's sanctioned device→host sync point: materialize device
+    arrays as numpy, blocking until their producing dispatches complete.
+    Every OTHER engine/device interaction is an async dispatch — funneling
+    the blocking reads through this one name is what lets the tpu-lint
+    PTL004 rule keep flagging raw ``np.asarray`` added inside step loops
+    without false-positiving on the pipelined drain."""
+    return [np.asarray(a) for a in arrays]
 
 
 class _EngineMetrics:
@@ -129,6 +163,13 @@ class _EngineMetrics:
         self.spec_accept_rate = reg.gauge(
             "serving_spec_accept_rate",
             "cumulative accepted/drafted ratio", L).labels(**lbl)
+        self.pipeline_stall = reg.histogram(
+            "serving_pipeline_stall_seconds",
+            "drain-side block waiting on the inflight dispatch",
+            L).labels(**lbl)
+        self.inflight = reg.gauge(
+            "serving_inflight_steps",
+            "device steps dispatched but not yet drained", L).labels(**lbl)
         self.span_step = span("serving.step", registry=reg)
         self.span_prefill = span("serving.prefill", registry=reg)
         self.span_decode = span("serving.decode", registry=reg)
@@ -213,12 +254,19 @@ class ServingEngine:
     (run-to-completion baseline).  ``prompt_buckets``: padded prefill
     widths (default: powers of two up to ``max_len``).
     ``detokenizer``: optional ``ids -> str`` for streamed ``.text``.
+    ``pipeline``: double-buffer the decode loop — dispatch step N+1 before
+    syncing step N's tokens (module docstring has the one-step-late
+    retirement invariant); ``False`` is the synchronous A/B baseline with
+    byte-identical token streams.  ``decode_chunk``: KV chunk size for the
+    length-adaptive cache read (ops/decode_attention.py); ``None`` reads
+    the full ``[B, max_len]`` cache every step.  The default (256) falls
+    back to the full read automatically when ``max_len <= 256``.
     """
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
                  spec_k=8, sync_every=1, policy="continuous",
                  prompt_buckets=None, detokenizer=None, registry=None,
-                 instrument=True):
+                 instrument=True, pipeline=True, decode_chunk=256):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -237,6 +285,8 @@ class ServingEngine:
         self._sync = max(1, int(sync_every))
         self._policy = policy
         self._detok = detokenizer
+        self._pipeline = bool(pipeline)
+        self._chunk = int(decode_chunk) if decode_chunk else None
         self._params, self._cfg = _decode_params_of(model, self._lmax)
         nh, nkv, hd, eps = self._cfg
         dtype = self._params["embed"].dtype
@@ -263,16 +313,29 @@ class ServingEngine:
         self._queue = deque()
         self._finished = []
         self._next_rid = 0
+        # pipelined-dispatch state: the one outstanding (dispatched, not yet
+        # drained) step, the device-resident carries feeding the NEXT
+        # dispatch without a host round-trip, and the slots admitted since
+        # the last dispatch (whose cur/length live host-side until mixed in)
+        self._inflight = None
+        self._dev_cur = None
+        self._dev_len = None
+        self._adm_pending = set()
 
     # ------------------------------------------------------------- scheduling
     @property
     def has_work(self):
-        return bool(self._queue) or any(r is not None for r in self._reqs)
+        return (bool(self._queue) or any(r is not None for r in self._reqs)
+                or self._inflight is not None)
 
     def _headroom(self):
         # greedy may overshoot a retiring slot by < sync_every cache rows;
         # spec's verify forward writes spec_k+1 rows before the rewind
-        return self._spec_k + 1 if self._mode == "spec" else self._sync
+        per = self._spec_k + 1 if self._mode == "spec" else self._sync
+        # a pipelined engine discovers retirement one drain late, so one
+        # extra full dispatch of cache writes can land past the emission
+        # point before the slot's offset is masked to lmax
+        return 2 * per if self._pipeline else per
 
     def submit(self, request):
         p = int(request.prompt_ids.size)
@@ -304,6 +367,7 @@ class ServingEngine:
         if self._policy == "gang" and len(free) < self._B:
             return  # run-to-completion: wait for the whole batch to drain
         m = self._m
+        pending = []
         while free and self._queue:
             r = self._queue.popleft()
             slot = free.pop(0)
@@ -321,11 +385,19 @@ class ServingEngine:
                     jnp.asarray(np.array([p], np.int32)), self._caches,
                     jnp.asarray(slot, jnp.int32),
                     hist=self._hist, hist_len=self._hist_len,
-                    with_hist=self._mode == "spec")
+                    with_hist=self._mode == "spec",
+                    chunk_size=self._chunk)
             if self._mode == "spec":
                 self._hist, self._hist_len = hist, hist_len
             self._len[slot] = p
-            first = int(np.asarray(first)[0])
+            self._adm_pending.add(slot)
+            pending.append((slot, first))
+        # every prefill in the wave is dispatched (async) above; block ONCE
+        # here for all their first tokens — one host sync per _admit, not
+        # one per admitted request
+        firsts = _host_fetch(*(f for _, f in pending))
+        for (slot, _), fv in zip(pending, firsts):
+            first = int(fv[0])
             self._cur[slot] = first
             self._emit(slot, [first])
         if m is not None:
@@ -391,8 +463,19 @@ class ServingEngine:
             return self._step_impl()
 
     def _step_impl(self):
-        m = self._m
         self._admit()
+        if not self._pipeline:
+            self._adm_pending.clear()
+            return self._step_sync()
+        self._dispatch()
+        return self._drain()
+
+    # ------------------------------------------------- synchronous baseline
+    def _step_sync(self):
+        """``pipeline=False``: dispatch one step and block on its tokens in
+        the same iteration — the A/B baseline the pipelined loop is
+        byte-identical to."""
+        m = self._m
         live = [i for i in range(self._B) if self._reqs[i] is not None]
         if not live:
             return 0
@@ -404,20 +487,22 @@ class ServingEngine:
             with m.span_decode if m is not None else _NULL_CTX:
                 toks, self._caches = serving_decode_steps(
                     self._params, self._cfg, jnp.asarray(self._cur),
-                    self._caches, dev_len, n_steps=self._sync)
-                toks = np.asarray(toks)
+                    self._caches, dev_len, n_steps=self._sync,
+                    chunk_size=self._chunk)
+                (toks,) = _host_fetch(toks)
             for i in live:
                 emitted += self._emit(i, toks[i].tolist())
                 self._len[i] += self._sync
                 self._cur[i] = toks[i, -1]
         else:
             with m.span_spec if m is not None else _NULL_CTX:
-                blk, j, cur, self._caches, self._hist, self._hist_len = \
+                blk, j, cur, _, self._caches, self._hist, self._hist_len = \
                     serving_spec_step(
                         self._params, self._cfg, jnp.asarray(self._cur),
                         self._caches, dev_len, self._hist, self._hist_len,
-                        jnp.asarray(active), spec_k=self._spec_k)
-                blk, j, cur = np.asarray(blk), np.asarray(j), np.asarray(cur)
+                        jnp.asarray(active), spec_k=self._spec_k,
+                        chunk_size=self._chunk)
+                blk, j, cur = _host_fetch(blk, j, cur)
             accepted = 0
             for i in live:
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
@@ -429,6 +514,105 @@ class ServingEngine:
                 # j of them (the +1 bonus token is the verify forward's own
                 # pick, not a draft)
                 m.spec_round(self._spec_k * len(live), accepted)
+        return emitted
+
+    # --------------------------------------------------- pipelined dispatch
+    def _dispatch(self):
+        """Dispatch the next decode step WITHOUT waiting for the inflight
+        one.  The step's inputs are all device-resident: the carried
+        ``cur`` tokens / lengths of the previous dispatch (still futures —
+        the device executes in program order) plus the caches; slots
+        admitted since the last dispatch mix their host-known first token
+        and prompt length into the carry."""
+        live = [i for i in range(self._B) if self._reqs[i] is not None]
+        if not live:
+            return
+        m = self._m
+        active = np.array([r is not None for r in self._reqs])
+        host_len = masked_lengths(jnp.asarray(self._len),
+                                  jnp.asarray(active), self._lmax)
+        use_host = ~active
+        use_host[list(self._adm_pending)] = True
+        if self._dev_cur is None:
+            cur = jnp.asarray(self._cur)
+        else:
+            cur = jnp.where(jnp.asarray(use_host), jnp.asarray(self._cur),
+                            self._dev_cur)
+        if self._mode == "greedy":
+            # greedy lengths are host-derivable: every live slot advances
+            # exactly sync_every per dispatch, so the mirror (bumped below)
+            # IS the device value and needs no device carry
+            with m.span_decode if m is not None else _NULL_CTX:
+                toks, self._caches = serving_decode_steps(
+                    self._params, self._cfg, cur, self._caches, host_len,
+                    n_steps=self._sync, chunk_size=self._chunk)
+            self._dev_cur = toks[:, -1]
+            for i in live:
+                self._len[i] += self._sync
+            self._inflight = {"kind": "greedy", "toks": toks,
+                              "reqs": list(self._reqs), "live": live}
+        else:
+            if self._dev_len is None:
+                dev_len = host_len
+            else:
+                # spec lengths advance by the DEVICE-known j+1, so the
+                # carry comes back from serving_spec_step; host values are
+                # authoritative only for just-admitted (prompt length) and
+                # freed (masked to lmax) slots
+                dev_len = jnp.where(jnp.asarray(use_host), host_len,
+                                    self._dev_len)
+            with m.span_spec if m is not None else _NULL_CTX:
+                blk, j, cur2, new_len, self._caches, self._hist, \
+                    self._hist_len = serving_spec_step(
+                        self._params, self._cfg, cur, self._caches,
+                        dev_len, self._hist, self._hist_len,
+                        jnp.asarray(active), spec_k=self._spec_k,
+                        chunk_size=self._chunk)
+            self._dev_cur, self._dev_len = cur2, new_len
+            self._inflight = {"kind": "spec", "blk": blk, "j": j,
+                              "reqs": list(self._reqs), "live": live}
+        self._adm_pending.clear()
+        if m is not None:
+            m.inflight.set(1)
+
+    def _drain(self):
+        """Sync the PREVIOUS dispatch's tokens and run the host-side emit /
+        retire bookkeeping for it.  A slot whose Request object changed
+        since that dispatch (retired, or retired-and-readmitted) gets its
+        stale tokens discarded — the host-visible half of the one-step-late
+        retirement invariant."""
+        rec, self._inflight = self._inflight, None
+        if rec is None:
+            return 0
+        m = self._m
+        t0 = time.perf_counter()
+        emitted = 0
+        if rec["kind"] == "greedy":
+            (toks,) = _host_fetch(rec["toks"])
+            if m is not None:
+                m.pipeline_stall.observe(time.perf_counter() - t0)
+                m.inflight.set(0)
+            for i in rec["live"]:
+                if self._reqs[i] is not rec["reqs"][i]:
+                    continue
+                emitted += self._emit(i, toks[i].tolist())
+                self._cur[i] = toks[i, -1]
+        else:
+            blk, j = _host_fetch(rec["blk"], rec["j"])
+            if m is not None:
+                m.pipeline_stall.observe(time.perf_counter() - t0)
+                m.inflight.set(0)
+            accepted = 0
+            drained = 0
+            for i in rec["live"]:
+                if self._reqs[i] is not rec["reqs"][i]:
+                    continue
+                drained += 1
+                emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
+                self._len[i] += int(j[i]) + 1
+                accepted += int(j[i])
+            if m is not None and drained:
+                m.spec_round(self._spec_k * drained, accepted)
         return emitted
 
     def run(self):

@@ -142,6 +142,107 @@ class TestDecodeAttention:
         np.testing.assert_array_equal(np.asarray(admit), [Lmax, 0, Lmax])
 
 
+class TestChunkedDecodeAttention:
+    """Parity matrix for the length-adaptive chunked read (chunk_size):
+    the online-softmax while_loop must be allclose-identical to the fused
+    full-length read on every LIVE row.  Rows parked by masked_lengths
+    (offset lmax) are excluded from the trip count BY DESIGN — the full
+    path attends over everything while the chunked path reads only the
+    chunks live rows need, so parked rows' (documented-garbage, scheduler-
+    ignored) outputs differ; the tests assert those stay finite and that
+    cache/length updates are byte-equal everywhere."""
+
+    def _pair(self, lens, Lmax, T=1, h=4, hkv=2, d=16, layout="blhd",
+              chunk=16, bias=False, seed=0):
+        from paddle_tpu.ops.decode_attention import decode_attention
+
+        B = len(lens)
+        ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+        q = jax.random.normal(ks[0], (B, T, h, d), jnp.float32)
+        kn = jax.random.normal(ks[1], (B, T, hkv, d), jnp.float32)
+        vn = jax.random.normal(ks[2], (B, T, hkv, d), jnp.float32)
+        shape = (B, Lmax, hkv, d) if layout == "blhd" else (B, hkv, Lmax, d)
+        kc = jax.random.normal(ks[3], shape, jnp.float32)
+        vc = jax.random.normal(ks[4], shape, jnp.float32)
+        ab = (jax.random.normal(ks[5], (B, 1, T, Lmax), jnp.float32)
+              if bias else None)
+        lengths = jnp.asarray(lens, jnp.int32)
+        full = decode_attention(q, kn, vn, kc, vc, lengths, layout=layout,
+                                attn_bias=ab)
+        chunked = decode_attention(q, kn, vn, kc, vc, lengths, layout=layout,
+                                   attn_bias=ab, chunk_size=chunk)
+        return full, chunked
+
+    def _assert_parity(self, full, chunked, lens, Lmax):
+        fo, fk, fv, fl = full
+        co, ck, cv, cl = chunked
+        live = np.asarray(lens) < Lmax
+        if live.any():
+            np.testing.assert_allclose(np.asarray(co)[live],
+                                       np.asarray(fo)[live],
+                                       rtol=2e-5, atol=2e-5)
+        # parked rows: garbage but FINITE (the online-softmax denominator
+        # never goes to zero — chunk 0 always runs)
+        assert np.isfinite(np.asarray(co)).all()
+        # cache and length updates are byte-equal regardless of read path
+        np.testing.assert_array_equal(np.asarray(ck), np.asarray(fk))
+        np.testing.assert_array_equal(np.asarray(cv), np.asarray(fv))
+        np.testing.assert_array_equal(np.asarray(cl), np.asarray(fl))
+
+    @pytest.mark.parametrize("layout", ["blhd", "bhld"])
+    def test_ragged_lengths_both_layouts(self, layout):
+        lens = [0, 5, 23, 47]
+        full, chunked = self._pair(lens, Lmax=48, layout=layout, chunk=16)
+        self._assert_parity(full, chunked, lens, 48)
+
+    @pytest.mark.parametrize("layout", ["blhd", "bhld"])
+    def test_multi_token_with_bias(self, layout):
+        """T>1 (the spec-verify forward) + attn_bias, both layouts."""
+        lens = [3, 11, 28]
+        full, chunked = self._pair(lens, Lmax=32, T=3, layout=layout,
+                                   chunk=8, bias=True, seed=2)
+        self._assert_parity(full, chunked, lens, 32)
+
+    def test_non_divisible_lmax_and_odd_chunk(self):
+        """lmax % C != 0: the clamped tail chunk re-reads the overlap and
+        must mask it out (no double count) — include a full-length row so
+        the tail chunk actually runs."""
+        for chunk in (16, 7):
+            lens = [59, 12, 0]
+            full, chunked = self._pair(lens, Lmax=60, chunk=chunk, seed=3)
+            self._assert_parity(full, chunked, lens, 60)
+
+    def test_all_retired_batch_stays_finite(self):
+        """Every slot parked at offset lmax (masked_lengths): trip count
+        clamps to 1, outputs are finite garbage, cache survives untouched
+        (writes drop on both paths)."""
+        from paddle_tpu.ops.decode_attention import masked_lengths
+
+        Lmax = 32
+        lens = np.asarray(masked_lengths(
+            jnp.asarray([4, 9, 31], jnp.int32),
+            jnp.zeros((3,), bool), Lmax)).tolist()
+        full, chunked = self._pair(lens, Lmax=Lmax, chunk=8, seed=4)
+        self._assert_parity(full, chunked, lens, Lmax)
+
+    def test_admission_prefill_lengths_zero(self):
+        """The serving admission shape: one slot at offset 0 (prefilling),
+        the rest parked at lmax — the mix the engine dispatches on every
+        admit."""
+        lens = [0, 40, 40]
+        full, chunked = self._pair(lens, Lmax=40, chunk=16, T=4, seed=5)
+        self._assert_parity(full, chunked, lens, 40)
+
+    def test_chunk_at_least_lmax_falls_back_bitwise(self):
+        """chunk_size >= Lmax routes to the fused full read — outputs are
+        BITWISE identical, not just allclose."""
+        for chunk in (32, 64):
+            full, chunked = self._pair([3, 17, 30], Lmax=32, chunk=chunk,
+                                       seed=6)
+            np.testing.assert_array_equal(np.asarray(chunked[0]),
+                                          np.asarray(full[0]))
+
+
 class TestMaskedMultiheadAttention:
     def test_matches_dense_with_mask_and_bias(self):
         import paddle_tpu.incubate.nn.functional as IF

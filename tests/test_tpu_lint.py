@@ -210,6 +210,44 @@ class TestRuleFixtures:
                 return out
         """) == []
 
+    def test_host_sync_tp_numpy_method(self):
+        # paddle-tensor readback: .numpy() blocks like .item()
+        assert _rules("""
+            def train(step_fn, batches):
+                for b in batches:
+                    loss = step_fn(b)
+                    print(loss.numpy())
+        """) == ["PTL004"]
+
+    def test_host_sync_tn_sanctioned_host_fetch(self):
+        # the deferred-readback helper (serving/engine.py) is the
+        # SANCTIONED sync point of the pipelined drain: routed calls are
+        # never recorded, a raw np.asarray next to it still is
+        assert _rules("""
+            import numpy as np
+            from paddle_tpu.serving.engine import _host_fetch
+            def drain(engine, xs):
+                out = []
+                for x in xs:
+                    y = engine.step(x)
+                    (t,) = _host_fetch(y)
+                    out.append(t)
+                return out
+        """) == []
+
+    def test_host_sync_tp_raw_asarray_beside_sanctioned(self):
+        assert _rules("""
+            import numpy as np
+            from paddle_tpu.serving.engine import _host_fetch
+            def drain(engine, xs):
+                out = []
+                for x in xs:
+                    y = engine.step(x)
+                    (t,) = _host_fetch(y)
+                    out.append(np.asarray(y))
+                return out
+        """) == ["PTL004"]
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
