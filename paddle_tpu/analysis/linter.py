@@ -52,12 +52,15 @@ _IMPURE_TIME = {"time.time", "time.perf_counter", "time.monotonic",
 # host-sync calls inside step loops (PTL004)
 _SYNC_NP = {"numpy.asarray", "numpy.array"}
 _SYNC_METHODS = {"block_until_ready", "item", "numpy"}
-# the deferred-readback helper (serving/engine.py `_host_fetch`) is the
-# SANCTIONED sync point of a pipelined dispatch loop: the drain side must
-# block exactly once per iteration by design, so calls routed through this
-# name are never recorded as PTL004 syncs — raw np.asarray/.numpy() added
-# next to it still is
-_SYNC_SANCTIONED = {"host_fetch", "_host_fetch"}
+# deferred-readback helpers (serving/engine.py `_host_fetch`): a call with
+# this name blocks like the np.asarray it wraps, so PTL004 models it as a
+# sync — and then exempts it as the SANCTIONED once-per-iteration drain of
+# a pipelined dispatch loop, but only when the name RESOLVES to a
+# host-fetch helper (the canonical engine import, or a bare/attribute
+# spelling of a local helper).  A raw sync primitive smuggled in under the
+# name — `from numpy import asarray as host_fetch` — resolves to
+# numpy.asarray instead and stays flagged.
+_SYNC_HELPERS = {"host_fetch", "_host_fetch"}
 _STEP_NAME_RE = re.compile(r"(^|_)steps?($|_)")
 
 
@@ -576,11 +579,18 @@ class _Checker:
                 sync = "np." + f.split(".")[-1] + "()"
             elif f == "jax.device_get":
                 sync = "jax.device_get()"
+            elif name in _SYNC_HELPERS:
+                sync = name + "()"
             elif isinstance(node.func, ast.Attribute) and \
                     node.func.attr in _SYNC_METHODS:
                 sync = "." + node.func.attr + "()"
-            sanctioned = name in _SYNC_SANCTIONED or (
-                f is not None and f.split(".")[-1] in _SYNC_SANCTIONED)
+            # sanction through the RESOLVED name, not the surface one: a
+            # genuine host_fetch helper (unresolvable call targets get the
+            # benefit of the doubt) is the designed drain point; an import
+            # alias of numpy.asarray/np.array resolves elsewhere and is
+            # recorded like any raw sync
+            sanctioned = name in _SYNC_HELPERS and (
+                f is None or f.split(".")[-1] in _SYNC_HELPERS)
             if sync is not None and not sanctioned:
                 rec.syncs.append((node, sync))
 

@@ -70,7 +70,9 @@ _RULE_LIST = [
         "async dispatch pipeline (the serving/training hot path).  Calls "
         "routed through the sanctioned deferred-readback helper "
         "(host_fetch/_host_fetch, serving/engine.py) are exempt: a "
-        "pipelined drain blocks exactly once per iteration by design",
+        "pipelined drain blocks exactly once per iteration by design.  "
+        "The exemption follows the RESOLVED import — aliasing "
+        "np.asarray to a host_fetch-style name does not earn it",
         "batch readbacks through _host_fetch outside the loop, or sync "
         "once per block (sync_every-style) instead of per iteration",
     ),

@@ -188,9 +188,13 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
     acc0 = jnp.zeros((b, hkv, g, t, d), jnp.float32)
     _, _, l, acc = jax.lax.while_loop(
         lambda carry: carry[0] < trip, body, (z, m0, l0, acc0))
-    # l > 0 always: chunk 0 runs unconditionally and position 0 is causally
-    # visible to every query (q_pos >= 0)
-    return acc / l[..., None]
+    # chunk 0 runs unconditionally and position 0 is causally visible to
+    # every query (q_pos >= 0), so l > 0 for any FINITE attn_bias — but a
+    # bias of -inf over every visible position of a row zeroes its whole
+    # denominator.  Guard the division so that row comes back 0 (finite
+    # garbage, like the full path's softmax over all-masked scores) instead
+    # of NaN.
+    return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
 @functools.partial(jax.jit,

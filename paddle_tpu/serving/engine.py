@@ -467,8 +467,15 @@ class ServingEngine:
         if not self._pipeline:
             self._adm_pending.clear()
             return self._step_sync()
+        # the double buffer: stash the record of the PREVIOUS iteration's
+        # dispatch, issue the next dispatch, and only then drain the stash —
+        # step N+1 is outstanding on the device while step N's tokens are
+        # synced and its emit/retire bookkeeping runs.  When _dispatch has
+        # nothing to issue (e.g. every slot retired at the last drain) the
+        # stashed record is still drained, so run() terminates.
+        prev, self._inflight = self._inflight, None
         self._dispatch()
-        return self._drain()
+        return self._drain(prev)
 
     # ------------------------------------------------- synchronous baseline
     def _step_sync(self):
@@ -518,12 +525,13 @@ class ServingEngine:
 
     # --------------------------------------------------- pipelined dispatch
     def _dispatch(self):
-        """Dispatch the next decode step WITHOUT waiting for the inflight
-        one.  The step's inputs are all device-resident: the carried
-        ``cur`` tokens / lengths of the previous dispatch (still futures —
-        the device executes in program order) plus the caches; slots
-        admitted since the last dispatch mix their host-known first token
-        and prompt length into the carry."""
+        """Dispatch the next decode step WITHOUT waiting for the previous
+        one (still undrained — ``_step_impl`` holds its record).  The
+        step's inputs are all device-resident: the carried ``cur`` tokens /
+        lengths of the previous dispatch (still futures — the device
+        executes in program order) plus the caches; slots admitted since
+        the last dispatch mix their host-known first token and prompt
+        length into the carry."""
         live = [i for i in range(self._B) if self._reqs[i] is not None]
         if not live:
             return
@@ -575,23 +583,27 @@ class ServingEngine:
         if m is not None:
             m.inflight.set(1)
 
-    def _drain(self):
-        """Sync the PREVIOUS dispatch's tokens and run the host-side emit /
-        retire bookkeeping for it.  A slot whose Request object changed
-        since that dispatch (retired, or retired-and-readmitted) gets its
-        stale tokens discarded — the host-visible half of the one-step-late
-        retirement invariant."""
-        rec, self._inflight = self._inflight, None
+    def _drain(self, rec):
+        """Sync the PREVIOUS iteration's dispatch (handed over by
+        ``_step_impl`` after the next one is already issued) and run the
+        host-side emit / retire bookkeeping for it.  A slot whose Request
+        object changed since that dispatch (retired, or
+        retired-and-readmitted) gets its stale tokens discarded — the
+        host-visible half of the one-step-late retirement invariant."""
         if rec is None:
             return 0
         m = self._m
+        # the freshly issued dispatch (if any) stays outstanding through
+        # this drain — that overlap is the point; the gauge must not claim
+        # the pipe is empty just because THIS record got synced
+        still_inflight = 1 if self._inflight is not None else 0
         t0 = time.perf_counter()
         emitted = 0
         if rec["kind"] == "greedy":
             (toks,) = _host_fetch(rec["toks"])
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
-                m.inflight.set(0)
+                m.inflight.set(still_inflight)
             for i in rec["live"]:
                 if self._reqs[i] is not rec["reqs"][i]:
                     continue
@@ -601,7 +613,7 @@ class ServingEngine:
             blk, j = _host_fetch(rec["blk"], rec["j"])
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
-                m.inflight.set(0)
+                m.inflight.set(still_inflight)
             accepted = 0
             drained = 0
             for i in rec["live"]:

@@ -233,6 +233,26 @@ class TestChunkedDecodeAttention:
         full, chunked = self._pair(lens, Lmax=40, chunk=16, T=4, seed=5)
         self._assert_parity(full, chunked, lens, 40)
 
+    def test_all_neg_inf_bias_row_stays_finite(self):
+        """A -inf attn_bias over every causally visible position of a row
+        zeroes the online-softmax denominator; the guarded division must
+        return finite garbage (like the full path), never NaN."""
+        from paddle_tpu.ops.decode_attention import decode_attention
+
+        B, T, h, hkv, d, Lmax = 2, 1, 4, 2, 16, 32
+        ks = jax.random.split(jax.random.PRNGKey(7), 5)
+        q = jax.random.normal(ks[0], (B, T, h, d), jnp.float32)
+        kn = jax.random.normal(ks[1], (B, T, hkv, d), jnp.float32)
+        vn = jax.random.normal(ks[2], (B, T, hkv, d), jnp.float32)
+        kc = jax.random.normal(ks[3], (B, Lmax, hkv, d), jnp.float32)
+        vc = jax.random.normal(ks[4], (B, Lmax, hkv, d), jnp.float32)
+        ab = jnp.zeros((B, 1, T, Lmax), jnp.float32)
+        ab = ab.at[0].set(-jnp.inf)  # row 0: every position masked out
+        lengths = jnp.asarray([5, 9], jnp.int32)
+        out, _, _, _ = decode_attention(q, kn, vn, kc, vc, lengths,
+                                        attn_bias=ab, chunk_size=8)
+        assert np.isfinite(np.asarray(out)).all()
+
     def test_chunk_at_least_lmax_falls_back_bitwise(self):
         """chunk_size >= Lmax routes to the fused full read — outputs are
         BITWISE identical, not just allclose."""

@@ -243,6 +243,23 @@ class TestPipelinedDispatch:
                 np.testing.assert_array_equal(pipe[i].output_ids,
                                               sync[i].output_ids)
 
+    def test_step_leaves_a_dispatch_outstanding(self):
+        """The double buffer is real: each iteration drains the PREVIOUS
+        iteration's dispatch, so between scheduler iterations exactly one
+        dispatched step stays inflight (regression: dispatch-then-drain of
+        the SAME record in one iteration — no overlap at all)."""
+        model = _tiny_model(seed=11)
+        eng = ServingEngine(model, batch_size=1, max_len=64, pipeline=True)
+        r = eng.submit(Request(np.arange(1, 7), 4))
+        eng.step()  # admit (first token via prefill) + dispatch step 1
+        assert eng._inflight is not None
+        assert len(r.output_ids) == 1
+        eng.step()  # dispatch step 2, drain step 1
+        assert eng._inflight is not None
+        assert len(r.output_ids) == 2
+        eng.run()
+        assert r.done and eng._inflight is None and len(r.output_ids) == 4
+
     def test_retire_during_inflight_step(self):
         """Regression: a slot retiring (EOS) at drain time while the NEXT
         step over its old request is already dispatched.  The stale
@@ -265,6 +282,24 @@ class TestPipelinedDispatch:
         assert r0.done and r0.output_ids == full.output_ids[:3]
         assert r1.done
         np.testing.assert_array_equal(r1.output_ids, ref.output_ids)
+
+    def test_ragged_serving_steps_are_retrace_free(self):
+        """Acceptance: once a warmup run has traced the prefill bucket and
+        the decode step, a second mixed ragged run — admissions,
+        retirements, pipelined double-buffered dispatch, chunked reads —
+        triggers ZERO retraces: the chunked trip count is a traced scalar,
+        not a shape, and every scheduler iteration reuses the same
+        compiled programs."""
+        from paddle_tpu.analysis import assert_no_retrace
+
+        model = _tiny_model(seed=12)
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(0, 256, (p,)) for p in (5, 9, 14, 7)]
+        new_lens = [6, 4, 9, 5]
+        kw = dict(batch_size=2, max_len=64, decode_chunk=16, pipeline=True)
+        _run(model, prompts, new_lens, **kw)  # warmup: the legitimate traces
+        with assert_no_retrace():
+            _run(model, prompts, new_lens, **kw)
 
     def test_pipeline_metrics_and_full_drain(self):
         """run() leaves no step inflight; the stall histogram saw every

@@ -248,6 +248,35 @@ class TestRuleFixtures:
                 return out
         """) == ["PTL004"]
 
+    def test_host_sync_tp_numpy_aliased_to_host_fetch(self):
+        # the exemption follows the RESOLVED import: smuggling the raw
+        # primitive in under the helper's name earns no sanction
+        assert _rules("""
+            from numpy import asarray as host_fetch
+            def drain(engine, xs):
+                out = []
+                for x in xs:
+                    y = engine.step(x)
+                    out.append(host_fetch(y))
+                return out
+        """) == ["PTL004"]
+
+    def test_host_sync_tn_local_host_fetch_helper(self):
+        # a locally defined funneling helper is the same design pattern as
+        # the engine's — sanctioned through its (bare) resolved name
+        assert _rules("""
+            import numpy as np
+            def _host_fetch(*arrays):
+                return [np.asarray(a) for a in arrays]
+            def drain(engine, xs):
+                out = []
+                for x in xs:
+                    y = engine.step(x)
+                    (t,) = _host_fetch(y)
+                    out.append(t)
+                return out
+        """) == []
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
