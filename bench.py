@@ -247,7 +247,19 @@ def bench_serving(n_requests=64, batch=8):
     (``serving_low_occ_*``: short contexts in the same Lmax=2048 cache —
     the regime where chunked reads win big; the standard mixed workload
     doubles as the full-occupancy column, where the requirement is merely
-    no regression)."""
+    no regression).
+
+    Round 10 adds the chunked-prefill A/B on a long-prompt-heavy mix
+    (prompts at the top of the bucket range, modest outputs — admissions
+    keep landing while residents decode): ``serving_chunked_prefill_speedup``
+    (budgeted chunk interleaving vs the monolithic per-bucket prefill),
+    ``serving_adm_tpot_p95_ms_{monolithic,chunked}`` (p95 of
+    ``serving_tpot_during_admission_seconds`` — decode interference while
+    admission work is in flight, the stall the chunking exists to bound),
+    and ``serving_prefill_programs_{monolithic,chunked}`` (one program per
+    touched bucket before — the A/B-run trace delta — vs the process-wide
+    chunked total after: O(1) regardless of prompt lengths served — read
+    off the llama_decode CompileCacheMonitor)."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import MetricsRegistry
     from paddle_tpu.serving import Request, ServingEngine
@@ -345,6 +357,38 @@ def bench_serving(n_requests=64, batch=8):
     dt_lc, _, _ = run("continuous", "greedy", reqs=list(lo_reqs))
     dt_lf, _, _ = run("continuous", "greedy", reqs=list(lo_reqs),
                       decode_chunk=None)
+    # A/B 3 (round 10) — chunked prefill vs monolithic per-bucket prefill
+    # on a long-prompt-heavy mix; program counts are trace-count deltas
+    from paddle_tpu.models.llama_decode import _mon as _dec_mon
+    lp_n = max(8, n_requests // 2)
+    lp_p = rng.integers(max(p_lo, int(p_hi * 0.6)), p_hi, lp_n)
+    lp_o = rng.integers(o_lo, max(o_lo + 1, o_hi // 2), lp_n)
+    lp_reqs = [(np.tile(rng.integers(0, cfg.vocab_size, 32),
+                        p // 32 + 1)[:p], o) for p, o in zip(lp_p, lp_o)]
+    pchunk = 64 if small else 256
+
+    def adm_tpot_p95_ms(reg):
+        h = reg.get("serving_tpot_during_admission_seconds").labels(
+            policy="continuous")
+        return round(h.percentile(95) * 1e3, 1) if h.count else None
+
+    def traces(key):
+        return _dec_mon.trace_counts().get(key, 0)
+
+    mono0 = traces("serving_prefill_slot")
+    run("continuous", "greedy", reqs=list(lp_reqs), prefill_chunk=None)
+    dt_mp, _, reg_mp = run("continuous", "greedy", reqs=list(lp_reqs),
+                           prefill_chunk=None)
+    mono_programs = traces("serving_prefill_slot") - mono0
+    run("continuous", "greedy", reqs=list(lp_reqs), prefill_chunk=pchunk)
+    dt_cp, _, reg_cp = run("continuous", "greedy", reqs=list(lp_reqs),
+                           prefill_chunk=pchunk)
+    # process-wide total: EVERY chunked run in this bench, across every
+    # distinct prompt length served, compiled this many prefill programs
+    # (one per static config — chunk width x spec-mode hist; the
+    # monolithic delta above is one per touched bucket for the A/B
+    # workload alone)
+    chunk_programs = traces("serving_prefill_chunk")
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -376,6 +420,12 @@ def bench_serving(n_requests=64, batch=8):
             stall.percentile(50) * 1e3, 2),
         "serving_low_occ_tok_per_sec": round(lo_new / dt_lc, 1),
         "serving_low_occ_chunked_speedup": round(dt_lf / dt_lc, 2),
+        # chunked-prefill A/B (round 10): stall-free admission
+        "serving_chunked_prefill_speedup": round(dt_mp / dt_cp, 2),
+        "serving_adm_tpot_p95_ms_monolithic": adm_tpot_p95_ms(reg_mp),
+        "serving_adm_tpot_p95_ms_chunked": adm_tpot_p95_ms(reg_cp),
+        "serving_prefill_programs_monolithic": mono_programs,
+        "serving_prefill_programs_chunked": chunk_programs,
         # analytic achieved-HBM estimate: bytes a step MUST move per token
         # on each read path, and that figure scaled by the measured rate
         "serving_hbm_gb_per_tok_full": round(hbm_gb_per_tok(lmax), 4),

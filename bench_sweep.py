@@ -12,6 +12,12 @@ regression-free at high occupancy becomes ServingEngine's ``decode_chunk``
 default — 256 on the v5e-class chip this grew up on: small enough that a
 128-token batch reads 1/8th of the cache, large enough that the per-chunk
 while_loop overhead stays under the noise floor at full occupancy.
+
+Round 10 adds ``python -u bench_sweep.py prefill_chunk``: a prefill
+chunk-size x budget sweep over a long-prompt serving run (end-to-end
+time + TPOT-p95-during-admission per variant, monolithic baseline
+included) — the source of ServingEngine's ``prefill_chunk=256`` /
+``prefill_budget=2`` defaults.
 """
 from __future__ import annotations
 
@@ -135,12 +141,79 @@ def sweep_decode_chunk(iters=20, n_steps=8):
     return rows
 
 
+PREFILL_CHUNKS = [64, 128, 256, 512]
+PREFILL_BUDGETS = [1, 2, 4]
+
+
+def sweep_prefill_chunk(n_requests=24):
+    """Chunk-size x budget sweep for budgeted chunked prefill: end-to-end
+    time and TPOT-p95-during-admission of a long-prompt-heavy serving run
+    (prompts 1024-1792 in an Lmax=2048 cache, outputs 64-128 — admissions
+    keep landing while residents decode) at each (prefill_chunk,
+    prefill_budget), against the monolithic per-bucket baseline
+    (``prefill_chunk=None``).  Picks the engine defaults: the smallest
+    interference number that doesn't cost end-to-end throughput."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.serving import Request, ServingEngine
+
+    lmax, batch = 2048, 8
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    plens = rng.integers(1024, 1793, n_requests)
+    olens = rng.integers(64, 129, n_requests)
+    reqs = [(np.tile(rng.integers(0, cfg.vocab_size, 32),
+                     p // 32 + 1)[:p], int(o)) for p, o in zip(plens, olens)]
+    total_new = int(olens.sum())
+
+    def run(pchunk, budget):
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=batch, max_len=lmax,
+                            sync_every=4, registry=reg,
+                            prefill_chunk=pchunk, prefill_budget=budget)
+        for p, o in reqs:
+            eng.submit(Request(p, o))
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        h = reg.get("serving_tpot_during_admission_seconds").labels(
+            policy="continuous")
+        p95 = round(h.percentile(95) * 1e3, 1) if h.count else None
+        return dt, p95
+
+    rows = []
+    variants = [(None, 1)] + [(c, b) for c in PREFILL_CHUNKS
+                              for b in PREFILL_BUDGETS]
+    for pchunk, budget in variants:
+        run(pchunk, budget)  # warm this configuration's programs
+        dt, p95 = run(pchunk, budget)
+        name = ("prefill_monolithic" if pchunk is None
+                else f"prefill_chunk_{pchunk}_budget_{budget}")
+        rows.append({"variant": name, "e2e_s": round(dt, 2),
+                     "tok_per_sec": round(total_new / dt, 1),
+                     "adm_tpot_p95_ms": p95})
+        gc.collect()
+    return rows
+
+
 def main():
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "bench_sweep.jsonl")
     import sys
     if len(sys.argv) > 1 and sys.argv[1] == "decode_chunk":
         for rec in sweep_decode_chunk():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "prefill_chunk":
+        for rec in sweep_prefill_chunk():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

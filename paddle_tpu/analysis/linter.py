@@ -61,7 +61,11 @@ _SYNC_METHODS = {"block_until_ready", "item", "numpy"}
 # name — `from numpy import asarray as host_fetch` — resolves to
 # numpy.asarray instead and stays flagged.
 _SYNC_HELPERS = {"host_fetch", "_host_fetch"}
-_STEP_NAME_RE = re.compile(r"(^|_)steps?($|_)")
+# loops dispatching compiled per-iteration device work: decode/spec step
+# calls (`..._step`/`..._steps`) and the serving engine's chunked-prefill
+# dispatch loop (`serving_prefill_chunk` under `prefill_budget`) — a host
+# sync inside either serializes the pipeline the same way
+_STEP_NAME_RE = re.compile(r"(^|_)(steps?|prefill_chunk)($|_)")
 
 
 @dataclass

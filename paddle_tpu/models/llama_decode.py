@@ -31,11 +31,13 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.observability.compilecache import CompileCacheMonitor
-from paddle_tpu.ops.decode_attention import decode_attention, init_kv_cache
+from paddle_tpu.ops.decode_attention import (
+    decode_attention, init_kv_cache, slot_prefill_attention,
+)
 
 __all__ = ["extract_decode_params", "decode_greedy", "decode_speculative",
-           "serving_prefill_slot", "serving_decode_steps",
-           "serving_spec_step"]
+           "serving_prefill_slot", "serving_prefill_chunk",
+           "serving_decode_steps", "serving_spec_step"]
 
 # compile-cache visibility (paddle_tpu/observability): each jitted program
 # marks its traces from inside the traced body (host python there runs once
@@ -121,6 +123,15 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
     return h, k_cache, v_cache
 
 
+def _lm_logits(params, h):
+    """Project hidden states to vocab logits — a tied embedding unless the
+    checkpoint carries a separate lm_head (pytree-structure branch, so it
+    specializes at trace time)."""
+    if "lm_head" in params:
+        return h @ params["lm_head"]
+    return h @ params["embed"].T.astype(h.dtype)
+
+
 def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
              chunk_size=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
@@ -141,10 +152,7 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
         h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
     elif last_only:
         h = h[:, -1]  # [B, hidden]
-    if "lm_head" in params:
-        logits = h @ params["lm_head"]
-    else:
-        logits = h @ params["embed"].T.astype(h.dtype)
+    logits = _lm_logits(params, h)
     return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
 
 
@@ -459,6 +467,104 @@ def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
 
 serving_prefill_slot = _mon.wrap("serving_prefill_slot",
                                  serving_prefill_slot)
+
+
+def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
+                         cos_t, sin_t, chunk_size=None):
+    """One decoder layer over a [1, P] prompt chunk, writing/reading the
+    SLOT'S rows of the shared batch cache (ops.slot_prefill_attention) —
+    the chunked-prefill twin of ``_layer_step``, which operates on whole
+    per-batch caches at per-batch offsets."""
+    b, t, hidden = h.shape
+    nh, nkv, hd, eps = cfg
+    x = _rmsnorm(h, lp["ln1"], eps)
+    q = (x @ lp["wq"]).reshape(b, t, nh, hd)
+    k = (x @ lp["wk"]).reshape(b, t, nkv, hd)
+    v = (x @ lp["wv"]).reshape(b, t, nkv, hd)
+    positions = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q, k = _rope_at(q, k, cos_t, sin_t, positions)
+    out, k_cache, v_cache = slot_prefill_attention(
+        q, k, v, k_cache, v_cache, slot, offset, chunk_size=chunk_size)
+    h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
+    x2 = _rmsnorm(h, lp["ln2"], eps)
+    h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
+    return h, k_cache, v_cache
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "with_hist", "chunk_size"),
+                   donate_argnames=("caches", "hist"))
+def serving_prefill_chunk(params, cfg, tokens, offset, prompt_len, caches,
+                          slot, hist=None, hist_len=None, with_hist=False,
+                          chunk_size=None):
+    """Process the next ``[1, P]`` chunk of an admitted prompt against the
+    slot's rows of the batch cache — ONE compiled program for every prompt
+    length (``P`` is the only shape; ``offset``, ``prompt_len`` and
+    ``slot`` are traced operands), replacing the per-bucket
+    ``serving_prefill_slot`` program family.
+
+    ``tokens [1, P]`` is the chunk, right-padded past the prompt tail;
+    ``offset`` (traced scalar) is the device-carried write cursor — chunk
+    rows land at cache positions ``offset + i`` and attend causally over
+    every previously written row plus the intra-chunk prefix
+    (ops.slot_prefill_attention), so chaining chunks at offsets 0, P,
+    2P, ... reproduces the monolithic prefill's mask exactly.  Tail pads
+    write garbage rows at positions ``>= prompt_len`` — causally invisible
+    and overwritten by decode appends (the monolithic bucket-pad
+    invariant).  Every chunk computes the greedy pick at the prompt's last
+    column RELATIVE to itself (``clip(prompt_len - 1 - offset, 0, P-1)``)
+    — only the final chunk's pick is meaningful (the request's first
+    token); earlier chunks return garbage the scheduler ignores, which
+    keeps the program count at one instead of a final-chunk variant.
+
+    With ``with_hist`` the slot's prompt-lookup history row accretes in
+    the same program: chunk tokens at ``offset + i`` (< lmax rows only),
+    and — gated on this being the final chunk (``offset + P >=
+    prompt_len``) — the first token at ``prompt_len`` with ``hist_len``
+    set to ``prompt_len + 1``.  Rows beyond ``hist_len`` may hold a prior
+    occupant's stale tokens; ``_ngram_draft`` masks its match scan by
+    ``hist_len``, and a stale token drafted past the frontier only ever
+    costs acceptance length, never output bytes (_verify_and_emit emits
+    the verify forward's own picks).
+
+    Returns (first [1], caches', hist', hist_len')."""
+    _mon.mark_trace("serving_prefill_chunk")
+    t = tokens.shape[1]
+    nh, nkv, hd, eps = cfg
+    offset = offset.astype(jnp.int32)
+    slot = slot.astype(jnp.int32)
+    h = params["embed"][tokens]                             # [1, P, hidden]
+    cos_t, sin_t = params["_rope"]
+    new_caches = []
+    for lp, (kc, vc) in zip(params["layers"], caches):
+        h, kc, vc = _layer_prefill_chunk(lp, cfg, h, kc, vc, slot, offset,
+                                         cos_t, sin_t, chunk_size=chunk_size)
+        new_caches.append((kc, vc))
+    h = _rmsnorm(h, params["norm"], eps)
+    last_rel = jnp.clip(prompt_len - 1 - offset, 0, t - 1)  # [1]
+    h = jnp.take_along_axis(h, last_rel[:, None, None], axis=1)[:, 0]
+    logits = _lm_logits(params, h)
+    first = jnp.argmax(logits.astype(jnp.float32), axis=-1) \
+        .astype(jnp.int32)                                  # [1]
+    if with_hist:
+        lmax = hist.shape[1]
+        is_final = offset + t >= prompt_len[0]
+        cols = offset + jnp.arange(t, dtype=jnp.int32)
+        hist = hist.at[jnp.full((t,), slot, jnp.int32), cols].set(
+            tokens[0].astype(jnp.int32), mode="drop")
+        # the first token lands at prompt_len only once the pick is real
+        # (final chunk); otherwise the write is routed past capacity
+        fcol = jnp.where(is_final,
+                         jnp.clip(prompt_len[0], 0, lmax - 1),
+                         jnp.int32(lmax))
+        hist = hist.at[slot, fcol].set(first[0], mode="drop")
+        hist_len = hist_len.at[slot].set(
+            jnp.where(is_final, prompt_len[0] + 1, hist_len[slot]))
+    return first, new_caches, hist, hist_len
+
+
+serving_prefill_chunk = _mon.wrap("serving_prefill_chunk",
+                                  serving_prefill_chunk)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "chunk_size"),

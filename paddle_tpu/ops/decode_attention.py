@@ -40,7 +40,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_kv_cache", "decode_attention", "masked_lengths"]
+__all__ = ["init_kv_cache", "decode_attention", "masked_lengths",
+           "slot_prefill_attention"]
 
 _NEG_INF = -1e30
 
@@ -245,3 +246,78 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
                            layout, attn_bias)
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d).astype(q.dtype)
     return out, k_cache, v_cache, lengths + t
+
+
+def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
+                           scale=None, chunk_size=None):
+    """Chunked-prefill attention for ONE slot of the batch cache.
+
+    The serving engine's chunked admission path processes a prompt in
+    fixed-size ``[1, P]`` pieces against the SLOT'S rows of the shared
+    ``[B, Lmax]`` batch cache — not against a fresh per-bucket mini cache —
+    so one compiled program covers every prompt length.  ``slot`` and
+    ``offset`` are TRACED scalars (the device-carried write cursor): the
+    chunk's k/v rows are scattered into cache row ``slot`` at positions
+    ``offset + i`` (rows past capacity DROP, never clamp — same contract as
+    ``_append``), and the chunk's queries attend causally over the slot's
+    written prefix: query i (global position ``offset + i``) sees every
+    previously written row ``< offset`` plus the intra-chunk causal prefix
+    ``<= offset + i`` — exactly the monolithic prefill's mask restricted to
+    this chunk's query rows, so chaining the chunks reproduces the
+    monolithic forward.  Tail-chunk pad rows land in the cache as garbage
+    at positions ``>= prompt_len`` — causally invisible to every real
+    query and overwritten by decode appends, the same invariant the
+    monolithic bucket-pad path relies on.
+
+    ``chunk_size`` selects the length-adaptive chunked read over the
+    slot's row (trip count tracks ``offset + P``, not ``Lmax``); ``None``
+    keeps the fused full-length read.  Only the ``blhd`` layout (the
+    model projection order the serving path uses) is supported.
+
+    q [1, P, H, D]; k_new/v_new [1, P, Hkv, D]; caches [B, Lmax, Hkv, D].
+    Returns (out [1, P, H, D], k_cache', v_cache').
+    """
+    b, t, h, d = q.shape
+    if b != 1:
+        raise ValueError(
+            f"slot_prefill_attention: chunk batch must be 1 (got {b})")
+    hkv = k_new.shape[2]
+    lmax = k_cache.shape[1]
+    if hkv <= 0 or h % hkv:
+        raise ValueError(
+            f"slot_prefill_attention: query heads ({h}) must be an integer "
+            f"multiple of kv heads ({hkv})")
+    g = h // hkv
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    slot = slot.astype(jnp.int32) if hasattr(slot, "astype") \
+        else jnp.int32(slot)
+    offset = offset.astype(jnp.int32) if hasattr(offset, "astype") \
+        else jnp.int32(offset)
+
+    # scatter the chunk's rows into the slot (drop past capacity)
+    rows = offset + jnp.arange(t, dtype=jnp.int32)
+    batch_idx = jnp.full((t,), slot, jnp.int32)
+    k_cache = k_cache.at[batch_idx, rows].set(
+        k_new[0].astype(k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[batch_idx, rows].set(
+        v_new[0].astype(v_cache.dtype), mode="drop")
+
+    # the slot's [1, Lmax] view (slot < B: no dynamic_slice clamping)
+    ks = jax.lax.dynamic_slice(
+        k_cache, (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        (1, lmax, hkv, d))
+    vs = jax.lax.dynamic_slice(
+        v_cache, (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        (1, lmax, hkv, d))
+
+    qg = q.reshape(1, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
+        .astype(jnp.float32)                                # [1,Hkv,G,T,D]
+    q_pos = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    lengths = offset[None]                                  # [1]
+    if chunk_size is not None and int(chunk_size) < lmax:
+        out = _attend_chunked(qg, ks, vs, lengths, q_pos, scale, "blhd",
+                              None, int(chunk_size))
+    else:
+        out = _attend_full(qg, ks, vs, lengths, q_pos, scale, "blhd", None)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(1, t, h, d).astype(q.dtype)
+    return out, k_cache, v_cache

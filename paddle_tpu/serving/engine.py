@@ -10,16 +10,33 @@ program keeps ONE static compiled shape:
 * The device runs a fixed-batch-B step; a host-side scheduler retires
   finished slots (EOS / max-new-tokens) and admits queued requests into
   them *between* compiled steps.
-* Admission prefills the incoming prompt against fresh [1, bucket] mini
-  caches — cost proportional to the PROMPT, not B×bucket — and inserts
-  the rows into the batch cache at the freed slot: the ragged cache's
-  per-slot reset.  Retired slots stay parked via
+* **Chunked prefill with budgeted interleaving** (``prefill_chunk``,
+  default 256; ``prefill_budget`` chunks per scheduler step).  Admission
+  is INCREMENTAL: an admitted request enters a ``prefilling`` state and
+  its prompt is processed in fixed ``[1, P]`` chunks
+  (``serving_prefill_chunk``) written straight into the slot's rows of
+  the batch cache at a device-carried offset — ONE compiled program for
+  every prompt length (short/tail chunks are length-masked, zero
+  retraces in steady state), and each scheduler step spends at most
+  ``prefill_budget`` chunks before dispatching the decode step, so a
+  long prompt never stalls resident decode for its full prefill
+  (Sarathi-style stall-free admission; the TPOT spike the monolithic
+  path takes at admission is bounded by the budget).  The final chunk's
+  program also returns the first sampled token — it stays device-
+  resident and feeds the slot's first decode dispatch without a host
+  round-trip; the host copy is synced at the next drain.
+  ``prefill_chunk=None`` falls back to the bitwise-compatible monolithic
+  path: the whole prompt against fresh [1, bucket] mini caches — cost
+  proportional to the PROMPT, not B×bucket — inserted into the batch
+  cache at the freed slot (one compiled program per power-of-two
+  bucket).  Either way retired slots stay parked via
   ``ops.decode_attention.masked_lengths``: their write offset is lmax so
   every decode-step cache write DROPS — recycling needs no reshape,
-  copy-out, or recompile.  Prompts are right-padded to a small set of
-  power-of-two buckets, bounding the compile count; the slot's first
-  token is picked from the logit at its own last prompt column (pad
-  columns are causally invisible to it).
+  copy-out, or recompile.  Prompts validate against the bucket set in
+  both modes (buckets bound the admissible prompt length and label the
+  per-bucket prefill counter); the slot's first token is picked from the
+  logit at its own last prompt column (pad columns are causally
+  invisible to it).
 * Decode runs either mode behind one ``ServingEngine.step()``: greedy
   (``sync_every`` tokens per dispatch via an inner lax.scan) or model-free
   prompt-lookup speculative drafting (serving_spec_step — the same
@@ -62,6 +79,7 @@ tracks the longest LIVE context instead of ``max_len``.
 """
 from __future__ import annotations
 
+import bisect
 import contextlib
 import time
 import warnings
@@ -72,8 +90,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.models.llama_decode import (
-    _decode_params_of, serving_decode_steps, serving_prefill_slot,
-    serving_spec_step,
+    _decode_params_of, serving_decode_steps, serving_prefill_chunk,
+    serving_prefill_slot, serving_spec_step,
 )
 from paddle_tpu.observability.metrics import get_registry
 from paddle_tpu.observability.trace import span
@@ -163,6 +181,19 @@ class _EngineMetrics:
         self.spec_accept_rate = reg.gauge(
             "serving_spec_accept_rate",
             "cumulative accepted/drafted ratio", L).labels(**lbl)
+        self.prefill_chunks = reg.counter(
+            "serving_prefill_chunks_total",
+            "prompt chunks dispatched by the chunked-prefill path",
+            L).labels(**lbl)
+        self.prefill_backlog = reg.gauge(
+            "serving_prefill_backlog",
+            "prompt chunks still to dispatch across slots mid-prefill",
+            L).labels(**lbl)
+        self.tpot_admission = reg.histogram(
+            "serving_tpot_during_admission_seconds",
+            "per-token decode interval observed while a prefill "
+            "(monolithic or chunked) was in progress — the decode-"
+            "interference histogram", L).labels(**lbl)
         self.pipeline_stall = reg.histogram(
             "serving_pipeline_stall_seconds",
             "drain-side block waiting on the inflight dispatch",
@@ -261,12 +292,21 @@ class ServingEngine:
     length-adaptive cache read (ops/decode_attention.py); ``None`` reads
     the full ``[B, max_len]`` cache every step.  The default (256) falls
     back to the full read automatically when ``max_len <= 256``.
+    ``prefill_chunk``: prompt tokens per chunked-prefill dispatch (one
+    compiled program for every prompt length; ``None`` restores the
+    monolithic per-bucket prefill — token streams byte-identical when
+    both sides resolve to the same attention read, which the default
+    ``decode_chunk`` does for every bucket <= 256).  ``prefill_budget``:
+    max prefill chunks dispatched per scheduler step before the decode
+    step goes out — bounds how long resident decode can stall on an
+    admission (both knobs tuned via ``bench_sweep.py prefill_chunk``).
     """
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
                  spec_k=8, sync_every=1, policy="continuous",
                  prompt_buckets=None, detokenizer=None, registry=None,
-                 instrument=True, pipeline=True, decode_chunk=256):
+                 instrument=True, pipeline=True, decode_chunk=256,
+                 prefill_chunk=256, prefill_budget=2):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -287,6 +327,13 @@ class ServingEngine:
         self._detok = detokenizer
         self._pipeline = bool(pipeline)
         self._chunk = int(decode_chunk) if decode_chunk else None
+        # a chunk wider than the cache would only pad — clamp so small
+        # max_len engines don't pay a [1, 256] forward per tiny prompt
+        self._pchunk = (min(int(prefill_chunk), self._lmax)
+                        if prefill_chunk else None)
+        if self._pchunk is not None and self._pchunk < 1:
+            raise ValueError("prefill_chunk must be >= 1 or None")
+        self._pbudget = max(1, int(prefill_budget))
         self._params, self._cfg = _decode_params_of(model, self._lmax)
         nh, nkv, hd, eps = self._cfg
         dtype = self._params["embed"].dtype
@@ -298,9 +345,13 @@ class ServingEngine:
             while b < self._lmax:
                 prompt_buckets.append(b)
                 b *= 2
-        self._buckets = sorted(int(b) for b in prompt_buckets)
+        self._buckets = [int(b) for b in prompt_buckets]
         if not self._buckets or self._buckets[-1] > self._lmax:
             raise ValueError("prompt_buckets must be non-empty and <= max_len")
+        if any(b2 <= b1 for b1, b2 in zip(self._buckets, self._buckets[1:])):
+            raise ValueError(
+                "prompt_buckets must be sorted strictly ascending (submit "
+                f"bisects over them), got {self._buckets}")
         # host mirrors of per-slot device state
         self._len = np.zeros((self._B,), np.int32)
         self._cur = np.zeros((self._B,), np.int32)
@@ -313,6 +364,7 @@ class ServingEngine:
         self._queue = deque()
         self._finished = []
         self._next_rid = 0
+        self._rids = set()
         # pipelined-dispatch state: the one outstanding (dispatched, not yet
         # drained) step, the device-resident carries feeding the NEXT
         # dispatch without a host round-trip, and the slots admitted since
@@ -321,6 +373,17 @@ class ServingEngine:
         self._dev_cur = None
         self._dev_len = None
         self._adm_pending = set()
+        # chunked-prefill state: per-slot prefill progress (insertion order
+        # = admission order, the budget-spend order), the device-resident
+        # first token of slots whose final chunk is dispatched but whose
+        # host copy has not been drained yet, the (slot, request, first)
+        # triples awaiting host emission, and the was-a-prefill-running
+        # flag feeding the decode-interference histogram
+        self._pf = {}
+        self._dev_first = {}
+        self._pending_firsts = []
+        self._adm_wave = False
+        self._t_lastdrain = None
 
     # ------------------------------------------------------------- scheduling
     @property
@@ -339,11 +402,12 @@ class ServingEngine:
 
     def submit(self, request):
         p = int(request.prompt_ids.size)
-        bucket = next((b for b in self._buckets if b >= p), None)
-        if bucket is None:
+        i = bisect.bisect_left(self._buckets, p)
+        if i == len(self._buckets):
             raise ValueError(
                 f"prompt length {p} exceeds the largest prompt bucket "
                 f"{self._buckets[-1]}")
+        bucket = self._buckets[i]
         need = p + request.max_new_tokens + self._headroom()
         if need > self._lmax:
             raise ValueError(
@@ -352,13 +416,31 @@ class ServingEngine:
                 f"{self._headroom()}) > max_len {self._lmax}")
         request._bucket = bucket
         if request.rid is None:
+            # the engine assigns (and only then advances) the auto rid
             request.rid = self._next_rid
-        self._next_rid += 1
+            self._next_rid += 1
+        else:
+            # a caller-provided rid must never collide with one already
+            # handed out, nor silently alias a FUTURE auto rid: reject the
+            # former, bump the auto counter past the latter
+            if request.rid in self._rids:
+                raise ValueError(
+                    f"rid {request.rid!r} is already in use by another "
+                    "request on this engine")
+            if isinstance(request.rid, int):
+                self._next_rid = max(self._next_rid, request.rid + 1)
+        self._rids.add(request.rid)
         request.t_submit = time.perf_counter()
         self._queue.append(request)
         if self._m is not None:
             self._m.queue_depth.set(len(self._queue))
         return request
+
+    def _decodable(self, i):
+        """Slot ``i`` holds a live request that finished prefilling — the
+        population the decode dispatch runs over.  Slots mid-prefill stay
+        parked (masked_lengths) until their final chunk is dispatched."""
+        return self._reqs[i] is not None and i not in self._pf
 
     def _admit(self):
         free = [i for i in range(self._B) if self._reqs[i] is None]
@@ -366,6 +448,10 @@ class ServingEngine:
             return
         if self._policy == "gang" and len(free) < self._B:
             return  # run-to-completion: wait for the whole batch to drain
+        if self._pchunk is not None:
+            self._admit_chunked(free)
+            return
+        self._adm_wave = True
         m = self._m
         pending = []
         while free and self._queue:
@@ -404,6 +490,98 @@ class ServingEngine:
             m.queue_depth.set(len(self._queue))
             m.slots_occupied.set(
                 sum(r is not None for r in self._reqs))
+
+    def _admit_chunked(self, free):
+        """Chunked admission: assign freed slots and queue each prompt for
+        incremental chunk dispatch (``_spend_prefill``).  Nothing here
+        touches the device, so admission itself never stalls the loop —
+        the prompt work is spread over the following scheduler steps under
+        ``prefill_budget``."""
+        m = self._m
+        P = self._pchunk
+        while free and self._queue:
+            r = self._queue.popleft()
+            slot = free.pop(0)
+            self._reqs[slot] = r
+            p = int(r.prompt_ids.size)
+            padded = np.zeros((-(-p // P) * P,), np.int32)
+            padded[:p] = r.prompt_ids
+            # device-ready prompt length, built here (outside the chunk
+            # dispatch loop) so _spend_prefill stays sync-free
+            self._pf[slot] = {"req": r, "tok": padded, "p": p, "off": 0,
+                              "plen": jnp.asarray(np.array([p], np.int32))}
+            if m is not None:
+                m.admitted.inc()
+                m.prefill(r._bucket)
+                m.queue_wait.observe(time.perf_counter() - r.t_submit)
+        if m is not None:
+            m.queue_depth.set(len(self._queue))
+            m.slots_occupied.set(sum(r is not None for r in self._reqs))
+
+    def _spend_prefill(self):
+        """Dispatch up to ``prefill_budget`` prompt chunks across the
+        slots mid-prefill, admission order first (the earliest admission
+        reaches its first token soonest).  Every chunk dispatch is async
+        and feeds off device-resident state (the carried caches / hist /
+        write offset) — the loop never syncs, the tpu-lint PTL004 rule
+        polices that.  A slot whose FINAL chunk went out leaves the
+        prefilling state: it joins the very next decode dispatch with its
+        device-resident first token, and the host copy is emitted at the
+        next drain.  Returns the number of chunks dispatched."""
+        if not self._pf:
+            return 0
+        m = self._m
+        P = self._pchunk
+        budget = self._pbudget
+        spent = 0
+        for slot in list(self._pf):
+            if not budget:
+                break
+            st = self._pf[slot]
+            while budget:
+                chunk = st["tok"][st["off"]:st["off"] + P][None, :]
+                with m.span_prefill if m is not None else _NULL_CTX:
+                    first, self._caches, hist, hist_len = \
+                        serving_prefill_chunk(
+                            self._params, self._cfg, jnp.asarray(chunk),
+                            jnp.asarray(st["off"], jnp.int32), st["plen"],
+                            self._caches, jnp.asarray(slot, jnp.int32),
+                            hist=self._hist, hist_len=self._hist_len,
+                            with_hist=self._mode == "spec",
+                            chunk_size=self._chunk)
+                if self._mode == "spec":
+                    self._hist, self._hist_len = hist, hist_len
+                st["off"] += P
+                budget -= 1
+                spent += 1
+                if m is not None:
+                    m.prefill_chunks.inc()
+                if st["off"] >= st["p"]:
+                    del self._pf[slot]
+                    self._len[slot] = st["p"]
+                    self._dev_first[slot] = first
+                    self._pending_firsts.append((slot, st["req"], first))
+                    break
+        if m is not None:
+            m.prefill_backlog.set(sum(
+                -(-(st["p"] - st["off"]) // P) for st in self._pf.values()))
+        return spent
+
+    def _flush_firsts(self):
+        """Synchronous-mode first-token drain: block ONCE on the wave of
+        pending final chunks and emit (``pipeline=True`` instead rides
+        them on the next inflight record, fetched with its tokens)."""
+        if not self._pending_firsts:
+            return 0
+        pend, self._pending_firsts = self._pending_firsts, []
+        vals = _host_fetch(*(f for _, _, f in pend))
+        emitted = 0
+        for (slot, r, _), fv in zip(pend, vals):
+            self._cur[slot] = int(fv[0])
+            self._dev_first.pop(slot, None)
+            if self._reqs[slot] is r:
+                emitted += self._emit(slot, [int(fv[0])])
+        return emitted
 
     def _emit(self, slot, toks):
         """Append emitted tokens to the slot's request, truncating at EOS /
@@ -463,10 +641,15 @@ class ServingEngine:
             return self._step_impl()
 
     def _step_impl(self):
+        self._adm_wave = False
         self._admit()
+        spent = self._spend_prefill()
+        # decode-interference flag for this iteration: a monolithic prefill
+        # wave ran, chunks were spent, or a prefill is still in progress
+        adm_active = self._adm_wave or spent > 0 or bool(self._pf)
         if not self._pipeline:
             self._adm_pending.clear()
-            return self._step_sync()
+            return self._step_sync(adm_active)
         # the double buffer: stash the record of the PREVIOUS iteration's
         # dispatch, issue the next dispatch, and only then drain the stash —
         # step N+1 is outstanding on the device while step N's tokens are
@@ -474,22 +657,35 @@ class ServingEngine:
         # nothing to issue (e.g. every slot retired at the last drain) the
         # stashed record is still drained, so run() terminates.
         prev, self._inflight = self._inflight, None
-        self._dispatch()
+        self._dispatch(adm_active)
         return self._drain(prev)
 
+    def _observe_interference(self, adm_active, per_slot_tokens):
+        """Feed ``serving_tpot_during_admission_seconds``: the per-token
+        interval between this decode drain and the previous one, observed
+        only while admission work (monolithic wave or chunked backlog) was
+        in flight — the series the chunked-prefill A/B reads its
+        TPOT-p95-during-admission from."""
+        now = time.perf_counter()
+        if (self._m is not None and adm_active
+                and self._t_lastdrain is not None):
+            self._m.tpot_admission.observe(
+                (now - self._t_lastdrain) / max(1.0, per_slot_tokens))
+        self._t_lastdrain = now
+
     # ------------------------------------------------- synchronous baseline
-    def _step_sync(self):
+    def _step_sync(self, adm_active=False):
         """``pipeline=False``: dispatch one step and block on its tokens in
         the same iteration — the A/B baseline the pipelined loop is
         byte-identical to."""
         m = self._m
-        live = [i for i in range(self._B) if self._reqs[i] is not None]
+        emitted = self._flush_firsts()
+        live = [i for i in range(self._B) if self._decodable(i)]
         if not live:
-            return 0
-        active = np.array([r is not None for r in self._reqs])
+            return emitted
+        active = np.array([self._decodable(i) for i in range(self._B)])
         dev_len = masked_lengths(jnp.asarray(self._len), jnp.asarray(active),
                                  self._lmax)
-        emitted = 0
         if self._mode == "greedy":
             with m.span_decode if m is not None else _NULL_CTX:
                 toks, self._caches = serving_decode_steps(
@@ -497,6 +693,7 @@ class ServingEngine:
                     self._caches, dev_len, n_steps=self._sync,
                     chunk_size=self._chunk)
                 (toks,) = _host_fetch(toks)
+            self._observe_interference(adm_active, self._sync)
             for i in live:
                 emitted += self._emit(i, toks[i].tolist())
                 self._len[i] += self._sync
@@ -516,6 +713,8 @@ class ServingEngine:
                 self._len[i] += int(j[i]) + 1
                 self._cur[i] = cur[i]
                 accepted += int(j[i])
+            self._observe_interference(
+                adm_active, 1.0 + accepted / len(live))
             if m is not None:
                 # per verify round each live slot drafts spec_k and accepts
                 # j of them (the +1 bonus token is the verify forward's own
@@ -524,28 +723,39 @@ class ServingEngine:
         return emitted
 
     # --------------------------------------------------- pipelined dispatch
-    def _dispatch(self):
+    def _dispatch(self, adm_active=False):
         """Dispatch the next decode step WITHOUT waiting for the previous
         one (still undrained — ``_step_impl`` holds its record).  The
         step's inputs are all device-resident: the carried ``cur`` tokens /
         lengths of the previous dispatch (still futures — the device
         executes in program order) plus the caches; slots admitted since
         the last dispatch mix their host-known first token and prompt
-        length into the carry."""
-        live = [i for i in range(self._B) if self._reqs[i] is not None]
+        length into the carry.  A slot whose FINAL prefill chunk was just
+        dispatched joins with its DEVICE-resident first token
+        (``_dev_first`` — still a future) and host-known prompt length;
+        its first token rides this record and is emitted at its drain."""
+        live = [i for i in range(self._B) if self._decodable(i)]
         if not live:
             return
         m = self._m
-        active = np.array([r is not None for r in self._reqs])
+        active = np.array([self._decodable(i) for i in range(self._B)])
         host_len = masked_lengths(jnp.asarray(self._len),
                                   jnp.asarray(active), self._lmax)
         use_host = ~active
         use_host[list(self._adm_pending)] = True
+        # freshly prefilled slots: length is host-known (the prompt length,
+        # stamped at the final chunk) but cur is a device future
+        use_host_len = use_host.copy()
+        use_host_len[list(self._dev_first)] = True
         if self._dev_cur is None:
             cur = jnp.asarray(self._cur)
         else:
             cur = jnp.where(jnp.asarray(use_host), jnp.asarray(self._cur),
                             self._dev_cur)
+        for s, f in self._dev_first.items():
+            cur = cur.at[s].set(f[0])
+        self._dev_first.clear()
+        firsts, self._pending_firsts = self._pending_firsts, []
         if self._mode == "greedy":
             # greedy lengths are host-derivable: every live slot advances
             # exactly sync_every per dispatch, so the mirror (bumped below)
@@ -558,16 +768,17 @@ class ServingEngine:
             for i in live:
                 self._len[i] += self._sync
             self._inflight = {"kind": "greedy", "toks": toks,
-                              "reqs": list(self._reqs), "live": live}
+                              "reqs": list(self._reqs), "live": live,
+                              "firsts": firsts, "adm": adm_active}
         else:
             if self._dev_len is None:
                 dev_len = host_len
             else:
                 # spec lengths advance by the DEVICE-known j+1, so the
                 # carry comes back from serving_spec_step; host values are
-                # authoritative only for just-admitted (prompt length) and
-                # freed (masked to lmax) slots
-                dev_len = jnp.where(jnp.asarray(use_host), host_len,
+                # authoritative only for just-admitted / just-prefilled
+                # (prompt length) and freed (masked to lmax) slots
+                dev_len = jnp.where(jnp.asarray(use_host_len), host_len,
                                     self._dev_len)
             with m.span_spec if m is not None else _NULL_CTX:
                 blk, j, cur2, new_len, self._caches, self._hist, \
@@ -578,7 +789,8 @@ class ServingEngine:
                         chunk_size=self._chunk)
             self._dev_cur, self._dev_len = cur2, new_len
             self._inflight = {"kind": "spec", "blk": blk, "j": j,
-                              "reqs": list(self._reqs), "live": live}
+                              "reqs": list(self._reqs), "live": live,
+                              "firsts": firsts, "adm": adm_active}
         self._adm_pending.clear()
         if m is not None:
             m.inflight.set(1)
@@ -597,23 +809,39 @@ class ServingEngine:
         # this drain — that overlap is the point; the gauge must not claim
         # the pipe is empty just because THIS record got synced
         still_inflight = 1 if self._inflight is not None else 0
+        firsts = rec.get("firsts", [])
         t0 = time.perf_counter()
         emitted = 0
         if rec["kind"] == "greedy":
-            (toks,) = _host_fetch(rec["toks"])
+            vals = _host_fetch(rec["toks"], *(f for _, _, f in firsts))
+            toks, fvals = vals[0], vals[1:]
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
                 m.inflight.set(still_inflight)
+            self._observe_interference(rec.get("adm", False), self._sync)
+            # the first tokens ride the record they were dispatched before
+            # (program order: final prefill chunk, then this decode step) —
+            # emit them ahead of the slot's decode block
+            for (slot, r, _), fv in zip(firsts, fvals):
+                if self._reqs[slot] is r:
+                    self._cur[slot] = int(fv[0])
+                    emitted += self._emit(slot, [int(fv[0])])
             for i in rec["live"]:
                 if self._reqs[i] is not rec["reqs"][i]:
                     continue
                 emitted += self._emit(i, toks[i].tolist())
                 self._cur[i] = toks[i, -1]
         else:
-            blk, j = _host_fetch(rec["blk"], rec["j"])
+            vals = _host_fetch(rec["blk"], rec["j"],
+                               *(f for _, _, f in firsts))
+            blk, j, fvals = vals[0], vals[1], vals[2:]
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
                 m.inflight.set(still_inflight)
+            for (slot, r, _), fv in zip(firsts, fvals):
+                if self._reqs[slot] is r:
+                    self._cur[slot] = int(fv[0])
+                    emitted += self._emit(slot, [int(fv[0])])
             accepted = 0
             drained = 0
             for i in rec["live"]:
@@ -623,6 +851,8 @@ class ServingEngine:
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
                 self._len[i] += int(j[i]) + 1
                 accepted += int(j[i])
+            self._observe_interference(
+                rec.get("adm", False), 1.0 + accepted / max(1, drained))
             if m is not None and drained:
                 m.spec_round(self._spec_k * drained, accepted)
         return emitted

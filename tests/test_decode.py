@@ -263,6 +263,84 @@ class TestChunkedDecodeAttention:
                                           np.asarray(full[0]))
 
 
+class TestSlotPrefillAttention:
+    """The chunked-prefill attention op (ops.slot_prefill_attention):
+    chaining [1, P] chunks at offsets 0, P, 2P, ... against one slot of
+    the batch cache must reproduce a single monolithic causal pass
+    byte-for-byte — each chunk's query i sees exactly the rows written
+    before it (previous chunks + intra-chunk causal prefix)."""
+
+    def _chain(self, x_len, P, Lmax=64, B=3, slot=1, h=4, hkv=2, d=16,
+               seed=0, chunk_size=None):
+        from paddle_tpu.ops.decode_attention import slot_prefill_attention
+
+        # fixed-width source buffers (sliced per chunk) so every P sees
+        # the SAME query/key/value values for the real rows
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (1, Lmax, h, d), jnp.float32)
+        kn = jax.random.normal(ks[1], (1, Lmax, hkv, d), jnp.float32)
+        vn = jax.random.normal(ks[2], (1, Lmax, hkv, d), jnp.float32)
+        kc = jnp.zeros((B, Lmax, hkv, d), jnp.float32)
+        vc = jnp.zeros((B, Lmax, hkv, d), jnp.float32)
+        outs = []
+        for off in range(0, x_len + (-x_len % P), P):
+            o, kc, vc = slot_prefill_attention(
+                q[:, off:off + P], kn[:, off:off + P], vn[:, off:off + P],
+                kc, vc, jnp.int32(slot), jnp.int32(off),
+                chunk_size=chunk_size)
+            outs.append(np.asarray(o))
+        return np.concatenate(outs, axis=1), kc, vc
+
+    @pytest.mark.parametrize("x_len,P", [(5, 16), (16, 16), (32, 8),
+                                         (13, 8)])
+    def test_chunk_chain_matches_monolithic(self, x_len, P):
+        """Prompt lengths <, =, a multiple of, and a non-multiple of the
+        chunk width: the chained outputs on the REAL rows equal a single
+        full-width pass, and both leave byte-identical cache rows."""
+        chained, kc, vc = self._chain(x_len, P)
+        mono, kc1, vc1 = self._chain(x_len, x_len)
+        np.testing.assert_array_equal(chained[:, :x_len], mono[:, :x_len])
+        np.testing.assert_array_equal(np.asarray(kc)[:, :x_len],
+                                      np.asarray(kc1)[:, :x_len])
+        np.testing.assert_array_equal(np.asarray(vc)[:, :x_len],
+                                      np.asarray(vc1)[:, :x_len])
+
+    def test_only_the_slot_row_is_written(self):
+        from paddle_tpu.ops.decode_attention import slot_prefill_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (1, 8, 4, 16), jnp.float32)
+        kn = jax.random.normal(ks[1], (1, 8, 2, 16), jnp.float32)
+        vn = jax.random.normal(ks[2], (1, 8, 2, 16), jnp.float32)
+        kc = jnp.zeros((3, 32, 2, 16), jnp.float32)
+        vc = jnp.zeros((3, 32, 2, 16), jnp.float32)
+        _, kc, vc = slot_prefill_attention(q, kn, vn, kc, vc,
+                                           jnp.int32(2), jnp.int32(0))
+        assert not np.asarray(kc)[:2].any() and not np.asarray(vc)[:2].any()
+        assert np.asarray(kc)[2, :8].any()
+        # rows past the chunk untouched
+        assert not np.asarray(kc)[2, 8:].any()
+
+    def test_offset_past_lmax_drops_writes(self):
+        """A parked offset (masked_lengths -> lmax) routes every scatter
+        out of bounds with mode='drop' — the cache survives bitwise."""
+        from paddle_tpu.ops.decode_attention import slot_prefill_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = jax.random.normal(ks[0], (1, 8, 4, 16), jnp.float32)
+        kn = jax.random.normal(ks[1], (1, 8, 2, 16), jnp.float32)
+        vn = jax.random.normal(ks[2], (1, 8, 2, 16), jnp.float32)
+        kc = jax.random.normal(jax.random.PRNGKey(5), (2, 32, 2, 16),
+                               jnp.float32)
+        vc = jax.random.normal(jax.random.PRNGKey(6), (2, 32, 2, 16),
+                               jnp.float32)
+        out, kc2, vc2 = slot_prefill_attention(q, kn, vn, kc, vc,
+                                               jnp.int32(0), jnp.int32(32))
+        np.testing.assert_array_equal(np.asarray(kc2), np.asarray(kc))
+        np.testing.assert_array_equal(np.asarray(vc2), np.asarray(vc))
+        assert np.isfinite(np.asarray(out)).all()
+
+
 class TestMaskedMultiheadAttention:
     def test_matches_dense_with_mask_and_bias(self):
         import paddle_tpu.incubate.nn.functional as IF

@@ -251,10 +251,11 @@ class TestPipelinedDispatch:
         model = _tiny_model(seed=11)
         eng = ServingEngine(model, batch_size=1, max_len=64, pipeline=True)
         r = eng.submit(Request(np.arange(1, 7), 4))
-        eng.step()  # admit (first token via prefill) + dispatch step 1
+        eng.step()  # admit + final prefill chunk + dispatch step 1; the
+        # first token is a device future riding the inflight record
         assert eng._inflight is not None
-        assert len(r.output_ids) == 1
-        eng.step()  # dispatch step 2, drain step 1
+        assert len(r.output_ids) == 0
+        eng.step()  # dispatch step 2, drain step 1 (first + block 1)
         assert eng._inflight is not None
         assert len(r.output_ids) == 2
         eng.run()
@@ -318,6 +319,144 @@ class TestPipelinedDispatch:
         assert reg.get("serving_inflight_steps").labels(**lbl).value == 0
         assert reg.get(
             "serving_pipeline_stall_seconds").labels(**lbl).count > 0
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (serving_prefill_chunk) under budgeted
+    prefill/decode interleaving: byte-identical to the monolithic
+    per-bucket path, O(1) compiled programs, retrace-free steady state,
+    and invisible to resident decode streams."""
+
+    @pytest.mark.parametrize("pipeline", [False, True])
+    @pytest.mark.parametrize("mode", ["greedy", "spec"])
+    def test_parity_matrix_vs_monolithic(self, mode, pipeline):
+        """Byte-identity across prompt lengths that are <, =, a multiple
+        of, and a non-multiple of the chunk size (P=8), in both scheduler
+        modes with the pipeline on and off."""
+        model = _tiny_model(seed=21)
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(0, 256, (p,)) for p in (5, 8, 16, 13)]
+        new_lens = [6, 5, 4, 7]
+        kw = dict(batch_size=2, max_len=64, mode=mode, pipeline=pipeline)
+        mono = _run(model, prompts, new_lens, prefill_chunk=None, **kw)
+        chunk = _run(model, prompts, new_lens, prefill_chunk=8,
+                     prefill_budget=2, **kw)
+        for i in range(len(prompts)):
+            assert list(chunk[i].output_ids) == list(mono[i].output_ids)
+
+    def test_prefill_program_count_is_o1(self):
+        """Eight DISTINCT prompt lengths across three buckets cost exactly
+        ONE serving_prefill_chunk trace — the per-bucket program family is
+        gone (offset / prompt_len / slot are traced operands; only the
+        chunk width P is a shape)."""
+        from paddle_tpu.models.llama_decode import _mon
+
+        model = _tiny_model(seed=22)
+        rng = np.random.default_rng(22)
+        lens = (3, 5, 7, 9, 11, 14, 17, 21)
+        prompts = [rng.integers(0, 256, (p,)) for p in lens]
+        before = _mon.trace_counts().get("serving_prefill_chunk", 0)
+        mono_before = _mon.trace_counts().get("serving_prefill_slot", 0)
+        _run(model, prompts, [3] * len(lens), batch_size=2, max_len=64,
+             prefill_chunk=8, prompt_buckets=(8, 16, 24))
+        # at most ONE new program for eight distinct lengths (zero when an
+        # earlier test in this process already traced the P=8 program —
+        # the jit cache is process-wide, which is exactly the point)
+        assert _mon.trace_counts()["serving_prefill_chunk"] - before <= 1
+        # and the monolithic family was never touched
+        assert _mon.trace_counts().get(
+            "serving_prefill_slot", 0) == mono_before
+
+    def test_staggered_admissions_are_retrace_free(self):
+        """Acceptance: steady-state serving with long prompts admitted
+        mid-decode and drip-fed under prefill_budget=1 triggers ZERO
+        retraces after a warmup run."""
+        from paddle_tpu.analysis import assert_no_retrace
+
+        model = _tiny_model(seed=23)
+        rng = np.random.default_rng(23)
+
+        def go():
+            eng = ServingEngine(model, batch_size=2, max_len=64,
+                                prefill_chunk=4, prefill_budget=1,
+                                decode_chunk=16, pipeline=True)
+            eng.submit(Request(rng.integers(0, 256, (17,)), 6))
+            for _ in range(3):
+                eng.step()
+            eng.submit(Request(rng.integers(0, 256, (23,)), 4))
+            for _ in range(2):
+                eng.step()
+            eng.submit(Request(rng.integers(0, 256, (9,)), 5))
+            eng.run()
+
+        go()  # warmup: the legitimate traces
+        with assert_no_retrace():
+            go()
+
+    def test_resident_stream_unaffected_by_mid_prefill(self):
+        """Regression: a resident slot's per-step token stream is
+        byte-identical whether or not another slot is mid-prefill beside
+        it (the prefilling slot stays parked via masked_lengths until its
+        final chunk)."""
+        model = _tiny_model(seed=24)
+        rng = np.random.default_rng(24)
+        prompt = rng.integers(0, 256, (6,))
+        other = rng.integers(0, 256, (21,))
+        kw = dict(batch_size=2, max_len=64, prefill_chunk=4,
+                  prefill_budget=1, pipeline=True)
+        eng = ServingEngine(model, **kw)
+        alone = eng.submit(Request(prompt.copy(), 10))
+        eng.run()
+        eng2 = ServingEngine(model, **kw)
+        beside = eng2.submit(Request(prompt.copy(), 10))
+        for _ in range(4):
+            eng2.step()
+        # a long prompt lands while the resident slot is mid-stream and
+        # drips through prefill one chunk per step
+        eng2.submit(Request(other, 4))
+        eng2.run()
+        assert list(beside.output_ids) == list(alone.output_ids)
+
+
+class TestSubmitValidation2:
+    """rid bookkeeping and bucket-order validation (PR-5 satellites)."""
+
+    def test_auto_rids_only_advance_on_assignment(self):
+        model = _tiny_model()
+        eng = ServingEngine(model, batch_size=2, max_len=64)
+        r0 = eng.submit(Request(np.arange(1, 5), 2))
+        assert r0.rid == 0
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(Request(np.arange(0, 40), 2))
+        # the rejected submit must not have burned an auto rid
+        assert eng.submit(Request(np.arange(1, 6), 2)).rid == 1
+
+    def test_user_rid_collision_rejected(self):
+        model = _tiny_model()
+        eng = ServingEngine(model, batch_size=2, max_len=64)
+        eng.submit(Request(np.arange(1, 5), 2, rid="job-a"))
+        with pytest.raises(ValueError, match="already in use"):
+            eng.submit(Request(np.arange(1, 6), 2, rid="job-a"))
+        auto = eng.submit(Request(np.arange(1, 7), 2))
+        with pytest.raises(ValueError, match="already in use"):
+            eng.submit(Request(np.arange(1, 8), 2, rid=auto.rid))
+
+    def test_user_int_rid_bumps_auto_counter(self):
+        """A caller-provided int rid can no longer alias a FUTURE auto
+        rid: the auto counter jumps past it."""
+        model = _tiny_model()
+        eng = ServingEngine(model, batch_size=2, max_len=64)
+        eng.submit(Request(np.arange(1, 5), 2, rid=5))
+        assert eng.submit(Request(np.arange(1, 6), 2)).rid == 6
+
+    def test_unsorted_buckets_rejected(self):
+        model = _tiny_model()
+        with pytest.raises(ValueError, match="sorted strictly ascending"):
+            ServingEngine(model, batch_size=2, max_len=64,
+                          prompt_buckets=(16, 8, 32))
+        with pytest.raises(ValueError, match="sorted strictly ascending"):
+            ServingEngine(model, batch_size=2, max_len=64,
+                          prompt_buckets=(8, 8, 16))
 
 
 @pytest.mark.slow

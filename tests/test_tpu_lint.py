@@ -277,6 +277,32 @@ class TestRuleFixtures:
                 return out
         """) == []
 
+    def test_host_sync_tp_prefill_chunk_loop(self):
+        # the serving engine's chunked-prefill dispatch loop is a step
+        # loop: each serving_prefill_chunk dispatch is per-iteration
+        # compiled device work, so a raw sync inside it serializes the
+        # pipeline exactly like one inside a decode-step loop
+        assert _rules("""
+            import numpy as np
+            def spend(engine, slots):
+                for s in slots:
+                    first = engine.serving_prefill_chunk(s)
+                    engine.cur[s] = int(np.asarray(first)[0])
+        """) == ["PTL004"]
+
+    def test_host_sync_tn_prefill_chunk_loop_sanctioned(self):
+        # the budgeted chunk loop itself is clean when the only readback
+        # funnels through the sanctioned drain helper AFTER the loop
+        assert _rules("""
+            import numpy as np
+            from paddle_tpu.serving.engine import _host_fetch
+            def spend(engine, slots):
+                firsts = []
+                for s in slots:
+                    firsts.append(engine.serving_prefill_chunk(s))
+                return _host_fetch(*firsts)
+        """) == []
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
