@@ -259,10 +259,34 @@ def bench_serving(n_requests=64, batch=8):
     and ``serving_prefill_programs_{monolithic,chunked}`` (one program per
     touched bucket before — the A/B-run trace delta — vs the process-wide
     chunked total after: O(1) regardless of prompt lengths served — read
-    off the llama_decode CompileCacheMonitor)."""
+    off the llama_decode CompileCacheMonitor).
+
+    Round 11 adds the tensor-parallel A/B (serving/sharding.py): the same
+    model mesh-placed across ``serving_tp_devices`` host devices vs the
+    single-device engine (``serving_tp_speedup`` — on the CPU host mesh
+    this is a ratio-only smoke column: host collectives cost more than
+    they parallelize, the capacity win is the point), plus the per-shard
+    analytic ``serving_hbm_gb_per_tok_tp`` (replicated params in full +
+    sharded params and head-sharded KV reads at 1/N — the per-chip
+    bytes/token the placement buys).  The row needs >1 host device, so
+    the device-count forcing at the top of this function must run before
+    jax initializes its backend; when it loses that race the TP columns
+    report the single-device fallback instead of failing the bench."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import MetricsRegistry
     from paddle_tpu.serving import Request, ServingEngine
+
+    # TP row device forcing — effective only while the backend is still
+    # uninitialized (BENCH_ONLY=bench_serving guarantees that; a full
+    # bench sweep may have spent it, in which case the row degrades to
+    # its single-device fallback)
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 
     # BENCH_SERVING_SMALL=1 shrinks the model + workload to a CPU-feasible
     # scale (same scheduler, same compiled-program family, same A/B
@@ -297,9 +321,10 @@ def bench_serving(n_requests=64, batch=8):
                for p in plens]
     total_new = int(olens.sum())
 
-    def run(policy, mode, reqs=None, **ekw):
+    def run(policy, mode, reqs=None, m=None, **ekw):
         reg = MetricsRegistry()  # isolated per run: clean percentiles
-        eng = ServingEngine(model, batch_size=batch, max_len=lmax,
+        eng = ServingEngine(m if m is not None else model,
+                            batch_size=batch, max_len=lmax,
                             mode=mode, sync_every=4, spec_k=8, policy=policy,
                             registry=reg, **ekw)
         for p, o in (reqs if reqs is not None else zip(prompts, olens)):
@@ -389,6 +414,53 @@ def bench_serving(n_requests=64, batch=8):
     # monolithic delta above is one per touched bucket for the A/B
     # workload alone)
     chunk_programs = traces("serving_prefill_chunk")
+    # A/B 4 (round 11) — tensor-parallel mesh placement vs single device
+    # (serving/sharding.py): same workload, same scheduler; the small
+    # config's nkv=2 is bumped to 4 so the KV heads divide the mesh axis
+    n_tp = 4
+    tp_cols = {"serving_tp_devices": 1}
+    if len(jax.devices()) >= n_tp:
+        import dataclasses
+
+        from jax.sharding import Mesh, PartitionSpec as _PS
+
+        from paddle_tpu.serving.sharding import (llama_tp_rules,
+                                                 match_partition_rules)
+        tp_cfg = cfg if cfg.num_key_value_heads % n_tp == 0 else \
+            dataclasses.replace(cfg, num_key_value_heads=4)
+        tp_model = model if tp_cfg is cfg else LlamaForCausalLM(tp_cfg)
+        tp_model.eval()
+        mesh = Mesh(np.array(jax.devices()[:n_tp]), ("mp",))
+        run("continuous", "greedy", m=tp_model)              # warm 1-dev
+        dt_t1, _, _ = run("continuous", "greedy", m=tp_model)
+        run("continuous", "greedy", m=tp_model, mesh=mesh)   # warm mesh
+        dt_tn, _, _ = run("continuous", "greedy", m=tp_model, mesh=mesh)
+        # per-shard analytic bytes/token: replicated params read in full
+        # on every chip, sharded params and the head-sharded KV at 1/N
+        tp_params, _ = _decode_params_of(tp_model, lmax)
+        tp_specs = match_partition_rules(llama_tp_rules(), tp_params)
+        repl_b = shard_b = 0
+        for leaf, spec in zip(
+                _jax.tree_util.tree_leaves(tp_params),
+                _jax.tree_util.tree_leaves(
+                    tp_specs, is_leaf=lambda x: isinstance(x, _PS))):
+            b = leaf.size * leaf.dtype.itemsize
+            if any(ax is not None for ax in spec):
+                shard_b += b
+            else:
+                repl_b += b
+        tp_kv_row = tp_cfg.num_hidden_layers * 2 * \
+            tp_cfg.num_key_value_heads * \
+            (tp_cfg.hidden_size // tp_cfg.num_attention_heads) * kv_itemsize
+        tp_cols = {
+            "serving_tp_devices": n_tp,
+            "serving_tp_speedup": round(dt_t1 / dt_tn, 2),
+            "serving_tp_tok_per_sec": round(total_new / dt_tn, 1),
+            "serving_hbm_gb_per_tok_tp": round(
+                ((repl_b + shard_b / n_tp) / batch
+                 + tp_kv_row * float(np.mean(plens + olens / 2)) / n_tp)
+                / 1e9, 4),
+        }
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -437,6 +509,8 @@ def bench_serving(n_requests=64, batch=8):
             hbm_gb_per_tok(ctx_full) * (total_new / dt_c), 1),
         "serving_low_occ_hbm_gb_per_tok_chunked": round(
             hbm_gb_per_tok(ctx_lo), 4),
+        # tensor-parallel A/B (round 11)
+        **tp_cols,
     }
 
 

@@ -626,6 +626,13 @@ class _Checker:
                               f"unhashable literal in static position "
                               f"{pos} of jitted `{node.func.id}` — "
                               "TypeError at dispatch")
+                elif self._mesh_ctor(a):
+                    self.emit("PTL003", a,
+                              f"`{self._mesh_ctor(a)}` constructed inline "
+                              f"in static position {pos} of jitted "
+                              f"`{node.func.id}` — a fresh mesh/sharding "
+                              "instance per call churns the compile "
+                              "cache; construct once and reuse")
                 elif isinstance(a, ast.Name) and a.id in loop_names:
                     self.emit("PTL003", a,
                               f"loop variable `{a.id}` in static position "
@@ -637,13 +644,47 @@ class _Checker:
                           f"jitted `{node.func.id}` — the pytree length "
                           "enters the compile-cache key")
         for kw in node.keywords:
-            if kw.arg in info.static_names and isinstance(
-                    kw.value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
-                               ast.SetComp, ast.DictComp)):
+            if kw.arg not in info.static_names:
+                continue
+            if isinstance(kw.value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.SetComp,
+                                     ast.DictComp)):
                 self.emit("PTL003", kw.value,
                           f"unhashable literal for static argument "
                           f"`{kw.arg}` of jitted `{node.func.id}` — "
                           "TypeError at dispatch")
+            elif self._mesh_ctor(kw.value):
+                self.emit("PTL003", kw.value,
+                          f"`{self._mesh_ctor(kw.value)}` constructed "
+                          f"inline for static argument `{kw.arg}` of "
+                          f"jitted `{node.func.id}` — a fresh "
+                          "mesh/sharding instance per call churns the "
+                          "compile cache; construct once and reuse")
+
+    _MESH_CTORS = ("Mesh", "NamedSharding")
+
+    def _mesh_ctor(self, a):
+        """The Mesh/NamedSharding constructor name if ``a`` builds one
+        inline (``Mesh(...)`` / ``jax.sharding.NamedSharding(...)``), else
+        None.  Device topology objects hash by content but a per-call
+        instance still defeats jit's identity fast path and re-keys the
+        static signature — the same retrace churn as any loop-varying
+        static — so PTL003 treats an inline construction as a hazard."""
+        if not isinstance(a, ast.Call):
+            return None
+        f = self.resolve(a.func)
+        if f is not None:
+            last = f.split(".")[-1]
+            if last in self._MESH_CTORS and (
+                    f.startswith("jax.") or f == last):
+                return last
+            return None
+        if isinstance(a.func, ast.Name) and a.func.id in self._MESH_CTORS:
+            return a.func.id
+        if isinstance(a.func, ast.Attribute) and \
+                a.func.attr in self._MESH_CTORS:
+            return a.func.attr
+        return None
 
 
 # --------------------------------------------------------------------------

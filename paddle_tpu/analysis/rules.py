@@ -57,10 +57,15 @@ _RULE_LIST = [
         "jit call site that churns the compile cache: an unhashable "
         "list/dict/set literal in a static position (TypeError at "
         "dispatch), an inline list literal as a dynamic argument (pytree "
-        "length enters the cache key), or a loop variable fed to a static "
-        "parameter (one retrace per iteration)",
+        "length enters the cache key), a loop variable fed to a static "
+        "parameter (one retrace per iteration), or a Mesh/NamedSharding "
+        "constructed inline in a static position (a fresh instance per "
+        "call defeats the dispatch fast path and re-keys the static "
+        "signature)",
         "pass tuples for static args; pass arrays (not list literals) as "
-        "dynamic args; hoist loop-varying values out of static positions",
+        "dynamic args; hoist loop-varying values and mesh/sharding "
+        "construction out of static positions — build the Mesh once and "
+        "reuse it",
     ),
     Rule(
         "PTL004", "host-sync-in-step-loop", WARNING,

@@ -413,12 +413,9 @@ _spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
 # masked_lengths): a dead slot's offset is lmax, so its cache writes drop and
 # its state survives the step untouched.
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "with_hist", "chunk_size"),
-                   donate_argnames=("caches", "hist"))
-def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
-                         hist=None, hist_len=None, with_hist=False,
-                         chunk_size=None):
+def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
+                               hist=None, hist_len=None, with_hist=False,
+                               chunk_size=None):
     """Admit ONE request: prefill its prompt, insert into the batch cache.
 
     ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
@@ -465,8 +462,14 @@ def serving_prefill_slot(params, cfg, tokens, prompt_len, caches, slot,
     return first, new_caches, hist, hist_len
 
 
-serving_prefill_slot = _mon.wrap("serving_prefill_slot",
-                                 serving_prefill_slot)
+# the serving entry points ship as RAW impls plus module-level jitted
+# exports: the single-device engine dispatches the exports below, while
+# serving/sharding.py re-jits the same impls with explicit mesh in/out
+# shardings — one body, one ``mark_trace`` name, two placement strategies.
+serving_prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
+    _serving_prefill_slot_impl,
+    static_argnames=("cfg", "with_hist", "chunk_size"),
+    donate_argnames=("caches", "hist")))
 
 
 def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
@@ -491,12 +494,9 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
     return h, k_cache, v_cache
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "with_hist", "chunk_size"),
-                   donate_argnames=("caches", "hist"))
-def serving_prefill_chunk(params, cfg, tokens, offset, prompt_len, caches,
-                          slot, hist=None, hist_len=None, with_hist=False,
-                          chunk_size=None):
+def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
+                                caches, slot, hist=None, hist_len=None,
+                                with_hist=False, chunk_size=None):
     """Process the next ``[1, P]`` chunk of an admitted prompt against the
     slot's rows of the batch cache — ONE compiled program for every prompt
     length (``P`` is the only shape; ``offset``, ``prompt_len`` and
@@ -563,14 +563,14 @@ def serving_prefill_chunk(params, cfg, tokens, offset, prompt_len, caches,
     return first, new_caches, hist, hist_len
 
 
-serving_prefill_chunk = _mon.wrap("serving_prefill_chunk",
-                                  serving_prefill_chunk)
+serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
+    _serving_prefill_chunk_impl,
+    static_argnames=("cfg", "with_hist", "chunk_size"),
+    donate_argnames=("caches", "hist")))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "chunk_size"),
-                   donate_argnames=("caches",))
-def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1,
-                         chunk_size=None):
+def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
+                               n_steps=1, chunk_size=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
@@ -595,13 +595,14 @@ def serving_decode_steps(params, cfg, cur, caches, dev_lengths, n_steps=1,
     return toks.T, caches
 
 
-serving_decode_steps = _mon.wrap("serving_decode_steps",
-                                 serving_decode_steps)
+serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
+    _serving_decode_steps_impl,
+    static_argnames=("cfg", "n_steps", "chunk_size"),
+    donate_argnames=("caches",)))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "spec_k", "chunk_size"))
-def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
-                      active, spec_k=4, chunk_size=None):
+def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
+                            hist_len, active, spec_k=4, chunk_size=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -642,7 +643,9 @@ def serving_spec_step(params, cfg, cur, caches, dev_lengths, hist, hist_len,
     return emitted, j, cur, new_len, caches, hist, hist_len
 
 
-serving_spec_step = _mon.wrap("serving_spec_step", serving_spec_step)
+serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(
+    _serving_spec_step_impl,
+    static_argnames=("cfg", "spec_k", "chunk_size")))
 
 
 def _decode_params_of(model, lmax):

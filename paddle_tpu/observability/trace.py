@@ -29,11 +29,16 @@ SPAN_EVENT_TYPE = "Span"
 
 
 def span_histogram(registry=None):
-    """The ``span_seconds`` histogram family in ``registry``."""
+    """The ``span_seconds`` histogram family in ``registry``.
+
+    Labeled ``{name, mesh}``: ``mesh`` is "" for ordinary host spans and
+    the device count for spans wrapping mesh-sharded dispatches
+    (serving/sharding.py), so a single-chip engine and its tensor-parallel
+    twin stay separable in one scrape."""
     reg = registry if registry is not None else get_registry()
     return reg.histogram(
         "span_seconds", "wall seconds spent inside observability spans",
-        labelnames=("name",))
+        labelnames=("name", "mesh"))
 
 
 def _host_tracer():
@@ -51,10 +56,11 @@ class span:
     itself and across threads correctly.
     """
 
-    def __init__(self, name, registry=None, event_type=SPAN_EVENT_TYPE):
+    def __init__(self, name, registry=None, event_type=SPAN_EVENT_TYPE,
+                 mesh=""):
         self.name = name
         self.event_type = event_type
-        self._hist = span_histogram(registry).labels(name=name)
+        self._hist = span_histogram(registry).labels(name=name, mesh=mesh)
         self._local = threading.local()
 
     def __enter__(self):

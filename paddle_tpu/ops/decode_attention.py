@@ -30,6 +30,15 @@ TPU-first design choices:
 * **Per-batch lengths.**  ``lengths [B]`` supports ragged batches (the
   reference's ``sequence_lengths``); appends use a vmapped
   ``dynamic_update_slice`` (lowers to one scatter).
+* **Head-sharding safe.**  Under tensor-parallel serving
+  (serving/sharding.py) the cache is sharded along the ``Hkv`` axis and
+  these reads partition cleanly: the chunked online-softmax running
+  max/denominator reduce over the per-head chunk axis, never across heads,
+  and the trip count reduces over the (replicated) ``lengths`` — so GSPMD
+  runs the identical program per shard on ``Hkv/N`` heads with zero
+  cross-chip collectives inside the attention read.  Keep it that way: any
+  future reduction ACROSS the head axis (head-mixing, cross-head norm)
+  breaks the partition and must be hoisted out of this module.
 * Differentiability is not a goal (decode is inference); everything here is
   plain jnp under jit.
 """

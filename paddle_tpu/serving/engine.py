@@ -93,9 +93,8 @@ from paddle_tpu.models.llama_decode import (
     _decode_params_of, serving_decode_steps, serving_prefill_chunk,
     serving_prefill_slot, serving_spec_step,
 )
-from paddle_tpu.observability.metrics import get_registry
-from paddle_tpu.observability.trace import span
-from paddle_tpu.ops.decode_attention import init_kv_cache, masked_lengths
+from paddle_tpu.serving.kv_cache import KVCacheManager
+from paddle_tpu.serving.metrics import EngineMetrics
 
 # the serving step/prefill programs donate their cache buffers (in-place
 # update on TPU instead of a full-cache copy per dispatch); CPU has no
@@ -116,105 +115,6 @@ def _host_fetch(*arrays):
     PTL004 rule keep flagging raw ``np.asarray`` added inside step loops
     without false-positiving on the pipelined drain."""
     return [np.asarray(a) for a in arrays]
-
-
-class _EngineMetrics:
-    """Pre-bound metric children for one engine (observability subsystem).
-
-    The series live in ``registry`` (default: the process-wide one) keyed by
-    a ``policy`` label, so a continuous engine and its gang baseline stay
-    separable in one scrape.  All instrumentation is host-side bookkeeping —
-    the compiled device programs are untouched, which is what keeps the
-    instrumented engine's token outputs byte-identical to an uninstrumented
-    run (tested: tests/test_observability.py).
-    """
-
-    def __init__(self, registry, policy, batch_size):
-        reg = registry if registry is not None else get_registry()
-        self.registry = reg
-        L = ("policy",)
-        lbl = {"policy": policy}
-        self.queue_depth = reg.gauge(
-            "serving_queue_depth", "requests waiting for a slot",
-            L).labels(**lbl)
-        self.slots_occupied = reg.gauge(
-            "serving_slots_occupied", "batch slots holding a live request",
-            L).labels(**lbl)
-        self.slots_total = reg.gauge(
-            "serving_slots_total", "engine batch size", L).labels(**lbl)
-        self.slots_total.set(batch_size)
-        self.admitted = reg.counter(
-            "serving_requests_admitted_total",
-            "requests admitted into a slot", L).labels(**lbl)
-        self.retired = reg.counter(
-            "serving_requests_retired_total",
-            "requests completed (EOS or max_new_tokens)", L).labels(**lbl)
-        self.emitted = reg.counter(
-            "serving_tokens_emitted_total",
-            "tokens delivered to requests", L).labels(**lbl)
-        self.steps = reg.counter(
-            "serving_steps_total", "scheduler iterations", L).labels(**lbl)
-        self._prefills = reg.counter(
-            "serving_prefill_total", "slot prefills by prompt bucket",
-            ("policy", "bucket"))
-        self._policy = policy
-        self.queue_wait = reg.histogram(
-            "serving_queue_wait_seconds",
-            "submit -> slot admission", L).labels(**lbl)
-        self.ttft = reg.histogram(
-            "serving_ttft_seconds", "submit -> first token", L).labels(**lbl)
-        self.tpot = reg.histogram(
-            "serving_tpot_seconds",
-            "mean per-token time after the first", L).labels(**lbl)
-        self.e2e = reg.histogram(
-            "serving_e2e_seconds", "submit -> completion", L).labels(**lbl)
-        self.stream_cb_errors = reg.counter(
-            "serving_stream_cb_errors_total",
-            "stream_cb exceptions swallowed by the scheduler",
-            L).labels(**lbl)
-        self.spec_drafted = reg.counter(
-            "serving_spec_drafted_total",
-            "draft tokens proposed by prompt-lookup", L).labels(**lbl)
-        self.spec_accepted = reg.counter(
-            "serving_spec_accepted_total",
-            "draft tokens accepted by the verify forward", L).labels(**lbl)
-        self.spec_accept_rate = reg.gauge(
-            "serving_spec_accept_rate",
-            "cumulative accepted/drafted ratio", L).labels(**lbl)
-        self.prefill_chunks = reg.counter(
-            "serving_prefill_chunks_total",
-            "prompt chunks dispatched by the chunked-prefill path",
-            L).labels(**lbl)
-        self.prefill_backlog = reg.gauge(
-            "serving_prefill_backlog",
-            "prompt chunks still to dispatch across slots mid-prefill",
-            L).labels(**lbl)
-        self.tpot_admission = reg.histogram(
-            "serving_tpot_during_admission_seconds",
-            "per-token decode interval observed while a prefill "
-            "(monolithic or chunked) was in progress — the decode-"
-            "interference histogram", L).labels(**lbl)
-        self.pipeline_stall = reg.histogram(
-            "serving_pipeline_stall_seconds",
-            "drain-side block waiting on the inflight dispatch",
-            L).labels(**lbl)
-        self.inflight = reg.gauge(
-            "serving_inflight_steps",
-            "device steps dispatched but not yet drained", L).labels(**lbl)
-        self.span_step = span("serving.step", registry=reg)
-        self.span_prefill = span("serving.prefill", registry=reg)
-        self.span_decode = span("serving.decode", registry=reg)
-        self.span_spec = span("serving.spec_step", registry=reg)
-
-    def prefill(self, bucket):
-        self._prefills.labels(policy=self._policy, bucket=bucket).inc()
-
-    def spec_round(self, drafted, accepted):
-        self.spec_drafted.inc(drafted)
-        self.spec_accepted.inc(accepted)
-        total = self.spec_drafted.value
-        if total:
-            self.spec_accept_rate.set(self.spec_accepted.value / total)
 
 
 class Request:
@@ -300,23 +200,37 @@ class ServingEngine:
     max prefill chunks dispatched per scheduler step before the decode
     step goes out — bounds how long resident decode can stall on an
     admission (both knobs tuned via ``bench_sweep.py prefill_chunk``).
+    ``mesh``: a ``jax.sharding.Mesh`` to tensor-parallel the compiled
+    hot path across (``None`` = single-device, bitwise the pre-mesh
+    engine).  Params are shard-placed once at construction under the
+    llama TP rules and the KV cache shards along heads
+    (serving/sharding.py); every host-facing operand stays replicated,
+    so the scheduler, pipeline, and chunked prefill above this line run
+    unchanged.  ``tp_axis`` names the mesh axis to shard along (default
+    ``"mp"``); the attention and KV head counts must divide its size.
     """
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
                  spec_k=8, sync_every=1, policy="continuous",
                  prompt_buckets=None, detokenizer=None, registry=None,
                  instrument=True, pipeline=True, decode_chunk=256,
-                 prefill_chunk=256, prefill_budget=2):
+                 prefill_chunk=256, prefill_budget=2, mesh=None,
+                 tp_axis="mp"):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
             raise ValueError(f"unknown policy {policy!r}")
+        if mesh is not None and tp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh has no axis {tp_axis!r} (axes: {mesh.axis_names})")
+        mesh_devices = int(mesh.shape[tp_axis]) if mesh is not None else 1
         # observability: purely host-side counters/gauges/histograms/spans
         # keyed by policy (paddle_tpu/observability).  ``registry=None``
         # feeds the process-wide registry; benches pass private registries
         # for isolated readings.  ``instrument=False`` removes every metric
         # touch — token outputs are byte-identical either way (tested).
-        self._m = (_EngineMetrics(registry, policy, int(batch_size))
+        self._m = (EngineMetrics(registry, policy, int(batch_size),
+                                  mesh_devices=mesh_devices)
                    if instrument else None)
         self._B = int(batch_size)
         self._lmax = int(max_len)
@@ -337,8 +251,34 @@ class ServingEngine:
         self._params, self._cfg = _decode_params_of(model, self._lmax)
         nh, nkv, hd, eps = self._cfg
         dtype = self._params["embed"].dtype
-        self._caches = [init_kv_cache(self._B, self._lmax, nkv, hd, dtype)
-                        for _ in self._params["layers"]]
+        # mesh=None: single-device engine, module-level jitted programs,
+        # byte-identical to every prior release.  mesh set: params are
+        # shard-placed ONCE here under the llama TP rules, the KV cache is
+        # head-sharded, and the four entry points dispatch through the
+        # process-wide cached TP programs (serving/sharding.py).  Host
+        # scheduler state (cur/lengths/queues) stays replicated either way.
+        self._tp = None
+        cache_sharding = None
+        if mesh is not None:
+            from paddle_tpu.serving.sharding import (
+                shard_decode_params, serving_tp_programs)
+            n = mesh_devices
+            if nkv % n or nh % n:
+                raise ValueError(
+                    f"heads not shardable {n}-way along {tp_axis!r}: "
+                    f"num_attention_heads={nh}, num_key_value_heads={nkv} "
+                    f"(the KV cache shards along heads)")
+            self._params, pspecs = shard_decode_params(
+                self._params, mesh, axis=tp_axis)
+            self._tp = serving_tp_programs(
+                mesh, tp_axis, self._cfg, pspecs,
+                len(self._params["layers"]), sync_every=self._sync,
+                spec_k=self._spec_k, with_hist=mode == "spec",
+                chunk_size=self._chunk)
+            cache_sharding = self._tp.cache_sharding
+        self._kv = KVCacheManager(
+            len(self._params["layers"]), self._B, self._lmax, nkv, hd,
+            dtype, sharding=cache_sharding)
         if prompt_buckets is None:
             prompt_buckets = []
             b = 16
@@ -352,10 +292,9 @@ class ServingEngine:
             raise ValueError(
                 "prompt_buckets must be sorted strictly ascending (submit "
                 f"bisects over them), got {self._buckets}")
-        # host mirrors of per-slot device state
-        self._len = np.zeros((self._B,), np.int32)
+        # host mirror of the carried next-token per slot; lengths and the
+        # slot -> request table live on the cache manager
         self._cur = np.zeros((self._B,), np.int32)
-        self._reqs = [None] * self._B
         if mode == "spec":
             self._hist = jnp.zeros((self._B, self._lmax), jnp.int32)
             self._hist_len = jnp.zeros((self._B,), jnp.int32)
@@ -388,7 +327,7 @@ class ServingEngine:
     # ------------------------------------------------------------- scheduling
     @property
     def has_work(self):
-        return (bool(self._queue) or any(r is not None for r in self._reqs)
+        return (bool(self._queue) or self._kv.any_live()
                 or self._inflight is not None)
 
     def _headroom(self):
@@ -440,10 +379,55 @@ class ServingEngine:
         """Slot ``i`` holds a live request that finished prefilling — the
         population the decode dispatch runs over.  Slots mid-prefill stay
         parked (masked_lengths) until their final chunk is dispatched."""
-        return self._reqs[i] is not None and i not in self._pf
+        return self._kv.reqs[i] is not None and i not in self._pf
+
+    # --------------------------------------------------- program dispatch
+    # the four compiled entry points behind ONE seam: mesh=None dispatches
+    # the module-level single-device jits (bitwise the pre-mesh engine);
+    # a mesh dispatches the cached TP programs (serving/sharding.py —
+    # statics baked in at construction).  Both take and return replicated
+    # host-facing operands, so every caller is placement-oblivious.
+    def _call_decode(self, cur, dev_len):
+        if self._tp is not None:
+            return self._tp.decode_steps(self._params, cur,
+                                         self._kv.caches, dev_len)
+        return serving_decode_steps(
+            self._params, self._cfg, cur, self._kv.caches, dev_len,
+            n_steps=self._sync, chunk_size=self._chunk)
+
+    def _call_spec(self, cur, dev_len, active):
+        if self._tp is not None:
+            return self._tp.spec_step(self._params, cur, self._kv.caches,
+                                      dev_len, self._hist, self._hist_len,
+                                      active)
+        return serving_spec_step(
+            self._params, self._cfg, cur, self._kv.caches, dev_len,
+            self._hist, self._hist_len, active, spec_k=self._spec_k,
+            chunk_size=self._chunk)
+
+    def _call_prefill_slot(self, tokens, prompt_len, slot):
+        if self._tp is not None:
+            return self._tp.prefill_slot(self._params, tokens, prompt_len,
+                                         self._kv.caches, slot,
+                                         self._hist, self._hist_len)
+        return serving_prefill_slot(
+            self._params, self._cfg, tokens, prompt_len, self._kv.caches,
+            slot, hist=self._hist, hist_len=self._hist_len,
+            with_hist=self._mode == "spec", chunk_size=self._chunk)
+
+    def _call_prefill_chunk(self, tokens, offset, prompt_len, slot):
+        if self._tp is not None:
+            return self._tp.prefill_chunk(self._params, tokens, offset,
+                                          prompt_len, self._kv.caches,
+                                          slot, self._hist, self._hist_len)
+        return serving_prefill_chunk(
+            self._params, self._cfg, tokens, offset, prompt_len,
+            self._kv.caches, slot, hist=self._hist,
+            hist_len=self._hist_len, with_hist=self._mode == "spec",
+            chunk_size=self._chunk)
 
     def _admit(self):
-        free = [i for i in range(self._B) if self._reqs[i] is None]
+        free = self._kv.free_slots()
         if not free or not self._queue:
             return
         if self._policy == "gang" and len(free) < self._B:
@@ -457,7 +441,7 @@ class ServingEngine:
         while free and self._queue:
             r = self._queue.popleft()
             slot = free.pop(0)
-            self._reqs[slot] = r
+            self._kv.assign(slot, r)
             p = r.prompt_ids.size
             if m is not None:
                 m.admitted.inc()
@@ -466,16 +450,14 @@ class ServingEngine:
             tokens = np.zeros((1, r._bucket), np.int32)
             tokens[0, :p] = r.prompt_ids
             with m.span_prefill if m is not None else _NULL_CTX:
-                first, self._caches, hist, hist_len = serving_prefill_slot(
-                    self._params, self._cfg, jnp.asarray(tokens),
-                    jnp.asarray(np.array([p], np.int32)), self._caches,
-                    jnp.asarray(slot, jnp.int32),
-                    hist=self._hist, hist_len=self._hist_len,
-                    with_hist=self._mode == "spec",
-                    chunk_size=self._chunk)
+                first, self._kv.caches, hist, hist_len = \
+                    self._call_prefill_slot(
+                        jnp.asarray(tokens),
+                        jnp.asarray(np.array([p], np.int32)),
+                        jnp.asarray(slot, jnp.int32))
             if self._mode == "spec":
                 self._hist, self._hist_len = hist, hist_len
-            self._len[slot] = p
+            self._kv.lengths[slot] = p
             self._adm_pending.add(slot)
             pending.append((slot, first))
         # every prefill in the wave is dispatched (async) above; block ONCE
@@ -488,8 +470,7 @@ class ServingEngine:
             self._emit(slot, [first])
         if m is not None:
             m.queue_depth.set(len(self._queue))
-            m.slots_occupied.set(
-                sum(r is not None for r in self._reqs))
+            m.slots_occupied.set(self._kv.occupied())
 
     def _admit_chunked(self, free):
         """Chunked admission: assign freed slots and queue each prompt for
@@ -502,7 +483,7 @@ class ServingEngine:
         while free and self._queue:
             r = self._queue.popleft()
             slot = free.pop(0)
-            self._reqs[slot] = r
+            self._kv.assign(slot, r)
             p = int(r.prompt_ids.size)
             padded = np.zeros((-(-p // P) * P,), np.int32)
             padded[:p] = r.prompt_ids
@@ -516,7 +497,7 @@ class ServingEngine:
                 m.queue_wait.observe(time.perf_counter() - r.t_submit)
         if m is not None:
             m.queue_depth.set(len(self._queue))
-            m.slots_occupied.set(sum(r is not None for r in self._reqs))
+            m.slots_occupied.set(self._kv.occupied())
 
     def _spend_prefill(self):
         """Dispatch up to ``prefill_budget`` prompt chunks across the
@@ -541,14 +522,11 @@ class ServingEngine:
             while budget:
                 chunk = st["tok"][st["off"]:st["off"] + P][None, :]
                 with m.span_prefill if m is not None else _NULL_CTX:
-                    first, self._caches, hist, hist_len = \
-                        serving_prefill_chunk(
-                            self._params, self._cfg, jnp.asarray(chunk),
+                    first, self._kv.caches, hist, hist_len = \
+                        self._call_prefill_chunk(
+                            jnp.asarray(chunk),
                             jnp.asarray(st["off"], jnp.int32), st["plen"],
-                            self._caches, jnp.asarray(slot, jnp.int32),
-                            hist=self._hist, hist_len=self._hist_len,
-                            with_hist=self._mode == "spec",
-                            chunk_size=self._chunk)
+                            jnp.asarray(slot, jnp.int32))
                 if self._mode == "spec":
                     self._hist, self._hist_len = hist, hist_len
                 st["off"] += P
@@ -558,7 +536,7 @@ class ServingEngine:
                     m.prefill_chunks.inc()
                 if st["off"] >= st["p"]:
                     del self._pf[slot]
-                    self._len[slot] = st["p"]
+                    self._kv.lengths[slot] = st["p"]
                     self._dev_first[slot] = first
                     self._pending_firsts.append((slot, st["req"], first))
                     break
@@ -579,7 +557,7 @@ class ServingEngine:
         for (slot, r, _), fv in zip(pend, vals):
             self._cur[slot] = int(fv[0])
             self._dev_first.pop(slot, None)
-            if self._reqs[slot] is r:
+            if self._kv.reqs[slot] is r:
                 emitted += self._emit(slot, [int(fv[0])])
         return emitted
 
@@ -587,7 +565,7 @@ class ServingEngine:
         """Append emitted tokens to the slot's request, truncating at EOS /
         max_new_tokens; retires the slot when the request completes.
         Returns the number of tokens actually consumed."""
-        r = self._reqs[slot]
+        r = self._kv.reqs[slot]
         m = self._m
         took = 0
         for t in toks:
@@ -619,14 +597,13 @@ class ServingEngine:
                         m.stream_cb_errors.inc()
         if r.done:
             r.t_done = time.perf_counter()
-            self._reqs[slot] = None
+            self._kv.release(slot)
             self._finished.append(r)
             if m is not None:
                 m.retired.inc()
                 m.e2e.observe(r.t_done - r.t_submit)
                 m.tpot.observe(r.tpot)
-                m.slots_occupied.set(
-                    sum(q is not None for q in self._reqs))
+                m.slots_occupied.set(self._kv.occupied())
         return took
 
     # ------------------------------------------------------------ step / run
@@ -684,33 +661,28 @@ class ServingEngine:
         if not live:
             return emitted
         active = np.array([self._decodable(i) for i in range(self._B)])
-        dev_len = masked_lengths(jnp.asarray(self._len), jnp.asarray(active),
-                                 self._lmax)
+        dev_len = self._kv.device_lengths(active)
         if self._mode == "greedy":
             with m.span_decode if m is not None else _NULL_CTX:
-                toks, self._caches = serving_decode_steps(
-                    self._params, self._cfg, jnp.asarray(self._cur),
-                    self._caches, dev_len, n_steps=self._sync,
-                    chunk_size=self._chunk)
+                toks, self._kv.caches = self._call_decode(
+                    jnp.asarray(self._cur), dev_len)
                 (toks,) = _host_fetch(toks)
             self._observe_interference(adm_active, self._sync)
             for i in live:
                 emitted += self._emit(i, toks[i].tolist())
-                self._len[i] += self._sync
+                self._kv.lengths[i] += self._sync
                 self._cur[i] = toks[i, -1]
         else:
             with m.span_spec if m is not None else _NULL_CTX:
-                blk, j, cur, _, self._caches, self._hist, self._hist_len = \
-                    serving_spec_step(
-                        self._params, self._cfg, jnp.asarray(self._cur),
-                        self._caches, dev_len, self._hist, self._hist_len,
-                        jnp.asarray(active), spec_k=self._spec_k,
-                        chunk_size=self._chunk)
+                blk, j, cur, _, self._kv.caches, self._hist, \
+                    self._hist_len = self._call_spec(
+                        jnp.asarray(self._cur), dev_len,
+                        jnp.asarray(active))
                 blk, j, cur = _host_fetch(blk, j, cur)
             accepted = 0
             for i in live:
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
-                self._len[i] += int(j[i]) + 1
+                self._kv.lengths[i] += int(j[i]) + 1
                 self._cur[i] = cur[i]
                 accepted += int(j[i])
             self._observe_interference(
@@ -739,8 +711,7 @@ class ServingEngine:
             return
         m = self._m
         active = np.array([self._decodable(i) for i in range(self._B)])
-        host_len = masked_lengths(jnp.asarray(self._len),
-                                  jnp.asarray(active), self._lmax)
+        host_len = self._kv.device_lengths(active)
         use_host = ~active
         use_host[list(self._adm_pending)] = True
         # freshly prefilled slots: length is host-known (the prompt length,
@@ -761,14 +732,12 @@ class ServingEngine:
             # exactly sync_every per dispatch, so the mirror (bumped below)
             # IS the device value and needs no device carry
             with m.span_decode if m is not None else _NULL_CTX:
-                toks, self._caches = serving_decode_steps(
-                    self._params, self._cfg, cur, self._caches, host_len,
-                    n_steps=self._sync, chunk_size=self._chunk)
+                toks, self._kv.caches = self._call_decode(cur, host_len)
             self._dev_cur = toks[:, -1]
             for i in live:
-                self._len[i] += self._sync
+                self._kv.lengths[i] += self._sync
             self._inflight = {"kind": "greedy", "toks": toks,
-                              "reqs": list(self._reqs), "live": live,
+                              "reqs": list(self._kv.reqs), "live": live,
                               "firsts": firsts, "adm": adm_active}
         else:
             if self._dev_len is None:
@@ -781,15 +750,12 @@ class ServingEngine:
                 dev_len = jnp.where(jnp.asarray(use_host_len), host_len,
                                     self._dev_len)
             with m.span_spec if m is not None else _NULL_CTX:
-                blk, j, cur2, new_len, self._caches, self._hist, \
-                    self._hist_len = serving_spec_step(
-                        self._params, self._cfg, cur, self._caches,
-                        dev_len, self._hist, self._hist_len,
-                        jnp.asarray(active), spec_k=self._spec_k,
-                        chunk_size=self._chunk)
+                blk, j, cur2, new_len, self._kv.caches, self._hist, \
+                    self._hist_len = self._call_spec(
+                        cur, dev_len, jnp.asarray(active))
             self._dev_cur, self._dev_len = cur2, new_len
             self._inflight = {"kind": "spec", "blk": blk, "j": j,
-                              "reqs": list(self._reqs), "live": live,
+                              "reqs": list(self._kv.reqs), "live": live,
                               "firsts": firsts, "adm": adm_active}
         self._adm_pending.clear()
         if m is not None:
@@ -823,11 +789,11 @@ class ServingEngine:
             # (program order: final prefill chunk, then this decode step) —
             # emit them ahead of the slot's decode block
             for (slot, r, _), fv in zip(firsts, fvals):
-                if self._reqs[slot] is r:
+                if self._kv.reqs[slot] is r:
                     self._cur[slot] = int(fv[0])
                     emitted += self._emit(slot, [int(fv[0])])
             for i in rec["live"]:
-                if self._reqs[i] is not rec["reqs"][i]:
+                if self._kv.reqs[i] is not rec["reqs"][i]:
                     continue
                 emitted += self._emit(i, toks[i].tolist())
                 self._cur[i] = toks[i, -1]
@@ -839,17 +805,17 @@ class ServingEngine:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
                 m.inflight.set(still_inflight)
             for (slot, r, _), fv in zip(firsts, fvals):
-                if self._reqs[slot] is r:
+                if self._kv.reqs[slot] is r:
                     self._cur[slot] = int(fv[0])
                     emitted += self._emit(slot, [int(fv[0])])
             accepted = 0
             drained = 0
             for i in rec["live"]:
-                if self._reqs[i] is not rec["reqs"][i]:
+                if self._kv.reqs[i] is not rec["reqs"][i]:
                     continue
                 drained += 1
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
-                self._len[i] += int(j[i]) + 1
+                self._kv.lengths[i] += int(j[i]) + 1
                 accepted += int(j[i])
             self._observe_interference(
                 rec.get("adm", False), 1.0 + accepted / max(1, drained))

@@ -1,0 +1,88 @@
+"""KV-cache + slot state machine for the serving engine.
+
+Extracted from serving/engine.py so placement policies (tensor-parallel
+head sharding today, paged block tables next — ROADMAP item 2) plug in
+underneath the scheduler without re-threading it.  The manager owns the
+three per-slot facts the engine's scheduling logic reads and the device
+programs consume:
+
+* ``caches`` — the per-layer ``(k, v)`` pytrees, ``[B, Lmax, Hkv, D]``
+  each, preallocated once (ops.decode_attention.init_kv_cache) and
+  thereafter only REBOUND by the engine to each dispatch's donated
+  outputs.  With ``sharding`` set (a ``NamedSharding`` over the head
+  axis — serving/sharding.kv_cache_pspec) the zeros are placed sharded
+  at construction, so every later donated output inherits the layout and
+  no per-step resharding ever happens.
+* ``lengths`` — the host int32 mirror of each slot's device write offset
+  (prompt + emitted so far).  The engine bumps it as dispatches go out;
+  ``device_lengths`` masks it through
+  ops.decode_attention.masked_lengths, which parks every dead slot at
+  ``max_len`` so its cache writes DROP — retirement needs no reshape,
+  copy-out, or recompile (the write-drop parking invariant).
+* ``reqs`` — slot -> live Request (None = free).  Slot allocation is
+  lowest-free-first; the engine compares stored Request objects by
+  identity at drain time to discard stale pipelined tokens, so the
+  manager never recycles state, only the slot index.
+
+Everything here is host-side bookkeeping plus ONE eager masking op;
+nothing dispatches a compiled step — that stays the engine's job.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.decode_attention import init_kv_cache, masked_lengths
+
+__all__ = ["KVCacheManager"]
+
+
+class KVCacheManager:
+    """Slot allocator + KV-cache owner for one fixed-batch engine."""
+
+    def __init__(self, n_layers, batch_size, max_len, num_kv_heads,
+                 head_dim, dtype, sharding=None):
+        self.batch_size = int(batch_size)
+        self.max_len = int(max_len)
+        caches = [init_kv_cache(self.batch_size, self.max_len,
+                                num_kv_heads, head_dim, dtype)
+                  for _ in range(n_layers)]
+        if sharding is not None:
+            caches = [(jax.device_put(k, sharding),
+                       jax.device_put(v, sharding)) for k, v in caches]
+        self.caches = caches
+        self.sharding = sharding
+        # host mirrors of per-slot device state
+        self.lengths = np.zeros((self.batch_size,), np.int32)
+        self.reqs = [None] * self.batch_size
+
+    # ------------------------------------------------------------- slots
+    def free_slots(self):
+        """Free slot indices, lowest first (the admission fill order)."""
+        return [i for i in range(self.batch_size) if self.reqs[i] is None]
+
+    def occupied(self):
+        """Count of slots holding a live request."""
+        return sum(r is not None for r in self.reqs)
+
+    def any_live(self):
+        return any(r is not None for r in self.reqs)
+
+    def assign(self, slot, request):
+        """Bind ``request`` to ``slot`` (admission)."""
+        self.reqs[slot] = request
+
+    def release(self, slot):
+        """Free ``slot`` (retirement).  The cache rows are NOT touched:
+        ``device_lengths`` parks the slot at ``max_len`` so subsequent
+        writes drop, and the next occupant's prefill overwrites them."""
+        self.reqs[slot] = None
+
+    # ------------------------------------------------------------ device
+    def device_lengths(self, active):
+        """The device lengths operand for one dispatch: the host mirror
+        with every non-``active`` slot masked to ``max_len`` (write-drop
+        parking)."""
+        return masked_lengths(jnp.asarray(self.lengths),
+                              jnp.asarray(active), self.max_len)

@@ -1,0 +1,121 @@
+"""Pre-bound observability series for one serving engine.
+
+Extracted from serving/engine.py alongside the KVCacheManager so the
+engine file holds scheduling logic only.  The series live in ``registry``
+(default: the process-wide one) keyed by a ``policy`` label, so a
+continuous engine and its gang baseline stay separable in one scrape.
+All instrumentation is host-side bookkeeping — the compiled device
+programs are untouched, which is what keeps the instrumented engine's
+token outputs byte-identical to an uninstrumented run (tested:
+tests/test_observability.py).
+"""
+from __future__ import annotations
+
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.observability.trace import span
+
+__all__ = ["EngineMetrics"]
+
+
+class EngineMetrics:
+    """One engine's metric children, bound once at construction."""
+
+    def __init__(self, registry, policy, batch_size, mesh_devices=1):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        L = ("policy",)
+        lbl = {"policy": policy}
+        # sharded engines label their spans with the mesh device count so
+        # a single-chip run ("" — the default every host span gets) and a
+        # TP run stay separable per scrape; the gauge carries the count
+        mesh_label = str(mesh_devices) if mesh_devices > 1 else ""
+        self.mesh_devices = reg.gauge(
+            "serving_mesh_devices",
+            "devices the engine's compiled programs span (1 = single-chip)",
+            L).labels(**lbl)
+        self.mesh_devices.set(mesh_devices)
+        self.queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting for a slot",
+            L).labels(**lbl)
+        self.slots_occupied = reg.gauge(
+            "serving_slots_occupied", "batch slots holding a live request",
+            L).labels(**lbl)
+        self.slots_total = reg.gauge(
+            "serving_slots_total", "engine batch size", L).labels(**lbl)
+        self.slots_total.set(batch_size)
+        self.admitted = reg.counter(
+            "serving_requests_admitted_total",
+            "requests admitted into a slot", L).labels(**lbl)
+        self.retired = reg.counter(
+            "serving_requests_retired_total",
+            "requests completed (EOS or max_new_tokens)", L).labels(**lbl)
+        self.emitted = reg.counter(
+            "serving_tokens_emitted_total",
+            "tokens delivered to requests", L).labels(**lbl)
+        self.steps = reg.counter(
+            "serving_steps_total", "scheduler iterations", L).labels(**lbl)
+        self._prefills = reg.counter(
+            "serving_prefill_total", "slot prefills by prompt bucket",
+            ("policy", "bucket"))
+        self._policy = policy
+        self.queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit -> slot admission", L).labels(**lbl)
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds", "submit -> first token", L).labels(**lbl)
+        self.tpot = reg.histogram(
+            "serving_tpot_seconds",
+            "mean per-token time after the first", L).labels(**lbl)
+        self.e2e = reg.histogram(
+            "serving_e2e_seconds", "submit -> completion", L).labels(**lbl)
+        self.stream_cb_errors = reg.counter(
+            "serving_stream_cb_errors_total",
+            "stream_cb exceptions swallowed by the scheduler",
+            L).labels(**lbl)
+        self.spec_drafted = reg.counter(
+            "serving_spec_drafted_total",
+            "draft tokens proposed by prompt-lookup", L).labels(**lbl)
+        self.spec_accepted = reg.counter(
+            "serving_spec_accepted_total",
+            "draft tokens accepted by the verify forward", L).labels(**lbl)
+        self.spec_accept_rate = reg.gauge(
+            "serving_spec_accept_rate",
+            "cumulative accepted/drafted ratio", L).labels(**lbl)
+        self.prefill_chunks = reg.counter(
+            "serving_prefill_chunks_total",
+            "prompt chunks dispatched by the chunked-prefill path",
+            L).labels(**lbl)
+        self.prefill_backlog = reg.gauge(
+            "serving_prefill_backlog",
+            "prompt chunks still to dispatch across slots mid-prefill",
+            L).labels(**lbl)
+        self.tpot_admission = reg.histogram(
+            "serving_tpot_during_admission_seconds",
+            "per-token decode interval observed while a prefill "
+            "(monolithic or chunked) was in progress — the decode-"
+            "interference histogram", L).labels(**lbl)
+        self.pipeline_stall = reg.histogram(
+            "serving_pipeline_stall_seconds",
+            "drain-side block waiting on the inflight dispatch",
+            L).labels(**lbl)
+        self.inflight = reg.gauge(
+            "serving_inflight_steps",
+            "device steps dispatched but not yet drained", L).labels(**lbl)
+        self.span_step = span("serving.step", registry=reg,
+                              mesh=mesh_label)
+        self.span_prefill = span("serving.prefill", registry=reg,
+                                 mesh=mesh_label)
+        self.span_decode = span("serving.decode", registry=reg,
+                                mesh=mesh_label)
+        self.span_spec = span("serving.spec_step", registry=reg,
+                              mesh=mesh_label)
+
+    def prefill(self, bucket):
+        self._prefills.labels(policy=self._policy, bucket=bucket).inc()
+
+    def spec_round(self, drafted, accepted):
+        self.spec_drafted.inc(drafted)
+        self.spec_accepted.inc(accepted)
+        total = self.spec_drafted.value
+        if total:
+            self.spec_accept_rate.set(self.spec_accepted.value / total)

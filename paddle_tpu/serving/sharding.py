@@ -1,0 +1,251 @@
+"""Tensor-parallel sharding for the serving hot path (GSPMD).
+
+The engine's compiled programs (models/llama_decode.py) are pure functions
+over a params pytree + KV caches, so mesh parallelism is a PLACEMENT
+decision, not a code change: pick a ``PartitionSpec`` per parameter, place
+the weights once, and re-``jit`` the same impl bodies with explicit in/out
+shardings — XLA's SPMD partitioner inserts the collectives.  This module
+owns that decision for llama serving:
+
+* ``match_partition_rules(rules, params)`` — the fmengine/fmtrainer idiom:
+  a regex → ``PartitionSpec`` table applied to the "/"-joined tree path of
+  every leaf.  Scalars (and size-1 leaves) are always replicated (``PS()``);
+  an unmatched non-scalar raises — silent replication of a 30B weight is
+  exactly the OOM this module exists to prevent.
+* ``llama_tp_rules(axis)`` — Megatron-style tensor parallelism for the
+  decode params pytree: attention qkv and the MLP gate/up are COLUMN-
+  parallel (output features split: ``PS(None, axis)``), the return
+  projections wo/down are ROW-parallel (input features split:
+  ``PS(axis, None)`` — each shard holds exactly the rows its column-
+  parallel producer computed, so the only collective per layer pair is
+  one psum on the residual add).  Embeddings, norms, the lm_head and the
+  rope tables replicate: they are small, and a replicated lm_head keeps
+  the sampled token replicated — which is what lets the host scheduler
+  stay mesh-oblivious.
+* ``kv_cache_pspec(axis)`` — the KV cache ``[B, Lmax, Hkv, D]`` shards
+  along the HEAD axis (``PS(None, None, axis, None)``).  Decode is
+  HBM-bound on KV reads (ops/decode_attention.py), and attention is
+  embarrassingly parallel over heads: each chip reads only its
+  ``Hkv / N`` heads — per-chip KV bytes/token drop by N, which is the
+  capacity lever (the ``serving_hbm_gb_per_tok_tp`` bench column).  The
+  chunked online-softmax read needs no change: its softmax/max/sum
+  reductions run over the per-head chunk axis, never across heads, and
+  its trip count reduces over the (replicated) lengths — head sharding
+  splits only the vmapped head dimension.
+* ``serving_tp_programs(...)`` — the four serving entry points re-jitted
+  over the SAME impl bodies with sharded params/caches in+out, replicated
+  ``cur``/``lengths``/``hist`` (the host-facing operands), and donated
+  cache buffers.  Instances are cached process-wide keyed by
+  (mesh, specs, statics): two engines on one mesh share compiled
+  programs, exactly like the module-level single-device jits — which is
+  what keeps warm sharded steps at zero retraces (``assert_no_retrace``).
+
+Replicated-scheduler-state invariant: everything the host scheduler
+touches (``cur``, ``lengths``, the spec history, emitted token blocks)
+goes in and comes out replicated, so the pipelined double-buffer, chunked
+prefill admission and ``_host_fetch`` drain in serving/engine.py run
+UNCHANGED on a mesh — a replicated array fetches like a single-device one.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from paddle_tpu.models.llama_decode import (
+    _mon, _serving_decode_steps_impl, _serving_prefill_chunk_impl,
+    _serving_prefill_slot_impl, _serving_spec_step_impl,
+)
+
+__all__ = ["match_partition_rules", "llama_tp_rules", "kv_cache_pspec",
+           "shard_decode_params", "serving_tp_programs", "TPPrograms"]
+
+
+def _path_str(path):
+    """tree path entries (DictKey/SequenceKey/...) -> "layers/0/wq"."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, params):
+    """Map ``rules`` — an ordered ``(regex, PartitionSpec)`` table — over a
+    params pytree, returning the matching PartitionSpec pytree.
+
+    Each leaf's tree path is joined with "/" (``layers/3/wq``) and matched
+    with ``re.search``; the FIRST matching rule wins, so put specific
+    rules above catch-alls.  Scalar and size-1 leaves short-circuit to
+    ``PS()`` (nothing to shard; rope scalars and norm epsilons never need
+    rules).  A non-scalar leaf no rule matches raises ``ValueError`` —
+    a new parameter must get an explicit placement decision, not a silent
+    full replica on every chip."""
+    def spec_of(path, leaf):
+        name = _path_str(path)
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return PS()
+        for rule, spec in rules:
+            if re.search(rule, name):
+                return spec
+        raise ValueError(f"no partition rule matched param {name!r} "
+                         f"with shape {tuple(shape)}")
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def llama_tp_rules(axis="mp"):
+    """Megatron-style tensor-parallel rules for the llama decode pytree
+    (module docstring has the column/row-parallel rationale)."""
+    return (
+        # column-parallel: split output features across the mesh
+        (r"(^|/)(wq|wk|wv|gate|up)$", PS(None, axis)),
+        # row-parallel: split input features; psum rejoins on the residual
+        (r"(^|/)(wo|down)$", PS(axis, None)),
+        # small + host-facing: replicate (keeps sampled tokens replicated)
+        (r"(^|/)(embed|norm|lm_head|ln1|ln2)$", PS()),
+        (r"(^|/)_rope($|/)", PS()),
+    )
+
+
+def kv_cache_pspec(axis="mp"):
+    """KV cache ``[B, Lmax, Hkv, D]`` sharded along the head axis."""
+    return PS(None, None, axis, None)
+
+
+def _tp_geometry_check(params, mesh, axis):
+    """Every sharded dimension must divide by the mesh axis size — an
+    indivisible placement would silently pad on some backends and raise on
+    others; fail loudly at engine construction instead."""
+    n = int(mesh.shape[axis])
+    specs = match_partition_rules(llama_tp_rules(axis), params)
+    bad = []
+
+    def chk(path, leaf, spec):
+        for dim, ax in enumerate(spec):
+            if ax is None:
+                continue
+            if int(leaf.shape[dim]) % n:
+                bad.append(f"{_path_str(path)} dim {dim} "
+                           f"({leaf.shape[dim]} % {n} != 0)")
+    jax.tree_util.tree_map_with_path(chk, params, specs)
+    if bad:
+        raise ValueError(
+            f"model not shardable {n}-way along mesh axis {axis!r}: "
+            + "; ".join(bad))
+    return specs
+
+
+def shard_decode_params(params, mesh, axis="mp"):
+    """Place the decode params pytree onto ``mesh`` under the llama TP
+    rules (validated for divisibility).  Returns ``(sharded_params,
+    specs)`` — a one-time placement at engine construction; after it the
+    sharded jits consume the weights in place with zero per-step
+    transfers."""
+    specs = _tp_geometry_check(params, mesh, axis)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    return sharded, specs
+
+
+class TPPrograms:
+    """The four serving entry points jitted with explicit mesh shardings.
+
+    Statics (``cfg``, ``n_steps``, ``spec_k``, ``with_hist``,
+    ``chunk_size``) are closed over — the engine fixes them at
+    construction, and closing over them keeps every TP program's calling
+    convention all-positional so ``in_shardings`` line up by position.
+    Cache buffers are donated exactly like the single-device exports
+    (plus the spec history on prefill, which the engine carries forward).
+    Each wrapper dispatches through the SAME ``_mon`` program name as its
+    single-device twin, so compile-cache hit/miss telemetry and
+    ``assert_no_retrace`` see one program family per entry point.
+    """
+
+    def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
+                 sync_every, spec_k, with_hist, chunk_size):
+        repl = NamedSharding(mesh, PS())
+        pshard = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, PS))
+        cshard = [(NamedSharding(mesh, kv_cache_pspec(axis)),) * 2
+                  for _ in range(n_layers)]
+        hshard = repl if with_hist else None
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = int(mesh.shape[axis])
+        self.cache_sharding = cshard[0][0] if n_layers else repl
+
+        def decode(params, cur, caches, dev_lengths):
+            return _serving_decode_steps_impl(
+                params, cfg, cur, caches, dev_lengths, n_steps=sync_every,
+                chunk_size=chunk_size)
+        self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
+            decode,
+            in_shardings=(pshard, repl, cshard, repl),
+            out_shardings=(repl, cshard),
+            donate_argnums=(2,)))
+
+        def spec(params, cur, caches, dev_lengths, hist, hist_len, active):
+            return _serving_spec_step_impl(
+                params, cfg, cur, caches, dev_lengths, hist, hist_len,
+                active, spec_k=spec_k, chunk_size=chunk_size)
+        self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
+            spec,
+            in_shardings=(pshard, repl, cshard, repl, repl, repl, repl),
+            out_shardings=(repl, repl, repl, repl, cshard, repl, repl)))
+
+        def pchunk(params, tokens, offset, prompt_len, caches, slot,
+                   hist, hist_len):
+            return _serving_prefill_chunk_impl(
+                params, cfg, tokens, offset, prompt_len, caches, slot,
+                hist=hist, hist_len=hist_len, with_hist=with_hist,
+                chunk_size=chunk_size)
+        self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
+            pchunk,
+            in_shardings=(pshard, repl, repl, repl, cshard, repl,
+                          hshard, repl),
+            out_shardings=(repl, cshard, hshard, repl),
+            donate_argnums=(4, 6) if with_hist else (4,)))
+
+        def pslot(params, tokens, prompt_len, caches, slot, hist, hist_len):
+            return _serving_prefill_slot_impl(
+                params, cfg, tokens, prompt_len, caches, slot,
+                hist=hist, hist_len=hist_len, with_hist=with_hist,
+                chunk_size=chunk_size)
+        self.prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
+            pslot,
+            in_shardings=(pshard, repl, repl, cshard, repl, hshard, repl),
+            out_shardings=(repl, cshard, hshard, repl),
+            donate_argnums=(3, 5) if with_hist else (3,)))
+
+
+# process-wide: two engines with the same (mesh, specs, statics) must
+# share compiled programs — per-engine jits would retrace per engine and
+# break the warm-path zero-retrace guarantee the single-device engine has
+_PROGRAMS = {}
+
+
+def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
+                        sync_every, spec_k, with_hist, chunk_size):
+    """Cached ``TPPrograms`` factory (see class docstring)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, PS))
+    key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
+           sync_every, spec_k, with_hist, chunk_size)
+    progs = _PROGRAMS.get(key)
+    if progs is None:
+        progs = _PROGRAMS[key] = TPPrograms(
+            mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
+            spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size)
+    return progs

@@ -200,10 +200,10 @@ class TestSpans:
             with span("phase.inner", registry=reg):
                 pass
         h = reg.get("span_seconds")
-        assert h.labels(name="phase.outer").count == 1
-        assert h.labels(name="phase.inner").count == 1
-        assert h.labels(name="phase.outer").sum >= \
-            h.labels(name="phase.inner").sum
+        assert h.labels(name="phase.outer", mesh="").count == 1
+        assert h.labels(name="phase.inner", mesh="").count == 1
+        assert h.labels(name="phase.outer", mesh="").sum >= \
+            h.labels(name="phase.inner", mesh="").sum
 
     def test_span_reentrant_single_instance(self):
         reg = MetricsRegistry()
@@ -211,7 +211,8 @@ class TestSpans:
         with s:
             with s:
                 pass
-        assert reg.get("span_seconds").labels(name="phase.re").count == 2
+        assert reg.get("span_seconds").labels(
+            name="phase.re", mesh="").count == 2
 
     def test_span_decorator(self):
         reg = MetricsRegistry()
@@ -221,7 +222,8 @@ class TestSpans:
             return x + 1
 
         assert f(1) == 2 and f(2) == 3
-        assert reg.get("span_seconds").labels(name="phase.fn").count == 2
+        assert reg.get("span_seconds").labels(
+            name="phase.fn", mesh="").count == 2
 
     def test_serving_spans_nest_in_chrome_trace(self, tmp_path):
         """Satellite: spans emitted during a B2 serving smoke appear in the
@@ -381,7 +383,7 @@ class TestCompileCacheMetrics:
         assert self._val("compile_cache_misses_total", **lab) == m0 + 1
         assert self._val("compile_cache_hits_total", **lab) == h0 + 1
         # train.step spans recorded in the default registry
-        sp = reg.get("span_seconds").labels(name="train.step")
+        sp = reg.get("span_seconds").labels(name="train.step", mesh="")
         assert sp.count >= 2
 
 

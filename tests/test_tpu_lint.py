@@ -157,6 +157,30 @@ class TestRuleFixtures:
                 return f([a, b])
         """) == ["PTL003"]
 
+    def test_retrace_tp_mesh_in_static_position(self):
+        # a Mesh built PER CALL in a static slot re-keys every dispatch
+        assert _rules("""
+            import functools
+            import jax
+            from jax.sharding import Mesh
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, mesh):
+                return x
+            def g(x, devs):
+                return f(x, Mesh(devs, ("mp",)))
+        """) == ["PTL003"]
+
+    def test_retrace_tp_named_sharding_static_kwarg(self):
+        assert _rules("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("sh",))
+            def f(x, sh=None):
+                return x
+            def g(x, mesh, spec):
+                return f(x, sh=jax.sharding.NamedSharding(mesh, spec))
+        """) == ["PTL003"]
+
     def test_retrace_tn(self):
         # tuple static, array variable dynamic: no churn
         assert _rules("""
@@ -167,6 +191,22 @@ class TestRuleFixtures:
                 return x
             def g(x):
                 return f(x, (1, 2))
+        """) == []
+
+    def test_retrace_tn_hoisted_mesh(self):
+        # the sanctioned pattern: ONE Mesh instance, reused per call —
+        # and an inline Mesh in a DYNAMIC position is someone else's
+        # problem (jax rejects it), not cache churn
+        assert _rules("""
+            import functools
+            import jax
+            from jax.sharding import Mesh
+            MESH = Mesh(DEVS, ("mp",))
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, mesh):
+                return x
+            def g(x):
+                return f(x, MESH)
         """) == []
 
     # PTL004 — host-sync-in-step-loop ----------------------------------
