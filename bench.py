@@ -271,10 +271,24 @@ def bench_serving(n_requests=64, batch=8):
     bytes/token the placement buys).  The row needs >1 host device, so
     the device-count forcing at the top of this function must run before
     jax initializes its backend; when it loses that race the TP columns
-    report the single-device fallback instead of failing the bench."""
+    report the single-device fallback instead of failing the bench.
+
+    Round 12 adds the degraded-mode smoke (the reliability layer,
+    serving/faults.py): the same mixed workload under a seeded FaultPlan
+    (5% transient dispatch faults retried with backoff, two poison
+    requests quarantined off the batch, deadlines on ~10% of traffic) and
+    a bounded admission queue the submit loop backpressures against —
+    ``serving_degraded_tok_per_sec`` (goodput: tokens of requests that
+    finished ``done``), ``serving_degraded_goodput_ratio`` (vs the clean
+    continuous run), and the terminal counts
+    (``serving_degraded_{shed,timed_out,poisoned,retries}``) read off the
+    engine's own reliability counters.  The column the row exists for is
+    the ratio: injected faults must degrade throughput proportionally —
+    never collapse it."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import MetricsRegistry
-    from paddle_tpu.serving import Request, ServingEngine
+    from paddle_tpu.serving import (EngineOverloaded, FaultPlan, Request,
+                                    ServingEngine)
 
     # TP row device forcing — effective only while the backend is still
     # uninitialized (BENCH_ONLY=bench_serving guarantees that; a full
@@ -461,6 +475,39 @@ def bench_serving(n_requests=64, batch=8):
                  + tp_kv_row * float(np.mean(plens + olens / 2)) / n_tp)
                 / 1e9, 4),
         }
+    # A/B 5 (round 12) — degraded-mode smoke: the standard workload under
+    # a seeded fault plan + bounded queue; goodput counts only requests
+    # that finished "done" (shed/timed_out/poisoned traffic is the cost
+    # being measured, not throughput)
+    fplan = FaultPlan(seed=12, dispatch_error_rate=0.05,
+                      poison={1: 8, 5: 24})
+    reg_fb = MetricsRegistry()
+    eng_fb = ServingEngine(model, batch_size=batch, max_len=lmax,
+                           mode="greedy", sync_every=4, registry=reg_fb,
+                           max_pending=2 * batch, retry_backoff=1e-3,
+                           faults=fplan)
+    fb_deadline = 500 if small else 30_000
+    shed_n = 0
+    t0 = time.perf_counter()
+    for i, (p, o) in enumerate(zip(prompts, olens)):
+        dl = fb_deadline if i % 10 == 0 else None
+        while True:
+            try:
+                eng_fb.submit(Request(p, int(o), rid=i, deadline_ms=dl))
+                break
+            except EngineOverloaded:
+                # client backpressure: spend a step to drain the queue,
+                # then resubmit — each rejection is one shed
+                shed_n += 1
+                eng_fb.step()
+    fb_statuses = eng_fb.drain()
+    dt_fb = time.perf_counter() - t0
+    good_tok = sum(len(r.output_ids) for r in eng_fb._finished
+                   if r.status == "done")
+
+    def _rel(series):
+        return int(reg_fb.get(series).labels(policy="continuous").value)
+
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -511,6 +558,19 @@ def bench_serving(n_requests=64, batch=8):
             hbm_gb_per_tok(ctx_lo), 4),
         # tensor-parallel A/B (round 11)
         **tp_cols,
+        # degraded-mode smoke (round 12): goodput under injected faults
+        "serving_degraded_tok_per_sec": round(good_tok / dt_fb, 1),
+        "serving_degraded_goodput_ratio": round(
+            (good_tok / dt_fb) / (total_new / dt_c), 2),
+        "serving_degraded_done": sum(
+            1 for s in fb_statuses.values() if s == "done"),
+        "serving_degraded_shed": shed_n,
+        "serving_degraded_timed_out": _rel(
+            "serving_requests_timed_out_total"),
+        "serving_degraded_poisoned": _rel(
+            "serving_requests_poisoned_total"),
+        "serving_degraded_retries": _rel(
+            "serving_dispatch_retries_total"),
     }
 
 

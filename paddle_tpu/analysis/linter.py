@@ -61,6 +61,13 @@ _SYNC_METHODS = {"block_until_ready", "item", "numpy"}
 # name — `from numpy import asarray as host_fetch` — resolves to
 # numpy.asarray instead and stays flagged.
 _SYNC_HELPERS = {"host_fetch", "_host_fetch"}
+# blocking waits inside step loops (PTL008): time.sleep stalls the host
+# while the device sits idle — same pipeline serialization as a sync.
+# The bounded-retry backoff helper (serving/engine.py `_backoff_sleep`)
+# is the sanctioned exemption, resolved the same way as _SYNC_HELPERS: a
+# `from time import sleep as _backoff_sleep` alias resolves to
+# time.sleep and stays flagged.
+_WAIT_SANCTIONED = {"backoff_sleep", "_backoff_sleep"}
 # loops dispatching compiled per-iteration device work: decode/spec step
 # calls (`..._step`/`..._steps`) and the serving engine's chunked-prefill
 # dispatch loop (`serving_prefill_chunk` under `prefill_budget`) — a host
@@ -313,6 +320,7 @@ class _Loop:
     node: object
     has_step: bool = False
     syncs: list = field(default_factory=list)
+    waits: list = field(default_factory=list)
 
 
 class _Checker:
@@ -461,8 +469,14 @@ class _Checker:
                 self.emit("PTL004", call,
                           f"`{what}` inside a loop that dispatches a "
                           "compiled step forces a host sync every iteration")
+            for call, what in rec.waits:
+                self.emit("PTL008", call,
+                          f"`{what}` inside a loop that dispatches a "
+                          "compiled step stalls the host while the device "
+                          "idles")
         elif self.loop_stack:
             self.loop_stack[-1].syncs.extend(rec.syncs)
+            self.loop_stack[-1].waits.extend(rec.waits)
 
     def _loop_targets(self):
         names = set()
@@ -597,6 +611,17 @@ class _Checker:
                 f is None or f.split(".")[-1] in _SYNC_HELPERS)
             if sync is not None and not sanctioned:
                 rec.syncs.append((node, sync))
+            # PTL008: blocking waits, sanctioned through the same
+            # resolved-name logic as the host_fetch exemption above
+            wait = None
+            if f == "time.sleep":
+                wait = "time.sleep()"
+            elif name in _WAIT_SANCTIONED:
+                wait = name + "()"
+            wait_ok = name in _WAIT_SANCTIONED and (
+                f is None or f.split(".")[-1] in _WAIT_SANCTIONED)
+            if wait is not None and not wait_ok:
+                rec.waits.append((node, wait))
 
     # PTL003: call sites of module-level jitted functions
     def _call_site(self, node):

@@ -102,6 +102,19 @@ _RULE_LIST = [
         "trace-time errors",
         "catch Exception (or the specific error) instead",
     ),
+    Rule(
+        "PTL008", "blocking-wait-in-step-loop", WARNING,
+        "time.sleep inside a loop that dispatches a compiled step — the "
+        "host stalls while the device sits idle, serializing the async "
+        "dispatch pipeline exactly like a stray sync.  Calls routed "
+        "through the sanctioned bounded-retry helper "
+        "(backoff_sleep/_backoff_sleep, serving/engine.py) are exempt: "
+        "backing off a FAILED dispatch is the one legitimate wait on the "
+        "hot path.  The exemption follows the RESOLVED import — aliasing "
+        "time.sleep to a backoff_sleep-style name does not earn it",
+        "move waits off the step loop, or route genuine retry backoff "
+        "through _backoff_sleep so the stall is bounded and attributed",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

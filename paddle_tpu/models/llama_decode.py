@@ -429,7 +429,10 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
     stale pads, invisible to decode_attention's position masking and
     overwritten as the slot decodes.  Returns the slot's first greedy
     token (logit at its last prompt column; pad columns are causally
-    invisible to it) and the updated caches; with ``with_hist`` the slot's
+    invisible to it), a ``[1]`` bool finite-logits flag (the poison-
+    quarantine input — an all-finite reduction adds no output tokens and
+    no program identity, so the clean path stays byte-identical and
+    retrace-free) and the updated caches; with ``with_hist`` the slot's
     prompt-lookup history row is rebuilt in the same program."""
     _mon.mark_trace("serving_prefill_slot")
     t = tokens.shape[1]
@@ -442,6 +445,7 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
         last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1),
         chunk_size=chunk_size)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [1]
+    ok = jnp.all(jnp.isfinite(logits), axis=-1)                 # [1]
     slot = slot.astype(jnp.int32)
     zero = jnp.int32(0)
     new_caches = []
@@ -459,7 +463,7 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
         row = row.at[0, jnp.clip(prompt_len[0], 0, lmax - 1)].set(first[0])
         hist = jax.lax.dynamic_update_slice(hist, row, (slot, zero))
         hist_len = hist_len.at[slot].set(prompt_len[0] + 1)
-    return first, new_caches, hist, hist_len
+    return first, ok, new_caches, hist, hist_len
 
 
 # the serving entry points ship as RAW impls plus module-level jitted
@@ -527,7 +531,10 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
     costs acceptance length, never output bytes (_verify_and_emit emits
     the verify forward's own picks).
 
-    Returns (first [1], caches', hist', hist_len')."""
+    Returns (first [1], ok [1] — the finite-logits flag; only the FINAL
+    chunk's value is meaningful (its query attends the slot's whole
+    prefix, so a non-finite row anywhere upstream surfaces here), exactly
+    like ``first`` itself —, caches', hist', hist_len')."""
     _mon.mark_trace("serving_prefill_chunk")
     t = tokens.shape[1]
     nh, nkv, hd, eps = cfg
@@ -546,6 +553,7 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
     logits = _lm_logits(params, h)
     first = jnp.argmax(logits.astype(jnp.float32), axis=-1) \
         .astype(jnp.int32)                                  # [1]
+    ok = jnp.all(jnp.isfinite(logits), axis=-1)             # [1]
     if with_hist:
         lmax = hist.shape[1]
         is_final = offset + t >= prompt_len[0]
@@ -560,7 +568,7 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
         hist = hist.at[slot, fcol].set(first[0], mode="drop")
         hist_len = hist_len.at[slot].set(
             jnp.where(is_final, prompt_len[0] + 1, hist_len[slot]))
-    return first, new_caches, hist, hist_len
+    return first, ok, new_caches, hist, hist_len
 
 
 serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
@@ -578,21 +586,27 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
     lmax + i only moves further past capacity, AND the chunked read's
     trip count excludes them (ops.decode_attention), so one parked slot
     never forces full-length reads.  Returns (tokens [B, n_steps],
-    caches')."""
+    ok [B] — True iff every inner step's logits for that slot were
+    finite; the engine's poison quarantine retires a False slot and
+    discards its block.  The reduction is a pure extra output: tokens
+    and caches are bit-unchanged, and per-row attention isolation means
+    one slot's NaN never flips a cohabitant's flag —, caches')."""
     _mon.mark_trace("serving_decode_steps")
 
     def body(carry, _):
-        tok, caches, lengths = carry
+        tok, ok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
             params, cfg, tok[:, None], caches, lengths,
             chunk_size=chunk_size)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (nxt, caches, lengths), nxt
+        ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
+        return (nxt, ok, caches, lengths), nxt
 
-    (_, caches, _), toks = jax.lax.scan(
-        body, (cur, caches, dev_lengths.astype(jnp.int32)), None,
+    ok0 = jnp.ones(cur.shape, bool)
+    (_, ok, caches, _), toks = jax.lax.scan(
+        body, (cur, ok0, caches, dev_lengths.astype(jnp.int32)), None,
         length=n_steps)
-    return toks.T, caches
+    return toks.T, ok, caches
 
 
 serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
@@ -616,9 +630,11 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     the accepted-prefix-advanced device lengths (dev_lengths + j + 1 for
     live slots, untouched for dead ones), the device-resident carry the
     pipelined engine feeds straight into the next dispatch without a host
-    sync —, caches', hist', hist_len').  The host rewinds its length
-    mirror to +j+1; dead slots (``active`` False) drop cache AND history
-    writes."""
+    sync —, ok [B] — True iff the verify forward's logits for the slot
+    were finite (the poison-quarantine flag; a pure extra reduction,
+    tokens unchanged) —, caches', hist', hist_len').  The host rewinds
+    its length mirror to +j+1; dead slots (``active`` False) drop cache
+    AND history writes."""
     _mon.mark_trace("serving_spec_step")
     b = cur.shape[0]
     lmax = hist.shape[1]
@@ -626,6 +642,7 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
     logits, caches, _ = _forward_step_all(
         params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size)
+    ok = jnp.all(jnp.isfinite(logits), axis=(-2, -1))        # [B]
     # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
     # out IS the accepted-prefix block for this round
     emitted, cur, j, emit = _verify_and_emit(
@@ -640,7 +657,7 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     hist_len = hist_len + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
     new_len = dev_lengths.astype(jnp.int32) \
         + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
-    return emitted, j, cur, new_len, caches, hist, hist_len
+    return emitted, j, cur, new_len, ok, caches, hist, hist_len
 
 
 serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(

@@ -1,5 +1,15 @@
 """Continuous-batching serving engine (Orca-style iteration-level
-scheduling) over the compiled static-cache decode path."""
-from paddle_tpu.serving.engine import Request, ServingEngine
+scheduling) over the compiled static-cache decode path, plus the
+reliability layer around it: deadlines/cancellation, bounded-queue load
+shedding (``EngineOverloaded``), poison-request quarantine, dispatch
+retry with backoff, and the deterministic fault-injection harness
+(``FaultPlan``)."""
+from paddle_tpu.serving.engine import (
+    EngineOverloaded, Request, ServingEngine,
+)
+from paddle_tpu.serving.faults import (
+    FaultPlan, InjectedDispatchError, InjectedStreamCbError,
+)
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["EngineOverloaded", "FaultPlan", "InjectedDispatchError",
+           "InjectedStreamCbError", "Request", "ServingEngine"]

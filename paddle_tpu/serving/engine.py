@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import bisect
 import contextlib
+import logging
 import time
 import warnings
 from collections import deque
@@ -93,6 +94,7 @@ from paddle_tpu.models.llama_decode import (
     _decode_params_of, serving_decode_steps, serving_prefill_chunk,
     serving_prefill_slot, serving_spec_step,
 )
+from paddle_tpu.serving.faults import InjectedDispatchError
 from paddle_tpu.serving.kv_cache import KVCacheManager
 from paddle_tpu.serving.metrics import EngineMetrics
 
@@ -102,9 +104,42 @@ from paddle_tpu.serving.metrics import EngineMetrics
 warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable")
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["EngineOverloaded", "Request", "ServingEngine"]
 
 _NULL_CTX = contextlib.nullcontext()
+
+_LOG = logging.getLogger(__name__)
+
+# the transient device-error class the bounded dispatch retry targets
+# (runtime/compile-service hiccups surface as XlaRuntimeError); the
+# injected twin from serving/faults.py rides the same path so the retry
+# machinery is provable without a flaky device
+try:
+    from jax.errors import JaxRuntimeError as _XLA_ERROR
+except ImportError:  # pragma: no cover — older jax spellings
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError as _XLA_ERROR
+    except ImportError:
+        class _XLA_ERROR(Exception):
+            pass
+_RETRYABLE = (_XLA_ERROR, InjectedDispatchError)
+
+
+class EngineOverloaded(RuntimeError):
+    """``submit()`` rejected the request: the bounded admission queue
+    (``max_pending``) is full.  Load shedding at the front door — the
+    caller owns the backoff/reroute decision; the engine's resident work
+    is never displaced."""
+
+
+def _backoff_sleep(seconds):
+    """The engine's sanctioned blocking wait: the exponential backoff
+    between dispatch retry attempts.  Funneled through this one name for
+    the same reason ``_host_fetch`` exists — the tpu-lint PTL008 rule
+    keeps flagging raw ``time.sleep`` added inside step-dispatch loops
+    without false-positiving on the bounded retry's deliberate backoff."""
+    if seconds > 0:
+        time.sleep(seconds)
 
 
 def _host_fetch(*arrays):
@@ -125,14 +160,26 @@ class Request:
     (optional ``cb(request, new_ids)``) fires per emission batch — the
     streaming hook; with an engine ``detokenizer`` the accumulated text is
     kept current in ``.text``.  A raising ``stream_cb`` never kills the
-    scheduler: the error is counted (``serving_stream_cb_errors_total``)
-    and decoding continues.  Timing (perf_counter): ``t_submit`` /
-    ``t_first`` (first token) / ``t_done``, with derived ``ttft`` /
-    ``tpot`` / ``latency`` properties (None until available).
+    scheduler: the error is counted (``serving_stream_cb_errors_total``,
+    labeled by exception type) and logged once per request, and decoding
+    continues.  ``deadline_ms`` (optional) bounds submit -> completion:
+    when it expires the request is retired wherever it is — queued,
+    mid-prefill, or mid-decode — with whatever tokens it has.  Timing
+    (perf_counter): ``t_submit`` / ``t_first`` (first token) /
+    ``t_done``, with derived ``ttft`` / ``tpot`` / ``latency`` properties
+    (None until available).
+
+    ``status`` is the terminal-status state machine every front-end
+    consumer reads: ``None`` while pending/in-flight, then exactly one of
+    ``"done"`` (EOS / max_new_tokens), ``"timed_out"`` (deadline_ms),
+    ``"cancelled"`` (host ``cancel()``/``close()``), ``"poisoned"``
+    (non-finite logits quarantine) or ``"shed"`` (rejected at submit by
+    the bounded admission queue).  ``done`` is True for every terminal
+    status except ``"shed"`` (a shed request never entered the engine).
     """
 
     def __init__(self, prompt_ids, max_new_tokens, eos_token_id=None,
-                 stream_cb=None, rid=None):
+                 stream_cb=None, rid=None, deadline_ms=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt_ids.size == 0:
             raise ValueError("Request: empty prompt")
@@ -142,12 +189,19 @@ class Request:
         self.eos_token_id = eos_token_id
         self.stream_cb = stream_cb
         self.rid = rid
+        self.deadline_ms = (float(deadline_ms)
+                            if deadline_ms is not None else None)
+        if self.deadline_ms is not None and self.deadline_ms < 0:
+            raise ValueError("Request: deadline_ms must be >= 0")
         self.output_ids = []
         self.text = ""
         self.done = False
+        self.status = None
         self.t_submit = None
         self.t_first = None
         self.t_done = None
+        self._t_deadline = None   # stamped at submit()
+        self._cb_err_logged = False
 
     @property
     def latency(self):
@@ -208,6 +262,28 @@ class ServingEngine:
     so the scheduler, pipeline, and chunked prefill above this line run
     unchanged.  ``tp_axis`` names the mesh axis to shard along (default
     ``"mp"``); the attention and KV head counts must divide its size.
+
+    Reliability layer (a strict no-op on the clean path — with no
+    deadlines, no faults and ``max_pending=None`` the token streams,
+    program identities and sync structure are unchanged):
+    ``max_pending`` bounds the admission queue — a ``submit()`` that
+    would push it past the bound raises ``EngineOverloaded`` (status
+    ``"shed"``, counted in ``serving_requests_shed_total``) instead of
+    growing an unbounded backlog.  ``retry_attempts`` /
+    ``retry_backoff``: the decode dispatch and the drain-side fetch are
+    wrapped in a bounded retry (exponential backoff through the
+    sanctioned ``_backoff_sleep``) against transient
+    ``XlaRuntimeError``-class failures; exhaustion re-raises.  Per-slot
+    non-finite logits (the jitted ``ok`` flag riding every step's
+    outputs through the SAME ``_host_fetch`` — no extra sync) quarantine
+    the slot's request with status ``"poisoned"``; cohabiting slots are
+    untouched (per-row attention isolation, tested byte-identical).
+    ``faults``: a serving/faults.FaultPlan injecting deterministic
+    dispatch errors / NaN payloads / slow steps / stream_cb crashes
+    through test-only seams.  ``cancel(rid)`` and per-request
+    ``deadline_ms`` retire work anywhere in its lifecycle via the same
+    write-drop parking retirement the scheduler already uses — no
+    recompile, no retrace.
     """
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
@@ -215,7 +291,8 @@ class ServingEngine:
                  prompt_buckets=None, detokenizer=None, registry=None,
                  instrument=True, pipeline=True, decode_chunk=256,
                  prefill_chunk=256, prefill_budget=2, mesh=None,
-                 tp_axis="mp"):
+                 tp_axis="mp", max_pending=None, retry_attempts=3,
+                 retry_backoff=0.05, faults=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -323,6 +400,17 @@ class ServingEngine:
         self._pending_firsts = []
         self._adm_wave = False
         self._t_lastdrain = None
+        # reliability state: the bounded admission queue, the dispatch
+        # retry policy, the fault-injection plan (None in production) and
+        # the scheduler-step index the plan keys its injections to
+        self._max_pending = (int(max_pending)
+                             if max_pending is not None else None)
+        if self._max_pending is not None and self._max_pending < 0:
+            raise ValueError("max_pending must be >= 0 or None")
+        self._retry_attempts = max(1, int(retry_attempts))
+        self._retry_backoff = float(retry_backoff)
+        self._faults = faults
+        self._step_idx = -1
 
     # ------------------------------------------------------------- scheduling
     @property
@@ -354,6 +442,19 @@ class ServingEngine:
                 f"max_new {request.max_new_tokens} + headroom "
                 f"{self._headroom()}) > max_len {self._lmax}")
         request._bucket = bucket
+        # load shedding AFTER validation (a malformed request stays a
+        # ValueError) but BEFORE rid assignment (a shed request never
+        # consumes engine state): bounding what's QUEUED — resident slots
+        # are capacity already paid for — keeps worst-case queue wait
+        # proportional to max_pending, the backpressure contract
+        if self._max_pending is not None \
+                and len(self._queue) >= self._max_pending:
+            request.status = "shed"
+            if self._m is not None:
+                self._m.terminal("shed")
+            raise EngineOverloaded(
+                f"admission queue full ({len(self._queue)} pending >= "
+                f"max_pending={self._max_pending}); request shed")
         if request.rid is None:
             # the engine assigns (and only then advances) the auto rid
             request.rid = self._next_rid
@@ -370,6 +471,9 @@ class ServingEngine:
                 self._next_rid = max(self._next_rid, request.rid + 1)
         self._rids.add(request.rid)
         request.t_submit = time.perf_counter()
+        if request.deadline_ms is not None:
+            request._t_deadline = request.t_submit \
+                + request.deadline_ms / 1e3
         self._queue.append(request)
         if self._m is not None:
             self._m.queue_depth.set(len(self._queue))
@@ -380,6 +484,154 @@ class ServingEngine:
         population the decode dispatch runs over.  Slots mid-prefill stay
         parked (masked_lengths) until their final chunk is dispatched."""
         return self._kv.reqs[i] is not None and i not in self._pf
+
+    # -------------------------------------------------- request lifecycle
+    # terminal statuses beyond "done": every path below retires through
+    # the SAME write-drop parking the scheduler already uses (the slot's
+    # masked offset goes to lmax at the next dispatch, its stale pipelined
+    # tokens fail the request-identity drain check) — no recompile, no
+    # retrace, and the freed slot re-admits immediately.
+
+    def _terminal_queued(self, r, status):
+        """Retire a request that never reached a slot (still queued)."""
+        r.status = status
+        r.done = True
+        r.t_done = time.perf_counter()
+        self._finished.append(r)
+        if self._m is not None:
+            self._m.terminal(status)
+
+    def _forget_slot(self, slot):
+        """Drop every piece of per-slot scheduler state that outlives the
+        slot's request: chunked-prefill progress, the device-resident
+        first token, monolithic-admission membership and not-yet-drained
+        first-token records.  Records already riding an inflight dispatch
+        need no scrub — the drain's identity check discards them."""
+        self._pf.pop(slot, None)
+        self._dev_first.pop(slot, None)
+        self._adm_pending.discard(slot)
+        self._pending_firsts = [t for t in self._pending_firsts
+                                if t[0] != slot]
+
+    def _retire(self, slot, status):
+        """Retire ``slot``'s request with a non-``done`` terminal status
+        (timed_out / cancelled / poisoned), keeping whatever tokens it
+        already emitted as its partial output."""
+        r = self._kv.reqs[slot]
+        r.status = status
+        r.done = True
+        r.t_done = time.perf_counter()
+        self._kv.release(slot)
+        self._forget_slot(slot)
+        self._finished.append(r)
+        if self._m is not None:
+            self._m.terminal(status)
+            self._m.slots_occupied.set(self._kv.occupied())
+
+    def cancel(self, rid):
+        """Host-side cancellation: retire ``rid`` wherever it is —
+        queued, mid-prefill (``_pf``) or mid-decode-flight (stale
+        pipelined tokens are discarded by the drain's identity check).
+        Partial outputs stay on the request (status ``"cancelled"``).
+        Returns True if the request was found live, False otherwise
+        (already finished, shed, or unknown)."""
+        for r in self._queue:
+            if r.rid == rid:
+                self._queue.remove(r)
+                self._terminal_queued(r, "cancelled")
+                if self._m is not None:
+                    self._m.queue_depth.set(len(self._queue))
+                return True
+        for slot, r in enumerate(self._kv.reqs):
+            if r is not None and r.rid == rid:
+                self._retire(slot, "cancelled")
+                return True
+        return False
+
+    def _expire_deadlines(self):
+        """Retire every request whose ``deadline_ms`` has passed — queued
+        requests never reach a slot; resident ones (mid-prefill or
+        decoding) free their slot for re-admission this same step."""
+        now = time.perf_counter()
+        expired = [r for r in self._queue
+                   if r._t_deadline is not None and now >= r._t_deadline]
+        for r in expired:
+            self._queue.remove(r)
+            self._terminal_queued(r, "timed_out")
+        if expired and self._m is not None:
+            self._m.queue_depth.set(len(self._queue))
+        for slot, r in enumerate(self._kv.reqs):
+            if r is not None and r._t_deadline is not None \
+                    and now >= r._t_deadline:
+                self._retire(slot, "timed_out")
+
+    # ------------------------------------------------- faults and retries
+    def _inject_nan(self, slot):
+        """Fault seam (FaultPlan poison): overwrite the slot's first
+        cached key row (layer 0, position 0 — attended by every later
+        query of the slot) with NaN, eagerly between compiled steps.
+        Functional ``.at[].set`` touches only that row, so cohabiting
+        slots' cache bytes are untouched — the quarantine's
+        byte-identity guarantee rests on per-row attention isolation."""
+        k, v = self._kv.caches[0]
+        self._kv.caches[0] = (k.at[slot, 0].set(jnp.nan), v)
+
+    def _apply_poison(self):
+        """Inject every due NaN payload from the fault plan.  Injection
+        waits until the slot has at least one cache row written (a
+        mid-prefill slot at offset 0 would have its poison overwritten by
+        its own first chunk)."""
+        f = self._faults
+        if f is None or not f.poison:
+            return
+        for slot, r in enumerate(self._kv.reqs):
+            if r is None or not f.poison_due(r.rid, self._step_idx):
+                continue
+            st = self._pf.get(slot)
+            if st is not None and st["off"] == 0:
+                continue   # no rows written yet — defer to a later step
+            self._inject_nan(slot)
+            f.mark_poisoned(r.rid)
+
+    def _fault_point(self, kind, attempt):
+        if self._faults is not None:
+            self._faults.maybe_dispatch_error(kind, self._step_idx,
+                                              attempt)
+
+    def _retry(self, fn, what):
+        """Bounded dispatch/drain retry: run ``fn(attempt)`` up to
+        ``retry_attempts`` times against transient
+        ``XlaRuntimeError``-class failures, backing off exponentially
+        through the sanctioned ``_backoff_sleep``; exhaustion re-raises
+        the last error.  ``fn`` must be side-effect-free until it
+        returns (the engine's fault points raise BEFORE the real
+        dispatch), so a retried attempt re-issues an identical program
+        and the run's outputs stay byte-identical to an unfaulted one."""
+        delay = self._retry_backoff
+        for attempt in range(self._retry_attempts):
+            try:
+                return fn(attempt)
+            except _RETRYABLE as e:
+                if attempt + 1 >= self._retry_attempts:
+                    raise
+                if self._m is not None:
+                    self._m.dispatch_retries.inc()
+                _LOG.warning(
+                    "serving %s failed (%s: %s) — retrying "
+                    "(attempt %d/%d) after %.3fs backoff",
+                    what, type(e).__name__, e, attempt + 1,
+                    self._retry_attempts - 1, delay)
+                _backoff_sleep(delay)
+                delay *= 2
+
+    def _fetch(self, kind, *arrays):
+        """``_host_fetch`` behind the bounded retry + fault seam: the
+        drain-side twin of the dispatch retry (re-fetching the same
+        device futures is idempotent)."""
+        def go(attempt):
+            self._fault_point(kind, attempt)
+            return _host_fetch(*arrays)
+        return self._retry(go, kind)
 
     # --------------------------------------------------- program dispatch
     # the four compiled entry points behind ONE seam: mesh=None dispatches
@@ -450,7 +702,7 @@ class ServingEngine:
             tokens = np.zeros((1, r._bucket), np.int32)
             tokens[0, :p] = r.prompt_ids
             with m.span_prefill if m is not None else _NULL_CTX:
-                first, self._kv.caches, hist, hist_len = \
+                first, okf, self._kv.caches, hist, hist_len = \
                     self._call_prefill_slot(
                         jnp.asarray(tokens),
                         jnp.asarray(np.array([p], np.int32)),
@@ -459,12 +711,16 @@ class ServingEngine:
                 self._hist, self._hist_len = hist, hist_len
             self._kv.lengths[slot] = p
             self._adm_pending.add(slot)
-            pending.append((slot, first))
+            pending.append((slot, first, okf))
         # every prefill in the wave is dispatched (async) above; block ONCE
-        # here for all their first tokens — one host sync per _admit, not
-        # one per admitted request
-        firsts = _host_fetch(*(f for _, f in pending))
-        for (slot, _), fv in zip(pending, firsts):
+        # here for all their first tokens (+ finite flags) — one host sync
+        # per _admit, not one per admitted request
+        vals = _host_fetch(*(x for _, f, o in pending for x in (f, o)))
+        for n, (slot, _, _) in enumerate(pending):
+            fv, ov = vals[2 * n], vals[2 * n + 1]
+            if not bool(ov[0]):
+                self._retire(slot, "poisoned")
+                continue
             first = int(fv[0])
             self._cur[slot] = first
             self._emit(slot, [first])
@@ -522,7 +778,7 @@ class ServingEngine:
             while budget:
                 chunk = st["tok"][st["off"]:st["off"] + P][None, :]
                 with m.span_prefill if m is not None else _NULL_CTX:
-                    first, self._kv.caches, hist, hist_len = \
+                    first, okf, self._kv.caches, hist, hist_len = \
                         self._call_prefill_chunk(
                             jnp.asarray(chunk),
                             jnp.asarray(st["off"], jnp.int32), st["plen"],
@@ -535,10 +791,14 @@ class ServingEngine:
                 if m is not None:
                     m.prefill_chunks.inc()
                 if st["off"] >= st["p"]:
+                    # only the FINAL chunk's finite flag is meaningful
+                    # (its query attends the whole prefix) — it rides
+                    # with the first token and is checked at emission
                     del self._pf[slot]
                     self._kv.lengths[slot] = st["p"]
                     self._dev_first[slot] = first
-                    self._pending_firsts.append((slot, st["req"], first))
+                    self._pending_firsts.append(
+                        (slot, st["req"], first, okf))
                     break
         if m is not None:
             m.prefill_backlog.set(sum(
@@ -552,13 +812,19 @@ class ServingEngine:
         if not self._pending_firsts:
             return 0
         pend, self._pending_firsts = self._pending_firsts, []
-        vals = _host_fetch(*(f for _, _, f in pend))
+        vals = self._fetch(
+            "drain", *(x for _, _, f, o in pend for x in (f, o)))
         emitted = 0
-        for (slot, r, _), fv in zip(pend, vals):
+        for n, (slot, r, _, _) in enumerate(pend):
+            fv, ov = vals[2 * n], vals[2 * n + 1]
             self._cur[slot] = int(fv[0])
             self._dev_first.pop(slot, None)
-            if self._kv.reqs[slot] is r:
-                emitted += self._emit(slot, [int(fv[0])])
+            if self._kv.reqs[slot] is not r:
+                continue
+            if not bool(ov[0]):
+                self._retire(slot, "poisoned")
+                continue
+            emitted += self._emit(slot, [int(fv[0])])
         return emitted
 
     def _emit(self, slot, toks):
@@ -588,14 +854,25 @@ class ServingEngine:
                 r.text = self._detok(list(r.output_ids))
             if r.stream_cb is not None:
                 try:
+                    if self._faults is not None:
+                        self._faults.maybe_crash_stream_cb(self._step_idx)
                     r.stream_cb(r, r.output_ids[-took:])
-                except Exception:
+                except Exception as e:
                     # a crashing user callback must not kill the scheduler
                     # loop mid-batch (every other live slot would lose its
-                    # in-flight block): count the drop and keep decoding
+                    # in-flight block): count the drop by exception type,
+                    # log once per request, and keep decoding
                     if m is not None:
-                        m.stream_cb_errors.inc()
+                        m.stream_cb_error(type(e).__name__)
+                    if not r._cb_err_logged:
+                        r._cb_err_logged = True
+                        _LOG.warning(
+                            "stream_cb for request %r raised %s: %s — "
+                            "further errors from this request are "
+                            "counted but not logged", r.rid,
+                            type(e).__name__, e)
         if r.done:
+            r.status = "done"
             r.t_done = time.perf_counter()
             self._kv.release(slot)
             self._finished.append(r)
@@ -618,6 +895,11 @@ class ServingEngine:
             return self._step_impl()
 
     def _step_impl(self):
+        self._step_idx += 1
+        if self._faults is not None:
+            self._faults.maybe_slow_step(self._step_idx)
+        self._expire_deadlines()
+        self._apply_poison()
         self._adm_wave = False
         self._admit()
         spent = self._spend_prefill()
@@ -663,24 +945,35 @@ class ServingEngine:
         active = np.array([self._decodable(i) for i in range(self._B)])
         dev_len = self._kv.device_lengths(active)
         if self._mode == "greedy":
+            def go(attempt):
+                self._fault_point("dispatch", attempt)
+                return self._call_decode(jnp.asarray(self._cur), dev_len)
             with m.span_decode if m is not None else _NULL_CTX:
-                toks, self._kv.caches = self._call_decode(
-                    jnp.asarray(self._cur), dev_len)
-                (toks,) = _host_fetch(toks)
+                toks, okd, self._kv.caches = self._retry(
+                    go, "decode dispatch")
+                toks, okd = self._fetch("drain", toks, okd)
             self._observe_interference(adm_active, self._sync)
             for i in live:
+                if not bool(okd[i]):
+                    self._retire(i, "poisoned")
+                    continue
                 emitted += self._emit(i, toks[i].tolist())
                 self._kv.lengths[i] += self._sync
                 self._cur[i] = toks[i, -1]
         else:
+            def go(attempt):
+                self._fault_point("dispatch", attempt)
+                return self._call_spec(jnp.asarray(self._cur), dev_len,
+                                       jnp.asarray(active))
             with m.span_spec if m is not None else _NULL_CTX:
-                blk, j, cur, _, self._kv.caches, self._hist, \
-                    self._hist_len = self._call_spec(
-                        jnp.asarray(self._cur), dev_len,
-                        jnp.asarray(active))
-                blk, j, cur = _host_fetch(blk, j, cur)
+                blk, j, cur, _, oks, self._kv.caches, self._hist, \
+                    self._hist_len = self._retry(go, "spec dispatch")
+                blk, j, cur, oks = self._fetch("drain", blk, j, cur, oks)
             accepted = 0
             for i in live:
+                if not bool(oks[i]):
+                    self._retire(i, "poisoned")
+                    continue
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
                 self._kv.lengths[i] += int(j[i]) + 1
                 self._cur[i] = cur[i]
@@ -731,12 +1024,16 @@ class ServingEngine:
             # greedy lengths are host-derivable: every live slot advances
             # exactly sync_every per dispatch, so the mirror (bumped below)
             # IS the device value and needs no device carry
+            def go(attempt):
+                self._fault_point("dispatch", attempt)
+                return self._call_decode(cur, host_len)
             with m.span_decode if m is not None else _NULL_CTX:
-                toks, self._kv.caches = self._call_decode(cur, host_len)
+                toks, okd, self._kv.caches = self._retry(
+                    go, "decode dispatch")
             self._dev_cur = toks[:, -1]
             for i in live:
                 self._kv.lengths[i] += self._sync
-            self._inflight = {"kind": "greedy", "toks": toks,
+            self._inflight = {"kind": "greedy", "toks": toks, "ok": okd,
                               "reqs": list(self._kv.reqs), "live": live,
                               "firsts": firsts, "adm": adm_active}
         else:
@@ -749,12 +1046,16 @@ class ServingEngine:
                 # (prompt length) and freed (masked to lmax) slots
                 dev_len = jnp.where(jnp.asarray(use_host_len), host_len,
                                     self._dev_len)
+
+            def go(attempt):
+                self._fault_point("dispatch", attempt)
+                return self._call_spec(cur, dev_len, jnp.asarray(active))
             with m.span_spec if m is not None else _NULL_CTX:
-                blk, j, cur2, new_len, self._kv.caches, self._hist, \
-                    self._hist_len = self._call_spec(
-                        cur, dev_len, jnp.asarray(active))
+                blk, j, cur2, new_len, oks, self._kv.caches, self._hist, \
+                    self._hist_len = self._retry(go, "spec dispatch")
             self._dev_cur, self._dev_len = cur2, new_len
             self._inflight = {"kind": "spec", "blk": blk, "j": j,
+                              "ok": oks,
                               "reqs": list(self._kv.reqs), "live": live,
                               "firsts": firsts, "adm": adm_active}
         self._adm_pending.clear()
@@ -778,9 +1079,10 @@ class ServingEngine:
         firsts = rec.get("firsts", [])
         t0 = time.perf_counter()
         emitted = 0
+        fo = [x for _, _, f, o in firsts for x in (f, o)]
         if rec["kind"] == "greedy":
-            vals = _host_fetch(rec["toks"], *(f for _, _, f in firsts))
-            toks, fvals = vals[0], vals[1:]
+            vals = self._fetch("drain", rec["toks"], rec["ok"], *fo)
+            toks, okd, fvals = vals[0], vals[1], vals[2:]
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
                 m.inflight.set(still_inflight)
@@ -788,30 +1090,46 @@ class ServingEngine:
             # the first tokens ride the record they were dispatched before
             # (program order: final prefill chunk, then this decode step) —
             # emit them ahead of the slot's decode block
-            for (slot, r, _), fv in zip(firsts, fvals):
-                if self._kv.reqs[slot] is r:
-                    self._cur[slot] = int(fv[0])
-                    emitted += self._emit(slot, [int(fv[0])])
+            for n, (slot, r, _, _) in enumerate(firsts):
+                if self._kv.reqs[slot] is not r:
+                    continue
+                fv, ov = fvals[2 * n], fvals[2 * n + 1]
+                if not bool(ov[0]):
+                    self._retire(slot, "poisoned")
+                    continue
+                self._cur[slot] = int(fv[0])
+                emitted += self._emit(slot, [int(fv[0])])
             for i in rec["live"]:
                 if self._kv.reqs[i] is not rec["reqs"][i]:
+                    continue
+                if not bool(okd[i]):
+                    self._retire(i, "poisoned")
                     continue
                 emitted += self._emit(i, toks[i].tolist())
                 self._cur[i] = toks[i, -1]
         else:
-            vals = _host_fetch(rec["blk"], rec["j"],
-                               *(f for _, _, f in firsts))
-            blk, j, fvals = vals[0], vals[1], vals[2:]
+            vals = self._fetch("drain", rec["blk"], rec["j"], rec["ok"],
+                               *fo)
+            blk, j, okd, fvals = vals[0], vals[1], vals[2], vals[3:]
             if m is not None:
                 m.pipeline_stall.observe(time.perf_counter() - t0)
                 m.inflight.set(still_inflight)
-            for (slot, r, _), fv in zip(firsts, fvals):
-                if self._kv.reqs[slot] is r:
-                    self._cur[slot] = int(fv[0])
-                    emitted += self._emit(slot, [int(fv[0])])
+            for n, (slot, r, _, _) in enumerate(firsts):
+                if self._kv.reqs[slot] is not r:
+                    continue
+                fv, ov = fvals[2 * n], fvals[2 * n + 1]
+                if not bool(ov[0]):
+                    self._retire(slot, "poisoned")
+                    continue
+                self._cur[slot] = int(fv[0])
+                emitted += self._emit(slot, [int(fv[0])])
             accepted = 0
             drained = 0
             for i in rec["live"]:
                 if self._kv.reqs[i] is not rec["reqs"][i]:
+                    continue
+                if not bool(okd[i]):
+                    self._retire(i, "poisoned")
                     continue
                 drained += 1
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
@@ -829,3 +1147,31 @@ class ServingEngine:
         while self.has_work:
             self.step()
         return self._finished
+
+    def drain(self):
+        """Run the engine to quiescence, then return ``{rid: terminal
+        status}`` over every request it finished — the graceful-shutdown
+        half of ``close()`` (all outstanding work completes; deadlines
+        and faults still apply while draining)."""
+        self.run()
+        return {r.rid: r.status for r in self._finished}
+
+    def close(self):
+        """Abort outstanding work cleanly.  The inflight pipelined
+        dispatch (if any) is drained first — its tokens still emit, so
+        every in-flight request keeps its partial output — then every
+        queued and resident request is retired with terminal status
+        ``"cancelled"``.  Returns ``{rid: terminal status}`` over every
+        request the engine ever finished.  Idempotent: a second call
+        finds nothing to cancel and returns the same map."""
+        if self._inflight is not None:
+            prev, self._inflight = self._inflight, None
+            self._drain(prev)
+        while self._queue:
+            self._terminal_queued(self._queue.popleft(), "cancelled")
+        for slot in range(self._B):
+            if self._kv.reqs[slot] is not None:
+                self._retire(slot, "cancelled")
+        if self._m is not None:
+            self._m.queue_depth.set(len(self._queue))
+        return {r.rid: r.status for r in self._finished}

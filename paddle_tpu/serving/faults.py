@@ -1,0 +1,134 @@
+"""Deterministic fault injection for the serving engine.
+
+A ``FaultPlan`` is a seeded, declarative schedule of failures threaded
+through the engine's test-only seams (``ServingEngine(faults=...)``).
+Every fault the reliability layer claims to survive is injected here and
+proved in tier-1 (tests/test_serving_reliability.py) instead of asserted
+in prose:
+
+* **dispatch errors** — ``maybe_dispatch_error`` raises
+  ``InjectedDispatchError`` at the engine's dispatch/drain fault points
+  (``dispatch_error_steps``: exact scheduler-step indices;
+  ``dispatch_error_rate``: a seeded per-step Bernoulli draw).  Each
+  chosen step fails ``dispatch_error_attempts`` consecutive attempts
+  (default 1) and then succeeds, so the bounded-retry path is exercised
+  end to end; raising the attempt count past the engine's
+  ``retry_attempts`` proves retry exhaustion.  The error fires BEFORE
+  the real device dispatch, so a retried attempt re-issues an identical
+  program — the byte-identity-under-retry invariant costs nothing.
+* **poison payloads** — ``poison`` maps ``rid -> step``: from that
+  scheduler step on, the engine overwrites one KV row of the request's
+  slot with NaN (eagerly, between compiled steps).  Per-row attention
+  isolation confines the damage to that slot; the jitted finite-logits
+  flag then quarantines it with terminal status ``poisoned``.
+* **slow steps** — ``slow_steps`` maps ``step -> seconds``:
+  ``maybe_slow_step`` blocks the host that long at the top of the step
+  (SLO / deadline-expiry pressure without touching device work).
+* **stream_cb crashes** — ``cb_crash_steps``: ``maybe_crash_stream_cb``
+  raises ``InjectedStreamCbError`` inside the engine's emission callback
+  guard, proving a crashing user callback is counted and survived.
+
+``stats`` counts every fault actually fired, so a bench/test can assert
+the plan executed (a plan whose faults never fire proves nothing).
+Determinism: the only randomness is ``random.Random(seed)`` consumed in
+engine-step order — two runs of the same workload against the same plan
+inject identically.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["FaultPlan", "InjectedDispatchError", "InjectedStreamCbError"]
+
+
+class InjectedDispatchError(RuntimeError):
+    """Stands in for a transient ``XlaRuntimeError`` at a dispatch/drain
+    fault point — retryable by design."""
+
+
+class InjectedStreamCbError(RuntimeError):
+    """Raised inside ``stream_cb`` delivery to simulate a crashing user
+    callback."""
+
+
+class FaultPlan:
+    """Seeded schedule of injected failures (module docstring)."""
+
+    def __init__(self, seed=0, dispatch_error_steps=(),
+                 dispatch_error_rate=0.0, dispatch_error_attempts=1,
+                 poison=None, slow_steps=None, cb_crash_steps=()):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self.dispatch_error_steps = set(dispatch_error_steps)
+        self.dispatch_error_rate = float(dispatch_error_rate)
+        self.dispatch_error_attempts = max(1, int(dispatch_error_attempts))
+        self.poison = dict(poison or {})            # rid -> step index
+        self.slow_steps = dict(slow_steps or {})    # step index -> seconds
+        self.cb_crash_steps = set(cb_crash_steps)
+        self._poisoned = set()
+        self._rate_drawn = {}                       # step -> bool (memoized)
+        self._fired = {}                            # step -> errors raised
+        self.stats = {"dispatch_errors": 0, "poisoned": 0,
+                      "slow_steps": 0, "cb_crashes": 0}
+
+    # ------------------------------------------------------- dispatch faults
+    def _step_faulty(self, step):
+        if step in self.dispatch_error_steps:
+            return True
+        if self.dispatch_error_rate <= 0.0:
+            return False
+        # memoize the draw per step: the engine probes the same step from
+        # both its dispatch and drain fault points, and a retry must see
+        # the same verdict for its attempt accounting to mean anything
+        drawn = self._rate_drawn.get(step)
+        if drawn is None:
+            drawn = self._rng.random() < self.dispatch_error_rate
+            self._rate_drawn[step] = drawn
+        return drawn
+
+    def maybe_dispatch_error(self, kind, step, attempt):
+        """Raise ``InjectedDispatchError`` when ``step`` is scheduled to
+        fail and fewer than ``dispatch_error_attempts`` errors have been
+        raised for it so far.  The budget is per STEP, not per fault
+        point: the engine probes several seams per step (flush / dispatch
+        / drain), and a step scheduled for one transient fault should
+        fail exactly once, at the first seam that asks.  ``kind`` labels
+        the seam ("dispatch" / "drain") in the error message."""
+        if not self._step_faulty(step):
+            return
+        n = self._fired.get(step, 0)
+        if n >= self.dispatch_error_attempts:
+            return
+        self._fired[step] = n + 1
+        self.stats["dispatch_errors"] += 1
+        raise InjectedDispatchError(
+            f"injected {kind} fault at step {step} (attempt {attempt})")
+
+    # --------------------------------------------------------- poison faults
+    def poison_due(self, rid, step):
+        """True when ``rid`` is scheduled for poisoning at or before
+        ``step`` and has not been injected yet (the engine defers
+        injection until the slot has cache rows to corrupt)."""
+        due = self.poison.get(rid)
+        return (due is not None and step >= due
+                and rid not in self._poisoned)
+
+    def mark_poisoned(self, rid):
+        self._poisoned.add(rid)
+        self.stats["poisoned"] += 1
+
+    # ----------------------------------------------------------- slow steps
+    def maybe_slow_step(self, step):
+        """Block the host for the step's scheduled stall, if any."""
+        s = self.slow_steps.get(step)
+        if s:
+            self.stats["slow_steps"] += 1
+            time.sleep(float(s))
+
+    # ------------------------------------------------------ stream_cb faults
+    def maybe_crash_stream_cb(self, step):
+        if step in self.cb_crash_steps:
+            self.stats["cb_crashes"] += 1
+            raise InjectedStreamCbError(
+                f"injected stream_cb crash at step {step}")

@@ -70,13 +70,26 @@ class KVCacheManager:
         return any(r is not None for r in self.reqs)
 
     def assign(self, slot, request):
-        """Bind ``request`` to ``slot`` (admission)."""
+        """Bind ``request`` to ``slot`` (admission).  Assigning over a
+        live slot raises: the old occupant's cache rows would be silently
+        orphaned and its retirement would then double-free the slot."""
+        if self.reqs[slot] is not None:
+            raise ValueError(
+                f"slot {slot} already holds request "
+                f"{getattr(self.reqs[slot], 'rid', None)!r} — release it "
+                "before assigning (double-assign orphans the occupant)")
         self.reqs[slot] = request
 
     def release(self, slot):
         """Free ``slot`` (retirement).  The cache rows are NOT touched:
         ``device_lengths`` parks the slot at ``max_len`` so subsequent
-        writes drop, and the next occupant's prefill overwrites them."""
+        writes drop, and the next occupant's prefill overwrites them.
+        Releasing a free slot raises: a silent double-free lets two
+        admissions claim the same slot from ``free_slots``."""
+        if self.reqs[slot] is None:
+            raise ValueError(
+                f"slot {slot} is already free — double-release corrupts "
+                "the slot free list")
         self.reqs[slot] = None
 
     # ------------------------------------------------------------ device

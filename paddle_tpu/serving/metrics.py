@@ -68,9 +68,36 @@ class EngineMetrics:
             "mean per-token time after the first", L).labels(**lbl)
         self.e2e = reg.histogram(
             "serving_e2e_seconds", "submit -> completion", L).labels(**lbl)
-        self.stream_cb_errors = reg.counter(
+        # keyed by exception type so a scrape distinguishes a buggy user
+        # callback (TypeError) from an injected crash; the bare series is
+        # pre-registered under error="Exception" so the family exports
+        # zero-valued before the first crash
+        self._stream_cb_errors = reg.counter(
             "serving_stream_cb_errors_total",
-            "stream_cb exceptions swallowed by the scheduler",
+            "stream_cb exceptions swallowed by the scheduler, by "
+            "exception type", ("policy", "error"))
+        self._stream_cb_errors.labels(policy=policy, error="Exception")
+        # reliability counters (pre-bound here so a Prometheus scrape sees
+        # zero-valued series before the first shed/timeout/cancel/poison —
+        # the registry convention every other engine series follows)
+        self.shed = reg.counter(
+            "serving_requests_shed_total",
+            "requests rejected at submit() by the bounded admission "
+            "queue (load shedding)", L).labels(**lbl)
+        self.timed_out = reg.counter(
+            "serving_requests_timed_out_total",
+            "requests retired by deadline_ms expiry", L).labels(**lbl)
+        self.cancelled = reg.counter(
+            "serving_requests_cancelled_total",
+            "requests retired by host-side cancel()/close()",
+            L).labels(**lbl)
+        self.poisoned = reg.counter(
+            "serving_requests_poisoned_total",
+            "requests quarantined after non-finite logits",
+            L).labels(**lbl)
+        self.dispatch_retries = reg.counter(
+            "serving_dispatch_retries_total",
+            "transient dispatch/drain failures retried with backoff",
             L).labels(**lbl)
         self.spec_drafted = reg.counter(
             "serving_spec_drafted_total",
@@ -112,6 +139,20 @@ class EngineMetrics:
 
     def prefill(self, bucket):
         self._prefills.labels(policy=self._policy, bucket=bucket).inc()
+
+    def stream_cb_error(self, etype):
+        self._stream_cb_errors.labels(
+            policy=self._policy, error=etype).inc()
+
+    def terminal(self, status):
+        """Bump the reliability counter for a non-``done`` terminal
+        status (the ``done`` path keeps its dedicated ``retired``
+        counter)."""
+        c = {"shed": self.shed, "timed_out": self.timed_out,
+             "cancelled": self.cancelled,
+             "poisoned": self.poisoned}.get(status)
+        if c is not None:
+            c.inc()
 
     def spec_round(self, drafted, accepted):
         self.spec_drafted.inc(drafted)

@@ -193,7 +193,7 @@ class TPPrograms:
         self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
             decode,
             in_shardings=(pshard, repl, cshard, repl),
-            out_shardings=(repl, cshard),
+            out_shardings=(repl, repl, cshard),
             donate_argnums=(2,)))
 
         def spec(params, cur, caches, dev_lengths, hist, hist_len, active):
@@ -203,7 +203,8 @@ class TPPrograms:
         self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
             spec,
             in_shardings=(pshard, repl, cshard, repl, repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl, cshard, repl, repl)))
+            out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
+                           repl)))
 
         def pchunk(params, tokens, offset, prompt_len, caches, slot,
                    hist, hist_len):
@@ -215,7 +216,7 @@ class TPPrograms:
             pchunk,
             in_shardings=(pshard, repl, repl, repl, cshard, repl,
                           hshard, repl),
-            out_shardings=(repl, cshard, hshard, repl),
+            out_shardings=(repl, repl, cshard, hshard, repl),
             donate_argnums=(4, 6) if with_hist else (4,)))
 
         def pslot(params, tokens, prompt_len, caches, slot, hist, hist_len):
@@ -226,7 +227,7 @@ class TPPrograms:
         self.prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
             pslot,
             in_shardings=(pshard, repl, repl, cshard, repl, hshard, repl),
-            out_shardings=(repl, cshard, hshard, repl),
+            out_shardings=(repl, repl, cshard, hshard, repl),
             donate_argnums=(3, 5) if with_hist else (3,)))
 
 

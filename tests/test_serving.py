@@ -183,7 +183,8 @@ class TestRequestTiming:
                 max_new_tokens=len(r.output_ids), max_len=64))[0]
             np.testing.assert_array_equal(np.array(r.output_ids), ref)
         errs = reg.get("serving_stream_cb_errors_total")
-        assert errs.labels(policy="continuous").value == len(r0.output_ids)
+        assert errs.labels(policy="continuous",
+                           error="RuntimeError").value == len(r0.output_ids)
 
 
 class TestRetirement:
@@ -457,6 +458,33 @@ class TestSubmitValidation2:
         with pytest.raises(ValueError, match="sorted strictly ascending"):
             ServingEngine(model, batch_size=2, max_len=64,
                           prompt_buckets=(8, 8, 16))
+
+
+class TestKVCacheGuards:
+    """Slot double-assign / double-release are loud ValueErrors, not
+    silent corruption (reliability-layer satellite)."""
+
+    def _mgr(self):
+        from paddle_tpu.serving.kv_cache import KVCacheManager
+        return KVCacheManager(n_layers=1, batch_size=2, max_len=8,
+                              num_kv_heads=1, head_dim=4, dtype="float32")
+
+    def test_double_assign_raises(self):
+        kv = self._mgr()
+        a = Request(np.arange(1, 4), 2, rid="a")
+        kv.assign(0, a)
+        with pytest.raises(ValueError, match="already holds request 'a'"):
+            kv.assign(0, Request(np.arange(1, 4), 2, rid="b"))
+        # the occupant survives the rejected assign
+        assert kv.reqs[0] is a and kv.free_slots() == [1]
+
+    def test_double_release_raises(self):
+        kv = self._mgr()
+        kv.assign(1, Request(np.arange(1, 4), 2))
+        kv.release(1)
+        with pytest.raises(ValueError, match="already free"):
+            kv.release(1)
+        assert kv.free_slots() == [0, 1]
 
 
 @pytest.mark.slow

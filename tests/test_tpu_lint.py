@@ -343,6 +343,58 @@ class TestRuleFixtures:
                 return _host_fetch(*firsts)
         """) == []
 
+    # PTL008 — blocking-wait-in-step-loop ------------------------------
+    def test_wait_tp_sleep_in_step_loop(self):
+        assert _rules("""
+            import time
+            def serve(engine, xs):
+                for x in xs:
+                    engine.step(x)
+                    time.sleep(0.01)
+        """) == ["PTL008"]
+
+    def test_wait_tn_sleep_without_step(self):
+        assert _rules("""
+            import time
+            def poll(q):
+                while q.empty():
+                    time.sleep(0.01)
+        """) == []
+
+    def test_wait_tn_sanctioned_backoff(self):
+        # the bounded-retry backoff helper (serving/engine.py) is the one
+        # legitimate wait on a step loop — routed calls are not recorded
+        assert _rules("""
+            from paddle_tpu.serving.engine import _backoff_sleep
+            def serve(engine, xs):
+                for x in xs:
+                    engine.step(x)
+                    _backoff_sleep(0.01)
+        """) == []
+
+    def test_wait_tp_sleep_aliased_to_backoff(self):
+        # like PTL004's host_fetch sanction, the exemption follows the
+        # RESOLVED import — aliasing time.sleep earns nothing
+        assert _rules("""
+            from time import sleep as _backoff_sleep
+            def serve(engine, xs):
+                for x in xs:
+                    engine.step(x)
+                    _backoff_sleep(0.01)
+        """) == ["PTL008"]
+
+    def test_wait_tp_nested_loop_propagates(self):
+        # a sleep in an inner non-step loop still stalls the enclosing
+        # step loop every iteration
+        assert _rules("""
+            import time
+            def serve(engine, xs):
+                for x in xs:
+                    engine.step(x)
+                    for _ in range(3):
+                        time.sleep(0.01)
+        """) == ["PTL008"]
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
