@@ -284,7 +284,16 @@ def bench_serving(n_requests=64, batch=8):
     (``serving_degraded_{shed,timed_out,poisoned,retries}``) read off the
     engine's own reliability counters.  The column the row exists for is
     the ratio: injected faults must degrade throughput proportionally —
-    never collapse it."""
+    never collapse it.
+
+    Round 13 adds the request-lifecycle observability tripwire:
+    ``serving_recorder_overhead_pct`` (the standard continuous run with
+    the flight recorder + request timelines on — the default — vs
+    ``recorder=False``; pure host bookkeeping, so the expected value is
+    measurement noise) and a ``metrics`` key carrying the continuous
+    run's full ``MetricsRegistry.snapshot()`` so every BENCH_r*.json row
+    records the series (phase histograms, SLO attainment, reliability
+    counters) its headline numbers were derived from."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import MetricsRegistry
     from paddle_tpu.serving import (EngineOverloaded, FaultPlan, Request,
@@ -379,6 +388,12 @@ def bench_serving(n_requests=64, batch=8):
     run("continuous", "greedy")  # warm: every prefill bucket + the step
     dt_c, lats_c, reg_c = run("continuous", "greedy")
     dt_g, lats_g, reg_g = run("gang", "greedy")
+    # A/B 6 (round 13) — flight-recorder overhead: the same continuous run
+    # with the event ring + request timelines disabled.  The recorder is
+    # pure host bookkeeping (lock + deque append per event), so this
+    # column is a regression tripwire expected to sit at measurement
+    # noise; a visible cost here means something started syncing.
+    dt_r, _, _ = run("continuous", "greedy", recorder=False)
     # A/B 1 — chunked vs full cache read (same scheduler, same programs
     # otherwise): decode_chunk=None restores the full [B, Lmax] masked read
     run("continuous", "greedy", decode_chunk=None)  # warm the full-read step
@@ -571,6 +586,14 @@ def bench_serving(n_requests=64, batch=8):
             "serving_requests_poisoned_total"),
         "serving_degraded_retries": _rel(
             "serving_dispatch_retries_total"),
+        # flight-recorder overhead (round 13): recorder-on (the default,
+        # dt_c) vs recorder-off on the same warm programs
+        "serving_recorder_overhead_pct": round(
+            (dt_c - dt_r) / dt_r * 100.0, 2),
+        # the continuous run's full registry snapshot rides along so each
+        # BENCH_r*.json row carries the observability data the numbers
+        # above were derived from (phase histograms, SLO gauges, counters)
+        "metrics": reg_c.snapshot(),
     }
 
 

@@ -73,6 +73,15 @@ _WAIT_SANCTIONED = {"backoff_sleep", "_backoff_sleep"}
 # dispatch loop (`serving_prefill_chunk` under `prefill_budget`) — a host
 # sync inside either serializes the pipeline the same way
 _STEP_NAME_RE = re.compile(r"(^|_)(steps?|prefill_chunk)($|_)")
+# per-request identifiers fed to `.labels(...)` inside step loops
+# (PTL009): every unique value mints a fresh metric child, so a
+# rid/uuid-valued label grows series cardinality with traffic.  Matched
+# against Name ids and Attribute attrs (`rid`, `r.rid`, `self._req_id`),
+# including through str()/f-string wrapping — ast.walk sees the inner
+# name either way.  Bare `request` is deliberately absent: label values
+# like `request.slo_class` are bounded and fine.
+_RID_NAME_RE = re.compile(r"(^|_)(rid|rids|uuid|guid|request_id|req_id)"
+                          r"($|_)", re.IGNORECASE)
 
 
 @dataclass
@@ -321,6 +330,7 @@ class _Loop:
     has_step: bool = False
     syncs: list = field(default_factory=list)
     waits: list = field(default_factory=list)
+    labels: list = field(default_factory=list)
 
 
 class _Checker:
@@ -474,9 +484,16 @@ class _Checker:
                           f"`{what}` inside a loop that dispatches a "
                           "compiled step stalls the host while the device "
                           "idles")
+            for call, ident in rec.labels:
+                self.emit("PTL009", call,
+                          f"`.labels(...)` fed per-request identifier "
+                          f"`{ident}` inside a loop that dispatches a "
+                          "compiled step — every unique value mints a new "
+                          "metric series (unbounded label cardinality)")
         elif self.loop_stack:
             self.loop_stack[-1].syncs.extend(rec.syncs)
             self.loop_stack[-1].waits.extend(rec.waits)
+            self.loop_stack[-1].labels.extend(rec.labels)
 
     def _loop_targets(self):
         names = set()
@@ -622,6 +639,32 @@ class _Checker:
                 f is None or f.split(".")[-1] in _WAIT_SANCTIONED)
             if wait is not None and not wait_ok:
                 rec.waits.append((node, wait))
+            # PTL009: per-request identifiers minted into metric labels
+            if name == "labels" and isinstance(node.func, ast.Attribute):
+                for v in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    ident = self._per_request_label(v)
+                    if ident is not None:
+                        rec.labels.append((node, ident))
+                        break
+
+    def _per_request_label(self, value):
+        """The per-request identifier feeding a ``.labels(...)`` value
+        expression (rid-like Name/Attribute, or a ``uuid.*`` call), or
+        None.  ``ast.walk`` sees through ``str(...)``/f-string/``.format``
+        wrapping for free — the inner name is still a child node."""
+        for n in ast.walk(value):
+            if isinstance(n, ast.Name) and _RID_NAME_RE.search(n.id):
+                return n.id
+            if isinstance(n, ast.Attribute) and \
+                    _RID_NAME_RE.search(n.attr):
+                return _dotted(n) or n.attr
+            if isinstance(n, ast.Call):
+                fn = self.resolve(n.func)
+                if fn is not None and (fn == "uuid"
+                                       or fn.startswith("uuid.")):
+                    return fn + "()"
+        return None
 
     # PTL003: call sites of module-level jitted functions
     def _call_site(self, node):

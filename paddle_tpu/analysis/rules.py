@@ -115,6 +115,17 @@ _RULE_LIST = [
         "move waits off the step loop, or route genuine retry backoff "
         "through _backoff_sleep so the stall is bounded and attributed",
     ),
+    Rule(
+        "PTL009", "per-request-metric-label", WARNING,
+        ".labels(...) fed a per-request identifier (rid / request_id / "
+        "uuid) inside a loop that dispatches a compiled step — every "
+        "unique id mints a fresh metric child, so series cardinality "
+        "grows without bound with traffic (the classic metrics-OOM) and "
+        "each new child takes the registry lock on the hot path",
+        "label by bounded dimensions (policy, bucket, status, slo_class); "
+        "put per-request detail in the flight recorder or request "
+        "timeline, which are bounded rings, not metric series",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

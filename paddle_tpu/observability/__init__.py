@@ -14,10 +14,24 @@ Three stdlib-only parts (no jax, no third-party deps):
   decorator recording into the registry AND the profiler host tracer, so
   framework spans appear in ``paddle.profiler`` chrome-trace exports.
 
+Two request-scoped modules ride on top (lazy-exported below — they load
+on first attribute access, keeping ``import paddle_tpu.observability``
+as light as before):
+
+* :mod:`~paddle_tpu.observability.flightrecorder` — ``FlightRecorder``
+  (the bounded engine-event ring with JSONL/chrome-trace dumps and
+  anomaly auto-dump) and ``RequestTrace`` (per-request lifecycle
+  timelines behind ``Request.timeline()``).
+* :mod:`~paddle_tpu.observability.slo` — ``SLOTracker``/``SLObjective``:
+  sliding-window per-class SLO attainment and burn-rate gauges.
+
 The serving engine, the decode/train compile caches and ``TrainStep`` are
-instrumented out of the box; see the README "Observability" section for the
-metric name table.
+instrumented out of the box; see the README "Observability" and
+"Request-lifecycle observability" sections for the metric name table and
+event schema.
 """
+import importlib
+
 from paddle_tpu.observability.compilecache import CompileCacheMonitor
 from paddle_tpu.observability.exporter import (
     MetricsExporter, start_default_exporter, stop_default_exporter,
@@ -28,8 +42,31 @@ from paddle_tpu.observability.metrics import (
 )
 from paddle_tpu.observability.trace import span
 
+# name -> defining module, resolved on first access (PEP 562)
+_LAZY = {
+    "FlightRecorder": "paddle_tpu.observability.flightrecorder",
+    "RequestTrace": "paddle_tpu.observability.flightrecorder",
+    "SLObjective": "paddle_tpu.observability.slo",
+    "SLOTracker": "paddle_tpu.observability.slo",
+    "DEFAULT_OBJECTIVES": "paddle_tpu.observability.slo",
+}
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "DEFAULT_LATENCY_BUCKETS", "MetricsExporter", "start_default_exporter",
     "stop_default_exporter", "span", "CompileCacheMonitor",
-]
+] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(mod), name)
+    globals()[name] = value   # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

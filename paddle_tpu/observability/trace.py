@@ -23,9 +23,30 @@ import time
 
 from paddle_tpu.observability.metrics import get_registry
 
-__all__ = ["span", "span_histogram"]
+__all__ = ["span", "span_histogram", "chrome_event"]
 
 SPAN_EVENT_TYPE = "Span"
+
+
+def chrome_event(name, start_ns, end_ns, *, tid, event_type=SPAN_EVENT_TYPE,
+                 args=None):
+    """One chrome-trace event dict in the profiler's exact shape.
+
+    Built THROUGH the profiler's ``_HostTracer`` (the same plumbing
+    ``span`` forwards into), so consumers that assemble their own
+    ``traceEvents`` lists — the flight recorder's one-track-per-rid dump —
+    stay format-identical to ``Profiler.export`` output by construction,
+    with ``tid`` overridden (the recorder tracks by rid, not by thread)
+    and an optional ``args`` payload attached."""
+    from paddle_tpu.profiler.profiler import _HostTracer
+    tracer = _HostTracer()
+    tracer.enabled = True
+    tracer.add(name, start_ns, end_ns, event_type=event_type)
+    ev = tracer.events[0]
+    ev["tid"] = tid
+    if args:
+        ev["args"] = args
+    return ev
 
 
 def span_histogram(registry=None):

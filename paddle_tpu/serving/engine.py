@@ -82,9 +82,10 @@ from __future__ import annotations
 import bisect
 import contextlib
 import logging
+import threading
 import time
 import warnings
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -94,6 +95,10 @@ from paddle_tpu.models.llama_decode import (
     _decode_params_of, serving_decode_steps, serving_prefill_chunk,
     serving_prefill_slot, serving_spec_step,
 )
+from paddle_tpu.observability.flightrecorder import (
+    FlightRecorder, RequestTrace,
+)
+from paddle_tpu.observability.slo import SLOTracker
 from paddle_tpu.serving.faults import InjectedDispatchError
 from paddle_tpu.serving.kv_cache import KVCacheManager
 from paddle_tpu.serving.metrics import EngineMetrics
@@ -176,10 +181,20 @@ class Request:
     (non-finite logits quarantine) or ``"shed"`` (rejected at submit by
     the bounded admission queue).  ``done`` is True for every terminal
     status except ``"shed"`` (a shed request never entered the engine).
+
+    ``slo_class`` names the request's traffic class for the engine's SLO
+    tracker (observability/slo.py; ``None`` = the tracker's default,
+    ``"interactive"``).  Classes must stay low-cardinality — they label
+    the attainment/burn-rate gauges.  ``timeline()`` returns the
+    engine-recorded lifecycle transitions (``queued`` → ``prefilling``
+    per chunk → ``decoding`` → terminal status) as a list of ``{"t",
+    "phase", ...}`` dicts on the ``perf_counter`` clock — empty until
+    the request is submitted.
     """
 
     def __init__(self, prompt_ids, max_new_tokens, eos_token_id=None,
-                 stream_cb=None, rid=None, deadline_ms=None):
+                 stream_cb=None, rid=None, deadline_ms=None,
+                 slo_class=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt_ids.size == 0:
             raise ValueError("Request: empty prompt")
@@ -193,6 +208,7 @@ class Request:
                             if deadline_ms is not None else None)
         if self.deadline_ms is not None and self.deadline_ms < 0:
             raise ValueError("Request: deadline_ms must be >= 0")
+        self.slo_class = None if slo_class is None else str(slo_class)
         self.output_ids = []
         self.text = ""
         self.done = False
@@ -201,7 +217,14 @@ class Request:
         self.t_first = None
         self.t_done = None
         self._t_deadline = None   # stamped at submit()
+        self._trace = None        # RequestTrace, attached at submit()
         self._cb_err_logged = False
+
+    def timeline(self):
+        """Lifecycle transitions the engine recorded for this request
+        (class docstring); ``[]`` before ``submit()``."""
+        tr = self._trace
+        return [] if tr is None else tr.as_dicts()
 
     @property
     def latency(self):
@@ -284,6 +307,26 @@ class ServingEngine:
     ``deadline_ms`` retire work anywhere in its lifecycle via the same
     write-drop parking retirement the scheduler already uses — no
     recompile, no retrace.
+
+    Request-lifecycle observability (host-side bookkeeping on the
+    existing sync structure — zero new device syncs, and token outputs
+    are byte-identical recorder-on vs recorder-off, tested):
+    ``recorder`` is the always-on flight recorder — ``True`` (default)
+    builds a :class:`~paddle_tpu.observability.flightrecorder.
+    FlightRecorder` with defaults, ``False`` disables recording, or pass
+    a configured instance (capacity / ``dump_dir`` for anomaly dumps).
+    A ``timed_out``/``poisoned`` retirement or a retry exhaustion
+    auto-dumps the last events and bumps
+    ``flight_recorder_dumps_total{reason}``.  Every request also gets a
+    rid-keyed lifecycle trace behind ``Request.timeline()``, aggregated
+    into the ``serving_queue/prefill/decode_seconds`` phase histograms
+    at retirement.  ``slo``: per-class SLO objectives — ``None`` uses
+    :data:`~paddle_tpu.observability.slo.DEFAULT_OBJECTIVES`, or pass an
+    iterable of ``SLObjective`` / a ready ``SLOTracker``; retirements
+    feed the windowed ``serving_slo_attainment`` / ``_burn_rate``
+    gauges by ``Request(slo_class=...)``.  ``debug_sources()`` plugs
+    ``/debug/requests``, ``/debug/flightrecorder`` and ``/debug/slo``
+    into a ``MetricsExporter``.
     """
 
     def __init__(self, model, batch_size=8, max_len=2048, mode="greedy",
@@ -292,7 +335,8 @@ class ServingEngine:
                  instrument=True, pipeline=True, decode_chunk=256,
                  prefill_chunk=256, prefill_budget=2, mesh=None,
                  tp_axis="mp", max_pending=None, retry_attempts=3,
-                 retry_backoff=0.05, faults=None):
+                 retry_backoff=0.05, faults=None, recorder=True,
+                 slo=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -309,6 +353,27 @@ class ServingEngine:
         self._m = (EngineMetrics(registry, policy, int(batch_size),
                                   mesh_devices=mesh_devices)
                    if instrument else None)
+        # request-scoped observability: the flight-recorder event ring,
+        # rid-keyed lifecycle traces (Request.timeline() / /debug/requests)
+        # and the sliding-window SLO tracker fed at retirement — all host
+        # bookkeeping riding the existing drain, never a device value
+        if recorder is True:
+            recorder = FlightRecorder(policy=policy)
+        elif recorder is False:
+            recorder = None
+        self._fr = recorder
+        if self._fr is not None and self._fr.on_dump is None \
+                and self._m is not None:
+            self._fr.on_dump = self._m.recorder_dump
+        if isinstance(slo, SLOTracker):
+            self._slo = slo
+        else:
+            self._slo = SLOTracker(
+                objectives=slo, policy=policy,
+                registry=self._m.registry if self._m is not None else None)
+        self._traces = OrderedDict()   # rid -> RequestTrace, newest last
+        self._trace_cap = 1024
+        self._trace_lock = threading.Lock()
         self._B = int(batch_size)
         self._lmax = int(max_len)
         self._mode = mode
@@ -452,6 +517,10 @@ class ServingEngine:
             request.status = "shed"
             if self._m is not None:
                 self._m.terminal("shed")
+            if self._fr is not None:
+                self._fr.record("shed", step=self._step_idx,
+                                rid=request.rid,
+                                queued=len(self._queue))
             raise EngineOverloaded(
                 f"admission queue full ({len(self._queue)} pending >= "
                 f"max_pending={self._max_pending}); request shed")
@@ -474,6 +543,21 @@ class ServingEngine:
         if request.deadline_ms is not None:
             request._t_deadline = request.t_submit \
                 + request.deadline_ms / 1e3
+        # lifecycle trace: born "queued"; bounded rid-keyed index so
+        # /debug/requests can show recent timelines without unbounded
+        # growth (the Request itself keeps its own trace alive regardless).
+        # recorder=False switches off ALL request-scoped recording —
+        # timelines included
+        if self._fr is not None:
+            tr = RequestTrace(request.rid)
+            request._trace = tr
+            with self._trace_lock:
+                self._traces[request.rid] = tr
+                while len(self._traces) > self._trace_cap:
+                    self._traces.popitem(last=False)
+            tr.mark("queued")
+            self._fr.record("submit", step=self._step_idx, rid=request.rid,
+                            prompt_len=p, slo_class=request.slo_class)
         self._queue.append(request)
         if self._m is not None:
             self._m.queue_depth.set(len(self._queue))
@@ -492,6 +576,30 @@ class ServingEngine:
     # tokens fail the request-identity drain check) — no recompile, no
     # retrace, and the freed slot re-admits immediately.
 
+    def _on_terminal(self, r, status, slot=None):
+        """Request-scoped observability fanout, once per terminal
+        transition: the timeline's terminal mark, the flight-recorder
+        ``retire`` event, the lifecycle phase histograms and the SLO
+        window — plus the anomaly auto-dump for ``timed_out`` /
+        ``poisoned`` (retry exhaustion dumps from ``_retry``).  Pure host
+        bookkeeping; the scheduling state machine is untouched."""
+        tr = r._trace
+        if tr is not None:
+            if slot is not None:
+                tr.mark(status, slot=slot)
+            else:
+                tr.mark(status)
+        if self._fr is not None:
+            self._fr.record("retire", step=self._step_idx, rid=r.rid,
+                            slot=slot, status=status,
+                            n_out=len(r.output_ids))
+            if status in ("timed_out", "poisoned"):
+                self._fr.auto_dump(status)
+        if self._m is not None and tr is not None:
+            self._m.observe_phases(tr.durations())
+        if self._slo is not None:
+            self._slo.observe(r)
+
     def _terminal_queued(self, r, status):
         """Retire a request that never reached a slot (still queued)."""
         r.status = status
@@ -500,6 +608,7 @@ class ServingEngine:
         self._finished.append(r)
         if self._m is not None:
             self._m.terminal(status)
+        self._on_terminal(r, status)
 
     def _forget_slot(self, slot):
         """Drop every piece of per-slot scheduler state that outlives the
@@ -527,6 +636,7 @@ class ServingEngine:
         if self._m is not None:
             self._m.terminal(status)
             self._m.slots_occupied.set(self._kv.occupied())
+        self._on_terminal(r, status, slot=slot)
 
     def cancel(self, rid):
         """Host-side cancellation: retire ``rid`` wherever it is —
@@ -538,12 +648,17 @@ class ServingEngine:
         for r in self._queue:
             if r.rid == rid:
                 self._queue.remove(r)
+                if self._fr is not None:
+                    self._fr.record("cancel", step=self._step_idx, rid=rid)
                 self._terminal_queued(r, "cancelled")
                 if self._m is not None:
                     self._m.queue_depth.set(len(self._queue))
                 return True
         for slot, r in enumerate(self._kv.reqs):
             if r is not None and r.rid == rid:
+                if self._fr is not None:
+                    self._fr.record("cancel", step=self._step_idx, rid=rid,
+                                    slot=slot)
                 self._retire(slot, "cancelled")
                 return True
         return False
@@ -592,6 +707,9 @@ class ServingEngine:
                 continue   # no rows written yet — defer to a later step
             self._inject_nan(slot)
             f.mark_poisoned(r.rid)
+            if self._fr is not None:
+                self._fr.record("poison", step=self._step_idx, rid=r.rid,
+                                slot=slot)
 
     def _fault_point(self, kind, attempt):
         if self._faults is not None:
@@ -613,9 +731,21 @@ class ServingEngine:
                 return fn(attempt)
             except _RETRYABLE as e:
                 if attempt + 1 >= self._retry_attempts:
+                    # exhaustion: the engine is about to surface a device
+                    # error to the caller — snapshot the path that led here
+                    if self._fr is not None:
+                        self._fr.record(
+                            "retry", step=self._step_idx, what=what,
+                            attempt=attempt + 1, error=type(e).__name__,
+                            exhausted=True)
+                        self._fr.auto_dump("retry_exhausted")
                     raise
                 if self._m is not None:
                     self._m.dispatch_retries.inc()
+                if self._fr is not None:
+                    self._fr.record("retry", step=self._step_idx,
+                                    what=what, attempt=attempt + 1,
+                                    error=type(e).__name__)
                 _LOG.warning(
                     "serving %s failed (%s: %s) — retrying "
                     "(attempt %d/%d) after %.3fs backoff",
@@ -695,6 +825,11 @@ class ServingEngine:
             slot = free.pop(0)
             self._kv.assign(slot, r)
             p = r.prompt_ids.size
+            if r._trace is not None:
+                r._trace.mark("prefilling", slot=slot)
+            if self._fr is not None:
+                self._fr.record("admit", step=self._step_idx, rid=r.rid,
+                                slot=slot, bucket=r._bucket)
             if m is not None:
                 m.admitted.inc()
                 m.prefill(r._bucket)
@@ -741,6 +876,11 @@ class ServingEngine:
             slot = free.pop(0)
             self._kv.assign(slot, r)
             p = int(r.prompt_ids.size)
+            if r._trace is not None:
+                r._trace.mark("prefilling", slot=slot)
+            if self._fr is not None:
+                self._fr.record("admit", step=self._step_idx, rid=r.rid,
+                                slot=slot, bucket=r._bucket)
             padded = np.zeros((-(-p // P) * P,), np.int32)
             padded[:p] = r.prompt_ids
             # device-ready prompt length, built here (outside the chunk
@@ -776,6 +916,12 @@ class ServingEngine:
                 break
             st = self._pf[slot]
             while budget:
+                k = st["off"] // P
+                if st["req"]._trace is not None:
+                    st["req"]._trace.mark("prefilling", chunk=k, slot=slot)
+                if self._fr is not None:
+                    self._fr.record("prefill_chunk", step=self._step_idx,
+                                    rid=st["req"].rid, slot=slot, chunk=k)
                 chunk = st["tok"][st["off"]:st["off"] + P][None, :]
                 with m.span_prefill if m is not None else _NULL_CTX:
                     first, okf, self._kv.caches, hist, hist_len = \
@@ -843,6 +989,8 @@ class ServingEngine:
                 r.t_first = time.perf_counter()
                 if m is not None:
                     m.ttft.observe(r.t_first - r.t_submit)
+                if r._trace is not None:
+                    r._trace.mark("decoding", slot=slot)
             if len(r.output_ids) >= r.max_new_tokens or (
                     r.eos_token_id is not None
                     and int(t) == int(r.eos_token_id)):
@@ -881,6 +1029,7 @@ class ServingEngine:
                 m.e2e.observe(r.t_done - r.t_submit)
                 m.tpot.observe(r.tpot)
                 m.slots_occupied.set(self._kv.occupied())
+            self._on_terminal(r, "done", slot=slot)
         return took
 
     # ------------------------------------------------------------ step / run
@@ -891,13 +1040,17 @@ class ServingEngine:
         if m is None:
             return self._step_impl()
         m.steps.inc()
+        m.last_step_time.set(time.time())
         with m.span_step:
             return self._step_impl()
 
     def _step_impl(self):
         self._step_idx += 1
         if self._faults is not None:
-            self._faults.maybe_slow_step(self._step_idx)
+            stalled = self._faults.maybe_slow_step(self._step_idx)
+            if stalled and self._fr is not None:
+                self._fr.record("stall", step=self._step_idx,
+                                seconds=stalled, injected=True)
         self._expire_deadlines()
         self._apply_poison()
         self._adm_wave = False
@@ -944,6 +1097,9 @@ class ServingEngine:
             return emitted
         active = np.array([self._decodable(i) for i in range(self._B)])
         dev_len = self._kv.device_lengths(active)
+        if self._fr is not None:
+            self._fr.record("dispatch", step=self._step_idx,
+                            mode=self._mode, n_live=len(live))
         if self._mode == "greedy":
             def go(attempt):
                 self._fault_point("dispatch", attempt)
@@ -952,6 +1108,9 @@ class ServingEngine:
                 toks, okd, self._kv.caches = self._retry(
                     go, "decode dispatch")
                 toks, okd = self._fetch("drain", toks, okd)
+            if self._fr is not None:
+                self._fr.record("drain", step=self._step_idx,
+                                mode="greedy", n_live=len(live))
             self._observe_interference(adm_active, self._sync)
             for i in live:
                 if not bool(okd[i]):
@@ -969,6 +1128,9 @@ class ServingEngine:
                 blk, j, cur, _, oks, self._kv.caches, self._hist, \
                     self._hist_len = self._retry(go, "spec dispatch")
                 blk, j, cur, oks = self._fetch("drain", blk, j, cur, oks)
+            if self._fr is not None:
+                self._fr.record("drain", step=self._step_idx, mode="spec",
+                                n_live=len(live))
             accepted = 0
             for i in live:
                 if not bool(oks[i]):
@@ -1003,6 +1165,10 @@ class ServingEngine:
         if not live:
             return
         m = self._m
+        if self._fr is not None:
+            self._fr.record("dispatch", step=self._step_idx,
+                            mode=self._mode, n_live=len(live),
+                            pipelined=True)
         active = np.array([self._decodable(i) for i in range(self._B)])
         host_len = self._kv.device_lengths(active)
         use_host = ~active
@@ -1083,9 +1249,14 @@ class ServingEngine:
         if rec["kind"] == "greedy":
             vals = self._fetch("drain", rec["toks"], rec["ok"], *fo)
             toks, okd, fvals = vals[0], vals[1], vals[2:]
+            stall = time.perf_counter() - t0
             if m is not None:
-                m.pipeline_stall.observe(time.perf_counter() - t0)
+                m.pipeline_stall.observe(stall)
                 m.inflight.set(still_inflight)
+            if self._fr is not None:
+                self._fr.record("stall", step=self._step_idx, seconds=stall)
+                self._fr.record("drain", step=self._step_idx, mode="greedy",
+                                n_live=len(rec["live"]), pipelined=True)
             self._observe_interference(rec.get("adm", False), self._sync)
             # the first tokens ride the record they were dispatched before
             # (program order: final prefill chunk, then this decode step) —
@@ -1111,9 +1282,14 @@ class ServingEngine:
             vals = self._fetch("drain", rec["blk"], rec["j"], rec["ok"],
                                *fo)
             blk, j, okd, fvals = vals[0], vals[1], vals[2], vals[3:]
+            stall = time.perf_counter() - t0
             if m is not None:
-                m.pipeline_stall.observe(time.perf_counter() - t0)
+                m.pipeline_stall.observe(stall)
                 m.inflight.set(still_inflight)
+            if self._fr is not None:
+                self._fr.record("stall", step=self._step_idx, seconds=stall)
+                self._fr.record("drain", step=self._step_idx, mode="spec",
+                                n_live=len(rec["live"]), pipelined=True)
             for n, (slot, r, _, _) in enumerate(firsts):
                 if self._kv.reqs[slot] is not r:
                     continue
@@ -1175,3 +1351,54 @@ class ServingEngine:
         if self._m is not None:
             self._m.queue_depth.set(len(self._queue))
         return {r.rid: r.status for r in self._finished}
+
+    # ------------------------------------------------- debug introspection
+    @property
+    def recorder(self):
+        """The engine's ``FlightRecorder`` (None when ``recorder=False``)."""
+        return self._fr
+
+    @property
+    def slo_tracker(self):
+        """The engine's ``SLOTracker``."""
+        return self._slo
+
+    def requests_snapshot(self, last=64):
+        """JSON-ready view of the most recent request timelines (newest
+        ``last`` of the rid-keyed trace cache, including still-live
+        requests).  Thread-safe: copies under the trace lock, so a scrape
+        thread can call it mid-``step()``."""
+        with self._trace_lock:
+            traces = list(self._traces.values())[-int(last):]
+        return {
+            "n_tracked": len(traces),
+            "requests": [{"rid": t.rid, "phase": t.phase,
+                          "timeline": t.as_dicts()} for t in traces],
+        }
+
+    def recorder_snapshot(self, last=256):
+        """JSON-ready flight-recorder view (plus the fault plan, when one
+        is configured, so a postmortem reader sees the injected schedule
+        next to the events it caused)."""
+        if self._fr is None:
+            return {"enabled": False}
+        snap = self._fr.snapshot(last=last)
+        snap["enabled"] = True
+        if self._faults is not None:
+            snap["fault_plan"] = self._faults.snapshot()
+        return snap
+
+    def slo_snapshot(self):
+        """JSON-ready windowed SLO attainment / burn-rate view."""
+        return self._slo.snapshot()
+
+    def debug_sources(self):
+        """``{name: callable}`` map for ``MetricsExporter`` — wires the
+        engine's ``/debug/requests``, ``/debug/flightrecorder`` and
+        ``/debug/slo`` endpoints in one call::
+
+            MetricsExporter(debug_sources=engine.debug_sources()).start()
+        """
+        return {"requests": self.requests_snapshot,
+                "flightrecorder": self.recorder_snapshot,
+                "slo": self.slo_snapshot}

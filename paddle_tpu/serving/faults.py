@@ -120,11 +120,31 @@ class FaultPlan:
 
     # ----------------------------------------------------------- slow steps
     def maybe_slow_step(self, step):
-        """Block the host for the step's scheduled stall, if any."""
+        """Block the host for the step's scheduled stall, if any.  Returns
+        the seconds actually slept (0.0 when the step is clean) so the
+        engine can attribute the injected stall in its flight recorder."""
         s = self.slow_steps.get(step)
-        if s:
-            self.stats["slow_steps"] += 1
-            time.sleep(float(s))
+        if not s:
+            return 0.0
+        self.stats["slow_steps"] += 1
+        time.sleep(float(s))
+        return float(s)
+
+    # -------------------------------------------------------- introspection
+    def snapshot(self):
+        """JSON-ready plan summary for the engine's ``/debug/*`` views:
+        the configured schedule plus the fire counts — a postmortem reader
+        sees WHAT was injected next to the events it caused."""
+        return {
+            "seed": self.seed,
+            "dispatch_error_steps": sorted(self.dispatch_error_steps),
+            "dispatch_error_rate": self.dispatch_error_rate,
+            "dispatch_error_attempts": self.dispatch_error_attempts,
+            "poison": dict(self.poison),
+            "slow_steps": dict(self.slow_steps),
+            "cb_crash_steps": sorted(self.cb_crash_steps),
+            "stats": dict(self.stats),
+        }
 
     # ------------------------------------------------------ stream_cb faults
     def maybe_crash_stream_cb(self, step):

@@ -68,6 +68,38 @@ class EngineMetrics:
             "mean per-token time after the first", L).labels(**lbl)
         self.e2e = reg.histogram(
             "serving_e2e_seconds", "submit -> completion", L).labels(**lbl)
+        # request-lifecycle phase histograms, fed from the RequestTrace at
+        # retirement: the three legs of queued -> prefilling -> decoding ->
+        # terminal (TTFT/TPOT above already cover the composite views)
+        self.queue_seconds = reg.histogram(
+            "serving_queue_seconds",
+            "lifecycle phase: submit -> slot admission (RequestTrace)",
+            L).labels(**lbl)
+        self.prefill_seconds = reg.histogram(
+            "serving_prefill_seconds",
+            "lifecycle phase: slot admission -> first token (RequestTrace)",
+            L).labels(**lbl)
+        self.decode_seconds = reg.histogram(
+            "serving_decode_seconds",
+            "lifecycle phase: first token -> terminal status (RequestTrace)",
+            L).labels(**lbl)
+        # anomaly auto-dumps of the flight recorder, by trigger; every
+        # reason child is pre-registered so a first scrape before any
+        # anomaly shows the full zero-valued series set
+        self._recorder_dumps = reg.counter(
+            "flight_recorder_dumps_total",
+            "anomaly-triggered flight-recorder snapshots, by trigger",
+            ("policy", "reason"))
+        for reason in ("timed_out", "poisoned", "retry_exhausted"):
+            self._recorder_dumps.labels(policy=policy, reason=reason)
+        # wall-clock stamp of the most recent scheduler step: /healthz
+        # derives "last-step age" from it, so a wedged engine (stuck
+        # dispatch, dead loop) is visible to a router's health check
+        # without parsing the full /metrics page
+        self.last_step_time = reg.gauge(
+            "serving_last_step_unixtime",
+            "time.time() of the engine's most recent scheduler step "
+            "(0 until the first step)", L).labels(**lbl)
         # keyed by exception type so a scrape distinguishes a buggy user
         # callback (TypeError) from an injected crash; the bare series is
         # pre-registered under error="Exception" so the family exports
@@ -143,6 +175,25 @@ class EngineMetrics:
     def stream_cb_error(self, etype):
         self._stream_cb_errors.labels(
             policy=self._policy, error=etype).inc()
+
+    def recorder_dump(self, reason):
+        """Count one anomaly auto-dump (FlightRecorder ``on_dump`` hook)."""
+        self._recorder_dumps.labels(
+            policy=self._policy, reason=reason).inc()
+
+    def observe_phases(self, durations):
+        """Feed the lifecycle phase histograms from a RequestTrace's
+        ``durations()`` dict (absent legs are skipped — a shed request
+        has no decode phase to observe)."""
+        v = durations.get("queue")
+        if v is not None:
+            self.queue_seconds.observe(v)
+        v = durations.get("prefill")
+        if v is not None:
+            self.prefill_seconds.observe(v)
+        v = durations.get("decode")
+        if v is not None:
+            self.decode_seconds.observe(v)
 
     def terminal(self, status):
         """Bump the reliability counter for a non-``done`` terminal

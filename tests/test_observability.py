@@ -161,9 +161,12 @@ class TestExporter:
             _assert_prometheus_valid(body)
             for name in reg.names():  # every registered series is scraped
                 assert name in body
-            hz = urllib.request.urlopen(
-                f"{exp.url}/healthz", timeout=5).read().decode()
-            assert json.loads(hz) == {"status": "ok"}
+            hz = json.loads(urllib.request.urlopen(
+                f"{exp.url}/healthz", timeout=5).read().decode())
+            # liveness detail reads the serving gauges; this registry has
+            # no engine, so every detail field is null but present
+            assert hz == {"status": "ok", "last_step_age_seconds": None,
+                          "queue_depth": None, "inflight_steps": None}
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(f"{exp.url}/nope", timeout=5)
             url, port = exp.url, exp.port

@@ -395,6 +395,64 @@ class TestRuleFixtures:
                         time.sleep(0.01)
         """) == ["PTL008"]
 
+    # PTL009 — per-request-metric-label --------------------------------
+    def test_labels_tp_rid_in_step_loop(self):
+        assert _rules("""
+            def serve(engine, reqs, m):
+                for r in reqs:
+                    engine.step(r)
+                    m.labels(rid=r.rid).inc()
+        """) == ["PTL009"]
+
+    def test_labels_tp_fstring_wrapped_rid(self):
+        # str()/f-string wrapping does not hide the identifier
+        assert _rules("""
+            def serve(engine, reqs, m):
+                for r in reqs:
+                    engine.step(r)
+                    m.labels(request=f"req-{r.request_id}").observe(1.0)
+        """) == ["PTL009"]
+
+    def test_labels_tp_uuid_call(self):
+        assert _rules("""
+            import uuid
+            def serve(engine, xs, m):
+                for x in xs:
+                    engine.step(x)
+                    m.labels(trace=str(uuid.uuid4())).inc()
+        """) == ["PTL009"]
+
+    def test_labels_tp_nested_loop_propagates(self):
+        # minted in an inner non-step loop, still per-iteration of the
+        # enclosing step loop
+        assert _rules("""
+            def serve(engine, batches, m):
+                for b in batches:
+                    engine.step(b)
+                    for r in b:
+                        m.labels(rid=r.rid).inc()
+        """) == ["PTL009"]
+
+    def test_labels_tn_bounded_dimensions(self):
+        # policy/bucket/status/slo_class are bounded label sets — the
+        # EngineMetrics idiom stays clean
+        assert _rules("""
+            def serve(engine, reqs, m):
+                for r in reqs:
+                    engine.step(r)
+                    m.labels(policy="continuous", bucket=r.bucket).inc()
+                    m.labels(slo_class=r.slo_class).observe(0.1)
+        """) == []
+
+    def test_labels_tn_rid_outside_step_loop(self):
+        # a rid label in a loop that never dispatches a step is someone
+        # else's problem (offline analysis, test code)
+        assert _rules("""
+            def summarize(reqs, m):
+                for r in reqs:
+                    m.labels(rid=r.rid).inc()
+        """) == []
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
