@@ -82,6 +82,17 @@ _STEP_NAME_RE = re.compile(r"(^|_)(steps?|prefill_chunk)($|_)")
 # like `request.slo_class` are bounded and fine.
 _RID_NAME_RE = re.compile(r"(^|_)(rid|rids|uuid|guid|request_id|req_id)"
                           r"($|_)", re.IGNORECASE)
+# host-built list operands to compiled steps (PTL010): a python list's
+# LENGTH enters the operand's shape, so a block-index / slot list that
+# grows between iterations retraces the step each time it changes size.
+# Wrapping it in an array constructor AT THE CALL SITE doesn't help — the
+# array inherits the list's ragged length.  Matched through the resolved
+# import so `jnp.asarray([...])` and `np.stack([...])` are caught alike;
+# a fixed-shape mirror shipped whole (`jnp.asarray(self.block_tables)` —
+# an ndarray, not a list) is the sanctioned idiom and passes.
+_ARRAY_WRAPPERS = {"numpy.asarray", "numpy.array", "numpy.stack",
+                   "jax.numpy.asarray", "jax.numpy.array",
+                   "jax.numpy.stack"}
 
 
 @dataclass
@@ -331,6 +342,7 @@ class _Loop:
     syncs: list = field(default_factory=list)
     waits: list = field(default_factory=list)
     labels: list = field(default_factory=list)
+    raggeds: list = field(default_factory=list)
 
 
 class _Checker:
@@ -490,10 +502,18 @@ class _Checker:
                           f"`{ident}` inside a loop that dispatches a "
                           "compiled step — every unique value mints a new "
                           "metric series (unbounded label cardinality)")
+            for call, what in rec.raggeds:
+                self.emit("PTL010", call,
+                          f"{what} passed as a compiled-step operand "
+                          "inside a step-dispatch loop — the list's "
+                          "length enters the operand's shape, retracing "
+                          "the step whenever it changes; ship a "
+                          "fixed-shape sentinel-padded array instead")
         elif self.loop_stack:
             self.loop_stack[-1].syncs.extend(rec.syncs)
             self.loop_stack[-1].waits.extend(rec.waits)
             self.loop_stack[-1].labels.extend(rec.labels)
+            self.loop_stack[-1].raggeds.extend(rec.raggeds)
 
     def _loop_targets(self):
         names = set()
@@ -609,6 +629,13 @@ class _Checker:
                                      or name in self.c.module_jitted):
                 for r in self.loop_stack:
                     r.has_step = True
+                # PTL010: host-built list operands fed to the step itself
+                # — their length becomes the operand shape
+                for v in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    what = self._host_list_operand(v)
+                    if what is not None:
+                        rec.raggeds.append((node, what))
             sync = None
             if f in _SYNC_NP:
                 sync = "np." + f.split(".")[-1] + "()"
@@ -664,6 +691,25 @@ class _Checker:
                 if fn is not None and (fn == "uuid"
                                        or fn.startswith("uuid.")):
                     return fn + "()"
+        return None
+
+    def _host_list_operand(self, value):
+        """The PTL010 offender inside a compiled-step call's operand
+        expression: a list literal / comprehension, bare or fed to an
+        array constructor AT THE CALL SITE (``jnp.asarray([...])``) —
+        either way the python list's length becomes the operand's shape.
+        An ndarray shipped whole (``jnp.asarray(self.block_tables)``)
+        has no list child and passes."""
+        if isinstance(value, ast.List):
+            return "a python list literal"
+        if isinstance(value, ast.ListComp):
+            return "a python list comprehension"
+        if isinstance(value, ast.Call) and value.args and \
+                self.resolve(value.func) in _ARRAY_WRAPPERS and \
+                isinstance(value.args[0], (ast.List, ast.ListComp)):
+            fn = value.func.attr if isinstance(value.func, ast.Attribute) \
+                else getattr(value.func, "id", "asarray")
+            return f"a python list wrapped in {fn}(...)"
         return None
 
     # PTL003: call sites of module-level jitted functions

@@ -126,6 +126,21 @@ _RULE_LIST = [
         "put per-request detail in the flight recorder or request "
         "timeline, which are bounded rings, not metric series",
     ),
+    Rule(
+        "PTL010", "host-list-step-operand", WARNING,
+        "a host-built python list (bare, or wrapped in jnp./np. "
+        "asarray/array/stack at the call site) passed as an operand to a "
+        "compiled step inside a step-dispatch loop — the list's LENGTH "
+        "enters the operand's shape, so a block-index / slot list that "
+        "grows or shrinks between iterations retraces the step every time "
+        "it changes size (the paged-KV ragged-shape hazard), and "
+        "rebuilding the array from python per step defeats the dispatch "
+        "fast path even when the length happens to stay fixed",
+        "keep step operands as fixed-shape padded device arrays — block "
+        "tables are a [B, W] int32 array with a sentinel for unmapped "
+        "entries, updated in place host-side and shipped whole "
+        "(kv.device_tables()-style), never rebuilt from a python list",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

@@ -103,10 +103,12 @@ def _rope_at(q, k, cos_t, sin_t, positions):
 
 
 def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
-                chunk_size=None):
+                chunk_size=None, block_tables=None):
     """One decoder layer over T new tokens with the static cache.
     h [B, T, hidden] -> (h', k_cache', v_cache').  ``chunk_size`` (static)
-    selects the length-adaptive chunked cache read in decode_attention."""
+    selects the length-adaptive chunked cache read in decode_attention;
+    ``block_tables [B, W]`` (traced) switches the caches to the paged
+    pool geometry."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
@@ -116,7 +118,8 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
     positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache, _ = decode_attention(
-        q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size)
+        q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size,
+        block_table=block_tables)
     h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
@@ -133,19 +136,23 @@ def _lm_logits(params, h):
 
 
 def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
-             chunk_size=None):
+             chunk_size=None, block_tables=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
     speculative verification).  ``last_idx`` [B] projects one PER-BATCH
     position instead ([B, V]) — the ragged-prefill path, where each
-    slot's prompt ends at a different column of the padded block."""
+    slot's prompt ends at a different column of the padded block.  One
+    ``block_tables`` operand serves every layer — block id ``i`` names
+    row ``i`` of EVERY layer's pool (the tables are geometry, the pools
+    are content)."""
     h = params["embed"][tokens]  # [B, T, hidden]
     new_caches = []
     cos_t, sin_t = params["_rope"]
     for lp, (kc, vc) in zip(params["layers"], caches):
         h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t,
-                                chunk_size=chunk_size)
+                                chunk_size=chunk_size,
+                                block_tables=block_tables)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
     if last_idx is not None:
@@ -156,18 +163,20 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
     return logits.astype(jnp.float32), new_caches, lengths + tokens.shape[1]
 
 
-def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None):
+def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None,
+                  block_tables=None):
     """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=True,
-                    chunk_size=chunk_size)
+                    chunk_size=chunk_size, block_tables=block_tables)
 
 
-def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None):
+def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None,
+                      block_tables=None):
     """Logits for EVERY input position [B, T, V] — the verification pass
     of speculative decoding needs the target's next-token distribution
     after each drafted token."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=False,
-                    chunk_size=chunk_size)
+                    chunk_size=chunk_size, block_tables=block_tables)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -477,7 +486,7 @@ serving_prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
 
 
 def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
-                         cos_t, sin_t, chunk_size=None):
+                         cos_t, sin_t, chunk_size=None, block_tables=None):
     """One decoder layer over a [1, P] prompt chunk, writing/reading the
     SLOT'S rows of the shared batch cache (ops.slot_prefill_attention) —
     the chunked-prefill twin of ``_layer_step``, which operates on whole
@@ -491,7 +500,8 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
     positions = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache = slot_prefill_attention(
-        q, k, v, k_cache, v_cache, slot, offset, chunk_size=chunk_size)
+        q, k, v, k_cache, v_cache, slot, offset, chunk_size=chunk_size,
+        block_table=block_tables)
     h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
@@ -500,7 +510,8 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
 
 def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
                                 caches, slot, hist=None, hist_len=None,
-                                with_hist=False, chunk_size=None):
+                                with_hist=False, chunk_size=None,
+                                block_tables=None):
     """Process the next ``[1, P]`` chunk of an admitted prompt against the
     slot's rows of the batch cache — ONE compiled program for every prompt
     length (``P`` is the only shape; ``offset``, ``prompt_len`` and
@@ -545,7 +556,8 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
     new_caches = []
     for lp, (kc, vc) in zip(params["layers"], caches):
         h, kc, vc = _layer_prefill_chunk(lp, cfg, h, kc, vc, slot, offset,
-                                         cos_t, sin_t, chunk_size=chunk_size)
+                                         cos_t, sin_t, chunk_size=chunk_size,
+                                         block_tables=block_tables)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], eps)
     last_rel = jnp.clip(prompt_len - 1 - offset, 0, t - 1)  # [1]
@@ -578,7 +590,8 @@ serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
 
 
 def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
-                               n_steps=1, chunk_size=None):
+                               n_steps=1, chunk_size=None,
+                               block_tables=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
@@ -597,7 +610,7 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
         tok, ok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
             params, cfg, tok[:, None], caches, lengths,
-            chunk_size=chunk_size)
+            chunk_size=chunk_size, block_tables=block_tables)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
         return (nxt, ok, caches, lengths), nxt
@@ -616,7 +629,8 @@ serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
 
 
 def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
-                            hist_len, active, spec_k=4, chunk_size=None):
+                            hist_len, active, spec_k=4, chunk_size=None,
+                            block_tables=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -641,7 +655,8 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     drafts = _ngram_draft(hist, hist_len, cur, spec_k)
     toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
     logits, caches, _ = _forward_step_all(
-        params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size)
+        params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size,
+        block_tables=block_tables)
     ok = jnp.all(jnp.isfinite(logits), axis=(-2, -1))        # [B]
     # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
     # out IS the accepted-prefix block for this round

@@ -6,9 +6,15 @@ fused append-new-kv + attend-over-cache step per generated token.
 
 TPU-first design choices:
 
-* **Static shapes.**  The cache is preallocated at ``[B, Lmax, Hkv, D]`` and
-  every decode step runs the SAME compiled program regardless of the current
-  length — position masking (``k_idx <= cur_len``) replaces dynamic slicing.
+* **Static shapes.**  The cache is preallocated once and every decode step
+  runs the SAME compiled program regardless of the current length — position
+  masking (``k_idx <= cur_len``) replaces dynamic slicing.  Two cache
+  geometries share that property: the DENSE layout ``[B, Lmax, Hkv, D]``
+  (one contiguous row span per slot) and the PAGED layout (a global block
+  pool ``[N, C, Hkv, D]`` indirected through a per-slot ``[B, Lmax/C]``
+  block table — ``init_kv_pool``).  The block table is a TRACED int32
+  operand, so appending a block mid-stream or remapping a slot to shared
+  prefix blocks changes only operand VALUES, never shapes: zero retraces.
 * **Length-adaptive chunked reads.**  Decode is HBM-bandwidth-bound (a GEMV
   per head against the cache), so KV bytes ARE the step time — and a masked
   full-length read pays ``Lmax`` bytes for a request at context 200 in an
@@ -24,6 +30,17 @@ TPU-first design choices:
   slot never forces full-length reads.  ``chunk_size=None`` (default) keeps
   the single fused full-length read — still optimal when contexts sit near
   ``Lmax`` or the cache is small.
+* **Paged block indirection rides the chunked loop.**  With a
+  ``block_table`` the while_loop body gathers logical chunk ``i`` of each
+  row from physical pool block ``table[b, i]`` instead of slicing a dense
+  row — the SAME online-softmax recurrence over the SAME ``[B, C]`` tiles
+  in the same order, so a paged read is bitwise the dense chunked read of
+  equal ``chunk_size`` at f32 (the serving engine's paged-vs-dense parity
+  matrix pins this).  Appends route through the same table: logical
+  position ``l`` lands in pool block ``table[b, l // C]`` row ``l % C``,
+  and any position past the slot's mapped capacity (or a table sentinel
+  ``>= N``) is routed past the pool so the scatter DROPS it — the
+  write-drop parking invariant survives paging unchanged.
 * **GQA-native.**  kv heads are consumed directly (``[B, Hkv, G, ...]``
   einsums) — no ``repeat`` materialization, KV reads are 1/G of expanded
   heads.
@@ -31,14 +48,18 @@ TPU-first design choices:
   reference's ``sequence_lengths``); appends use a vmapped
   ``dynamic_update_slice`` (lowers to one scatter).
 * **Head-sharding safe.**  Under tensor-parallel serving
-  (serving/sharding.py) the cache is sharded along the ``Hkv`` axis and
-  these reads partition cleanly: the chunked online-softmax running
-  max/denominator reduce over the per-head chunk axis, never across heads,
-  and the trip count reduces over the (replicated) ``lengths`` — so GSPMD
-  runs the identical program per shard on ``Hkv/N`` heads with zero
-  cross-chip collectives inside the attention read.  Keep it that way: any
-  future reduction ACROSS the head axis (head-mixing, cross-head norm)
-  breaks the partition and must be hoisted out of this module.
+  (serving/sharding.py) the cache is sharded along the ``Hkv`` axis —
+  axis 2 in BOTH geometries (dense ``[B, Lmax, Hkv, D]`` and the paged
+  pool ``[N, C, Hkv, D]``), so ``kv_cache_pspec`` covers either one
+  unchanged — and these reads partition cleanly: the chunked
+  online-softmax running max/denominator reduce over the per-head chunk
+  axis, never across heads; the trip count reduces over the (replicated)
+  ``lengths``; and the paged block-table gather indexes only the
+  unsharded pool axis 0 with a replicated table — so GSPMD runs the
+  identical program per shard on ``Hkv/N`` heads with zero cross-chip
+  collectives inside the attention read.  Keep it that way: any future
+  reduction ACROSS the head axis (head-mixing, cross-head norm) breaks
+  the partition and must be hoisted out of this module.
 * Differentiability is not a goal (decode is inference); everything here is
   plain jnp under jit.
 """
@@ -49,8 +70,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_kv_cache", "decode_attention", "masked_lengths",
-           "slot_prefill_attention"]
+__all__ = ["init_kv_cache", "init_kv_pool", "decode_attention",
+           "masked_lengths", "slot_prefill_attention"]
 
 _NEG_INF = -1e30
 
@@ -58,6 +79,20 @@ _NEG_INF = -1e30
 def init_kv_cache(batch, max_len, num_kv_heads, head_dim, dtype="bfloat16"):
     """Preallocate a (k, v) cache pair [B, Lmax, Hkv, D]."""
     shape = (batch, max_len, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_kv_pool(num_blocks, block, num_kv_heads, head_dim,
+                 dtype="bfloat16"):
+    """Preallocate a paged (k, v) pool pair [N, C, Hkv, D].
+
+    A slot's cache is no longer a contiguous ``[Lmax]`` row: it is the
+    chain of pool blocks its ``[Lmax/C]`` block-table row names, appended
+    lazily as the context grows and shareable across slots (refcounted
+    prefix reuse — serving/kv_cache.py owns that bookkeeping).  The head
+    axis sits at index 2 exactly like the dense cache, so the TP
+    head-sharding spec applies to either geometry unchanged."""
+    shape = (num_blocks, block, num_kv_heads, head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -79,17 +114,40 @@ def masked_lengths(lengths, live, lmax):
     return jnp.where(live, lengths.astype(jnp.int32), jnp.int32(lmax))
 
 
-def _append(cache, new, lengths, layout):
+def _append(cache, new, lengths, layout, block_table=None):
     """Write ``new [B, T, Hkv, D]`` into the cache at per-batch offsets
-    ``lengths [B]`` (vmapped indexed scatter — no reallocation).
+    ``lengths [B]`` (indexed scatter — no reallocation).
     ``layout``: "blhd" cache [B, Lmax, Hkv, D] or "bhld" cache
-    [B, Hkv, Lmax, D] (the reference's cache_kv layout).
+    [B, Hkv, Lmax, D] (the reference's cache_kv layout).  With
+    ``block_table [B, W]`` the cache is a paged pool [N, C, Hkv, D]
+    ("blhd" only): logical position ``l`` of row ``b`` scatters into pool
+    block ``table[b, l // C]`` at block row ``l % C``.
 
     Writes past the preallocated capacity are DROPPED (scatter
     mode="drop"), never clamped: a dynamic_update_slice would silently
     clamp the offset and overwrite the most recent valid entries (review
-    r5).  Callers must still bound their decode loops by Lmax - prompt_len
-    — an overflowing step simply does not extend the cache."""
+    r5).  The paged path preserves that contract by routing any logical
+    position past the table's ``W*C`` span — and any sentinel table entry
+    ``>= N`` (an unmapped chunk) — past the pool's block axis, so parked
+    slots (offset ``lmax``) still drop every write.  Callers must still
+    bound their decode loops by Lmax - prompt_len — an overflowing step
+    simply does not extend the cache."""
+    lengths = lengths.astype(jnp.int32)
+    if block_table is not None:
+        n_blocks, c = cache.shape[0], cache.shape[1]
+        b, t = new.shape[0], new.shape[1]
+        w = block_table.shape[1]
+        l = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        blk = jnp.take_along_axis(
+            block_table.astype(jnp.int32),
+            jnp.clip(l // c, 0, w - 1), axis=1)                     # [B, T]
+        # invalid positions (past the W*C logical span — parked slots land
+        # here) and sentinel entries route past the pool: scatter drops
+        phys = jnp.where((l < w * c) & (blk < n_blocks), blk,
+                         jnp.int32(n_blocks))
+        return cache.at[phys.reshape(-1), (l % c).reshape(-1)].set(
+            new.reshape(b * t, *new.shape[2:]).astype(cache.dtype),
+            mode="drop")
 
     def one(c, n, off):
         # n is [T, Hkv, D] per batch entry in either cache layout
@@ -99,7 +157,7 @@ def _append(cache, new, lengths, layout):
         return c.at[:, idx].set(jnp.swapaxes(n, 0, 1).astype(c.dtype),
                                 mode="drop")
 
-    return jax.vmap(one)(cache, new, lengths.astype(jnp.int32))
+    return jax.vmap(one)(cache, new, lengths)
 
 
 def _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
@@ -126,7 +184,7 @@ def _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
 
 
 def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
-                    attn_bias, chunk):
+                    attn_bias, chunk, block_table=None):
     """Online-softmax ``lax.while_loop`` over [C]-sized cache chunks.
 
     Flash-style running (max, denominator, accumulator) carry; exact (not
@@ -141,10 +199,30 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
     over whatever chunks DO run, which keeps every row's softmax finite.
     ``lmax % C != 0`` is handled by clamping the tail chunk's start to
     ``lmax - C`` and masking the re-read overlap out of the tail pass.
+
+    With ``block_table [B, W]`` the caches are a paged pool
+    ``[N, C, Hkv, D]`` (``C == chunk``, "blhd" only): iteration ``i``
+    gathers each row's chunk from pool block ``table[b, i]`` instead of
+    slicing a dense row, and the logical span is ``W * C``.  Sentinel /
+    stale table entries only ever name chunks past a row's live length
+    (the gather CLIPS OOB indices into the pool — never the NaN-filling
+    default), so the causal mask discards whatever they gather — same
+    guarantee the dense path gives chunks past ``lengths[b]``.
     """
     b, hkv, g, t, d = qg.shape
-    lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
     c = int(chunk)
+    if block_table is not None:
+        if layout != "blhd":
+            raise ValueError(
+                "paged _attend_chunked supports only the blhd layout")
+        if k_cache.shape[1] != c:
+            raise ValueError(
+                f"paged _attend_chunked: chunk ({c}) must equal the pool "
+                f"block size ({k_cache.shape[1]})")
+        block_table = block_table.astype(jnp.int32)
+        lmax = block_table.shape[1] * c
+    else:
+        lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
     n_chunks = -(-lmax // c)
     bias = None
     if attn_bias is not None:
@@ -158,7 +236,17 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
     def body(carry):
         i, m, l, acc = carry
         start = jnp.minimum(i * c, lmax - c)  # clamped tail start
-        if layout == "blhd":
+        if block_table is not None:
+            idx = jax.lax.dynamic_slice_in_dim(block_table, i, 1,
+                                               axis=1)[:, 0]        # [B]
+            # mode="clip", NOT the default "fill": fill gathers NaN for a
+            # sentinel/unmapped entry, and the masked softmax weight times
+            # NaN is NaN — clipping reads an arbitrary REAL block whose
+            # rows the causal mask zeroes exactly like dense garbage rows
+            kb = jnp.take(k_cache, idx, axis=0, mode="clip")
+            vb = jnp.take(v_cache, idx, axis=0, mode="clip")
+            kb, vb = jnp.swapaxes(kb, 1, 2), jnp.swapaxes(vb, 1, 2)
+        elif layout == "blhd":
             kb = jax.lax.dynamic_slice(k_cache, (z, start, z, z),
                                        (b, c, hkv, d))
             vb = jax.lax.dynamic_slice(v_cache, (z, start, z, z),
@@ -210,7 +298,8 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
 @functools.partial(jax.jit,
                    static_argnames=("scale", "layout", "chunk_size"))
 def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
-                     layout="blhd", attn_bias=None, chunk_size=None):
+                     layout="blhd", attn_bias=None, chunk_size=None,
+                     block_table=None):
     """One decode step: append new kv, attend causally over the cache.
 
     q [B, T, H, D] (T = tokens this step, usually 1); k_new/v_new
@@ -226,13 +315,31 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     fused full-length pass.  Returns (out [B, T, H, D], k_cache',
     v_cache', lengths + T).
 
+    ``block_table [B, W]`` (traced int32) switches to the PAGED geometry:
+    the caches are a global pool ``[N, C, Hkv, D]`` (``init_kv_pool``),
+    appends and reads indirect through the table, and the logical span is
+    ``W * C``.  Requires ``layout="blhd"`` and
+    ``chunk_size == C`` (the chunked loop IS the paged read — see the
+    module docstring); the paged read is bitwise the dense chunked read
+    of the same chunk size at f32.
+
     Query token t (global position lengths+t) attends to cache positions
     <= lengths+t: bottom-right-aligned causality, same convention as the
     flash kernels' cached prefill.
     """
     b, t, h, d = q.shape
     hkv = k_new.shape[2]
-    lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+    if block_table is not None:
+        if layout != "blhd":
+            raise ValueError(
+                "decode_attention: paged caches support only layout='blhd'")
+        if chunk_size is None or int(chunk_size) != k_cache.shape[1]:
+            raise ValueError(
+                f"decode_attention: paged caches require chunk_size == pool "
+                f"block size ({k_cache.shape[1]}), got {chunk_size}")
+        lmax = block_table.shape[1] * k_cache.shape[1]
+    else:
+        lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
     if hkv <= 0 or h % hkv:
         raise ValueError(
             f"decode_attention: query heads ({h}) must be an integer "
@@ -241,13 +348,17 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     lengths = lengths.astype(jnp.int32)
 
-    k_cache = _append(k_cache, k_new, lengths, layout)
-    v_cache = _append(v_cache, v_new, lengths, layout)
+    k_cache = _append(k_cache, k_new, lengths, layout, block_table)
+    v_cache = _append(v_cache, v_new, lengths, layout, block_table)
 
     qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
         .astype(jnp.float32)                                # [B,Hkv,G,T,D]
     q_pos = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
-    if chunk_size is not None and int(chunk_size) < lmax:
+    if block_table is not None:
+        out = _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
+                              layout, attn_bias, int(chunk_size),
+                              block_table)
+    elif chunk_size is not None and int(chunk_size) < lmax:
         out = _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
                               layout, attn_bias, int(chunk_size))
     else:
@@ -258,7 +369,7 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
 
 
 def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
-                           scale=None, chunk_size=None):
+                           scale=None, chunk_size=None, block_table=None):
     """Chunked-prefill attention for ONE slot of the batch cache.
 
     The serving engine's chunked admission path processes a prompt in
@@ -283,6 +394,12 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
     keeps the fused full-length read.  Only the ``blhd`` layout (the
     model projection order the serving path uses) is supported.
 
+    ``block_table [B, W]`` (traced int32) switches to the PAGED geometry:
+    the caches are a pool ``[N, C, Hkv, D]`` and the chunk's rows scatter
+    and read through the SLOT'S table row (gathered by the traced
+    ``slot``), so no dense per-slot view is materialized.  Requires
+    ``chunk_size == C``, like ``decode_attention``.
+
     q [1, P, H, D]; k_new/v_new [1, P, Hkv, D]; caches [B, Lmax, Hkv, D].
     Returns (out [1, P, H, D], k_cache', v_cache').
     """
@@ -302,6 +419,26 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         else jnp.int32(slot)
     offset = offset.astype(jnp.int32) if hasattr(offset, "astype") \
         else jnp.int32(offset)
+
+    if block_table is not None:
+        if chunk_size is None or int(chunk_size) != k_cache.shape[1]:
+            raise ValueError(
+                f"slot_prefill_attention: paged caches require chunk_size "
+                f"== pool block size ({k_cache.shape[1]}), got {chunk_size}")
+        w = block_table.shape[1]
+        # the slot's [1, W] table row (slot < B: no clamping)
+        trow = jax.lax.dynamic_slice(
+            block_table.astype(jnp.int32), (slot, jnp.int32(0)), (1, w))
+        k_cache = _append(k_cache, k_new, offset[None], "blhd", trow)
+        v_cache = _append(v_cache, v_new, offset[None], "blhd", trow)
+        qg = q.reshape(1, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
+            .astype(jnp.float32)
+        q_pos = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        out = _attend_chunked(qg, k_cache, v_cache, offset[None], q_pos,
+                              scale, "blhd", None, int(chunk_size), trow)
+        out = out.transpose(0, 3, 1, 2, 4).reshape(1, t, h, d) \
+            .astype(q.dtype)
+        return out, k_cache, v_cache
 
     # scatter the chunk's rows into the slot (drop past capacity)
     rows = offset + jnp.arange(t, dtype=jnp.int32)

@@ -70,6 +70,15 @@ program keeps ONE static compiled shape:
   ``pipeline=False`` restores the fully synchronous loop (the A/B
   baseline) — token streams are byte-identical either way (tested).
 
+* **Paged KV cache** (``kv_block=``): the dense per-slot ``[B, Lmax]``
+  cache rows become a global block pool indirected through per-slot
+  block tables (serving/kv_cache.py has the allocator; the constructor
+  docstring has the knob semantics).  Admission switches to total-live-
+  token budgeting, identical prompt prefixes are adopted from a radix
+  cache instead of re-prefilled, and refcount-0 cached blocks are
+  evicted LRU-first under pressure — all host bookkeeping over the same
+  compiled-program discipline (fixed shapes, zero retraces).
+
 The per-slot state the scheduler owns host-side: token history, a length
 mirror of the device cache, and the speculative rewind offset (folded into
 the length mirror as ``+ j + 1`` per accepted round).  Decode-side cache
@@ -100,7 +109,9 @@ from paddle_tpu.observability.flightrecorder import (
 )
 from paddle_tpu.observability.slo import SLOTracker
 from paddle_tpu.serving.faults import InjectedDispatchError
-from paddle_tpu.serving.kv_cache import KVCacheManager
+from paddle_tpu.serving.kv_cache import (
+    KVCacheManager, KVPoolExhausted, PagedKVCacheManager,
+)
 from paddle_tpu.serving.metrics import EngineMetrics
 
 # the serving step/prefill programs donate their cache buffers (in-place
@@ -109,7 +120,8 @@ from paddle_tpu.serving.metrics import EngineMetrics
 warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable")
 
-__all__ = ["EngineOverloaded", "Request", "ServingEngine"]
+__all__ = ["EngineOverloaded", "KVPoolExhausted", "Request",
+           "ServingEngine"]
 
 _NULL_CTX = contextlib.nullcontext()
 
@@ -277,6 +289,18 @@ class ServingEngine:
     max prefill chunks dispatched per scheduler step before the decode
     step goes out — bounds how long resident decode can stall on an
     admission (both knobs tuned via ``bench_sweep.py prefill_chunk``).
+    ``kv_block``: paged KV cache — the per-layer cache becomes a global
+    ``[num_blocks, kv_block, Hkv, D]`` pool indirected through per-slot
+    block tables (serving/kv_cache.PagedKVCacheManager), with
+    ``max_live_tokens`` (default ``batch_size * max_len``) sizing the
+    pool: admission budgets total live TOKENS instead of slots, defers
+    the queue head when the pool can't cover a request's worst case, and
+    radix prefix hits adopt already-cached blocks so chunked prefill
+    runs only the unmatched suffix.  Requires ``prefill_chunk``; forces
+    ``decode_chunk = kv_block`` (the paged read IS the chunked loop).
+    Token streams are byte-identical to the dense engine at f32
+    (tested), and the block tables are traced operands — zero retraces
+    across appends, prefix hits and evictions.
     ``mesh``: a ``jax.sharding.Mesh`` to tensor-parallel the compiled
     hot path across (``None`` = single-device, bitwise the pre-mesh
     engine).  Params are shard-placed once at construction under the
@@ -333,7 +357,8 @@ class ServingEngine:
                  spec_k=8, sync_every=1, policy="continuous",
                  prompt_buckets=None, detokenizer=None, registry=None,
                  instrument=True, pipeline=True, decode_chunk=256,
-                 prefill_chunk=256, prefill_budget=2, mesh=None,
+                 prefill_chunk=256, prefill_budget=2, kv_block=None,
+                 max_live_tokens=None, mesh=None,
                  tp_axis="mp", max_pending=None, retry_attempts=3,
                  retry_backoff=0.05, faults=None, recorder=True,
                  slo=None):
@@ -390,6 +415,31 @@ class ServingEngine:
         if self._pchunk is not None and self._pchunk < 1:
             raise ValueError("prefill_chunk must be >= 1 or None")
         self._pbudget = max(1, int(prefill_budget))
+        # paged KV geometry: ``kv_block`` switches the cache to a global
+        # block pool + per-slot block tables with radix prefix reuse, and
+        # admission to total-live-TOKEN budgeting (``max_live_tokens``).
+        # The paged read IS the chunked attention loop (one gather per
+        # chunk), so decode_chunk is forced to the block size; chunked
+        # prefill is required (the monolithic mini-cache path has no slot
+        # rows to insert into a pool), and the block/chunk sizes must
+        # divide one another so a prefix hit's suffix chunks start on the
+        # same chunk boundaries a miss would prefill — the byte-identity
+        # condition across hit/miss admission.
+        self._paged = kv_block is not None
+        if self._paged:
+            kv_block = int(kv_block)
+            if self._pchunk is None:
+                raise ValueError(
+                    "paged KV (kv_block=) requires chunked prefill "
+                    "(prefill_chunk=)")
+            if self._pchunk % kv_block and kv_block % self._pchunk:
+                raise ValueError(
+                    f"prefill_chunk ({self._pchunk}) and kv_block "
+                    f"({kv_block}) must divide one another (prefix hits "
+                    "must land on prefill-chunk boundaries)")
+            self._chunk = kv_block
+        elif max_live_tokens is not None:
+            raise ValueError("max_live_tokens requires kv_block (paged KV)")
         self._params, self._cfg = _decode_params_of(model, self._lmax)
         nh, nkv, hd, eps = self._cfg
         dtype = self._params["embed"].dtype
@@ -416,11 +466,24 @@ class ServingEngine:
                 mesh, tp_axis, self._cfg, pspecs,
                 len(self._params["layers"]), sync_every=self._sync,
                 spec_k=self._spec_k, with_hist=mode == "spec",
-                chunk_size=self._chunk)
+                chunk_size=self._chunk, paged=self._paged)
             cache_sharding = self._tp.cache_sharding
-        self._kv = KVCacheManager(
-            len(self._params["layers"]), self._B, self._lmax, nkv, hd,
-            dtype, sharding=cache_sharding)
+        if self._paged:
+            self._kv = PagedKVCacheManager(
+                len(self._params["layers"]), self._B, self._lmax, nkv, hd,
+                dtype, block=kv_block,
+                max_live_tokens=(int(max_live_tokens) if max_live_tokens
+                                 else self._B * self._lmax),
+                sharding=cache_sharding, on_event=self._kv_event)
+        else:
+            self._kv = KVCacheManager(
+                len(self._params["layers"]), self._B, self._lmax, nkv, hd,
+                dtype, sharding=cache_sharding)
+        # paged decode-time row growth is capped per slot by the token
+        # budget reserved at admission (prompt + max_new + headroom,
+        # clamped to lmax) — the mirror _spend/_dispatch draw ensure_rows
+        # against
+        self._need_rows = np.zeros((self._B,), np.int64)
         if prompt_buckets is None:
             prompt_buckets = []
             b = 16
@@ -687,8 +750,19 @@ class ServingEngine:
         query of the slot) with NaN, eagerly between compiled steps.
         Functional ``.at[].set`` touches only that row, so cohabiting
         slots' cache bytes are untouched — the quarantine's
-        byte-identity guarantee rests on per-row attention isolation."""
+        byte-identity guarantee rests on per-row attention isolation.
+        Paged engines poison the slot's FIRST MAPPED BLOCK instead (the
+        pool has no per-slot rows); the seam is test-only and the paged
+        fault tests use distinct prompts, so the poisoned block is never
+        a shared prefix block."""
         k, v = self._kv.caches[0]
+        if self._paged:
+            b = int(self._kv.block_tables[slot, 0])
+            if b >= self._kv.num_blocks:
+                return   # no rows mapped yet (unreachable: _apply_poison
+                         # already defers slots with no chunk dispatched)
+            self._kv.caches[0] = (k.at[b, 0].set(jnp.nan), v)
+            return
         self._kv.caches[0] = (k.at[slot, 0].set(jnp.nan), v)
 
     def _apply_poison(self):
@@ -769,23 +843,51 @@ class ServingEngine:
     # a mesh dispatches the cached TP programs (serving/sharding.py —
     # statics baked in at construction).  Both take and return replicated
     # host-facing operands, so every caller is placement-oblivious.
+    def _kv_event(self, kind, **info):
+        """PagedKVCacheManager event hook: mirror allocator activity
+        (``block_alloc`` / ``block_free``) into the flight recorder and
+        keep the block-pool gauges current.  Host bookkeeping only — the
+        allocator never touches a device value."""
+        if self._fr is not None:
+            self._fr.record(kind, step=self._step_idx, **info)
+        if self._m is not None:
+            self._m.kv_blocks_used.set(self._kv.blocks_used())
+            self._m.kv_blocks_free.set(self._kv.free_count())
+
+    def _tables(self):
+        """The block-table operand for one dispatch: the host mirror
+        shipped as a fixed-shape ``[B, W]`` traced array (never a Python
+        list — tpu-lint PTL010 polices the difference)."""
+        return self._kv.device_tables()
+
     def _call_decode(self, cur, dev_len):
         if self._tp is not None:
+            if self._paged:
+                return self._tp.decode_steps(self._params, cur,
+                                             self._kv.caches, dev_len,
+                                             self._tables())
             return self._tp.decode_steps(self._params, cur,
                                          self._kv.caches, dev_len)
         return serving_decode_steps(
             self._params, self._cfg, cur, self._kv.caches, dev_len,
-            n_steps=self._sync, chunk_size=self._chunk)
+            n_steps=self._sync, chunk_size=self._chunk,
+            block_tables=self._tables() if self._paged else None)
 
     def _call_spec(self, cur, dev_len, active):
         if self._tp is not None:
+            if self._paged:
+                return self._tp.spec_step(self._params, cur,
+                                          self._kv.caches, dev_len,
+                                          self._hist, self._hist_len,
+                                          active, self._tables())
             return self._tp.spec_step(self._params, cur, self._kv.caches,
                                       dev_len, self._hist, self._hist_len,
                                       active)
         return serving_spec_step(
             self._params, self._cfg, cur, self._kv.caches, dev_len,
             self._hist, self._hist_len, active, spec_k=self._spec_k,
-            chunk_size=self._chunk)
+            chunk_size=self._chunk,
+            block_tables=self._tables() if self._paged else None)
 
     def _call_prefill_slot(self, tokens, prompt_len, slot):
         if self._tp is not None:
@@ -799,6 +901,12 @@ class ServingEngine:
 
     def _call_prefill_chunk(self, tokens, offset, prompt_len, slot):
         if self._tp is not None:
+            if self._paged:
+                return self._tp.prefill_chunk(self._params, tokens, offset,
+                                              prompt_len, self._kv.caches,
+                                              slot, self._hist,
+                                              self._hist_len,
+                                              self._tables())
             return self._tp.prefill_chunk(self._params, tokens, offset,
                                           prompt_len, self._kv.caches,
                                           slot, self._hist, self._hist_len)
@@ -806,7 +914,8 @@ class ServingEngine:
             self._params, self._cfg, tokens, offset, prompt_len,
             self._kv.caches, slot, hist=self._hist,
             hist_len=self._hist_len, with_hist=self._mode == "spec",
-            chunk_size=self._chunk)
+            chunk_size=self._chunk,
+            block_tables=self._tables() if self._paged else None)
 
     def _admit(self):
         free = self._kv.free_slots()
@@ -868,14 +977,45 @@ class ServingEngine:
         incremental chunk dispatch (``_spend_prefill``).  Nothing here
         touches the device, so admission itself never stalls the loop —
         the prompt work is spread over the following scheduler steps under
-        ``prefill_budget``."""
+        ``prefill_budget``.
+
+        Paged engines budget TOKENS, not slots: admission reserves the
+        request's worst-case block count (prompt + max_new + headroom,
+        clamped to max_len, minus any radix-matched prefix) and DEFERS the
+        queue head when the pool can't cover it — FIFO, so a smaller later
+        request never starves the head.  A prefix hit adopts the matched
+        blocks and starts prefill at the suffix offset; when the prefill
+        chunk is wider than the kv block the match is aligned DOWN to a
+        chunk boundary so the suffix decomposes into the exact same
+        compiled chunks a miss would run (byte-identity across hit/miss)."""
         m = self._m
         P = self._pchunk
         while free and self._queue:
-            r = self._queue.popleft()
+            r = self._queue[0]
+            off0, shared, budget, need = 0, [], 0, 0
+            if self._paged:
+                C = self._kv.block
+                p = int(r.prompt_ids.size)
+                need = min(self._lmax,
+                           p + r.max_new_tokens + self._headroom())
+                off0, shared = self._kv.match_prefix(r.prompt_ids)
+                if P > C:
+                    off0 = (off0 // P) * P
+                    shared = shared[:off0 // C]
+                budget = -(-need // C) - len(shared)
+                if not self._kv.can_reserve(budget):
+                    if self._fr is not None:
+                        self._fr.record("admit_defer", step=self._step_idx,
+                                        rid=r.rid, need_blocks=budget)
+                    break
+            self._queue.popleft()
             slot = free.pop(0)
             self._kv.assign(slot, r)
             p = int(r.prompt_ids.size)
+            if self._paged:
+                self._kv.adopt_prefix(slot, shared)
+                self._kv.reserve(slot, budget)
+                self._need_rows[slot] = need
             if r._trace is not None:
                 r._trace.mark("prefilling", slot=slot)
             if self._fr is not None:
@@ -883,17 +1023,38 @@ class ServingEngine:
                                 slot=slot, bucket=r._bucket)
             padded = np.zeros((-(-p // P) * P,), np.int32)
             padded[:p] = r.prompt_ids
+            if off0:
+                # prefix hit: the adopted blocks already hold rows
+                # [0, off0) — prefill starts at the suffix offset
+                if self._fr is not None:
+                    self._fr.record("prefix_hit", step=self._step_idx,
+                                    rid=r.rid, slot=slot, tokens=off0)
+                if m is not None:
+                    m.prefix_reuse_tokens.inc(off0)
+                if self._mode == "spec":
+                    # the skipped chunks would have written hist rows
+                    # [0, off0); rebuild the slot's whole prompt row
+                    # eagerly.  Draft quality only — emission is always
+                    # the verify forward's own greedy picks (lossless),
+                    # so output bytes never depend on hist contents
+                    row = np.zeros((self._lmax,), np.int32)
+                    w = min(padded.size, self._lmax)
+                    row[:w] = padded[:w]
+                    self._hist = self._hist.at[slot].set(jnp.asarray(row))
             # device-ready prompt length, built here (outside the chunk
             # dispatch loop) so _spend_prefill stays sync-free
-            self._pf[slot] = {"req": r, "tok": padded, "p": p, "off": 0,
+            self._pf[slot] = {"req": r, "tok": padded, "p": p, "off": off0,
                               "plen": jnp.asarray(np.array([p], np.int32))}
             if m is not None:
                 m.admitted.inc()
                 m.prefill(r._bucket)
+                if self._paged:
+                    m.prompt_tokens.inc(p)
                 m.queue_wait.observe(time.perf_counter() - r.t_submit)
         if m is not None:
             m.queue_depth.set(len(self._queue))
             m.slots_occupied.set(self._kv.occupied())
+            m.live_tokens.set(self._kv.live_tokens())
 
     def _spend_prefill(self):
         """Dispatch up to ``prefill_budget`` prompt chunks across the
@@ -922,6 +1083,11 @@ class ServingEngine:
                 if self._fr is not None:
                     self._fr.record("prefill_chunk", step=self._step_idx,
                                     rid=st["req"].rid, slot=slot, chunk=k)
+                if self._paged:
+                    # map the chunk's REAL rows before its writes dispatch
+                    # (pad columns past the prompt drop on the sentinel);
+                    # draws down the reservation made at admission
+                    self._kv.ensure_rows(slot, min(st["off"] + P, st["p"]))
                 chunk = st["tok"][st["off"]:st["off"] + P][None, :]
                 with m.span_prefill if m is not None else _NULL_CTX:
                     first, okf, self._kv.caches, hist, hist_len = \
@@ -970,6 +1136,12 @@ class ServingEngine:
             if not bool(ov[0]):
                 self._retire(slot, "poisoned")
                 continue
+            if self._paged:
+                # publish the prefix only now that the finite check passed
+                # (registering at dispatch could publish poisoned blocks a
+                # later radix hit would silently adopt); before _emit,
+                # which may release the slot
+                self._kv.register_prefix(slot, r.prompt_ids)
             emitted += self._emit(slot, [int(fv[0])])
         return emitted
 
@@ -1079,11 +1251,26 @@ class ServingEngine:
         in flight — the series the chunked-prefill A/B reads its
         TPOT-p95-during-admission from."""
         now = time.perf_counter()
-        if (self._m is not None and adm_active
-                and self._t_lastdrain is not None):
-            self._m.tpot_admission.observe(
-                (now - self._t_lastdrain) / max(1.0, per_slot_tokens))
+        if self._m is not None:
+            self._m.live_tokens.set(self._kv.live_tokens())
+            if adm_active and self._t_lastdrain is not None:
+                self._m.tpot_admission.observe(
+                    (now - self._t_lastdrain) / max(1.0, per_slot_tokens))
         self._t_lastdrain = now
+
+    def _ensure_decode_rows(self, live):
+        """Paged: grow every live slot's block chain to cover the rows
+        this decode dispatch may write — the host length mirror plus
+        headroom (the mirror lags the device by at most one inflight
+        dispatch, which headroom doubles to cover), capped by the token
+        budget reserved at admission.  Must run BEFORE the dispatch reads
+        the table operand; a no-op once the chain reaches the cap."""
+        if not self._paged:
+            return
+        for i in live:
+            self._kv.ensure_rows(i, min(int(self._need_rows[i]),
+                                        int(self._kv.lengths[i])
+                                        + self._headroom()))
 
     # ------------------------------------------------- synchronous baseline
     def _step_sync(self, adm_active=False):
@@ -1095,6 +1282,7 @@ class ServingEngine:
         live = [i for i in range(self._B) if self._decodable(i)]
         if not live:
             return emitted
+        self._ensure_decode_rows(live)
         active = np.array([self._decodable(i) for i in range(self._B)])
         dev_len = self._kv.device_lengths(active)
         if self._fr is not None:
@@ -1164,6 +1352,7 @@ class ServingEngine:
         live = [i for i in range(self._B) if self._decodable(i)]
         if not live:
             return
+        self._ensure_decode_rows(live)
         m = self._m
         if self._fr is not None:
             self._fr.record("dispatch", step=self._step_idx,
@@ -1268,6 +1457,10 @@ class ServingEngine:
                 if not bool(ov[0]):
                     self._retire(slot, "poisoned")
                     continue
+                if self._paged:
+                    # post-finite-check, pre-_emit (which may release):
+                    # same registration rule as _flush_firsts
+                    self._kv.register_prefix(slot, r.prompt_ids)
                 self._cur[slot] = int(fv[0])
                 emitted += self._emit(slot, [int(fv[0])])
             for i in rec["live"]:
@@ -1297,6 +1490,10 @@ class ServingEngine:
                 if not bool(ov[0]):
                     self._retire(slot, "poisoned")
                     continue
+                if self._paged:
+                    # post-finite-check, pre-_emit (which may release):
+                    # same registration rule as _flush_firsts
+                    self._kv.register_prefix(slot, r.prompt_ids)
                 self._cur[slot] = int(fv[0])
                 emitted += self._emit(slot, [int(fv[0])])
             accepted = 0

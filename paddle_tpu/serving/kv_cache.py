@@ -24,6 +24,20 @@ programs consume:
   identity at drain time to discard stale pipelined tokens, so the
   manager never recycles state, only the slot index.
 
+``PagedKVCacheManager`` swaps the dense per-slot rows for a global block
+pool (ops.decode_attention.init_kv_pool) indirected through per-slot
+block tables — the paged geometry of ROADMAP item 2:
+
+* blocks are REFCOUNTED: a radix map keyed on token-id chunks lets
+  multiple slots map the same physical prefix blocks (decode only
+  appends PAST the shared prefix, so copy-on-write is unnecessary);
+* refcount-0 blocks that still back a cached prefix stay resident as
+  EVICTABLE until the allocator needs them (LRU-first subtree
+  eviction), so an identical prompt admitted later skips its prefill;
+* the table rows are host int32 mirrors shipped to the device as
+  TRACED operands — growing a slot's chain or remapping it to shared
+  blocks changes values, never shapes: zero retraces.
+
 Everything here is host-side bookkeeping plus ONE eager masking op;
 nothing dispatches a compiled step — that stays the engine's job.
 """
@@ -33,9 +47,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.ops.decode_attention import init_kv_cache, masked_lengths
+from paddle_tpu.ops.decode_attention import (init_kv_cache, init_kv_pool,
+                                             masked_lengths)
 
-__all__ = ["KVCacheManager"]
+__all__ = ["KVCacheManager", "PagedKVCacheManager", "KVPoolExhausted"]
+
+
+class KVPoolExhausted(RuntimeError):
+    """A block allocation could not be satisfied even after evicting
+    every refcount-0 cached block.  The engine treats this as
+    back-pressure (defer the admission, shed on queue overflow) — never
+    a crash mid-stream, because admission reserves a request's worst-case
+    block budget up front."""
 
 
 class KVCacheManager:
@@ -69,6 +92,13 @@ class KVCacheManager:
     def any_live(self):
         return any(r is not None for r in self.reqs)
 
+    def live_tokens(self):
+        """Total context tokens held by live slots (capacity-utilisation
+        numerator: dense strands ``B*Lmax - live_tokens`` cache rows)."""
+        return int(sum(int(self.lengths[i])
+                       for i in range(self.batch_size)
+                       if self.reqs[i] is not None))
+
     def assign(self, slot, request):
         """Bind ``request`` to ``slot`` (admission).  Assigning over a
         live slot raises: the old occupant's cache rows would be silently
@@ -99,3 +129,259 @@ class KVCacheManager:
         parking)."""
         return masked_lengths(jnp.asarray(self.lengths),
                               jnp.asarray(active), self.max_len)
+
+
+class PagedKVCacheManager(KVCacheManager):
+    """Block allocator + radix prefix cache over a paged KV pool.
+
+    Same slot interface as the dense manager (the engine's scheduler is
+    geometry-blind) plus the block machinery:
+
+    * ``caches`` — per-layer ``(k, v)`` POOL pairs ``[N, C, Hkv, D]``
+      where ``N = max_live_tokens // C``.  Concurrency is budgeted in
+      TOKENS, not slots: the engine may run far more slots than
+      ``N*C / Lmax`` dense equivalents as long as live contexts fit.
+    * ``block_tables`` — host int32 ``[B, W]`` (``W = Lmax / C``) mirror
+      of each slot's logical-chunk -> physical-block chain; unmapped
+      entries hold the sentinel ``N`` so device writes there DROP (the
+      paged continuation of the write-drop parking invariant).
+    * refcounts / radix map / LRU — see the module docstring.
+
+    Every block is in exactly one of three states: on the free list,
+    LIVE (refcount > 0), or EVICTABLE (refcount 0 but still registered
+    as a cached prefix, tracked LRU).  ``refcnt[child] <= refcnt[parent]``
+    holds along every registered chain because prefixes are adopted and
+    released whole — which is what makes subtree eviction safe.
+    """
+
+    def __init__(self, n_layers, batch_size, max_len, num_kv_heads,
+                 head_dim, dtype, block, max_live_tokens, sharding=None,
+                 on_event=None):
+        self.batch_size = int(batch_size)
+        self.max_len = int(max_len)
+        self.block = int(block)
+        if self.block <= 0 or self.max_len % self.block:
+            raise ValueError(
+                f"kv block ({block}) must divide max_len ({max_len}): the "
+                "paged read is the chunked loop and a partial tail block "
+                "would break the clamped-tail masking")
+        self.width = self.max_len // self.block
+        self.num_blocks = int(max_live_tokens) // self.block
+        if self.num_blocks < self.width:
+            raise ValueError(
+                f"max_live_tokens ({max_live_tokens}) must cover at least "
+                f"one full-length request ({max_len} tokens): a smaller "
+                "pool could never admit a valid submit() and would defer "
+                "it forever")
+        caches = [init_kv_pool(self.num_blocks, self.block, num_kv_heads,
+                               head_dim, dtype) for _ in range(n_layers)]
+        if sharding is not None:
+            caches = [(jax.device_put(k, sharding),
+                       jax.device_put(v, sharding)) for k, v in caches]
+        self.caches = caches
+        self.sharding = sharding
+        self.lengths = np.zeros((self.batch_size,), np.int32)
+        self.reqs = [None] * self.batch_size
+        # ---- block state (host-side; sentinel num_blocks = unmapped)
+        self.block_tables = np.full((self.batch_size, self.width),
+                                    self.num_blocks, np.int32)
+        self.refcnt = np.zeros((self.num_blocks,), np.int32)
+        self._free = list(range(self.num_blocks - 1, -1, -1))  # pop() -> 0
+        self._mapped = [0] * self.batch_size       # chunks mapped per slot
+        self._resv_left = [0] * self.batch_size    # reserved, unallocated
+        # ---- radix prefix map (root parent id = -1)
+        self._node = {}     # (parent_block, chunk tokens) -> block id
+        self._key_of = {}   # registered block id -> its key
+        self._kids = {}     # parent block id -> set(registered child ids)
+        self._lru = {}      # evictable block id -> release tick
+        self._tick = 0
+        self._on_event = on_event
+
+    def _emit(self, kind, **info):
+        if self._on_event is not None:
+            self._on_event(kind, **info)
+
+    def _check_block(self, b):
+        if not 0 <= b < self.num_blocks:
+            raise ValueError(
+                f"block index {b} out of range [0, {self.num_blocks})")
+
+    # ---------------------------------------------------------- accounting
+    def free_count(self):
+        return len(self._free)
+
+    def evictable_count(self):
+        return len(self._lru)
+
+    def blocks_used(self):
+        """Blocks that are live OR holding an evictable cached prefix."""
+        return self.num_blocks - len(self._free)
+
+    def outstanding(self):
+        """Blocks promised to admitted slots but not yet allocated."""
+        return sum(self._resv_left)
+
+    def can_reserve(self, n_blocks):
+        """Whether ``n_blocks`` NEW allocations can be promised without
+        starving any slot's existing reservation.  Evictable blocks count
+        as available — the allocator reclaims them on demand."""
+        return n_blocks <= (len(self._free) + len(self._lru)
+                            - self.outstanding())
+
+    def reserve(self, slot, n_blocks):
+        """Record ``slot``'s remaining worst-case block budget (admission
+        time, after shared prefix chunks are subtracted).  ``ensure_rows``
+        draws it down; ``release`` clears it."""
+        self._resv_left[slot] = int(n_blocks)
+
+    # ---------------------------------------------------------- allocator
+    def _evict_subtree(self, root):
+        """Reclaim evictable ``root`` and every registered descendant
+        (all refcount-0 by the chain invariant) back to the free list."""
+        parent = self._key_of[root][0]
+        self._kids.get(parent, set()).discard(root)
+        stack, n = [root], 0
+        while stack:
+            b = stack.pop()
+            if self.refcnt[b] != 0:
+                raise RuntimeError(
+                    f"prefix chain invariant broken: evicting block {b} "
+                    f"with refcount {int(self.refcnt[b])}")
+            stack.extend(self._kids.pop(b, ()))
+            self._node.pop(self._key_of.pop(b), None)
+            self._lru.pop(b, None)
+            self._free.append(b)
+            n += 1
+            self._emit("block_free", block=int(b), evicted=True)
+        return n
+
+    def alloc_block(self):
+        """One free block (refcount 1), evicting the least-recently-
+        released cached prefix subtree if the free list is dry.  Raises
+        ``KVPoolExhausted`` when every block is live."""
+        if not self._free:
+            if not self._lru:
+                raise KVPoolExhausted(
+                    f"kv pool exhausted: all {self.num_blocks} blocks of "
+                    f"{self.block} tokens are live")
+            self._evict_subtree(min(self._lru, key=self._lru.get))
+        b = self._free.pop()
+        self.refcnt[b] = 1
+        self._emit("block_alloc", block=int(b))
+        return b
+
+    def free_block(self, b):
+        """Drop one reference.  At refcount 0 a registered block parks as
+        EVICTABLE (its cached prefix stays matchable); an unregistered one
+        returns to the free list.  Underflow and OOB raise — a silent
+        double-free would let two slots claim the same physical block."""
+        b = int(b)
+        self._check_block(b)
+        if self.refcnt[b] <= 0:
+            raise ValueError(
+                f"refcount underflow: block {b} is already free "
+                "(double-free corrupts the pool)")
+        self.refcnt[b] -= 1
+        if self.refcnt[b] == 0:
+            if b in self._key_of:
+                self._tick += 1
+                self._lru[b] = self._tick
+            else:
+                self._free.append(b)
+            self._emit("block_free", block=b, evicted=False)
+
+    def ensure_rows(self, slot, upto):
+        """Grow ``slot``'s chain to cover logical rows ``[0, upto)``
+        (called before every dispatch that may write those rows).  Rows
+        past ``max_len`` are silently capped — the device drops those
+        writes anyway (parking invariant)."""
+        need = min(-(-int(upto) // self.block), self.width)
+        while self._mapped[slot] < need:
+            b = self.alloc_block()
+            self.block_tables[slot, self._mapped[slot]] = b
+            self._mapped[slot] += 1
+            if self._resv_left[slot] > 0:
+                self._resv_left[slot] -= 1
+        return self._mapped[slot]
+
+    # ------------------------------------------------------- prefix reuse
+    def match_prefix(self, tokens):
+        """Longest cached prefix of ``tokens`` -> (matched_tokens, blocks).
+
+        Only FULL blocks are shareable, and the match is capped at
+        ``((p-1)//C)*C`` so at least one suffix token always prefills —
+        the suffix forward is what produces the first-token logits."""
+        cap = max(0, (len(tokens) - 1) // self.block)
+        parent, out = -1, []
+        for k in range(cap):
+            chunk = tuple(int(t) for t in
+                          tokens[k * self.block:(k + 1) * self.block])
+            b = self._node.get((parent, chunk))
+            if b is None:
+                break
+            out.append(b)
+            parent = b
+        return len(out) * self.block, out
+
+    def adopt_prefix(self, slot, blocks):
+        """Map shared prefix ``blocks`` at the head of fresh ``slot``'s
+        chain (admission after a radix hit): refcounts bump and evictable
+        blocks return to LIVE.  Decode never writes below the adopted
+        span, so no copy-on-write is needed."""
+        if self._mapped[slot]:
+            raise ValueError(
+                f"adopt_prefix: slot {slot} already maps "
+                f"{self._mapped[slot]} blocks")
+        for w, b in enumerate(blocks):
+            b = int(b)
+            self._check_block(b)
+            self.refcnt[b] += 1
+            if self.refcnt[b] == 1:
+                self._lru.pop(b, None)
+            self.block_tables[slot, w] = b
+        self._mapped[slot] = len(blocks)
+
+    def register_prefix(self, slot, tokens):
+        """Publish ``slot``'s full-block prefix chain into the radix map.
+
+        Called at FIRST-TOKEN EMISSION (after the prefill's finite check
+        passed), never at dispatch — registering earlier could publish
+        NaN-poisoned blocks that a later hit would silently adopt.  First
+        writer wins per chunk key; on a collision (two identical prompts
+        prefilled concurrently) the rest of our chain stays private —
+        mixing blocks across chains would break the refcount ordering
+        that makes subtree eviction safe."""
+        parent = -1
+        n_full = min(len(tokens) // self.block, self._mapped[slot])
+        for k in range(n_full):
+            chunk = tuple(int(t) for t in
+                          tokens[k * self.block:(k + 1) * self.block])
+            key = (parent, chunk)
+            b = int(self.block_tables[slot, k])
+            cur = self._node.get(key)
+            if cur is None:
+                self._node[key] = b
+                self._key_of[b] = key
+                self._kids.setdefault(parent, set()).add(b)
+                parent = b
+            elif cur == b:          # adopted shared block: walk through
+                parent = b
+            else:                   # lost the race: keep the rest private
+                break
+
+    # -------------------------------------------------------------- slots
+    def release(self, slot):
+        """Retire ``slot``: unreference its whole chain (shared prefix
+        blocks may stay EVICTABLE for the next identical prompt), reset
+        the table row to the sentinel, clear the reservation."""
+        super().release(slot)
+        for w in range(self._mapped[slot]):
+            self.free_block(int(self.block_tables[slot, w]))
+        self.block_tables[slot, :] = self.num_blocks
+        self._mapped[slot] = 0
+        self._resv_left[slot] = 0
+
+    # -------------------------------------------------------------- device
+    def device_tables(self):
+        """The traced ``[B, W]`` block-table operand for one dispatch."""
+        return jnp.asarray(self.block_tables)

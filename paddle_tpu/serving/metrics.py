@@ -160,6 +160,31 @@ class EngineMetrics:
         self.inflight = reg.gauge(
             "serving_inflight_steps",
             "device steps dispatched but not yet drained", L).labels(**lbl)
+        # paged-KV series (PagedKVCacheManager): block-pool occupancy,
+        # the token-budget admission numerator, and the prefix-reuse
+        # counters the shared-prefix bench derives its hit rate from
+        # (reuse / prompt tokens).  Zero-valued on dense engines —
+        # pre-registered like every other family
+        self.kv_blocks_used = reg.gauge(
+            "serving_kv_blocks_used",
+            "KV pool blocks live or holding an evictable cached prefix",
+            L).labels(**lbl)
+        self.kv_blocks_free = reg.gauge(
+            "serving_kv_blocks_free",
+            "KV pool blocks on the free list", L).labels(**lbl)
+        self.live_tokens = reg.gauge(
+            "serving_live_tokens",
+            "context tokens held by live slots (token-budget admission "
+            "numerator; dense strands batch*max_len minus this)",
+            L).labels(**lbl)
+        self.prefix_reuse_tokens = reg.counter(
+            "serving_prefix_reuse_tokens_total",
+            "prompt tokens satisfied from cached prefix blocks instead "
+            "of being prefilled", L).labels(**lbl)
+        self.prompt_tokens = reg.counter(
+            "serving_prompt_tokens_total",
+            "prompt tokens admitted on the paged path (prefix hit-rate "
+            "denominator)", L).labels(**lbl)
         self.span_step = span("serving.step", registry=reg,
                               mesh=mesh_label)
         self.span_prefill = span("serving.prefill", registry=reg,

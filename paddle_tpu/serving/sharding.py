@@ -170,10 +170,17 @@ class TPPrograms:
     Each wrapper dispatches through the SAME ``_mon`` program name as its
     single-device twin, so compile-cache hit/miss telemetry and
     ``assert_no_retrace`` see one program family per entry point.
+
+    ``paged=True`` builds the block-table variants: decode/spec/pchunk
+    grow one trailing replicated ``tables`` operand and the cache
+    shardings apply to the ``[num_blocks, C, Hkv, D]`` pools (same
+    ``kv_cache_pspec`` — the head axis is index 2 in both geometries).
+    ``prefill_slot`` stays dense-only; the paged engine always runs
+    chunked prefill.
     """
 
     def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
-                 sync_every, spec_k, with_hist, chunk_size):
+                 sync_every, spec_k, with_hist, chunk_size, paged=False):
         repl = NamedSharding(mesh, PS())
         pshard = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), param_specs,
@@ -186,38 +193,83 @@ class TPPrograms:
         self.n_devices = int(mesh.shape[axis])
         self.cache_sharding = cshard[0][0] if n_layers else repl
 
-        def decode(params, cur, caches, dev_lengths):
-            return _serving_decode_steps_impl(
-                params, cfg, cur, caches, dev_lengths, n_steps=sync_every,
-                chunk_size=chunk_size)
-        self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
-            decode,
-            in_shardings=(pshard, repl, cshard, repl),
-            out_shardings=(repl, repl, cshard),
-            donate_argnums=(2,)))
+        if paged:
+            # paged programs take one extra trailing operand: the [B, W]
+            # block tables, replicated like every other host-facing array
+            # (the pool itself stays head-sharded — head axis is index 2
+            # in both the dense [B, Lmax, Hkv, D] and pool [N, C, Hkv, D]
+            # geometries, so kv_cache_pspec applies unchanged)
+            def decode(params, cur, caches, dev_lengths, tables):
+                return _serving_decode_steps_impl(
+                    params, cfg, cur, caches, dev_lengths,
+                    n_steps=sync_every, chunk_size=chunk_size,
+                    block_tables=tables)
+            self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
+                decode,
+                in_shardings=(pshard, repl, cshard, repl, repl),
+                out_shardings=(repl, repl, cshard),
+                donate_argnums=(2,)))
 
-        def spec(params, cur, caches, dev_lengths, hist, hist_len, active):
-            return _serving_spec_step_impl(
-                params, cfg, cur, caches, dev_lengths, hist, hist_len,
-                active, spec_k=spec_k, chunk_size=chunk_size)
-        self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
-            spec,
-            in_shardings=(pshard, repl, cshard, repl, repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
-                           repl)))
+            def spec(params, cur, caches, dev_lengths, hist, hist_len,
+                     active, tables):
+                return _serving_spec_step_impl(
+                    params, cfg, cur, caches, dev_lengths, hist, hist_len,
+                    active, spec_k=spec_k, chunk_size=chunk_size,
+                    block_tables=tables)
+            self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
+                spec,
+                in_shardings=(pshard, repl, cshard, repl, repl, repl,
+                              repl, repl),
+                out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
+                               repl)))
 
-        def pchunk(params, tokens, offset, prompt_len, caches, slot,
-                   hist, hist_len):
-            return _serving_prefill_chunk_impl(
-                params, cfg, tokens, offset, prompt_len, caches, slot,
-                hist=hist, hist_len=hist_len, with_hist=with_hist,
-                chunk_size=chunk_size)
-        self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
-            pchunk,
-            in_shardings=(pshard, repl, repl, repl, cshard, repl,
-                          hshard, repl),
-            out_shardings=(repl, repl, cshard, hshard, repl),
-            donate_argnums=(4, 6) if with_hist else (4,)))
+            def pchunk(params, tokens, offset, prompt_len, caches, slot,
+                       hist, hist_len, tables):
+                return _serving_prefill_chunk_impl(
+                    params, cfg, tokens, offset, prompt_len, caches, slot,
+                    hist=hist, hist_len=hist_len, with_hist=with_hist,
+                    chunk_size=chunk_size, block_tables=tables)
+            self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
+                pchunk,
+                in_shardings=(pshard, repl, repl, repl, cshard, repl,
+                              hshard, repl, repl),
+                out_shardings=(repl, repl, cshard, hshard, repl),
+                donate_argnums=(4, 6) if with_hist else (4,)))
+        else:
+            def decode(params, cur, caches, dev_lengths):
+                return _serving_decode_steps_impl(
+                    params, cfg, cur, caches, dev_lengths,
+                    n_steps=sync_every, chunk_size=chunk_size)
+            self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
+                decode,
+                in_shardings=(pshard, repl, cshard, repl),
+                out_shardings=(repl, repl, cshard),
+                donate_argnums=(2,)))
+
+            def spec(params, cur, caches, dev_lengths, hist, hist_len,
+                     active):
+                return _serving_spec_step_impl(
+                    params, cfg, cur, caches, dev_lengths, hist, hist_len,
+                    active, spec_k=spec_k, chunk_size=chunk_size)
+            self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
+                spec,
+                in_shardings=(pshard, repl, cshard, repl, repl, repl,
+                              repl),
+                out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
+                               repl)))
+
+            def pchunk(params, tokens, offset, prompt_len, caches, slot,
+                       hist, hist_len):
+                return _serving_prefill_chunk_impl(
+                    params, cfg, tokens, offset, prompt_len, caches, slot,
+                    hist=hist, hist_len=hist_len, with_hist=with_hist,
+                    chunk_size=chunk_size)
+            self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
+                pchunk,
+                in_shardings=(pshard, repl, repl, repl, cshard, repl,
+                              hshard, repl),
+                out_shardings=(repl, repl, cshard, hshard, repl),
+                donate_argnums=(4, 6) if with_hist else (4,)))
 
         def pslot(params, tokens, prompt_len, caches, slot, hist, hist_len):
             return _serving_prefill_slot_impl(
@@ -238,15 +290,17 @@ _PROGRAMS = {}
 
 
 def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
-                        sync_every, spec_k, with_hist, chunk_size):
+                        sync_every, spec_k, with_hist, chunk_size,
+                        paged=False):
     """Cached ``TPPrograms`` factory (see class docstring)."""
     leaves, treedef = jax.tree_util.tree_flatten(
         param_specs, is_leaf=lambda x: isinstance(x, PS))
     key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
-           sync_every, spec_k, with_hist, chunk_size)
+           sync_every, spec_k, with_hist, chunk_size, paged)
     progs = _PROGRAMS.get(key)
     if progs is None:
         progs = _PROGRAMS[key] = TPPrograms(
             mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
-            spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size)
+            spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size,
+            paged=paged)
     return progs

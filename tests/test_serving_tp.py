@@ -150,3 +150,22 @@ class TestTPByteIdentity:
         # program cache — warm steps must not trace anything
         with assert_no_retrace():
             _run(model, prompts, [4, 6], **kw)
+
+    @pytest.mark.parametrize("mode", ["greedy", "spec"])
+    def test_paged_matches_single_device(self, mode):
+        # paged + TP composes: the block pool shards over the head axis
+        # (index 2 in both geometries), the table replicates, and the
+        # shared-prefix workload exercises radix hits under the mesh
+        mesh = _mesh()
+        rng = np.random.default_rng(5)
+        shared = rng.integers(1, 200, size=24).tolist()
+        prompts = [shared + rng.integers(1, 200, size=int(k)).tolist()
+                   for k in (5, 9, 3, 12, 7)]
+        new_lens = [8, 6, 9, 5, 7]
+        kw = dict(batch_size=3, max_len=128, mode=mode, decode_chunk=16,
+                  prefill_chunk=16, kv_block=16, max_live_tokens=3 * 128,
+                  instrument=False, recorder=False)
+        a = _run(_tp_model(), prompts, new_lens, mesh=mesh, **kw)
+        b = _run(_tp_model(), prompts, new_lens, **kw)
+        for i in a:
+            np.testing.assert_array_equal(a[i].output_ids, b[i].output_ids)

@@ -453,6 +453,56 @@ class TestRuleFixtures:
                     m.labels(rid=r.rid).inc()
         """) == []
 
+    # PTL010 — host-list-step-operand ----------------------------------
+    def test_host_list_tp_bare_comprehension(self):
+        # a per-request block-index list: its length tracks the request's
+        # mapped chain, so the operand shape churns every admission
+        assert _rules("""
+            def serve(engine, reqs):
+                for r in reqs:
+                    engine.decode_step(r.x, [b for b in r.blocks])
+        """) == ["PTL010"]
+
+    def test_host_list_tp_jnp_wrapped(self):
+        # wrapping at the call site doesn't help — the array inherits
+        # the list's ragged length
+        assert _rules("""
+            import jax.numpy as jnp
+            def serve(engine, reqs):
+                for r in reqs:
+                    engine.decode_step(
+                        r.x, jnp.asarray([b for b in r.blocks]))
+        """) == ["PTL010"]
+
+    def test_host_list_tp_np_wrapped_also_syncs(self):
+        # np.asarray([...]) fed to the step is both a host sync (PTL004)
+        # and a ragged operand (PTL010) — both fire, ordered by column
+        # (the step call encloses the asarray call)
+        assert _rules("""
+            import numpy as np
+            def serve(engine, reqs):
+                for r in reqs:
+                    engine.decode_step(r.x, np.asarray([0, 1]))
+        """) == ["PTL010", "PTL004"]
+
+    def test_host_list_tn_fixed_shape_table(self):
+        # the sanctioned paged-KV idiom: the [B, W] sentinel-padded
+        # ndarray mirror shipped whole — no list child, no finding (and
+        # jnp.asarray is not a host sync, so PTL004 stays quiet too)
+        assert _rules("""
+            import jax.numpy as jnp
+            def serve(engine, kv, reqs):
+                for r in reqs:
+                    engine.decode_step(r.x, jnp.asarray(kv.block_tables))
+        """) == []
+
+    def test_host_list_tn_outside_step_loop(self):
+        # a one-off warmup call with a literal operand is not the hazard
+        assert _rules("""
+            def warmup(engine, x):
+                engine.decode_step(x, [0, 1])
+        """) == []
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
