@@ -236,6 +236,31 @@ def bench_serving(n_requests=64, batch=8):
     the observability path end-to-end (bucket-interpolated percentiles,
     accurate to within one log2 bucket).
 
+    Round 15 adds the int8-KV A/B (``kv_dtype="int8"``, quantize-on-append
+    / dequant-in-loop): the standard workload on the quantized cache vs
+    the same continuous-greedy baseline — ``serving_q8_speedup`` (ratio-
+    only off-chip: the CPU host pays the dequant multiplies without the
+    HBM-bandwidth win they buy on chip), two drift columns — the lossy
+    knob's quality cost — and the KV-only analytic traffic pair.  Drift
+    is reported two ways because greedy decoding cascades: once one
+    near-tied argmax flips, the streams explore different continuations
+    and every later position counts as a mismatch, so
+    ``serving_q8_greedy_drift`` (aligned-position mismatch fraction) is
+    an upper bound inflated by divergence, while
+    ``serving_q8_flip_per_tok`` (first divergences over tokens compared
+    up to each stream's first divergence) is the per-token probability
+    that quantization flips a pick — the number the quality budget is
+    declared on.  On the random-init bench-small model argmax margins
+    are artificially thin, so both read high relative to a trained
+    model; the tests' parity matrix (tests/test_serving_q8.py, trained-
+    margin-free but wide-margin f32 tiny model) observes drift 0.0.
+    The KV analytic pair:
+    bytes-per-context-token pair the acceptance gate compares —
+    ``serving_hbm_gb_per_tok_q8`` (int8 data + f16 per-(position, head)
+    scale: D+2 bytes per head-row) vs ``serving_hbm_gb_per_tok_kv_bf16``
+    (2D bytes at the production serving dtype), a fixed geometric ratio
+    of (D+2)/(2D) ~ 0.53 at D=32.
+
     Round 9 adds two engine A/Bs on the same compiled-program family:
     ``serving_chunked_speedup`` (length-adaptive chunked cache reads,
     decode_chunk=256, vs the full [B, Lmax] masked read) and
@@ -523,6 +548,41 @@ def bench_serving(n_requests=64, batch=8):
     def _rel(series):
         return int(reg_fb.get(series).labels(policy="continuous").value)
 
+    # A/B 7 (round 15) — int8 KV quantization: same workload, quantized
+    # cache.  Token streams are captured on both sides so the drift
+    # column measures the knob's quality cost, not just its speed.
+    def run_tok(**ekw):
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=batch, max_len=lmax,
+                            mode="greedy", sync_every=4, registry=reg,
+                            **ekw)
+        rs = [eng.submit(Request(p, int(o)))
+              for p, o in zip(prompts, olens)]
+        t0 = time.perf_counter()
+        eng.run()
+        return time.perf_counter() - t0, [list(r.output_ids) for r in rs]
+
+    _, ref_toks = run_tok()              # warm programs: reference tokens
+    run_tok(kv_dtype="int8")             # warm the q8 program family
+    dt_q8, q8_toks = run_tok(kv_dtype="int8")
+    q8_drift_n = sum(sum(x != y for x, y in zip(a, b))
+                     for a, b in zip(ref_toks, q8_toks))
+    # per-token flip (hazard) rate: count each stream's FIRST divergence
+    # over the tokens compared up to it — immune to cascade inflation
+    q8_div = q8_cmp = 0
+    for a, b in zip(ref_toks, q8_toks):
+        k = next((i for i, (x, y) in enumerate(zip(a, b)) if x != y), None)
+        if k is None:
+            q8_cmp += len(a)
+        else:
+            q8_div += 1
+            q8_cmp += k + 1
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    kv_tok_bf16 = cfg.num_hidden_layers * 2 * cfg.num_key_value_heads \
+        * hd * 2
+    kv_tok_q8 = cfg.num_hidden_layers * 2 * cfg.num_key_value_heads \
+        * (hd + 2)
+
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -586,6 +646,16 @@ def bench_serving(n_requests=64, batch=8):
             "serving_requests_poisoned_total"),
         "serving_degraded_retries": _rel(
             "serving_dispatch_retries_total"),
+        # int8-KV A/B (round 15): the lossy knob's cost (drift) and the
+        # analytic KV-traffic win it buys; the bf16 column is the
+        # production serving dtype regardless of the bench model's own
+        "serving_q8_tok_per_sec": round(total_new / dt_q8, 1),
+        "serving_q8_speedup": round(dt_c / dt_q8, 2),
+        "serving_q8_greedy_drift": round(q8_drift_n / total_new, 4),
+        "serving_q8_flip_per_tok": round(q8_div / max(q8_cmp, 1), 4),
+        "serving_hbm_gb_per_tok_kv_bf16": kv_tok_bf16 / 1e9,
+        "serving_hbm_gb_per_tok_q8": kv_tok_q8 / 1e9,
+        "serving_q8_kv_bytes_ratio": round(kv_tok_q8 / kv_tok_bf16, 4),
         # flight-recorder overhead (round 13): recorder-on (the default,
         # dt_c) vs recorder-off on the same warm programs
         "serving_recorder_overhead_pct": round(

@@ -18,6 +18,11 @@ chunk-size x budget sweep over a long-prompt serving run (end-to-end
 time + TPOT-p95-during-admission per variant, monolithic baseline
 included) — the source of ServingEngine's ``prefill_chunk=256`` /
 ``prefill_budget=2`` defaults.
+
+Round 15 adds ``python -u bench_sweep.py kv_dtype``: the KV-storage
+dtype axis (bf16 vs the int8 cache with f16 per-(position, head)
+scales) over the same low/high-occupancy regimes — per-step time plus
+the analytic KV bytes per context token each storage mode moves.
 """
 from __future__ import annotations
 
@@ -141,6 +146,70 @@ def sweep_decode_chunk(iters=20, n_steps=8):
     return rows
 
 
+KV_DTYPES = ["bfloat16", "int8"]
+
+
+def sweep_kv_dtype(iters=20, n_steps=8):
+    """KV-storage-dtype sweep for the quantized decode path: per-step
+    time of the compiled serving decode step at each ``kv_dtype``
+    (bf16 baseline vs the int8 cache with per-(position, head) f16
+    scales), across the same low/high-occupancy regimes as the
+    decode-chunk sweep.  The int8 rows move (D+2)/(2D) of the bf16 KV
+    bytes per context token — on the HBM-bound chip that headroom is the
+    win; the in-loop dequant multiplies are the cost being measured."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama_decode import (
+        _decode_params_of, serving_decode_steps)
+    from paddle_tpu.ops.decode_attention import init_kv_cache
+
+    lmax, batch, chunk = 2048, 8, 256
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    params, key = _decode_params_of(model, lmax)
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    rng = np.random.default_rng(0)
+    cur = jnp.asarray(rng.integers(0, cfg.vocab_size, batch), jnp.int32)
+    regimes = {
+        "low_occ": jnp.asarray(rng.integers(96, 161, batch), jnp.int32),
+        "high_occ": jnp.asarray(rng.integers(1664, 1985, batch), jnp.int32),
+    }
+    rows = []
+    for regime, lengths in regimes.items():
+        for kvd in KV_DTYPES:
+            caches = [init_kv_cache(batch, lmax, nkv, hd, kvd)
+                      for _ in range(cfg.num_hidden_layers)]
+            kv_dtype = kvd if kvd == "int8" else None
+            toks, _, caches = serving_decode_steps(
+                params, key, cur, caches, lengths,
+                n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype)
+            np.asarray(toks)  # compile + settle
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                toks, _, caches = serving_decode_steps(
+                    params, key, cur, caches, lengths,
+                    n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype)
+            np.asarray(toks)
+            dt = (time.perf_counter() - t0) / (iters * n_steps)
+            per_tok = 2 if kvd == "bfloat16" else 1  # data bytes/elt
+            kv_b = cfg.num_hidden_layers * 2 * nkv * (
+                hd * per_tok + (2 if kvd == "int8" else 0))
+            rows.append({"variant": f"kv_dtype_{regime}_{kvd}",
+                         "step_ms": round(dt * 1e3, 3),
+                         "tok_per_sec": round(batch / dt, 1),
+                         "kv_bytes_per_ctx_tok": kv_b})
+            del caches
+            gc.collect()
+    return rows
+
+
 PREFILL_CHUNKS = [64, 128, 256, 512]
 PREFILL_BUDGETS = [1, 2, 4]
 
@@ -214,6 +283,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "prefill_chunk":
         for rec in sweep_prefill_chunk():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "kv_dtype":
+        for rec in sweep_kv_dtype():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

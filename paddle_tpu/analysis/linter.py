@@ -46,6 +46,11 @@ _CONCRETE_BUILTINS = {"float", "int", "bool", "complex"}
 _CONCRETE_NP_LAST = {"asarray", "array", "float32", "float64", "int32",
                      "int64", "bool_"}
 _CONCRETE_METHODS = {"item", "tolist"}
+# 64-bit scalar constructors whose result is strongly typed — as a binop
+# operand inside a jit body they outrank low-precision arrays on the
+# promotion lattice (PTL011); resolved through import aliases like every
+# other numpy check here
+_PROMOTING_SCALARS = {"numpy.float64", "numpy.double", "numpy.longdouble"}
 # impure calls inside jit bodies (PTL005)
 _IMPURE_TIME = {"time.time", "time.perf_counter", "time.monotonic",
                 "time.time_ns", "time.process_time", "time.clock"}
@@ -557,6 +562,50 @@ class _Checker:
                 self.emit("PTL005", node,
                           "attribute mutation on `self` inside a jitted "
                           "body runs once at trace time, not per step")
+                return
+
+    # -- binary ops inside jit bodies (PTL011) ---------------------------
+    def _visit_BinOp(self, node):
+        if self.jit_stack:
+            self._jit_binop(node)
+        self.generic(node)
+
+    def _promoting_scalar(self, node):
+        """The 64-bit-scalar operand of a jit-body binop, or None.
+
+        Two shapes qualify: an ``np.float64(...)`` / ``np.double(...)``
+        constructor call (resolved through import aliases), and a python
+        float literal that has been *concretized* through ``float(...)``
+        — a bare literal stays weakly typed under JAX promotion and is
+        the sanctioned fix, so it is deliberately NOT flagged."""
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, (ast.UAdd, ast.USub)):
+            return self._promoting_scalar(node.operand)
+        if isinstance(node, ast.Call):
+            f = self.resolve(node.func)
+            if f in _PROMOTING_SCALARS:
+                return "np." + f.split(".")[-1] + "(...)"
+            if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and type(node.args[0].value) is float:
+                return f"float({node.args[0].value!r})"
+        return None
+
+    def _jit_binop(self, node):
+        for scalar, other in ((node.left, node.right),
+                              (node.right, node.left)):
+            what = self._promoting_scalar(scalar)
+            if what is None:
+                continue
+            tr = self._traced_in(other)
+            if tr:
+                self.emit("PTL011", node,
+                          f"`{what}` combined with traced argument "
+                          f"`{sorted(tr)[0]}` inside a jitted body — a "
+                          "concrete 64-bit scalar outranks the operand on "
+                          "the promotion lattice, silently upcasting the "
+                          "low-precision hot loop")
                 return
 
     # -- except handlers (PTL007) ----------------------------------------

@@ -141,6 +141,22 @@ _RULE_LIST = [
         "entries, updated in place host-side and shipped whole "
         "(kv.device_tables()-style), never rebuilt from a python list",
     ),
+    Rule(
+        "PTL011", "implicit-dtype-promotion-in-compiled-step", WARNING,
+        "a concretized 64-bit scalar — np.float64(...)/np.double(...), or "
+        "a python float literal pinned through float(...) — combined with "
+        "a traced operand inside a jit body.  Unlike a bare literal "
+        "(which JAX keeps weakly typed so the array operand's precision "
+        "wins), a concrete 64-bit scalar carries its dtype into the "
+        "promotion lattice, so a bf16/int8 hot-loop operand is silently "
+        "upcast (f32 everywhere, f64 under jax_enable_x64) and e.g. "
+        "quantized-KV arithmetic stops matching the storage dtype the "
+        "kernel was sized for",
+        "build the constant with the operand's own dtype "
+        "(jnp.asarray(c, x.dtype) / x.dtype.type(c)) or use a bare "
+        "python literal, which stays weakly typed so the traced "
+        "operand's precision wins",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

@@ -421,10 +421,17 @@ _spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
 # carried entirely in the ``lengths`` operand (ops.decode_attention.
 # masked_lengths): a dead slot's offset is lmax, so its cache writes drop and
 # its state survives the step untouched.
+#
+# ``kv_dtype`` (static on all four entry points) names the cache storage
+# dtype — "int8" selects the quantized (data, scale) cache.  Only the
+# prefill-slot program consumes it (mini-cache allocation); on the others
+# the cache PYTREE STRUCTURE already carries it, and the static arg exists
+# so the program identity states its quantization mode explicitly — one
+# extra program variant per engine, zero retraces past warmup.
 
 def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
                                hist=None, hist_len=None, with_hist=False,
-                               chunk_size=None):
+                               chunk_size=None, kv_dtype=None):
     """Admit ONE request: prefill its prompt, insert into the batch cache.
 
     ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
@@ -442,11 +449,15 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
     quarantine input — an all-finite reduction adds no output tokens and
     no program identity, so the clean path stays byte-identical and
     retrace-free) and the updated caches; with ``with_hist`` the slot's
-    prompt-lookup history row is rebuilt in the same program."""
+    prompt-lookup history row is rebuilt in the same program.
+
+    ``kv_dtype`` (static) selects the cache storage dtype — "int8" makes
+    the mini caches quantized ``(data, scale)`` pairs matching the batch
+    cache's structure, so insertion moves both leaves."""
     _mon.mark_trace("serving_prefill_slot")
     t = tokens.shape[1]
     nh, nkv, hd, eps = cfg
-    dtype = params["embed"].dtype
+    dtype = kv_dtype if kv_dtype is not None else params["embed"].dtype
     mini = [init_kv_cache(1, t, nkv, hd, dtype)
             for _ in params["layers"]]
     logits, mini, _ = _forward(
@@ -457,13 +468,18 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
     ok = jnp.all(jnp.isfinite(logits), axis=-1)                 # [1]
     slot = slot.astype(jnp.int32)
     zero = jnp.int32(0)
-    new_caches = []
-    for (kc, vc), (mk, mv) in zip(caches, mini):
-        kc = jax.lax.dynamic_update_slice(kc, mk.astype(kc.dtype),
-                                          (slot, zero, zero, zero))
-        vc = jax.lax.dynamic_update_slice(vc, mv.astype(vc.dtype),
-                                          (slot, zero, zero, zero))
-        new_caches.append((kc, vc))
+
+    def insert(c, m):
+        if isinstance(c, tuple):
+            return (jax.lax.dynamic_update_slice(
+                        c[0], m[0], (slot, zero, zero, zero)),
+                    jax.lax.dynamic_update_slice(
+                        c[1], m[1], (slot, zero, zero)))
+        return jax.lax.dynamic_update_slice(c, m.astype(c.dtype),
+                                            (slot, zero, zero, zero))
+
+    new_caches = [(insert(kc, mk), insert(vc, mv))
+                  for (kc, vc), (mk, mv) in zip(caches, mini)]
     if with_hist:
         lmax = hist.shape[1]
         row = jax.lax.dynamic_update_slice(
@@ -481,7 +497,7 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
 # shardings — one body, one ``mark_trace`` name, two placement strategies.
 serving_prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
     _serving_prefill_slot_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype"),
     donate_argnames=("caches", "hist")))
 
 
@@ -511,7 +527,7 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
 def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
                                 caches, slot, hist=None, hist_len=None,
                                 with_hist=False, chunk_size=None,
-                                block_tables=None):
+                                block_tables=None, kv_dtype=None):
     """Process the next ``[1, P]`` chunk of an admitted prompt against the
     slot's rows of the batch cache — ONE compiled program for every prompt
     length (``P`` is the only shape; ``offset``, ``prompt_len`` and
@@ -585,13 +601,13 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
 
 serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
     _serving_prefill_chunk_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype"),
     donate_argnames=("caches", "hist")))
 
 
 def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
                                n_steps=1, chunk_size=None,
-                               block_tables=None):
+                               block_tables=None, kv_dtype=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
@@ -624,13 +640,13 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
 
 serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
     _serving_decode_steps_impl,
-    static_argnames=("cfg", "n_steps", "chunk_size"),
+    static_argnames=("cfg", "n_steps", "chunk_size", "kv_dtype"),
     donate_argnames=("caches",)))
 
 
 def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
                             hist_len, active, spec_k=4, chunk_size=None,
-                            block_tables=None):
+                            block_tables=None, kv_dtype=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -677,7 +693,7 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
 
 serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(
     _serving_spec_step_impl,
-    static_argnames=("cfg", "spec_k", "chunk_size")))
+    static_argnames=("cfg", "spec_k", "chunk_size", "kv_dtype")))
 
 
 def _decode_params_of(model, lmax):

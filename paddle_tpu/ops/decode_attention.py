@@ -30,6 +30,16 @@ TPU-first design choices:
   slot never forces full-length reads.  ``chunk_size=None`` (default) keeps
   the single fused full-length read — still optimal when contexts sit near
   ``Lmax`` or the cache is small.
+* **int8 cache, float math.**  ``dtype="int8"`` in ``init_kv_cache`` /
+  ``init_kv_pool`` stores KV quantized (symmetric absmax over ``D``, one
+  float16 scale per (position, head) row in a parallel pytree leaf) —
+  quantized ON APPEND inside the same cache scatter, dequantized INSIDE
+  the chunked while_loop right after each chunk read, so only int8 bytes
+  (+ 2 scale bytes per row) cross HBM per chunk: ~0.53× the traffic of a
+  bf16 cache.  The scale array shares every piece of the index machinery —
+  ``mode="drop"`` parking, ``mode="clip"`` paged gathers, the block-table
+  indirection — because its indices are the data indices minus the
+  trailing ``D`` axis.  Attention math is unchanged f32.
 * **Paged block indirection rides the chunked loop.**  With a
   ``block_table`` the while_loop body gathers logical chunk ``i`` of each
   row from physical pool block ``table[b, i]`` instead of slicing a dense
@@ -75,10 +85,78 @@ __all__ = ["init_kv_cache", "init_kv_pool", "decode_attention",
 
 _NEG_INF = -1e30
 
+# the supported cache storage dtypes — anything else is a loud ValueError,
+# not a silent jnp.zeros coercion (a typo like "bfloat" used to surface as
+# an opaque dtype error deep inside the first decode step)
+_KV_DTYPES = ("float32", "float16", "bfloat16", "int8")
+_Q8_MAX = 127.0
+# int8 caches store a per-(position, head) float16 absmax scale alongside
+# the quantized values.  float16 (not float32) keeps the analytic byte
+# ratio vs a bf16 cache at (D + 2) / (2 D) — e.g. 0.53 at D=32 — instead
+# of (D + 4) / (2 D); the scale magnitude is an activation absmax / 127,
+# comfortably inside f16 range, and all arithmetic upcasts to f32 anyway.
+_Q8_SCALE_DTYPE = jnp.float16
+
+
+def _canon_kv_dtype(dtype, where):
+    """Validate a cache dtype against the supported set -> canonical name."""
+    try:
+        name = jnp.dtype(dtype).name
+    except TypeError:
+        name = None
+    if name not in _KV_DTYPES:
+        raise ValueError(
+            f"{where}: unsupported KV cache dtype {dtype!r} — supported: "
+            f"{', '.join(_KV_DTYPES)}.  'int8' selects the quantized cache "
+            "(per-(position, head) float16 scales stored in a parallel "
+            "pytree leaf, quantize-on-append / dequant-in-loop).")
+    return name
+
+
+def _kv_data(cache):
+    """Storage leaf of a cache operand: int8 caches are (data, scale)."""
+    return cache[0] if isinstance(cache, tuple) else cache
+
+
+def _q8_quantize(x):
+    """Symmetric absmax int8 quantization over the trailing (D) axis.
+
+    Returns (q int8 [..., D], scale f16 [...]): one scale per (position,
+    head) row — the granularity that rides the cache scatter for free
+    (same indices, one fewer trailing axis).  The divisor is the
+    f16-ROUNDED scale, so dequantization with the stored scale reproduces
+    each element to within scale/2 (+ one f16 ulp): the round-trip bound
+    the unit test pins.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = (amax / _Q8_MAX).astype(_Q8_SCALE_DTYPE)
+    inv = 1.0 / jnp.maximum(scale.astype(jnp.float32), 1e-8)
+    q = jnp.clip(jnp.round(xf * inv[..., None]), -_Q8_MAX, _Q8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def _q8_dequant(q, scale):
+    """Inverse of ``_q8_quantize``: f32 values from int8 data + f16 scale."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+
 
 def init_kv_cache(batch, max_len, num_kv_heads, head_dim, dtype="bfloat16"):
-    """Preallocate a (k, v) cache pair [B, Lmax, Hkv, D]."""
+    """Preallocate a (k, v) cache pair [B, Lmax, Hkv, D].
+
+    ``dtype="int8"`` selects the quantized cache: each of k/v becomes a
+    ``(data int8 [B, Lmax, Hkv, D], scale f16 [B, Lmax, Hkv])`` pair —
+    a nested pytree leaf that rides the same donated-cache plumbing, so
+    the compiled serving programs specialize once on the structure and
+    never retrace.
+    """
+    dtype = _canon_kv_dtype(dtype, "init_kv_cache")
     shape = (batch, max_len, num_kv_heads, head_dim)
+    if dtype == "int8":
+        def leaf():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], _Q8_SCALE_DTYPE))
+        return leaf(), leaf()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -91,8 +169,20 @@ def init_kv_pool(num_blocks, block, num_kv_heads, head_dim,
     lazily as the context grows and shareable across slots (refcounted
     prefix reuse — serving/kv_cache.py owns that bookkeeping).  The head
     axis sits at index 2 exactly like the dense cache, so the TP
-    head-sharding spec applies to either geometry unchanged."""
+    head-sharding spec applies to either geometry unchanged.
+
+    ``dtype="int8"`` quantizes the pool: each of k/v becomes a
+    ``(data int8 [N, C, Hkv, D], scale f16 [N, C, Hkv])`` pair.  The
+    scale pool shares the block-table indirection — scales for logical
+    chunk ``i`` live in scale block ``table[b, i]`` — so prefix sharing,
+    sentinel routing, and LRU eviction all see ONE block id."""
+    dtype = _canon_kv_dtype(dtype, "init_kv_pool")
     shape = (num_blocks, block, num_kv_heads, head_dim)
+    if dtype == "int8":
+        def leaf():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], _Q8_SCALE_DTYPE))
+        return leaf(), leaf()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -131,7 +221,20 @@ def _append(cache, new, lengths, layout, block_table=None):
     ``>= N`` (an unmapped chunk) — past the pool's block axis, so parked
     slots (offset ``lmax``) still drop every write.  Callers must still
     bound their decode loops by Lmax - prompt_len — an overflowing step
-    simply does not extend the cache."""
+    simply does not extend the cache.
+
+    An int8 ``(data, scale)`` cache quantizes ``new`` HERE — inside the
+    append, not in the caller — and scatters data and scales with the SAME
+    index math (the scale array is the data array minus the trailing ``D``
+    axis), so drop/parking semantics hold for both leaves ("blhd" only)."""
+    if isinstance(cache, tuple):
+        if layout != "blhd":
+            raise ValueError(
+                "_append: int8 KV caches support only the blhd layout")
+        data, scale = cache
+        qn, sn = _q8_quantize(new)
+        return (_append(data, qn, lengths, layout, block_table),
+                _append(scale, sn, lengths, layout, block_table))
     lengths = lengths.astype(jnp.int32)
     if block_table is not None:
         n_blocks, c = cache.shape[0], cache.shape[1]
@@ -164,6 +267,14 @@ def _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
                  attn_bias):
     """Single fused masked read over the whole [Lmax] cache."""
     b, hkv, g, t, d = qg.shape
+    if isinstance(k_cache, tuple):
+        if layout != "blhd":
+            raise ValueError(
+                "_attend_full: int8 KV caches support only the blhd layout")
+        # full-read fallback: dequantize the whole cache (the chunked path
+        # is where the bytes win lives; this keeps chunk_size=None correct)
+        k_cache = _q8_dequant(*k_cache)
+        v_cache = _q8_dequant(*v_cache)
     lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
     k_eq = "blkd" if layout == "blhd" else "bkld"
     s = jnp.einsum(
@@ -208,21 +319,34 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
     (the gather CLIPS OOB indices into the pool — never the NaN-filling
     default), so the causal mask discards whatever they gather — same
     guarantee the dense path gives chunks past ``lengths[b]``.
+
+    int8 ``(data, scale)`` caches dequantize HERE, inside the loop body,
+    immediately after each chunk slice/gather — so a step moves int8
+    bytes (plus 2 scale bytes per (position, head)) across HBM and the
+    f32 values exist only as a [B, C] working tile.  The scale chunk uses
+    the SAME start offset / block index as the data chunk (paged: the
+    same ``mode="clip"`` gather), so sentinel and tail semantics are
+    shared by construction.
     """
     b, hkv, g, t, d = qg.shape
     c = int(chunk)
+    quant = isinstance(k_cache, tuple)
+    if quant and layout != "blhd":
+        raise ValueError(
+            "_attend_chunked: int8 KV caches support only the blhd layout")
+    k_data = _kv_data(k_cache)
     if block_table is not None:
         if layout != "blhd":
             raise ValueError(
                 "paged _attend_chunked supports only the blhd layout")
-        if k_cache.shape[1] != c:
+        if k_data.shape[1] != c:
             raise ValueError(
                 f"paged _attend_chunked: chunk ({c}) must equal the pool "
-                f"block size ({k_cache.shape[1]})")
+                f"block size ({k_data.shape[1]})")
         block_table = block_table.astype(jnp.int32)
         lmax = block_table.shape[1] * c
     else:
-        lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+        lmax = k_data.shape[1] if layout == "blhd" else k_data.shape[2]
     n_chunks = -(-lmax // c)
     bias = None
     if attn_bias is not None:
@@ -243,14 +367,28 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
             # sentinel/unmapped entry, and the masked softmax weight times
             # NaN is NaN — clipping reads an arbitrary REAL block whose
             # rows the causal mask zeroes exactly like dense garbage rows
-            kb = jnp.take(k_cache, idx, axis=0, mode="clip")
-            vb = jnp.take(v_cache, idx, axis=0, mode="clip")
+
+            def read(cache):
+                if isinstance(cache, tuple):
+                    db = jnp.take(cache[0], idx, axis=0, mode="clip")
+                    sb = jnp.take(cache[1], idx, axis=0, mode="clip")
+                    return _q8_dequant(db, sb)
+                return jnp.take(cache, idx, axis=0, mode="clip")
+
+            kb, vb = read(k_cache), read(v_cache)
             kb, vb = jnp.swapaxes(kb, 1, 2), jnp.swapaxes(vb, 1, 2)
         elif layout == "blhd":
-            kb = jax.lax.dynamic_slice(k_cache, (z, start, z, z),
-                                       (b, c, hkv, d))
-            vb = jax.lax.dynamic_slice(v_cache, (z, start, z, z),
-                                       (b, c, hkv, d))
+            def read(cache):
+                if isinstance(cache, tuple):
+                    db = jax.lax.dynamic_slice(cache[0], (z, start, z, z),
+                                               (b, c, hkv, d))
+                    sb = jax.lax.dynamic_slice(cache[1], (z, start, z),
+                                               (b, c, hkv))
+                    return _q8_dequant(db, sb)
+                return jax.lax.dynamic_slice(cache, (z, start, z, z),
+                                             (b, c, hkv, d))
+
+            kb, vb = read(k_cache), read(v_cache)
             kb, vb = jnp.swapaxes(kb, 1, 2), jnp.swapaxes(vb, 1, 2)
         else:
             kb = jax.lax.dynamic_slice(k_cache, (z, z, start, z),
@@ -329,17 +467,21 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     """
     b, t, h, d = q.shape
     hkv = k_new.shape[2]
+    k_data = _kv_data(k_cache)
+    if isinstance(k_cache, tuple) and layout != "blhd":
+        raise ValueError(
+            "decode_attention: int8 KV caches support only layout='blhd'")
     if block_table is not None:
         if layout != "blhd":
             raise ValueError(
                 "decode_attention: paged caches support only layout='blhd'")
-        if chunk_size is None or int(chunk_size) != k_cache.shape[1]:
+        if chunk_size is None or int(chunk_size) != k_data.shape[1]:
             raise ValueError(
                 f"decode_attention: paged caches require chunk_size == pool "
-                f"block size ({k_cache.shape[1]}), got {chunk_size}")
-        lmax = block_table.shape[1] * k_cache.shape[1]
+                f"block size ({k_data.shape[1]}), got {chunk_size}")
+        lmax = block_table.shape[1] * k_data.shape[1]
     else:
-        lmax = k_cache.shape[1] if layout == "blhd" else k_cache.shape[2]
+        lmax = k_data.shape[1] if layout == "blhd" else k_data.shape[2]
     if hkv <= 0 or h % hkv:
         raise ValueError(
             f"decode_attention: query heads ({h}) must be an integer "
@@ -408,7 +550,7 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         raise ValueError(
             f"slot_prefill_attention: chunk batch must be 1 (got {b})")
     hkv = k_new.shape[2]
-    lmax = k_cache.shape[1]
+    lmax = _kv_data(k_cache).shape[1]
     if hkv <= 0 or h % hkv:
         raise ValueError(
             f"slot_prefill_attention: query heads ({h}) must be an integer "
@@ -421,10 +563,11 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         else jnp.int32(offset)
 
     if block_table is not None:
-        if chunk_size is None or int(chunk_size) != k_cache.shape[1]:
+        blk = _kv_data(k_cache).shape[1]
+        if chunk_size is None or int(chunk_size) != blk:
             raise ValueError(
                 f"slot_prefill_attention: paged caches require chunk_size "
-                f"== pool block size ({k_cache.shape[1]}), got {chunk_size}")
+                f"== pool block size ({blk}), got {chunk_size}")
         w = block_table.shape[1]
         # the slot's [1, W] table row (slot < B: no clamping)
         trow = jax.lax.dynamic_slice(
@@ -440,21 +583,38 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
             .astype(q.dtype)
         return out, k_cache, v_cache
 
-    # scatter the chunk's rows into the slot (drop past capacity)
+    # scatter the chunk's rows into the slot (drop past capacity); int8
+    # caches quantize the chunk here and scatter data + scales at the
+    # same (slot, row) indices
     rows = offset + jnp.arange(t, dtype=jnp.int32)
     batch_idx = jnp.full((t,), slot, jnp.int32)
-    k_cache = k_cache.at[batch_idx, rows].set(
-        k_new[0].astype(k_cache.dtype), mode="drop")
-    v_cache = v_cache.at[batch_idx, rows].set(
-        v_new[0].astype(v_cache.dtype), mode="drop")
+
+    def scatter(cache, new):
+        if isinstance(cache, tuple):
+            qn, sn = _q8_quantize(new[0])
+            return (cache[0].at[batch_idx, rows].set(qn, mode="drop"),
+                    cache[1].at[batch_idx, rows].set(sn, mode="drop"))
+        return cache.at[batch_idx, rows].set(
+            new[0].astype(cache.dtype), mode="drop")
+
+    k_cache = scatter(k_cache, k_new)
+    v_cache = scatter(v_cache, v_new)
 
     # the slot's [1, Lmax] view (slot < B: no dynamic_slice clamping)
-    ks = jax.lax.dynamic_slice(
-        k_cache, (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
-        (1, lmax, hkv, d))
-    vs = jax.lax.dynamic_slice(
-        v_cache, (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
-        (1, lmax, hkv, d))
+    def slot_view(cache):
+        if isinstance(cache, tuple):
+            return (jax.lax.dynamic_slice(
+                        cache[0], (slot, jnp.int32(0), jnp.int32(0),
+                                   jnp.int32(0)), (1, lmax, hkv, d)),
+                    jax.lax.dynamic_slice(
+                        cache[1], (slot, jnp.int32(0), jnp.int32(0)),
+                        (1, lmax, hkv)))
+        return jax.lax.dynamic_slice(
+            cache, (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+            (1, lmax, hkv, d))
+
+    ks = slot_view(k_cache)
+    vs = slot_view(v_cache)
 
     qg = q.reshape(1, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
         .astype(jnp.float32)                                # [1,Hkv,G,T,D]

@@ -108,6 +108,7 @@ from paddle_tpu.observability.flightrecorder import (
     FlightRecorder, RequestTrace,
 )
 from paddle_tpu.observability.slo import SLOTracker
+from paddle_tpu.ops.decode_attention import _canon_kv_dtype
 from paddle_tpu.serving.faults import InjectedDispatchError
 from paddle_tpu.serving.kv_cache import (
     KVCacheManager, KVPoolExhausted, PagedKVCacheManager,
@@ -301,6 +302,20 @@ class ServingEngine:
     Token streams are byte-identical to the dense engine at f32
     (tested), and the block tables are traced operands — zero retraces
     across appends, prefix hits and evictions.
+    ``kv_dtype``: KV cache STORAGE dtype (``None`` = the model dtype).
+    ``"int8"`` quantizes the cache — symmetric absmax over the head dim,
+    one float16 scale per (position, head) row in a parallel pytree leaf
+    riding the same donated-cache plumbing — quantized on append inside
+    the cache scatter and dequantized inside the chunked attention read,
+    so KV HBM traffic drops to ~0.53× of bf16 (~0.27× of f32).  Works
+    with dense AND paged geometries (the scale pool shares the block
+    tables — prefix reuse stays keyed on token ids) and with ``mesh``
+    (scales head-sharded like the data).  Greedy streams can drift from
+    the float engine within a small bounded rate (quantization error can
+    flip near-tied argmaxes — the tested drift budget); every
+    NON-quantized invariant (parking, poison quarantine, prefix
+    adoption/accounting, pipeline drain identity, paged-vs-dense and
+    TP-vs-single-device parity WITHIN q8) stays byte-identical.
     ``mesh``: a ``jax.sharding.Mesh`` to tensor-parallel the compiled
     hot path across (``None`` = single-device, bitwise the pre-mesh
     engine).  Params are shard-placed once at construction under the
@@ -358,7 +373,7 @@ class ServingEngine:
                  prompt_buckets=None, detokenizer=None, registry=None,
                  instrument=True, pipeline=True, decode_chunk=256,
                  prefill_chunk=256, prefill_budget=2, kv_block=None,
-                 max_live_tokens=None, mesh=None,
+                 max_live_tokens=None, kv_dtype=None, mesh=None,
                  tp_axis="mp", max_pending=None, retry_attempts=3,
                  retry_backoff=0.05, faults=None, recorder=True,
                  slo=None):
@@ -442,7 +457,20 @@ class ServingEngine:
             raise ValueError("max_live_tokens requires kv_block (paged KV)")
         self._params, self._cfg = _decode_params_of(model, self._lmax)
         nh, nkv, hd, eps = self._cfg
-        dtype = self._params["embed"].dtype
+        # kv_dtype: cache STORAGE dtype override.  None keeps the model
+        # dtype (bitwise the pre-quantization engine — kv_dtype simply
+        # never enters the program identity as a non-None static).
+        # "int8" switches every cache leaf to a quantized (data, scale)
+        # pair: quantize-on-append, dequant inside the chunked read
+        # (ops/decode_attention.py) — ~0.53× the KV bytes of bf16.
+        # Validated against the supported set here, at construction, so a
+        # typo fails loudly instead of deep inside the first dispatch.
+        self._kv_dtype = (_canon_kv_dtype(kv_dtype, "ServingEngine")
+                          if kv_dtype is not None else None)
+        self._q8 = self._kv_dtype == "int8"
+        self._kvq = "int8" if self._q8 else "off"
+        dtype = (self._kv_dtype if self._kv_dtype is not None
+                 else self._params["embed"].dtype)
         # mesh=None: single-device engine, module-level jitted programs,
         # byte-identical to every prior release.  mesh set: params are
         # shard-placed ONCE here under the llama TP rules, the KV cache is
@@ -451,6 +479,7 @@ class ServingEngine:
         # scheduler state (cur/lengths/queues) stays replicated either way.
         self._tp = None
         cache_sharding = None
+        scale_sharding = None
         if mesh is not None:
             from paddle_tpu.serving.sharding import (
                 shard_decode_params, serving_tp_programs)
@@ -466,19 +495,32 @@ class ServingEngine:
                 mesh, tp_axis, self._cfg, pspecs,
                 len(self._params["layers"]), sync_every=self._sync,
                 spec_k=self._spec_k, with_hist=mode == "spec",
-                chunk_size=self._chunk, paged=self._paged)
+                chunk_size=self._chunk, paged=self._paged,
+                kv_dtype=self._kv_dtype)
             cache_sharding = self._tp.cache_sharding
+            scale_sharding = self._tp.scale_sharding
         if self._paged:
             self._kv = PagedKVCacheManager(
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
                 dtype, block=kv_block,
                 max_live_tokens=(int(max_live_tokens) if max_live_tokens
                                  else self._B * self._lmax),
-                sharding=cache_sharding, on_event=self._kv_event)
+                sharding=cache_sharding, on_event=self._kv_event,
+                scale_sharding=scale_sharding)
         else:
             self._kv = KVCacheManager(
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
-                dtype, sharding=cache_sharding)
+                dtype, sharding=cache_sharding,
+                scale_sharding=scale_sharding)
+        if self._m is not None:
+            self._m.set_kv_quant(self._kvq)
+            if self._q8:
+                # analytic per-context-token KV traffic at int8: 1 data
+                # byte per (head, dim) element + 2 f16 scale bytes per
+                # (position, head) row, both k and v, every layer
+                n_layers = len(self._params["layers"])
+                self._m.hbm_gb_per_tok_q8.set(
+                    n_layers * 2 * nkv * (hd + 2) / 1e9)
         # paged decode-time row growth is capped per slot by the token
         # budget reserved at admission (prompt + max_new + headroom,
         # clamped to lmax) — the mirror _spend/_dispatch draw ensure_rows
@@ -754,16 +796,27 @@ class ServingEngine:
         Paged engines poison the slot's FIRST MAPPED BLOCK instead (the
         pool has no per-slot rows); the seam is test-only and the paged
         fault tests use distinct prompts, so the poisoned block is never
-        a shared prefix block."""
+        a shared prefix block.
+
+        int8 caches can't hold a NaN in the data leaf — the poison lands
+        in the SCALE leaf instead (same row indices minus the trailing
+        ``D`` axis): a NaN scale dequantizes the row to NaN, which
+        reaches the logits exactly like a NaN float row."""
         k, v = self._kv.caches[0]
+
+        def poison(leaf, *idx):
+            if isinstance(leaf, tuple):
+                return (leaf[0], leaf[1].at[idx].set(jnp.nan))
+            return leaf.at[idx].set(jnp.nan)
+
         if self._paged:
             b = int(self._kv.block_tables[slot, 0])
             if b >= self._kv.num_blocks:
                 return   # no rows mapped yet (unreachable: _apply_poison
                          # already defers slots with no chunk dispatched)
-            self._kv.caches[0] = (k.at[b, 0].set(jnp.nan), v)
+            self._kv.caches[0] = (poison(k, b, 0), v)
             return
-        self._kv.caches[0] = (k.at[slot, 0].set(jnp.nan), v)
+        self._kv.caches[0] = (poison(k, slot, 0), v)
 
     def _apply_poison(self):
         """Inject every due NaN payload from the fault plan.  Injection
@@ -871,7 +924,8 @@ class ServingEngine:
         return serving_decode_steps(
             self._params, self._cfg, cur, self._kv.caches, dev_len,
             n_steps=self._sync, chunk_size=self._chunk,
-            block_tables=self._tables() if self._paged else None)
+            block_tables=self._tables() if self._paged else None,
+            kv_dtype=self._kv_dtype)
 
     def _call_spec(self, cur, dev_len, active):
         if self._tp is not None:
@@ -887,7 +941,8 @@ class ServingEngine:
             self._params, self._cfg, cur, self._kv.caches, dev_len,
             self._hist, self._hist_len, active, spec_k=self._spec_k,
             chunk_size=self._chunk,
-            block_tables=self._tables() if self._paged else None)
+            block_tables=self._tables() if self._paged else None,
+            kv_dtype=self._kv_dtype)
 
     def _call_prefill_slot(self, tokens, prompt_len, slot):
         if self._tp is not None:
@@ -897,7 +952,8 @@ class ServingEngine:
         return serving_prefill_slot(
             self._params, self._cfg, tokens, prompt_len, self._kv.caches,
             slot, hist=self._hist, hist_len=self._hist_len,
-            with_hist=self._mode == "spec", chunk_size=self._chunk)
+            with_hist=self._mode == "spec", chunk_size=self._chunk,
+            kv_dtype=self._kv_dtype)
 
     def _call_prefill_chunk(self, tokens, offset, prompt_len, slot):
         if self._tp is not None:
@@ -915,7 +971,8 @@ class ServingEngine:
             self._kv.caches, slot, hist=self._hist,
             hist_len=self._hist_len, with_hist=self._mode == "spec",
             chunk_size=self._chunk,
-            block_tables=self._tables() if self._paged else None)
+            block_tables=self._tables() if self._paged else None,
+            kv_dtype=self._kv_dtype)
 
     def _admit(self):
         free = self._kv.free_slots()
@@ -1287,7 +1344,8 @@ class ServingEngine:
         dev_len = self._kv.device_lengths(active)
         if self._fr is not None:
             self._fr.record("dispatch", step=self._step_idx,
-                            mode=self._mode, n_live=len(live))
+                            mode=self._mode, n_live=len(live),
+                            kv_quant=self._kvq)
         if self._mode == "greedy":
             def go(attempt):
                 self._fault_point("dispatch", attempt)
@@ -1357,7 +1415,7 @@ class ServingEngine:
         if self._fr is not None:
             self._fr.record("dispatch", step=self._step_idx,
                             mode=self._mode, n_live=len(live),
-                            pipelined=True)
+                            pipelined=True, kv_quant=self._kvq)
         active = np.array([self._decodable(i) for i in range(self._B)])
         host_len = self._kv.device_lengths(active)
         use_host = ~active

@@ -30,7 +30,10 @@ block tables — the paged geometry of ROADMAP item 2:
 
 * blocks are REFCOUNTED: a radix map keyed on token-id chunks lets
   multiple slots map the same physical prefix blocks (decode only
-  appends PAST the shared prefix, so copy-on-write is unnecessary);
+  appends PAST the shared prefix, so copy-on-write is unnecessary).
+  The key is TOKEN IDS, never cache bytes — so prefix reuse is
+  storage-dtype-agnostic: an int8 (data, scale) pool shares blocks by
+  the same table ids, one block id covering both leaves;
 * refcount-0 blocks that still back a cached prefix stay resident as
   EVICTABLE until the allocator needs them (LRU-first subtree
   eviction), so an identical prompt admitted later skips its prefill;
@@ -53,6 +56,21 @@ from paddle_tpu.ops.decode_attention import (init_kv_cache, init_kv_pool,
 __all__ = ["KVCacheManager", "PagedKVCacheManager", "KVPoolExhausted"]
 
 
+def _place_caches(caches, sharding, scale_sharding):
+    """Shard-place freshly allocated caches.  A float cache leaf is one
+    array; an int8 cache leaf is a ``(data, scale)`` pair whose scale
+    array has no trailing ``D`` axis, so it takes its OWN head-sharded
+    spec (serving/sharding.kv_scale_pspec) rather than the data spec."""
+    def put(leaf):
+        if isinstance(leaf, tuple):
+            return (jax.device_put(leaf[0], sharding),
+                    jax.device_put(leaf[1], scale_sharding
+                                   if scale_sharding is not None
+                                   else sharding))
+        return jax.device_put(leaf, sharding)
+    return [(put(k), put(v)) for k, v in caches]
+
+
 class KVPoolExhausted(RuntimeError):
     """A block allocation could not be satisfied even after evicting
     every refcount-0 cached block.  The engine treats this as
@@ -65,15 +83,14 @@ class KVCacheManager:
     """Slot allocator + KV-cache owner for one fixed-batch engine."""
 
     def __init__(self, n_layers, batch_size, max_len, num_kv_heads,
-                 head_dim, dtype, sharding=None):
+                 head_dim, dtype, sharding=None, scale_sharding=None):
         self.batch_size = int(batch_size)
         self.max_len = int(max_len)
         caches = [init_kv_cache(self.batch_size, self.max_len,
                                 num_kv_heads, head_dim, dtype)
                   for _ in range(n_layers)]
         if sharding is not None:
-            caches = [(jax.device_put(k, sharding),
-                       jax.device_put(v, sharding)) for k, v in caches]
+            caches = _place_caches(caches, sharding, scale_sharding)
         self.caches = caches
         self.sharding = sharding
         # host mirrors of per-slot device state
@@ -156,7 +173,7 @@ class PagedKVCacheManager(KVCacheManager):
 
     def __init__(self, n_layers, batch_size, max_len, num_kv_heads,
                  head_dim, dtype, block, max_live_tokens, sharding=None,
-                 on_event=None):
+                 on_event=None, scale_sharding=None):
         self.batch_size = int(batch_size)
         self.max_len = int(max_len)
         self.block = int(block)
@@ -176,8 +193,7 @@ class PagedKVCacheManager(KVCacheManager):
         caches = [init_kv_pool(self.num_blocks, self.block, num_kv_heads,
                                head_dim, dtype) for _ in range(n_layers)]
         if sharding is not None:
-            caches = [(jax.device_put(k, sharding),
-                       jax.device_put(v, sharding)) for k, v in caches]
+            caches = _place_caches(caches, sharding, scale_sharding)
         self.caches = caches
         self.sharding = sharding
         self.lengths = np.zeros((self.batch_size,), np.int32)

@@ -185,6 +185,24 @@ class EngineMetrics:
             "serving_prompt_tokens_total",
             "prompt tokens admitted on the paged path (prefix hit-rate "
             "denominator)", L).labels(**lbl)
+        # KV quantization (kv_dtype=): an INFO gauge — one child per
+        # known mode, the active one reads 1 — so a scrape (and
+        # /debug/flightrecorder's kv_quant dispatch detail) states the
+        # storage mode without string-valued metrics, plus the analytic
+        # per-context-token KV traffic at int8 (0 on unquantized
+        # engines; the bench A/B pins it at ~0.53x the bf16 column)
+        self._kv_quant_mode = reg.gauge(
+            "serving_kv_quant_mode",
+            "KV cache quantization mode info gauge: the child whose "
+            "mode label names the active storage scheme reads 1, every "
+            "other pre-registered child 0", ("policy", "mode"))
+        for mode in ("off", "int8"):
+            self._kv_quant_mode.labels(policy=policy, mode=mode).set(0)
+        self.hbm_gb_per_tok_q8 = reg.gauge(
+            "serving_hbm_gb_per_tok_q8",
+            "analytic KV bytes (GB) read per context token at int8 "
+            "storage: layers * 2 * Hkv * (D + 2 scale bytes); zero when "
+            "kv_dtype is unquantized", L).labels(**lbl)
         self.span_step = span("serving.step", registry=reg,
                               mesh=mesh_label)
         self.span_prefill = span("serving.prefill", registry=reg,
@@ -196,6 +214,13 @@ class EngineMetrics:
 
     def prefill(self, bucket):
         self._prefills.labels(policy=self._policy, bucket=bucket).inc()
+
+    def set_kv_quant(self, mode):
+        """Point the kv-quant info gauge at ``mode`` (exactly one child
+        reads 1 after this — the engine calls it once at construction)."""
+        for m in ("off", "int8"):
+            self._kv_quant_mode.labels(policy=self._policy, mode=m).set(
+                1 if m == mode else 0)
 
     def stream_cb_error(self, etype):
         self._stream_cb_errors.labels(

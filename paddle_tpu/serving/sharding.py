@@ -61,7 +61,8 @@ from paddle_tpu.models.llama_decode import (
 )
 
 __all__ = ["match_partition_rules", "llama_tp_rules", "kv_cache_pspec",
-           "shard_decode_params", "serving_tp_programs", "TPPrograms"]
+           "kv_scale_pspec", "shard_decode_params", "serving_tp_programs",
+           "TPPrograms"]
 
 
 def _path_str(path):
@@ -122,6 +123,14 @@ def kv_cache_pspec(axis="mp"):
     return PS(None, None, axis, None)
 
 
+def kv_scale_pspec(axis="mp"):
+    """int8-cache scale array ``[B, Lmax, Hkv]`` / ``[N, C, Hkv]`` sharded
+    along the head axis — the data spec minus the trailing ``D`` axis, so
+    each chip holds exactly the scales for its own heads and the in-loop
+    dequant stays collective-free like the data read."""
+    return PS(None, None, axis)
+
+
 def _tp_geometry_check(params, mesh, axis):
     """Every sharded dimension must divide by the mesh axis size — an
     indivisible placement would silently pad on some backends and raise on
@@ -180,18 +189,26 @@ class TPPrograms:
     """
 
     def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
-                 sync_every, spec_k, with_hist, chunk_size, paged=False):
+                 sync_every, spec_k, with_hist, chunk_size, paged=False,
+                 kv_dtype=None):
         repl = NamedSharding(mesh, PS())
         pshard = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, PS))
-        cshard = [(NamedSharding(mesh, kv_cache_pspec(axis)),) * 2
-                  for _ in range(n_layers)]
+        dsh = NamedSharding(mesh, kv_cache_pspec(axis))
+        quant = kv_dtype == "int8"
+        ssh = NamedSharding(mesh, kv_scale_pspec(axis)) if quant else None
+        # int8 caches are nested (data, scale) leaves: the sharding pytree
+        # mirrors that structure, scales head-sharded on their own (3-axis)
+        # spec — out_shardings extend to the scale leaf automatically
+        leaf = (dsh, ssh) if quant else dsh
+        cshard = [(leaf,) * 2 for _ in range(n_layers)]
         hshard = repl if with_hist else None
         self.mesh = mesh
         self.axis = axis
         self.n_devices = int(mesh.shape[axis])
-        self.cache_sharding = cshard[0][0] if n_layers else repl
+        self.cache_sharding = dsh if n_layers else repl
+        self.scale_sharding = ssh
 
         if paged:
             # paged programs take one extra trailing operand: the [B, W]
@@ -203,7 +220,7 @@ class TPPrograms:
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
                     n_steps=sync_every, chunk_size=chunk_size,
-                    block_tables=tables)
+                    block_tables=tables, kv_dtype=kv_dtype)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl, repl),
@@ -215,7 +232,7 @@ class TPPrograms:
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
                     active, spec_k=spec_k, chunk_size=chunk_size,
-                    block_tables=tables)
+                    block_tables=tables, kv_dtype=kv_dtype)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -228,7 +245,8 @@ class TPPrograms:
                 return _serving_prefill_chunk_impl(
                     params, cfg, tokens, offset, prompt_len, caches, slot,
                     hist=hist, hist_len=hist_len, with_hist=with_hist,
-                    chunk_size=chunk_size, block_tables=tables)
+                    chunk_size=chunk_size, block_tables=tables,
+                    kv_dtype=kv_dtype)
             self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
                 pchunk,
                 in_shardings=(pshard, repl, repl, repl, cshard, repl,
@@ -239,7 +257,8 @@ class TPPrograms:
             def decode(params, cur, caches, dev_lengths):
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
-                    n_steps=sync_every, chunk_size=chunk_size)
+                    n_steps=sync_every, chunk_size=chunk_size,
+                    kv_dtype=kv_dtype)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl),
@@ -250,7 +269,8 @@ class TPPrograms:
                      active):
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
-                    active, spec_k=spec_k, chunk_size=chunk_size)
+                    active, spec_k=spec_k, chunk_size=chunk_size,
+                    kv_dtype=kv_dtype)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -263,7 +283,7 @@ class TPPrograms:
                 return _serving_prefill_chunk_impl(
                     params, cfg, tokens, offset, prompt_len, caches, slot,
                     hist=hist, hist_len=hist_len, with_hist=with_hist,
-                    chunk_size=chunk_size)
+                    chunk_size=chunk_size, kv_dtype=kv_dtype)
             self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
                 pchunk,
                 in_shardings=(pshard, repl, repl, repl, cshard, repl,
@@ -275,7 +295,7 @@ class TPPrograms:
             return _serving_prefill_slot_impl(
                 params, cfg, tokens, prompt_len, caches, slot,
                 hist=hist, hist_len=hist_len, with_hist=with_hist,
-                chunk_size=chunk_size)
+                chunk_size=chunk_size, kv_dtype=kv_dtype)
         self.prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
             pslot,
             in_shardings=(pshard, repl, repl, cshard, repl, hshard, repl),
@@ -291,16 +311,16 @@ _PROGRAMS = {}
 
 def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
                         sync_every, spec_k, with_hist, chunk_size,
-                        paged=False):
+                        paged=False, kv_dtype=None):
     """Cached ``TPPrograms`` factory (see class docstring)."""
     leaves, treedef = jax.tree_util.tree_flatten(
         param_specs, is_leaf=lambda x: isinstance(x, PS))
     key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
-           sync_every, spec_k, with_hist, chunk_size, paged)
+           sync_every, spec_k, with_hist, chunk_size, paged, kv_dtype)
     progs = _PROGRAMS.get(key)
     if progs is None:
         progs = _PROGRAMS[key] = TPPrograms(
             mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
             spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size,
-            paged=paged)
+            paged=paged, kv_dtype=kv_dtype)
     return progs

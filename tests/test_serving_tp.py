@@ -151,6 +151,33 @@ class TestTPByteIdentity:
         with assert_no_retrace():
             _run(model, prompts, [4, 6], **kw)
 
+    @pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+    def test_q8_matches_single_device(self, paged):
+        # the TP cell of the q8 parity matrix: int8 data shards over the
+        # head axis and the f16 scale leaf rides PS(None, None, "mp") —
+        # a mesh-placed q8 engine stays byte-identical to single-device
+        # q8 (quantization happens per head AFTER the column-parallel
+        # projection, so sharding never changes which values are scaled)
+        mesh = _mesh()
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(1, 200, (p,)) for p in (5, 9, 7)]
+        new_lens = [6, 4, 7]
+        kw = dict(batch_size=2, max_len=64, kv_dtype="int8")
+        if paged:
+            kw.update(kv_block=16, max_live_tokens=2 * 64)
+        a = _run(_tp_model(), prompts, new_lens, mesh=mesh, **kw)
+        b = _run(_tp_model(), prompts, new_lens, **kw)
+        for i in a:
+            np.testing.assert_array_equal(a[i].output_ids, b[i].output_ids)
+
+    def test_q8_scale_leaf_sharded(self):
+        mesh = _mesh()
+        eng = ServingEngine(_tp_model(), batch_size=2, max_len=64,
+                            mesh=mesh, kv_dtype="int8")
+        (kd, ks), _ = eng._kv.caches[0]
+        assert kd.sharding.spec == PS(None, None, "mp", None)
+        assert ks.sharding.spec == PS(None, None, "mp")
+
     @pytest.mark.parametrize("mode", ["greedy", "spec"])
     def test_paged_matches_single_device(self, mode):
         # paged + TP composes: the block pool shards over the head axis

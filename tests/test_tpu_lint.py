@@ -503,6 +503,78 @@ class TestRuleFixtures:
                 engine.decode_step(x, [0, 1])
         """) == []
 
+    # PTL011 — implicit-dtype-promotion-in-compiled-step ---------------
+    def test_promotion_tp_np_float64(self):
+        # a strongly-typed 64-bit scalar outranks the traced operand on
+        # the promotion lattice — the int8/bf16 hot loop silently upcasts
+        assert _rules("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def step(q):
+                return q * np.float64(0.5)
+        """) == ["PTL011"]
+
+    def test_promotion_tp_np_double_aliased_reversed(self):
+        # resolved through the import alias; operand order and a unary
+        # sign don't hide the scalar
+        assert _rules("""
+            import jax
+            import numpy as onp
+            @jax.jit
+            def step(q):
+                return -onp.double(2.0) + q
+        """) == ["PTL011"]
+
+    def test_promotion_tp_float_pinned_literal(self):
+        # float(127.0) concretizes the literal — the fix is the bare
+        # literal, which JAX keeps weakly typed
+        assert _rules("""
+            import jax
+            @jax.jit
+            def dequant(q):
+                return q / float(127.0)
+        """) == ["PTL011"]
+
+    def test_promotion_tn_bare_literal(self):
+        # a bare python literal stays weakly typed: the traced operand's
+        # precision wins, so this is the sanctioned spelling
+        assert _rules("""
+            import jax
+            @jax.jit
+            def step(q):
+                return q * 0.5
+        """) == []
+
+    def test_promotion_tn_outside_jit(self):
+        # host-side math is free to use concrete 64-bit scalars
+        assert _rules("""
+            import numpy as np
+            def host(x):
+                return x * np.float64(0.5)
+        """) == []
+
+    def test_promotion_tn_untraced_operand(self):
+        # combined with a trace-time python constant, not a traced value
+        assert _rules("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def step(q):
+                d = 4
+                return q[0] + (d * np.float64(0.5) - d)
+        """) == []
+
+    def test_promotion_tn_dtype_matched_constant(self):
+        # the hinted fix: build the constant in the operand's own dtype
+        assert _rules("""
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def step(q):
+                return q * jnp.asarray(0.5, q.dtype)
+        """) == []
+
     # PTL005 — impure-jit-body -----------------------------------------
     def test_impure_tp_time_and_nprandom(self):
         assert _rules("""
