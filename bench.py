@@ -583,6 +583,32 @@ def bench_serving(n_requests=64, batch=8):
     kv_tok_q8 = cfg.num_hidden_layers * 2 * cfg.num_key_value_heads \
         * (hd + 2)
 
+    # A/B 8 (round 16) — fused pallas decode read and int8 decode
+    # weights, each against the same continuous-greedy baseline.  Off
+    # the chip both kernels run under interpret/dequant emulation, so
+    # only the ratio columns carry cross-round meaning; the drift
+    # columns are the quality cost on the same captured token streams.
+    run_tok(attn_impl="pallas")          # warm the fused program family
+    dt_fa, fa_toks = run_tok(attn_impl="pallas")
+    fa_drift_n = sum(sum(x != y for x, y in zip(a, b))
+                     for a, b in zip(ref_toks, fa_toks))
+    run_tok(weight_dtype="int8")         # warm the w8 program family
+    dt_w8, w8_toks = run_tok(weight_dtype="int8")
+    w8_drift_n = sum(sum(x != y for x, y in zip(a, b))
+                     for a, b in zip(ref_toks, w8_toks))
+    # analytic per-token decode-weight traffic: every step reads the
+    # whole projection/MLP weight set once, amortized over the batch;
+    # bf16 is the production storage dtype, int8 adds one f16 scale per
+    # output channel
+    kvd = cfg.num_key_value_heads * hd
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    w_shapes = [(h, h), (h, kvd), (h, kvd), (h, h),
+                (h, inter), (h, inter), (inter, h)]
+    w_elems = cfg.num_hidden_layers * sum(a * b for a, b in w_shapes)
+    w_scales = cfg.num_hidden_layers * sum(b for _, b in w_shapes)
+    w_tok_bf16 = w_elems * 2 / batch
+    w_tok_w8 = (w_elems + 2 * w_scales) / batch
+
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -656,6 +682,19 @@ def bench_serving(n_requests=64, batch=8):
         "serving_hbm_gb_per_tok_kv_bf16": kv_tok_bf16 / 1e9,
         "serving_hbm_gb_per_tok_q8": kv_tok_q8 / 1e9,
         "serving_q8_kv_bytes_ratio": round(kv_tok_q8 / kv_tok_bf16, 4),
+        # fused-kernel + int8-weight A/Bs (round 16): wall-clock ratios
+        # vs the same baseline, drift on the same captured streams, and
+        # the analytic weight-traffic win (bf16 baseline vs int8 data +
+        # f16 per-output-channel scales)
+        "serving_fused_attn_tok_per_sec": round(total_new / dt_fa, 1),
+        "serving_fused_attn_speedup": round(dt_c / dt_fa, 2),
+        "serving_fused_greedy_drift": round(fa_drift_n / total_new, 4),
+        "serving_w8_tok_per_sec": round(total_new / dt_w8, 1),
+        "serving_w8_speedup": round(dt_c / dt_w8, 2),
+        "serving_w8_greedy_drift": round(w8_drift_n / total_new, 4),
+        "serving_hbm_gb_per_tok_w_bf16": w_tok_bf16 / 1e9,
+        "serving_hbm_gb_per_tok_w8": w_tok_w8 / 1e9,
+        "serving_w8_bytes_ratio": round(w_tok_w8 / w_tok_bf16, 4),
         # flight-recorder overhead (round 13): recorder-on (the default,
         # dt_c) vs recorder-off on the same warm programs
         "serving_recorder_overhead_pct": round(

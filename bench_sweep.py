@@ -23,6 +23,11 @@ Round 15 adds ``python -u bench_sweep.py kv_dtype``: the KV-storage
 dtype axis (bf16 vs the int8 cache with f16 per-(position, head)
 scales) over the same low/high-occupancy regimes — per-step time plus
 the analytic KV bytes per context token each storage mode moves.
+
+Round 16 adds ``python -u bench_sweep.py attn_impl``: the
+attention-read implementation axis (reference ``lax.while_loop``
+chunked read vs the fused Pallas gather+dequant+online-softmax kernel)
+crossed with the KV-storage dtype over the same occupancy regimes.
 """
 from __future__ import annotations
 
@@ -210,6 +215,72 @@ def sweep_kv_dtype(iters=20, n_steps=8):
     return rows
 
 
+ATTN_IMPLS = [None, "pallas"]
+
+
+def sweep_attn_impl(iters=20, n_steps=8):
+    """Attention-read implementation sweep for the fused Pallas kernel:
+    per-step time of the compiled serving decode step at each
+    ``attn_impl`` (reference ``lax.while_loop`` chunked read vs the
+    fused gather+dequant+online-softmax kernel) crossed with the
+    KV-storage dtype, across the same low/high-occupancy regimes as the
+    decode-chunk sweep.  The fused x int8 cell is the headline: the
+    kernel keeps each KV chunk in one VMEM residency, so the dequant
+    multiplies that cost the reference path its in-loop bandwidth ride
+    for free."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama_decode import (
+        _decode_params_of, serving_decode_steps)
+    from paddle_tpu.ops.decode_attention import init_kv_cache
+
+    lmax, batch, chunk = 2048, 8, 256
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    params, key = _decode_params_of(model, lmax)
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    rng = np.random.default_rng(0)
+    cur = jnp.asarray(rng.integers(0, cfg.vocab_size, batch), jnp.int32)
+    regimes = {
+        "low_occ": jnp.asarray(rng.integers(96, 161, batch), jnp.int32),
+        "high_occ": jnp.asarray(rng.integers(1664, 1985, batch), jnp.int32),
+    }
+    rows = []
+    for regime, lengths in regimes.items():
+        for kvd in KV_DTYPES:
+            for impl in ATTN_IMPLS:
+                caches = [init_kv_cache(batch, lmax, nkv, hd, kvd)
+                          for _ in range(cfg.num_hidden_layers)]
+                kv_dtype = kvd if kvd == "int8" else None
+                toks, _, caches = serving_decode_steps(
+                    params, key, cur, caches, lengths,
+                    n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype,
+                    attn_impl=impl)
+                np.asarray(toks)  # compile + settle
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    toks, _, caches = serving_decode_steps(
+                        params, key, cur, caches, lengths,
+                        n_steps=n_steps, chunk_size=chunk,
+                        kv_dtype=kv_dtype, attn_impl=impl)
+                np.asarray(toks)
+                dt = (time.perf_counter() - t0) / (iters * n_steps)
+                label = "pallas" if impl == "pallas" else "reference"
+                rows.append({"variant": f"attn_impl_{regime}_{kvd}_{label}",
+                             "step_ms": round(dt * 1e3, 3),
+                             "tok_per_sec": round(batch / dt, 1)})
+                del caches
+                gc.collect()
+    return rows
+
+
 PREFILL_CHUNKS = [64, 128, 256, 512]
 PREFILL_BUDGETS = [1, 2, 4]
 
@@ -289,6 +360,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "kv_dtype":
         for rec in sweep_kv_dtype():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "attn_impl":
+        for rec in sweep_attn_impl():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

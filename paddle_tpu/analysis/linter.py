@@ -98,6 +98,13 @@ _RID_NAME_RE = re.compile(r"(^|_)(rid|rids|uuid|guid|request_id|req_id)"
 _ARRAY_WRAPPERS = {"numpy.asarray", "numpy.array", "numpy.stack",
                    "jax.numpy.asarray", "jax.numpy.array",
                    "jax.numpy.stack"}
+# interpret-mode pallas_call outside tests (PTL012): a LITERAL
+# interpret=True ships a host-emulated kernel (~100x slower) to
+# production; a computed value (interpret=interpret / a backend check)
+# is the sanctioned CPU-fallback idiom and never fires.  Matched through
+# the resolved import (pl.pallas_call, a from-import, a module alias)
+# and through functools.partial(pallas_call, ..., interpret=True).
+_PALLAS_CALL_LAST = "pallas_call"
 
 
 @dataclass
@@ -358,6 +365,12 @@ class _Checker:
         self.findings = []
         self.jit_stack = []           # [(JitInfo, traced_name_set)]
         self.loop_stack = []          # [_Loop] — outside jit bodies only
+        # PTL012 exempts test files: a tests/ path component or a
+        # test_-prefixed basename (hard-coded interpret=True is exactly
+        # how kernel tests pin the emulated path)
+        parts = path.replace("\\", "/").split("/")
+        self.in_tests = "tests" in parts or \
+            parts[-1].startswith("test_")
 
     def emit(self, rule, node, message):
         if rule in self.enabled:
@@ -621,7 +634,36 @@ class _Checker:
         else:
             self._call_in_host(node)
         self._call_site(node)
+        self._pallas_interpret(node)
         self.generic(node)
+
+    # PTL012: literal interpret=True on a pallas_call outside tests —
+    # fires in or out of jit bodies (the kernel launch may sit in either)
+    def _pallas_interpret(self, node):
+        if self.in_tests:
+            return
+        f = self.resolve(node.func)
+        last = f.split(".")[-1] if f else None
+        what = None
+        if last == _PALLAS_CALL_LAST:
+            what = "pallas_call"
+        elif last == "partial" and node.args:
+            inner = self.resolve(node.args[0])
+            if inner is not None and \
+                    inner.split(".")[-1] == _PALLAS_CALL_LAST:
+                what = "functools.partial(pallas_call, ...)"
+        if what is None:
+            return
+        for kw in node.keywords:
+            if kw.arg == "interpret" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True:
+                self.emit("PTL012", node,
+                          f"`{what}` with a literal `interpret=True` "
+                          "outside test files — interpret mode emulates "
+                          "the kernel on the host (~100x slower); gate it "
+                          "on the backend instead")
+                return
 
     def _call_in_jit(self, node):
         f = self.resolve(node.func)

@@ -157,6 +157,19 @@ _RULE_LIST = [
         "python literal, which stays weakly typed so the traced "
         "operand's precision wins",
     ),
+    Rule(
+        "PTL012", "interpret-mode-pallas-call", WARNING,
+        "pl.pallas_call(..., interpret=True) with a LITERAL True outside "
+        "test files (alias-resolved imports and functools.partial "
+        "wrapping included) — interpret mode runs the kernel as a python "
+        "emulation on the host, silently shipping a ~100x slower kernel "
+        "to the chip.  A computed value (interpret=interpret, "
+        "interpret=jax.default_backend() != 'tpu') is the sanctioned "
+        "CPU-fallback idiom and does not fire",
+        "gate interpret on the backend (interpret=jax.default_backend() "
+        "!= 'tpu') or thread it through as a parameter defaulting to "
+        "that; hard-code True only in tests",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

@@ -32,12 +32,14 @@ import jax.numpy as jnp
 
 from paddle_tpu.observability.compilecache import CompileCacheMonitor
 from paddle_tpu.ops.decode_attention import (
-    decode_attention, init_kv_cache, slot_prefill_attention,
+    _Q8_MAX, _Q8_SCALE_DTYPE, _canon_dtype, decode_attention, init_kv_cache,
+    slot_prefill_attention,
 )
 
 __all__ = ["extract_decode_params", "decode_greedy", "decode_speculative",
-           "serving_prefill_slot", "serving_prefill_chunk",
-           "serving_decode_steps", "serving_spec_step"]
+           "quantize_decode_weights", "serving_prefill_slot",
+           "serving_prefill_chunk", "serving_decode_steps",
+           "serving_spec_step"]
 
 # compile-cache visibility (paddle_tpu/observability): each jitted program
 # marks its traces from inside the traced body (host python there runs once
@@ -75,6 +77,79 @@ def extract_decode_params(model):
     return p
 
 
+# the decode matmul weights eligible for int8 quantization — every [in, out]
+# projection in the layer stack (attention + MLP).  Norm gains, the embedding
+# and lm_head stay in the checkpoint dtype: they are tiny, and the embedding
+# doubles as a gather table.
+_QUANT_WEIGHTS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+_WEIGHT_DTYPES = ("int8",)
+
+
+def _canon_weight_dtype(dtype, where):
+    """Validate a decode-weight quantization dtype -> canonical name (or
+    None for off) — the same loud-ValueError contract as ``_canon_kv_dtype``
+    via the shared ``_canon_dtype`` body."""
+    if dtype is None:
+        return None
+    return _canon_dtype(
+        dtype, where, _WEIGHT_DTYPES, "decode weight",
+        hint="  'int8' selects symmetric per-output-channel quantization "
+        "(float16 absmax scales in sibling '<name>_scale' leaves, "
+        "dequant-in-matmul); None keeps the checkpoint dtype.")
+
+
+def quantize_decode_weights(params, weight_dtype="int8"):
+    """Quantize the seven decode matmul weights to int8 with symmetric
+    per-OUTPUT-channel float16 absmax scales.
+
+    Returns a NEW params pytree (fresh top-level dict, fresh layers list,
+    fresh per-layer dicts — the input, typically the ``_decode_params_of``
+    model cache, is never mutated): each ``lp[name] [in, out]`` becomes an
+    int8 array of the same shape plus a sibling ``lp[name + "_scale"]``
+    float16 ``[out]`` vector.  Per-output-channel scales commute with the
+    Megatron sharding rules: a column-parallel weight (out axis sharded)
+    shards its scale the same way, a row-parallel weight (in axis sharded)
+    replicates its scale, and applying the scale AFTER the matmul
+    distributes over the row-parallel partial-sum reduction.  The matmul
+    itself (``_mm``) dequantizes by casting int8 straight into the
+    activation dtype — f32 holds ±127 exactly — and scaling the product,
+    so host-facing behavior changes only by the quantization error the
+    drift tests budget."""
+    if _canon_weight_dtype(weight_dtype, "quantize_decode_weights") is None:
+        return params
+
+    def quant(w):
+        wf = w.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(wf), axis=0)                   # [out]
+        scale = (amax / _Q8_MAX).astype(_Q8_SCALE_DTYPE)
+        inv = 1.0 / jnp.maximum(scale.astype(jnp.float32), 1e-8)
+        q = jnp.clip(jnp.round(wf * inv[None, :]), -_Q8_MAX, _Q8_MAX)
+        return q.astype(jnp.int8), scale
+
+    out = dict(params)
+    layers = []
+    for lp in params["layers"]:
+        nlp = dict(lp)
+        for name in _QUANT_WEIGHTS:
+            nlp[name], nlp[name + "_scale"] = quant(lp[name])
+        layers.append(nlp)
+    out["layers"] = layers
+    return out
+
+
+def _mm(x, lp, name):
+    """``x @ lp[name]`` with transparent dequant-in-matmul: when the layer
+    dict carries a sibling ``name + "_scale"`` leaf (quantize_decode_weights)
+    the int8 weight is cast into the activation dtype and the per-output-
+    channel scale is applied to the product.  A pytree-STRUCTURE branch, so
+    each program specializes at trace time (same idiom as ``_lm_logits``)."""
+    w = lp[name]
+    s = lp.get(name + "_scale")
+    if s is None:
+        return x @ w
+    return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
+
+
 def _rmsnorm(x, w, eps):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -103,26 +178,28 @@ def _rope_at(q, k, cos_t, sin_t, positions):
 
 
 def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
-                chunk_size=None, block_tables=None):
+                chunk_size=None, block_tables=None, attn_impl=None):
     """One decoder layer over T new tokens with the static cache.
     h [B, T, hidden] -> (h', k_cache', v_cache').  ``chunk_size`` (static)
     selects the length-adaptive chunked cache read in decode_attention;
     ``block_tables [B, W]`` (traced) switches the caches to the paged
-    pool geometry."""
+    pool geometry; ``attn_impl`` (static) selects the fused Pallas cache
+    read (ops/paged_attention_pallas.py) vs the reference chunked loop."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
-    q = (x @ lp["wq"]).reshape(b, t, nh, hd)
-    k = (x @ lp["wk"]).reshape(b, t, nkv, hd)
-    v = (x @ lp["wv"]).reshape(b, t, nkv, hd)
+    q = _mm(x, lp, "wq").reshape(b, t, nh, hd)
+    k = _mm(x, lp, "wk").reshape(b, t, nkv, hd)
+    v = _mm(x, lp, "wv").reshape(b, t, nkv, hd)
     positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache, _ = decode_attention(
         q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size,
-        block_table=block_tables)
-    h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
+        block_table=block_tables, attn_impl=attn_impl)
+    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo")
     x2 = _rmsnorm(h, lp["ln2"], eps)
-    h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
+    h = h + _mm(jax.nn.silu(_mm(x2, lp, "gate")) * _mm(x2, lp, "up"),
+                lp, "down")
     return h, k_cache, v_cache
 
 
@@ -136,7 +213,7 @@ def _lm_logits(params, h):
 
 
 def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
-             chunk_size=None, block_tables=None):
+             chunk_size=None, block_tables=None, attn_impl=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
@@ -152,7 +229,8 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
     for lp, (kc, vc) in zip(params["layers"], caches):
         h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t,
                                 chunk_size=chunk_size,
-                                block_tables=block_tables)
+                                block_tables=block_tables,
+                                attn_impl=attn_impl)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
     if last_idx is not None:
@@ -164,19 +242,21 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
 
 
 def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None,
-                  block_tables=None):
+                  block_tables=None, attn_impl=None):
     """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=True,
-                    chunk_size=chunk_size, block_tables=block_tables)
+                    chunk_size=chunk_size, block_tables=block_tables,
+                    attn_impl=attn_impl)
 
 
 def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None,
-                      block_tables=None):
+                      block_tables=None, attn_impl=None):
     """Logits for EVERY input position [B, T, V] — the verification pass
     of speculative decoding needs the target's next-token distribution
     after each drafted token."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=False,
-                    chunk_size=chunk_size, block_tables=block_tables)
+                    chunk_size=chunk_size, block_tables=block_tables,
+                    attn_impl=attn_impl)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -428,10 +508,20 @@ _spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
 # the cache PYTREE STRUCTURE already carries it, and the static arg exists
 # so the program identity states its quantization mode explicitly — one
 # extra program variant per engine, zero retraces past warmup.
+#
+# ``attn_impl`` (static, same four entry points) selects the cache-read
+# implementation — "pallas" routes decode_attention through the fused
+# kernel (ops/paged_attention_pallas.py), None/"reference" keeps the
+# bitwise chunked loop.  ``weight_dtype`` is the kv_dtype of the WEIGHT
+# axis: the params pytree structure already carries the quantization
+# (sibling "<name>_scale" leaves, quantize_decode_weights), so the static
+# arg is identity-only — the program key states its weight mode explicitly
+# instead of relying on treedef hashing alone.
 
 def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
                                hist=None, hist_len=None, with_hist=False,
-                               chunk_size=None, kv_dtype=None):
+                               chunk_size=None, kv_dtype=None,
+                               attn_impl=None, weight_dtype=None):
     """Admit ONE request: prefill its prompt, insert into the batch cache.
 
     ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
@@ -463,7 +553,7 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
     logits, mini, _ = _forward(
         params, cfg, tokens, mini, jnp.zeros((1,), jnp.int32),
         last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1),
-        chunk_size=chunk_size)
+        chunk_size=chunk_size, attn_impl=attn_impl)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [1]
     ok = jnp.all(jnp.isfinite(logits), axis=-1)                 # [1]
     slot = slot.astype(jnp.int32)
@@ -497,12 +587,14 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
 # shardings — one body, one ``mark_trace`` name, two placement strategies.
 serving_prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
     _serving_prefill_slot_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype",
+                     "attn_impl", "weight_dtype"),
     donate_argnames=("caches", "hist")))
 
 
 def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
-                         cos_t, sin_t, chunk_size=None, block_tables=None):
+                         cos_t, sin_t, chunk_size=None, block_tables=None,
+                         attn_impl=None):
     """One decoder layer over a [1, P] prompt chunk, writing/reading the
     SLOT'S rows of the shared batch cache (ops.slot_prefill_attention) —
     the chunked-prefill twin of ``_layer_step``, which operates on whole
@@ -510,24 +602,26 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
-    q = (x @ lp["wq"]).reshape(b, t, nh, hd)
-    k = (x @ lp["wk"]).reshape(b, t, nkv, hd)
-    v = (x @ lp["wv"]).reshape(b, t, nkv, hd)
+    q = _mm(x, lp, "wq").reshape(b, t, nh, hd)
+    k = _mm(x, lp, "wk").reshape(b, t, nkv, hd)
+    v = _mm(x, lp, "wv").reshape(b, t, nkv, hd)
     positions = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache = slot_prefill_attention(
         q, k, v, k_cache, v_cache, slot, offset, chunk_size=chunk_size,
-        block_table=block_tables)
-    h = h + out.reshape(b, t, nh * hd) @ lp["wo"]
+        block_table=block_tables, attn_impl=attn_impl)
+    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo")
     x2 = _rmsnorm(h, lp["ln2"], eps)
-    h = h + (jax.nn.silu(x2 @ lp["gate"]) * (x2 @ lp["up"])) @ lp["down"]
+    h = h + _mm(jax.nn.silu(_mm(x2, lp, "gate")) * _mm(x2, lp, "up"),
+                lp, "down")
     return h, k_cache, v_cache
 
 
 def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
                                 caches, slot, hist=None, hist_len=None,
                                 with_hist=False, chunk_size=None,
-                                block_tables=None, kv_dtype=None):
+                                block_tables=None, kv_dtype=None,
+                                attn_impl=None, weight_dtype=None):
     """Process the next ``[1, P]`` chunk of an admitted prompt against the
     slot's rows of the batch cache — ONE compiled program for every prompt
     length (``P`` is the only shape; ``offset``, ``prompt_len`` and
@@ -573,7 +667,8 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
     for lp, (kc, vc) in zip(params["layers"], caches):
         h, kc, vc = _layer_prefill_chunk(lp, cfg, h, kc, vc, slot, offset,
                                          cos_t, sin_t, chunk_size=chunk_size,
-                                         block_tables=block_tables)
+                                         block_tables=block_tables,
+                                         attn_impl=attn_impl)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], eps)
     last_rel = jnp.clip(prompt_len - 1 - offset, 0, t - 1)  # [1]
@@ -601,13 +696,15 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
 
 serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
     _serving_prefill_chunk_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype",
+                     "attn_impl", "weight_dtype"),
     donate_argnames=("caches", "hist")))
 
 
 def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
                                n_steps=1, chunk_size=None,
-                               block_tables=None, kv_dtype=None):
+                               block_tables=None, kv_dtype=None,
+                               attn_impl=None, weight_dtype=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
@@ -626,7 +723,8 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
         tok, ok, caches, lengths = carry
         logits, caches, lengths = _forward_step(
             params, cfg, tok[:, None], caches, lengths,
-            chunk_size=chunk_size, block_tables=block_tables)
+            chunk_size=chunk_size, block_tables=block_tables,
+            attn_impl=attn_impl)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
         return (nxt, ok, caches, lengths), nxt
@@ -640,13 +738,15 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
 
 serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
     _serving_decode_steps_impl,
-    static_argnames=("cfg", "n_steps", "chunk_size", "kv_dtype"),
+    static_argnames=("cfg", "n_steps", "chunk_size", "kv_dtype",
+                     "attn_impl", "weight_dtype"),
     donate_argnames=("caches",)))
 
 
 def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
                             hist_len, active, spec_k=4, chunk_size=None,
-                            block_tables=None, kv_dtype=None):
+                            block_tables=None, kv_dtype=None,
+                            attn_impl=None, weight_dtype=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -672,7 +772,7 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
     logits, caches, _ = _forward_step_all(
         params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size,
-        block_tables=block_tables)
+        block_tables=block_tables, attn_impl=attn_impl)
     ok = jnp.all(jnp.isfinite(logits), axis=(-2, -1))        # [B]
     # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
     # out IS the accepted-prefix block for this round
@@ -693,7 +793,8 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
 
 serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(
     _serving_spec_step_impl,
-    static_argnames=("cfg", "spec_k", "chunk_size", "kv_dtype")))
+    static_argnames=("cfg", "spec_k", "chunk_size", "kv_dtype",
+                     "attn_impl", "weight_dtype")))
 
 
 def _decode_params_of(model, lmax):

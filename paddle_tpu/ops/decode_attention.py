@@ -98,19 +98,35 @@ _Q8_MAX = 127.0
 _Q8_SCALE_DTYPE = jnp.float16
 
 
-def _canon_kv_dtype(dtype, where):
-    """Validate a cache dtype against the supported set -> canonical name."""
+def _canon_dtype(dtype, where, supported, what, hint=""):
+    """THE dtype-validation helper: canonicalize ``dtype`` against a
+    supported-name set or raise a loud ValueError naming the set.
+
+    ``init_kv_cache`` / ``init_kv_pool`` / the engine's ``kv_dtype`` knob
+    share it via ``_canon_kv_dtype``, and the weight-quantization knob
+    (models/llama_decode.py ``_canon_weight_dtype``) rides the same body —
+    one canonical validation path instead of per-knob copies, so every
+    storage-dtype typo fails the same way: at construction, with the
+    supported set spelled out, never as an opaque dtype error deep inside
+    the first compiled step."""
     try:
         name = jnp.dtype(dtype).name
     except TypeError:
         name = None
-    if name not in _KV_DTYPES:
+    if name not in supported:
         raise ValueError(
-            f"{where}: unsupported KV cache dtype {dtype!r} — supported: "
-            f"{', '.join(_KV_DTYPES)}.  'int8' selects the quantized cache "
-            "(per-(position, head) float16 scales stored in a parallel "
-            "pytree leaf, quantize-on-append / dequant-in-loop).")
+            f"{where}: unsupported {what} dtype {dtype!r} — supported: "
+            f"{', '.join(supported)}.{hint}")
     return name
+
+
+def _canon_kv_dtype(dtype, where):
+    """Validate a cache dtype against the supported set -> canonical name."""
+    return _canon_dtype(
+        dtype, where, _KV_DTYPES, "KV cache",
+        hint="  'int8' selects the quantized cache "
+        "(per-(position, head) float16 scales stored in a parallel "
+        "pytree leaf, quantize-on-append / dequant-in-loop).")
 
 
 def _kv_data(cache):
@@ -433,11 +449,51 @@ def _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
     return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
+def _attend_dispatch(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
+                     attn_bias, chunk_size, lmax, block_table, attn_impl,
+                     where):
+    """Select the attention-read implementation for one attend.
+
+    ``attn_impl`` (static): ``None`` / ``"reference"`` keep the existing
+    dispatch — chunked ``lax.while_loop`` or fused full read — BITWISE
+    unchanged; ``"pallas"`` selects the fused Pallas kernel
+    (ops/paged_attention_pallas.py) when the geometry supports it and
+    falls back to the reference path with a once-per-process log when it
+    does not (a silent downgrade would ship while_loop speed under the
+    fused flag)."""
+    if attn_impl not in (None, "reference", "pallas"):
+        raise ValueError(
+            f"{where}: unknown attn_impl {attn_impl!r} — supported: "
+            "'reference' (the lax.while_loop chunked read, the default), "
+            "'pallas' (the fused paged-attention kernel, reference "
+            "fallback on unsupported geometry)")
+    if attn_impl == "pallas":
+        from paddle_tpu.ops.paged_attention_pallas import (
+            fused_decode_attention, fused_supported, warn_fallback,
+        )
+        reason = fused_supported(layout, attn_bias, chunk_size, lmax)
+        if reason is None:
+            return fused_decode_attention(
+                qg, k_cache, v_cache, lengths, scale, int(chunk_size),
+                block_table=block_table)
+        warn_fallback(where, reason)
+    if block_table is not None:
+        return _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
+                               layout, attn_bias, int(chunk_size),
+                               block_table)
+    if chunk_size is not None and int(chunk_size) < lmax:
+        return _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
+                               layout, attn_bias, int(chunk_size))
+    return _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale,
+                        layout, attn_bias)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "layout", "chunk_size"))
+                   static_argnames=("scale", "layout", "chunk_size",
+                                    "attn_impl"))
 def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
                      layout="blhd", attn_bias=None, chunk_size=None,
-                     block_table=None):
+                     block_table=None, attn_impl=None):
     """One decode step: append new kv, attend causally over the cache.
 
     q [B, T, H, D] (T = tokens this step, usually 1); k_new/v_new
@@ -464,6 +520,12 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     Query token t (global position lengths+t) attends to cache positions
     <= lengths+t: bottom-right-aligned causality, same convention as the
     flash kernels' cached prefill.
+
+    ``attn_impl`` (static): ``None``/``"reference"`` keep the existing
+    read paths bitwise unchanged; ``"pallas"`` fuses gather + dequant +
+    online softmax into one VMEM residency per KV chunk
+    (ops/paged_attention_pallas.py) with reference fallback on
+    unsupported geometry (logged once per process).
     """
     b, t, h, d = q.shape
     hkv = k_new.shape[2]
@@ -496,22 +558,16 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
         .astype(jnp.float32)                                # [B,Hkv,G,T,D]
     q_pos = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
-    if block_table is not None:
-        out = _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
-                              layout, attn_bias, int(chunk_size),
-                              block_table)
-    elif chunk_size is not None and int(chunk_size) < lmax:
-        out = _attend_chunked(qg, k_cache, v_cache, lengths, q_pos, scale,
-                              layout, attn_bias, int(chunk_size))
-    else:
-        out = _attend_full(qg, k_cache, v_cache, lengths, q_pos, scale,
-                           layout, attn_bias)
+    out = _attend_dispatch(qg, k_cache, v_cache, lengths, q_pos, scale,
+                           layout, attn_bias, chunk_size, lmax, block_table,
+                           attn_impl, "decode_attention")
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d).astype(q.dtype)
     return out, k_cache, v_cache, lengths + t
 
 
 def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
-                           scale=None, chunk_size=None, block_table=None):
+                           scale=None, chunk_size=None, block_table=None,
+                           attn_impl=None):
     """Chunked-prefill attention for ONE slot of the batch cache.
 
     The serving engine's chunked admission path processes a prompt in
@@ -577,8 +633,10 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         qg = q.reshape(1, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
             .astype(jnp.float32)
         q_pos = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-        out = _attend_chunked(qg, k_cache, v_cache, offset[None], q_pos,
-                              scale, "blhd", None, int(chunk_size), trow)
+        out = _attend_dispatch(qg, k_cache, v_cache, offset[None], q_pos,
+                               scale, "blhd", None, int(chunk_size),
+                               w * blk, trow, attn_impl,
+                               "slot_prefill_attention")
         out = out.transpose(0, 3, 1, 2, 4).reshape(1, t, h, d) \
             .astype(q.dtype)
         return out, k_cache, v_cache
@@ -620,10 +678,8 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         .astype(jnp.float32)                                # [1,Hkv,G,T,D]
     q_pos = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     lengths = offset[None]                                  # [1]
-    if chunk_size is not None and int(chunk_size) < lmax:
-        out = _attend_chunked(qg, ks, vs, lengths, q_pos, scale, "blhd",
-                              None, int(chunk_size))
-    else:
-        out = _attend_full(qg, ks, vs, lengths, q_pos, scale, "blhd", None)
+    out = _attend_dispatch(qg, ks, vs, lengths, q_pos, scale, "blhd", None,
+                           chunk_size, lmax, None, attn_impl,
+                           "slot_prefill_attention")
     out = out.transpose(0, 3, 1, 2, 4).reshape(1, t, h, d).astype(q.dtype)
     return out, k_cache, v_cache

@@ -1,0 +1,261 @@
+"""Fused Pallas paged-attention kernel for the decode hot path.
+
+The reference chunked decode read (ops/decode_attention.py:_attend_chunked)
+is a ``lax.while_loop`` of gather -> dequant -> online-softmax stages that
+XLA schedules as separate HBM round-trips: each chunk's int8 block is
+gathered to HBM-resident f32, re-read by the score einsum, and the partial
+softmax state bounces through registers between loop-carried arrays.  This
+module fuses the whole read into ONE Pallas kernel per (batch row, kv
+head), vLLM-PagedAttention-style:
+
+* **Single VMEM residency per KV chunk.**  Grid ``(B, Hkv, n_chunks)``
+  with the chunk axis minor: each program receives one ``[C, D]`` K tile
+  and one V tile straight from HBM into VMEM, dequantizes int8 in-place
+  (the f32 values never exist in HBM), scores against the resident
+  ``[G*T, D]`` query tile and folds the result into the flash-style
+  running (max, denominator, accumulator) carried in VMEM scratch across
+  the chunk sweep — exactly the streamed layout of
+  ops/flash_attention.py's ``_fwd_kernel_streamed``.
+* **The block-table gather IS the index map.**  Paged mode prefetches the
+  ``[B, W]`` block table as a scalar operand
+  (``pltpu.PrefetchScalarGridSpec``): logical chunk ``i`` of row ``b``
+  loads pool block ``clip(table[b, i], 0, N-1)`` directly — no gathered
+  copy of the chunk is ever materialized.  The clip reproduces the
+  reference's ``mode="clip"`` semantics: a sentinel (``>= N``) or stale
+  entry reads an arbitrary REAL block whose rows the causal mask zeroes,
+  never a NaN-filling OOB default.
+* **Reference-exact masking.**  Per row, chunk ``i`` is live for key
+  position ``k_idx <= q_pos`` with masked lanes explicitly zeroed after
+  the exp (``p = where(live, exp(s - m_new), 0)``) — the same
+  fully-masked-chunk pollution guard as the reference.  Slots parked by
+  ``masked_lengths`` (offset ``>= lmax``) pass the causal test everywhere
+  and come back as finite garbage the scheduler ignores, exactly like the
+  reference rows.
+* **Per-row adaptive compute.**  ``lengths`` rides the scalar prefetch
+  too: a chunk past ``ceil((eff + T) / C)`` for its row (``eff = 0`` for
+  parked slots — the reference's trip-count exclusion) skips its compute
+  entirely via ``pl.when``, so MXU work tracks each row's real context.
+* **CPU = interpret mode.**  ``interpret`` defaults to
+  ``jax.default_backend() != "tpu"`` so the parity suite runs the same
+  kernel logic on the virtual-device CPU platform; the flag is never the
+  literal ``True`` in product code (tpu-lint PTL012 polices exactly that
+  — interpret mode silently ships a ~100x slower kernel).
+
+Geometry the kernel does NOT cover falls back to the bitwise reference
+path: ``fused_supported`` returns the reason and ``warn_fallback`` logs
+it once per process (a silent fallback would ship while_loop speed under
+an ``attn_impl="pallas"`` flag).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_decode_attention", "fused_supported", "warn_fallback"]
+
+_NEG_INF = -1e30
+
+_LOG = logging.getLogger(__name__)
+# once-per-process fallback log: (where, reason) pairs already warned.
+# Serving dispatches thousands of steps through one traced program — the
+# fallback decision happens at trace time, but a per-trace log line would
+# still spam every warmup; dedup makes the downgrade loud exactly once.
+_warned = set()
+
+
+def fused_supported(layout, attn_bias, chunk_size, lmax):
+    """Geometry gate for the fused kernel: ``None`` when supported, else
+    a human-readable reason string (the fallback log line).
+
+    The kernel covers the serving hot path — ``blhd`` caches (dense or
+    paged), no additive bias, a chunked read whose chunk divides the
+    logical span (uniform Pallas blocks; the reference's clamped-tail
+    re-read has no block-uniform equivalent).  Everything else is the
+    reference ``lax.while_loop``'s job.
+    """
+    if layout != "blhd":
+        return f"layout {layout!r} (only 'blhd' is fused)"
+    if attn_bias is not None:
+        return "attn_bias is not fused"
+    if chunk_size is None:
+        return "chunk_size=None selects the single full-length read"
+    if int(chunk_size) > lmax or lmax % int(chunk_size):
+        return (f"chunk_size ({int(chunk_size)}) must divide the cache "
+                f"span ({lmax}) for uniform kernel blocks")
+    return None
+
+
+def warn_fallback(where, reason):
+    """Log the fused->reference downgrade once per process per reason."""
+    key = (where, reason)
+    if key not in _warned:
+        _warned.add(key)
+        _LOG.warning(
+            "%s: attn_impl='pallas' requested but unsupported — %s; "
+            "falling back to the reference chunked read (bitwise the "
+            "attn_impl=None path, logged once per process)", where, reason)
+
+
+def _fused_kernel(*refs, chunk, lmax, t, group, scale, quant, paged):
+    """One (batch row, kv head, chunk) step of the fused online softmax.
+
+    refs (scalar-prefetch first, per PrefetchScalarGridSpec): lengths
+    [B] (+ the [B, W] block table when paged, consumed by the index maps
+    only), then q [1, 1, G*T, D], k/v chunk tiles [1, C, 1, D] (+ their
+    [1, C, 1] f16 scale tiles when quant), the output block
+    [1, 1, G*T, D], and VMEM scratch acc [G*T, D] / m, l [8, G*T]
+    (sublane-replicated running state, the flash_attention idiom).
+    """
+    if paged:
+        len_ref, _tbl_ref, *refs = refs
+    else:
+        len_ref, *refs = refs
+    if quant:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    n_chunks = pl.num_programs(2)
+    rows = group * t
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    # the reference trip count, per ROW instead of per batch: parked slots
+    # (offset >= lmax) contribute eff = 0, so chunks past a row's live
+    # span skip their MXU work (chunk 0 always runs: eff + t >= 1)
+    eff = jnp.where(length < lmax, length, 0)
+    work = i * chunk < eff + t
+
+    @pl.when(work)
+    def _compute():
+        q = q_ref[0, 0]                                     # [G*T, D] f32
+        k = k_ref[0, :, 0, :].astype(jnp.float32)           # [C, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            # int8 dequant in VMEM: the f32 chunk never touches HBM
+            k = k * ks_ref[0, :, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, :, 0].astype(jnp.float32)[:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [G*T, C]
+        # row r of the [G, T] query tile is step token r % t
+        q_pos = length + jax.lax.broadcasted_iota(
+            jnp.int32, (group, t), 1).reshape(rows)
+        k_idx = i * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, chunk), 1)
+        live = k_idx <= q_pos[:, None]
+        s = jnp.where(live, s, _NEG_INF)
+        m = m_ref[0]
+        l = l_ref[0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # explicit zero on masked lanes — the online-softmax pollution
+        # guard the reference carries (a fully-masked row has
+        # s == m_new == _NEG_INF and exp(0) == 1 otherwise)
+        p = jnp.where(live, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n_chunks - 1)
+    def _fin():
+        l_safe = jnp.maximum(l_ref[0], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def fused_decode_attention(qg, k_cache, v_cache, lengths, scale, chunk,
+                           block_table=None, interpret=None):
+    """Fused drop-in for the reference ``_attend_chunked`` (blhd, no bias).
+
+    qg ``[B, Hkv, G, T, D]`` f32 queries (the reference's grouped layout);
+    caches dense ``[B, Lmax, Hkv, D]`` or — with ``block_table [B, W]`` —
+    a paged pool ``[N, C, Hkv, D]``; int8 caches are ``(data, scale)``
+    pairs dequantized in-kernel.  ``lengths [B]`` are the PRE-append
+    lengths (parked slots at ``>= lmax``).  Returns ``[B, Hkv, G, T, D]``
+    f32 — same contract as the reference read, numerically equal up to
+    dot-product reassociation (the parity matrix pins the drift budget).
+    ``interpret=None`` resolves to ``jax.default_backend() != "tpu"``.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hkv, g, t, d = qg.shape
+    c = int(chunk)
+    quant = isinstance(k_cache, tuple)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    paged = block_table is not None
+    if paged:
+        n_chunks = int(block_table.shape[1])
+        lmax = n_chunks * c
+        n_blocks = int((k_cache[0] if quant else k_cache).shape[0])
+    else:
+        lmax = int((k_cache[0] if quant else k_cache).shape[1])
+        n_chunks = lmax // c
+    gt = g * t
+    q2 = qg.reshape(b, hkv, gt, d).astype(jnp.float32)
+    lengths = lengths.astype(jnp.int32)
+
+    # index maps receive (b, h, i, *scalar_refs); constant dims use
+    # ``i * 0`` so the index dtype stays i32 under jax_enable_x64 (the
+    # flash_attention.py Mosaic idiom)
+    if paged:
+        scalars = (lengths, block_table.astype(jnp.int32))
+
+        def blk(tbl, bi, ci):
+            # the reference gather's mode="clip": sentinel/stale entries
+            # read a real pool block, the causal mask discards its rows
+            return jnp.clip(tbl[bi, ci], 0, n_blocks - 1)
+
+        q_idx = lambda bi, hi, ci, ln, tb: (bi, hi, ci * 0, ci * 0)
+        k_idx = lambda bi, hi, ci, ln, tb: (blk(tb, bi, ci), ci * 0, hi,
+                                            ci * 0)
+        s_idx = lambda bi, hi, ci, ln, tb: (blk(tb, bi, ci), ci * 0, hi)
+    else:
+        scalars = (lengths,)
+        q_idx = lambda bi, hi, ci, ln: (bi, hi, ci * 0, ci * 0)
+        k_idx = lambda bi, hi, ci, ln: (bi, ci, hi, ci * 0)
+        s_idx = lambda bi, hi, ci, ln: (bi, ci, hi)
+
+    kv_spec = pl.BlockSpec((1, c, 1, d), k_idx)
+    sc_spec = pl.BlockSpec((1, c, 1), s_idx)
+    in_specs = [pl.BlockSpec((1, 1, gt, d), q_idx)]
+    args = [q2]
+    if quant:
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+        args += [k_cache[0], k_cache[1], v_cache[0], v_cache[1]]
+    else:
+        in_specs += [kv_spec, kv_spec]
+        args += [k_cache, v_cache]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _fused_kernel, chunk=c, lmax=lmax, t=t, group=g,
+            scale=float(scale), quant=quant, paged=paged),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(scalars),
+            grid=(b, hkv, n_chunks),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, gt, d), q_idx),
+            scratch_shapes=[
+                pltpu.VMEM((gt, d), jnp.float32),
+                pltpu.VMEM((8, gt), jnp.float32),
+                pltpu.VMEM((8, gt), jnp.float32),
+            ]),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gt, d), jnp.float32),
+        interpret=interpret,
+    )(*scalars, *args)
+    return out.reshape(b, hkv, g, t, d)

@@ -101,8 +101,9 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.models.llama_decode import (
-    _decode_params_of, serving_decode_steps, serving_prefill_chunk,
-    serving_prefill_slot, serving_spec_step,
+    _canon_weight_dtype, _decode_params_of, quantize_decode_weights,
+    serving_decode_steps, serving_prefill_chunk, serving_prefill_slot,
+    serving_spec_step,
 )
 from paddle_tpu.observability.flightrecorder import (
     FlightRecorder, RequestTrace,
@@ -376,7 +377,7 @@ class ServingEngine:
                  max_live_tokens=None, kv_dtype=None, mesh=None,
                  tp_axis="mp", max_pending=None, retry_attempts=3,
                  retry_backoff=0.05, faults=None, recorder=True,
-                 slo=None):
+                 slo=None, attn_impl=None, weight_dtype=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -469,6 +470,34 @@ class ServingEngine:
                           if kv_dtype is not None else None)
         self._q8 = self._kv_dtype == "int8"
         self._kvq = "int8" if self._q8 else "off"
+        # attn_impl: cache-READ implementation.  None/"reference" keeps the
+        # chunked lax.while_loop (bitwise the pre-kernel engine — like
+        # kv_dtype=None it never enters the program identity as non-None);
+        # "pallas" routes decode_attention through the fused Pallas kernel
+        # (ops/paged_attention_pallas.py) — gather + dequant + online
+        # softmax in one VMEM residency, interpret mode off-TPU.
+        if attn_impl not in (None, "reference", "pallas"):
+            raise ValueError(
+                f"ServingEngine: unknown attn_impl {attn_impl!r} — "
+                "supported: None (reference), 'reference', 'pallas' "
+                "(fused kernel, falls back per-call when the geometry "
+                "is unsupported)")
+        self._attn_impl = attn_impl
+        self._attn_label = "fused" if attn_impl == "pallas" else "reference"
+        # weight_dtype: decode matmul WEIGHT storage.  "int8" swaps the
+        # seven projection weights for symmetric per-output-channel
+        # quantized copies with f16 scales (quantize_decode_weights) —
+        # dequant-in-matmul keeps the host-facing API unchanged.
+        self._weight_dtype = _canon_weight_dtype(weight_dtype,
+                                                 "ServingEngine")
+        self._w8 = self._weight_dtype == "int8"
+        self._wq_label = "int8" if self._w8 else "off"
+        if self._w8:
+            # quantize AFTER the model cache handed us its pytree (a fresh
+            # dict — the cache entry itself is never mutated) and BEFORE
+            # any mesh placement so the int8 leaves shard directly
+            self._params = quantize_decode_weights(
+                self._params, self._weight_dtype)
         dtype = (self._kv_dtype if self._kv_dtype is not None
                  else self._params["embed"].dtype)
         # mesh=None: single-device engine, module-level jitted programs,
@@ -496,7 +525,8 @@ class ServingEngine:
                 len(self._params["layers"]), sync_every=self._sync,
                 spec_k=self._spec_k, with_hist=mode == "spec",
                 chunk_size=self._chunk, paged=self._paged,
-                kv_dtype=self._kv_dtype)
+                kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
+                weight_dtype=self._weight_dtype)
             cache_sharding = self._tp.cache_sharding
             scale_sharding = self._tp.scale_sharding
         if self._paged:
@@ -514,6 +544,8 @@ class ServingEngine:
                 scale_sharding=scale_sharding)
         if self._m is not None:
             self._m.set_kv_quant(self._kvq)
+            self._m.set_decode_kernel(self._attn_label)
+            self._m.set_weight_quant(self._wq_label)
             if self._q8:
                 # analytic per-context-token KV traffic at int8: 1 data
                 # byte per (head, dim) element + 2 f16 scale bytes per
@@ -521,6 +553,16 @@ class ServingEngine:
                 n_layers = len(self._params["layers"])
                 self._m.hbm_gb_per_tok_q8.set(
                     n_layers * 2 * nkv * (hd + 2) / 1e9)
+            if self._w8:
+                # analytic per-decode-token WEIGHT traffic at int8: every
+                # projection element is read once per token — 1 byte of
+                # data plus 2 f16 scale bytes per output channel (global
+                # .size, placement-independent)
+                wbytes = sum(
+                    lp[n].size + 2 * lp[n + "_scale"].size
+                    for lp in self._params["layers"]
+                    for n in ("wq", "wk", "wv", "wo", "gate", "up", "down"))
+                self._m.hbm_gb_per_tok_w8.set(wbytes / 1e9)
         # paged decode-time row growth is capped per slot by the token
         # budget reserved at admission (prompt + max_new + headroom,
         # clamped to lmax) — the mirror _spend/_dispatch draw ensure_rows
@@ -925,7 +967,8 @@ class ServingEngine:
             self._params, self._cfg, cur, self._kv.caches, dev_len,
             n_steps=self._sync, chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype)
+            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
+            weight_dtype=self._weight_dtype)
 
     def _call_spec(self, cur, dev_len, active):
         if self._tp is not None:
@@ -942,7 +985,8 @@ class ServingEngine:
             self._hist, self._hist_len, active, spec_k=self._spec_k,
             chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype)
+            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
+            weight_dtype=self._weight_dtype)
 
     def _call_prefill_slot(self, tokens, prompt_len, slot):
         if self._tp is not None:
@@ -953,7 +997,8 @@ class ServingEngine:
             self._params, self._cfg, tokens, prompt_len, self._kv.caches,
             slot, hist=self._hist, hist_len=self._hist_len,
             with_hist=self._mode == "spec", chunk_size=self._chunk,
-            kv_dtype=self._kv_dtype)
+            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
+            weight_dtype=self._weight_dtype)
 
     def _call_prefill_chunk(self, tokens, offset, prompt_len, slot):
         if self._tp is not None:
@@ -972,7 +1017,8 @@ class ServingEngine:
             hist_len=self._hist_len, with_hist=self._mode == "spec",
             chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype)
+            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
+            weight_dtype=self._weight_dtype)
 
     def _admit(self):
         free = self._kv.free_slots()
@@ -1345,7 +1391,9 @@ class ServingEngine:
         if self._fr is not None:
             self._fr.record("dispatch", step=self._step_idx,
                             mode=self._mode, n_live=len(live),
-                            kv_quant=self._kvq)
+                            kv_quant=self._kvq,
+                            attn_impl=self._attn_label,
+                            weight_dtype=self._wq_label)
         if self._mode == "greedy":
             def go(attempt):
                 self._fault_point("dispatch", attempt)
@@ -1415,7 +1463,9 @@ class ServingEngine:
         if self._fr is not None:
             self._fr.record("dispatch", step=self._step_idx,
                             mode=self._mode, n_live=len(live),
-                            pipelined=True, kv_quant=self._kvq)
+                            pipelined=True, kv_quant=self._kvq,
+                            attn_impl=self._attn_label,
+                            weight_dtype=self._wq_label)
         active = np.array([self._decodable(i) for i in range(self._B)])
         host_len = self._kv.device_lengths(active)
         use_host = ~active

@@ -203,6 +203,34 @@ class EngineMetrics:
             "analytic KV bytes (GB) read per context token at int8 "
             "storage: layers * 2 * Hkv * (D + 2 scale bytes); zero when "
             "kv_dtype is unquantized", L).labels(**lbl)
+        # decode-kernel selection (attn_impl=) and weight quantization
+        # (weight_dtype=): the same info-gauge shape as kv_quant_mode —
+        # every known child pre-registered to 0 so a scrape always shows
+        # the full mode set, the active child set to 1 at construction —
+        # plus the analytic int8-weight traffic column the bench A/B
+        # pins against the bf16-weight baseline
+        self._decode_kernel = reg.gauge(
+            "serving_decode_kernel",
+            "decode cache-read implementation info gauge: 'fused' (the "
+            "Pallas gather+dequant+softmax kernel) or 'reference' (the "
+            "chunked lax.while_loop); the active child reads 1",
+            ("policy", "impl"))
+        for impl in ("reference", "fused"):
+            self._decode_kernel.labels(policy=policy, impl=impl).set(0)
+        self._weight_quant_mode = reg.gauge(
+            "serving_weight_quant_mode",
+            "decode matmul weight quantization mode info gauge: the "
+            "child whose mode label names the active storage scheme "
+            "reads 1, every other pre-registered child 0",
+            ("policy", "mode"))
+        for mode in ("off", "int8"):
+            self._weight_quant_mode.labels(policy=policy, mode=mode).set(0)
+        self.hbm_gb_per_tok_w8 = reg.gauge(
+            "serving_hbm_gb_per_tok_w8",
+            "analytic decode-weight bytes (GB) read per generated token "
+            "at int8 storage: every projection element once (1 byte) + "
+            "2 f16 scale bytes per output channel; zero when "
+            "weight_dtype is unquantized", L).labels(**lbl)
         self.span_step = span("serving.step", registry=reg,
                               mesh=mesh_label)
         self.span_prefill = span("serving.prefill", registry=reg,
@@ -220,6 +248,20 @@ class EngineMetrics:
         reads 1 after this — the engine calls it once at construction)."""
         for m in ("off", "int8"):
             self._kv_quant_mode.labels(policy=self._policy, mode=m).set(
+                1 if m == mode else 0)
+
+    def set_decode_kernel(self, impl):
+        """Point the decode-kernel info gauge at ``impl`` ('reference' or
+        'fused') — the engine calls it once at construction."""
+        for i in ("reference", "fused"):
+            self._decode_kernel.labels(policy=self._policy, impl=i).set(
+                1 if i == impl else 0)
+
+    def set_weight_quant(self, mode):
+        """Point the weight-quant info gauge at ``mode`` ('off' or
+        'int8') — the engine calls it once at construction."""
+        for m in ("off", "int8"):
+            self._weight_quant_mode.labels(policy=self._policy, mode=m).set(
                 1 if m == mode else 0)
 
     def stream_cb_error(self, etype):

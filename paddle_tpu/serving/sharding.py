@@ -108,6 +108,14 @@ def llama_tp_rules(axis="mp"):
     """Megatron-style tensor-parallel rules for the llama decode pytree
     (module docstring has the column/row-parallel rationale)."""
     return (
+        # int8 weight scales (quantize_decode_weights): a column-parallel
+        # weight's [out] scale shards with its output features; a
+        # row-parallel weight's scale multiplies the POST-psum product, so
+        # every chip needs the whole vector — replicate.  Listed first:
+        # the $-anchored weight rules below can never match "*_scale", but
+        # rule order documents the pairing.
+        (r"(^|/)(wq|wk|wv|gate|up)_scale$", PS(axis)),
+        (r"(^|/)(wo|down)_scale$", PS()),
         # column-parallel: split output features across the mesh
         (r"(^|/)(wq|wk|wv|gate|up)$", PS(None, axis)),
         # row-parallel: split input features; psum rejoins on the residual
@@ -190,7 +198,7 @@ class TPPrograms:
 
     def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
                  sync_every, spec_k, with_hist, chunk_size, paged=False,
-                 kv_dtype=None):
+                 kv_dtype=None, attn_impl=None, weight_dtype=None):
         repl = NamedSharding(mesh, PS())
         pshard = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), param_specs,
@@ -220,7 +228,8 @@ class TPPrograms:
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
                     n_steps=sync_every, chunk_size=chunk_size,
-                    block_tables=tables, kv_dtype=kv_dtype)
+                    block_tables=tables, kv_dtype=kv_dtype,
+                    attn_impl=attn_impl, weight_dtype=weight_dtype)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl, repl),
@@ -232,7 +241,8 @@ class TPPrograms:
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
                     active, spec_k=spec_k, chunk_size=chunk_size,
-                    block_tables=tables, kv_dtype=kv_dtype)
+                    block_tables=tables, kv_dtype=kv_dtype,
+                    attn_impl=attn_impl, weight_dtype=weight_dtype)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -258,7 +268,8 @@ class TPPrograms:
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
                     n_steps=sync_every, chunk_size=chunk_size,
-                    kv_dtype=kv_dtype)
+                    kv_dtype=kv_dtype, attn_impl=attn_impl,
+                    weight_dtype=weight_dtype)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl),
@@ -270,7 +281,8 @@ class TPPrograms:
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
                     active, spec_k=spec_k, chunk_size=chunk_size,
-                    kv_dtype=kv_dtype)
+                    kv_dtype=kv_dtype, attn_impl=attn_impl,
+                    weight_dtype=weight_dtype)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -283,7 +295,8 @@ class TPPrograms:
                 return _serving_prefill_chunk_impl(
                     params, cfg, tokens, offset, prompt_len, caches, slot,
                     hist=hist, hist_len=hist_len, with_hist=with_hist,
-                    chunk_size=chunk_size, kv_dtype=kv_dtype)
+                    chunk_size=chunk_size, kv_dtype=kv_dtype,
+                    attn_impl=attn_impl, weight_dtype=weight_dtype)
             self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
                 pchunk,
                 in_shardings=(pshard, repl, repl, repl, cshard, repl,
@@ -295,7 +308,8 @@ class TPPrograms:
             return _serving_prefill_slot_impl(
                 params, cfg, tokens, prompt_len, caches, slot,
                 hist=hist, hist_len=hist_len, with_hist=with_hist,
-                chunk_size=chunk_size, kv_dtype=kv_dtype)
+                chunk_size=chunk_size, kv_dtype=kv_dtype,
+                attn_impl=attn_impl, weight_dtype=weight_dtype)
         self.prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
             pslot,
             in_shardings=(pshard, repl, repl, cshard, repl, hshard, repl),
@@ -311,16 +325,19 @@ _PROGRAMS = {}
 
 def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
                         sync_every, spec_k, with_hist, chunk_size,
-                        paged=False, kv_dtype=None):
+                        paged=False, kv_dtype=None, attn_impl=None,
+                        weight_dtype=None):
     """Cached ``TPPrograms`` factory (see class docstring)."""
     leaves, treedef = jax.tree_util.tree_flatten(
         param_specs, is_leaf=lambda x: isinstance(x, PS))
     key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
-           sync_every, spec_k, with_hist, chunk_size, paged, kv_dtype)
+           sync_every, spec_k, with_hist, chunk_size, paged, kv_dtype,
+           attn_impl, weight_dtype)
     progs = _PROGRAMS.get(key)
     if progs is None:
         progs = _PROGRAMS[key] = TPPrograms(
             mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
             spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size,
-            paged=paged, kv_dtype=kv_dtype)
+            paged=paged, kv_dtype=kv_dtype, attn_impl=attn_impl,
+            weight_dtype=weight_dtype)
     return progs

@@ -644,6 +644,53 @@ class TestRuleFixtures:
                     return 0
         """) == []
 
+    # PTL012 — interpret-mode-pallas-call ------------------------------
+    def test_interpret_tp_literal(self):
+        # literal interpret=True outside tests ships a host-emulated
+        # kernel; resolved through the module alias
+        assert _rules("""
+            from jax.experimental import pallas as pl
+            def launch(kernel, grid):
+                return pl.pallas_call(kernel, grid=grid, interpret=True)
+        """) == ["PTL012"]
+
+    def test_interpret_tp_from_import_and_partial(self):
+        # a from-import alias and a functools.partial wrapping both
+        # resolve to pallas_call
+        assert _rules("""
+            import functools
+            from jax.experimental.pallas import pallas_call as launch_k
+            def a(kernel):
+                return launch_k(kernel, interpret=True)
+            def b(kernel):
+                return functools.partial(launch_k, kernel,
+                                         interpret=True)()
+        """) == ["PTL012", "PTL012"]
+
+    def test_interpret_tn_computed_value(self):
+        # the sanctioned CPU-fallback idiom: interpret gated on the
+        # backend (a computed value, not a literal)
+        assert _rules("""
+            import jax
+            from jax.experimental import pallas as pl
+            def launch(kernel, grid, interpret=None):
+                if interpret is None:
+                    interpret = jax.default_backend() != "tpu"
+                return pl.pallas_call(kernel, grid=grid,
+                                      interpret=interpret)
+        """) == []
+
+    def test_interpret_tn_test_file(self):
+        # test files pin the emulated path on purpose — both a tests/
+        # path component and a test_ basename are exempt
+        src = textwrap.dedent("""
+            from jax.experimental import pallas as pl
+            def launch(kernel):
+                return pl.pallas_call(kernel, interpret=True)
+        """)
+        for path in ("tests/helpers.py", "test_kernels.py"):
+            assert [f.rule for f in lint_source(src, path=path)] == []
+
     # rule filtering ----------------------------------------------------
     def test_rules_filter(self):
         src = textwrap.dedent("""
