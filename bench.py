@@ -830,6 +830,130 @@ def bench_serving_paged(n_requests=64, batch=8):
     }
 
 
+def bench_serving_router(n_requests=64, n_replicas=2, batch=8):
+    """Fleet router A/B (round 17, serving/router.Router): prefix-aware
+    vs round-robin placement over ``n_replicas`` paged replicas on a
+    multi-tenant workload — ``n_fam`` distinct prefix families (each a
+    long shared system prompt plus short unique suffixes), arrivals
+    interleaved across families the way real tenant traffic mixes.
+
+    The fleet hit rate is a PLACEMENT property: a family only reuses its
+    head's KV where it consistently lands.  Prefix-aware routing pins
+    each family to one replica (first request by least-backlog, the rest
+    via the router's radix mirror + engine probe), so only one head
+    prefill per family fleet-wide; round-robin splits every family
+    across all replicas and pays the head prefill ``n_replicas`` times
+    — ``serving_router_hit_rate_prefix`` must clear 0.74 while the
+    round-robin baseline sits below it, and the duplicated prefill work
+    shows up as ``serving_router_speedup`` (decode work is identical by
+    construction, so CPU-host speedups are modest; on chip the skipped
+    head prefills are whole attention ramps).
+
+    ``serving_preempt_recompute_ratio`` measures the suffix-cost
+    preemption claim on one replica: park a low-priority decode under a
+    high-priority arrival, then read resumed-suffix over resumed-total
+    tokens off the engine's own counters — well under 1.0 means a
+    preemption round-trip re-prefills only what the radix chain could
+    not keep.
+    """
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Replica, Request, Router, ServingEngine
+
+    small = os.environ.get("BENCH_SERVING_SMALL") == "1"
+    if small:
+        n_requests, batch, lmax, kvb = min(n_requests, 32), 4, 512, 64
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=688,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=2, max_position_embeddings=lmax,
+            dtype="float32",
+        )
+        o_lo, o_hi = 16, 33
+    else:
+        lmax, kvb = 2048, 256
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=lmax,
+            dtype="bfloat16",
+        )
+        o_lo, o_hi = 64, 129
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(17)
+    n_fam = 4
+    heads = [rng.integers(0, cfg.vocab_size, lmax // 2)
+             for _ in range(n_fam)]
+    sfx_lens = rng.integers(kvb // 4, kvb // 2 + 1, n_requests)
+    prompts = [np.concatenate([heads[k % n_fam],
+                               rng.integers(0, cfg.vocab_size, int(s))])
+               for k, s in enumerate(sfx_lens)]
+    olens = rng.integers(o_lo, o_hi, n_requests)
+    total_new = int(olens.sum())
+    # shuffled arrivals: tenant traffic interleaves, it doesn't arrive
+    # family-sorted (a sorted order would hand round-robin accidental
+    # family/replica alignment)
+    order = rng.permutation(n_requests)
+    geom = dict(batch_size=batch, max_len=lmax, sync_every=4,
+                decode_chunk=kvb, prefill_chunk=kvb,
+                prompt_buckets=[lmax // 8, lmax // 4, lmax // 2,
+                                3 * lmax // 4],
+                kv_block=kvb, max_live_tokens=batch * lmax,
+                instrument=False, recorder=False)
+
+    def mk_router(policy):
+        return Router([Replica(ServingEngine(model, **geom),
+                               name=f"rep{i}") for i in range(n_replicas)],
+                      policy=policy)
+
+    def run(router):
+        # prime each tenant's head wherever the policy places it (ongoing
+        # tenants, not cold start: the steady state placement is paid for)
+        for f in range(n_fam):
+            router.submit(Request(prompts[f], int(olens[f])))
+        router.run()
+        # the measured burst: every request, shuffled arrival order
+        for k in order:
+            router.submit(Request(prompts[k], int(olens[k])))
+        t0 = time.perf_counter()
+        router.run()
+        dt = time.perf_counter() - t0
+        hit = router.hit_rate()
+        router.close()
+        return dt, hit
+
+    run(mk_router("prefix"))            # warm the compiled programs
+    dt_prefix, hit_prefix = run(mk_router("prefix"))
+    dt_rr, hit_rr = run(mk_router("round_robin"))
+
+    # preemption cost on one replica: two low-priority decodes occupy
+    # both slots, a high-priority arrival preempts one, the victim
+    # resumes off its surviving radix chain
+    eng = ServingEngine(model, **{**geom, "batch_size": 2})
+    lows = [Request(p, 40) for p in prompts[:2]]
+    for r in lows:
+        eng.submit(r)
+    for _ in range(6):
+        eng.step()
+    eng.submit(Request(prompts[2], 8, priority=5))
+    eng.run()
+    s = eng.stats()
+    eng.close()
+
+    return {
+        "serving_router_replicas": n_replicas,
+        "serving_router_families": n_fam,
+        "serving_router_speedup": round(dt_rr / dt_prefix, 2),
+        "serving_router_tok_per_sec": round(total_new / dt_prefix, 1),
+        "serving_router_hit_rate_prefix": round(hit_prefix, 3),
+        "serving_router_hit_rate_round_robin": round(hit_rr, 3),
+        "serving_preempted": int(s["preempted"]),
+        "serving_preempt_recompute_ratio": round(
+            s["preempt_resume_suffix_tokens"]
+            / max(1, s["preempt_resume_total_tokens"]), 3),
+    }
+
+
 def bench_longseq(seqs=(16384, 32768), iters=3):
     """Long-context flash attention (VERDICT r4 next-round #7): causal
     fwd+bwd MFU of the streamed-KV Pallas kernels at 16k/32k tokens on one
@@ -1115,8 +1239,9 @@ def bench_collectives():
 def main():
     only = os.environ.get("BENCH_ONLY")  # e.g. "bench_serving": one table
     fns = (bench_resnet50, bench_bert, bench_moe, bench_decode,
-           bench_serving, bench_serving_paged, bench_longseq,
-           bench_llama_long, bench_eager, bench_collectives)
+           bench_serving, bench_serving_paged, bench_serving_router,
+           bench_longseq, bench_llama_long, bench_eager,
+           bench_collectives)
     if only:
         out = {}
         for fn in fns:

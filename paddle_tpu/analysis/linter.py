@@ -73,6 +73,23 @@ _SYNC_HELPERS = {"host_fetch", "_host_fetch"}
 # `from time import sleep as _backoff_sleep` alias resolves to
 # time.sleep and stays flagged.
 _WAIT_SANCTIONED = {"backoff_sleep", "_backoff_sleep"}
+# blocking calls inside `async def` bodies (PTL013): one blocked
+# coroutine stalls every request the event loop is serving.  time.sleep
+# and the sanctioned sync/wait helpers are resolved exactly like
+# PTL004/PTL008 — but here the helper IS the offense (the engine's
+# designed drain point is a deliberate block, which is precisely what
+# an async handler must never do inline).  The socket sets cover the
+# blocking module-level entry points and the blocking socket METHODS
+# (asyncio replaces them with streams / loop.sock_*); method matching
+# is by attribute name — these names are socket-specific enough that
+# a duck-typed `.recv()`/`.sendall()` on anything else blocks too.
+_ASYNC_BLOCKING_SOCKET = {
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "socket.getfqdn", "socket.socketpair",
+}
+_ASYNC_SOCKET_METHODS = {"accept", "recv", "recv_into", "recvfrom",
+                         "recvfrom_into", "sendall", "makefile"}
 # loops dispatching compiled per-iteration device work: decode/spec step
 # calls (`..._step`/`..._steps`) and the serving engine's chunked-prefill
 # dispatch loop (`serving_prefill_chunk` under `prefill_budget`) — a host
@@ -365,6 +382,7 @@ class _Checker:
         self.findings = []
         self.jit_stack = []           # [(JitInfo, traced_name_set)]
         self.loop_stack = []          # [_Loop] — outside jit bodies only
+        self.async_stack = []         # [(is_async_def, name)] — PTL013
         # PTL012 exempts test files: a tests/ path component or a
         # test_-prefixed basename (hard-coded interpret=True is exactly
         # how kernel tests pin the emulated path)
@@ -475,11 +493,18 @@ class _Checker:
                 shadow.add(node.args.vararg.arg)
             self.jit_stack.append((self.jit_stack[-1][0], outer - shadow))
             pushed = True
+        # PTL013 context: a nested plain `def` inside an async handler is
+        # NOT the event-loop thread (it runs wherever it's called), so
+        # the stack tracks the INNERMOST def's asyncness, not "any
+        # enclosing async def"
+        self.async_stack.append(
+            (isinstance(node, ast.AsyncFunctionDef), node.name))
         decorators = set(map(id, node.decorator_list))
         for child in ast.iter_child_nodes(node):
             if id(child) in decorators:
                 continue
             self.visit(child)
+        self.async_stack.pop()
         if pushed:
             self.jit_stack.pop()
 
@@ -632,10 +657,38 @@ class _Checker:
         if self.jit_stack:
             self._call_in_jit(node)
         else:
+            if self.async_stack and self.async_stack[-1][0]:
+                self._call_in_async(node)
             self._call_in_host(node)
         self._call_site(node)
         self._pallas_interpret(node)
         self.generic(node)
+
+    # PTL013: blocking calls on the event-loop thread
+    def _call_in_async(self, node):
+        f = self.resolve(node.func)
+        name = None
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        what = None
+        if f == "time.sleep":
+            what = "time.sleep()"
+        elif name in _SYNC_HELPERS and (
+                f is None or f.split(".")[-1] in _SYNC_HELPERS):
+            what = name + "() (a blocking device sync)"
+        elif f in _ASYNC_BLOCKING_SOCKET:
+            what = f + "()"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _ASYNC_SOCKET_METHODS:
+            what = "." + node.func.attr + "() (a blocking socket call)"
+        if what is not None:
+            self.emit("PTL013", node,
+                      f"`{what}` inside `async def "
+                      f"{self.async_stack[-1][1]}` blocks the event "
+                      "loop — every coroutine it serves stalls until "
+                      "the call returns")
 
     # PTL012: literal interpret=True on a pallas_call outside tests —
     # fires in or out of jit bodies (the kernel launch may sit in either)

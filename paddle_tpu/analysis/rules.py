@@ -170,6 +170,21 @@ _RULE_LIST = [
         "!= 'tpu') or thread it through as a parameter defaulting to "
         "that; hard-code True only in tests",
     ),
+    Rule(
+        "PTL013", "blocking-call-in-async-handler", WARNING,
+        "a blocking call inside an `async def` body — time.sleep, a "
+        "host_fetch/_host_fetch device sync (sanctioned in host step "
+        "loops by PTL004, but a blocking sync parks the whole event "
+        "loop here), a blocking socket-module entry point, or a "
+        "blocking socket method (accept/recv/sendall/...).  One "
+        "stalled coroutine freezes EVERY request the loop is serving — "
+        "the streaming front end's characteristic failure mode, and "
+        "invisible under light load",
+        "await asyncio.sleep(...) instead of time.sleep; hand device "
+        "syncs to the engine driver thread (run_in_executor / a "
+        "thread-safe handoff queue) and await the result; use asyncio "
+        "streams or loop.sock_* for socket I/O",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

@@ -3,13 +3,19 @@ scheduling) over the compiled static-cache decode path, plus the
 reliability layer around it: deadlines/cancellation, bounded-queue load
 shedding (``EngineOverloaded``), poison-request quarantine, dispatch
 retry with backoff, and the deterministic fault-injection harness
-(``FaultPlan``)."""
+(``FaultPlan``) — and the fleet traffic layer above it: the
+:class:`Replica` engine handle, the prefix-aware :class:`Router`, and
+the stdlib asyncio streaming :class:`ServingServer`."""
 from paddle_tpu.serving.engine import (
     EngineOverloaded, Request, ServingEngine,
 )
 from paddle_tpu.serving.faults import (
     FaultPlan, InjectedDispatchError, InjectedStreamCbError,
 )
+from paddle_tpu.serving.replica import Replica
+from paddle_tpu.serving.router import Router
+from paddle_tpu.serving.server import PRIORITY_CLASSES, ServingServer
 
 __all__ = ["EngineOverloaded", "FaultPlan", "InjectedDispatchError",
-           "InjectedStreamCbError", "Request", "ServingEngine"]
+           "InjectedStreamCbError", "PRIORITY_CLASSES", "Replica",
+           "Request", "Router", "ServingEngine", "ServingServer"]

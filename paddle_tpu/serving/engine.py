@@ -204,11 +204,22 @@ class Request:
     per chunk → ``decoding`` → terminal status) as a list of ``{"t",
     "phase", ...}`` dicts on the ``perf_counter`` clock — empty until
     the request is submitted.
+
+    ``priority`` (int, default 0, higher wins) orders admission and —
+    on paged engines — arms preemption: when a strictly higher-priority
+    request is queued and cannot be admitted, the engine parks the
+    lowest-priority resident slot (its emitted tokens survive on the
+    request; its KV chain survives EVICTABLE in the radix map) and
+    re-queues it.  The resumed request re-adopts its own prefix, so a
+    preemption round-trip costs one suffix prefill, not a recompute.
+    ``preempts`` counts how many times this request was parked.
+    All-default-priority traffic never preempts and admits in exact
+    FIFO order — byte-identical to the pre-priority engine.
     """
 
     def __init__(self, prompt_ids, max_new_tokens, eos_token_id=None,
                  stream_cb=None, rid=None, deadline_ms=None,
-                 slo_class=None):
+                 slo_class=None, priority=0):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt_ids.size == 0:
             raise ValueError("Request: empty prompt")
@@ -223,6 +234,9 @@ class Request:
         if self.deadline_ms is not None and self.deadline_ms < 0:
             raise ValueError("Request: deadline_ms must be >= 0")
         self.slo_class = None if slo_class is None else str(slo_class)
+        self.priority = int(priority)
+        self.preempts = 0
+        self._adm_ids = None      # tokens the last chunked admission prefilled
         self.output_ids = []
         self.text = ""
         self.done = False
@@ -623,6 +637,15 @@ class ServingEngine:
         self._retry_backoff = float(retry_backoff)
         self._faults = faults
         self._step_idx = -1
+        # fleet-facing host counters, maintained UNCONDITIONALLY (a
+        # router reads them through stats() even on instrument=False
+        # engines): paged prompt/reuse token totals (the fleet hit-rate
+        # ratio) and the preemption park/resume tallies
+        self._n_prompt_tokens = 0
+        self._n_reuse_tokens = 0
+        self._n_preempted = 0
+        self._n_resume_suffix = 0
+        self._n_resume_total = 0
 
     # ------------------------------------------------------------- scheduling
     @property
@@ -715,6 +738,92 @@ class ServingEngine:
         population the decode dispatch runs over.  Slots mid-prefill stay
         parked (masked_lengths) until their final chunk is dispatched."""
         return self._kv.reqs[i] is not None and i not in self._pf
+
+    # --------------------------------------------------- priority preemption
+    @staticmethod
+    def _admission_ids(r):
+        """The token sequence a (re-)admission must prefill: the prompt,
+        plus — for a request resuming after preemption — every token it
+        already emitted.  The emitted tokens' KV rows must exist before
+        decode continues, and the LAST emitted token's forward is exactly
+        what produces the next one, so re-admitting this sequence through
+        the ordinary chunked-prefill path continues the greedy stream
+        byte-identically."""
+        if not r.output_ids:
+            return r.prompt_ids
+        return np.concatenate(
+            [r.prompt_ids, np.asarray(r.output_ids, np.int32)])
+
+    def _preempt_slot(self, slot):
+        """Park ``slot``'s request mid-decode.  The tokens whose KV rows
+        are verified written — the prompt plus every emitted token but
+        the last (the last token's row is written by the NEXT dispatch,
+        which the park cancels) — are registered into the radix map, so
+        ``release`` parks that chain EVICTABLE instead of freeing it and
+        the resume admission re-adopts it for the cost of one suffix
+        prefill.  An inflight pipelined dispatch for this slot is
+        harmless by the same one-step-late invariant retirement rides:
+        its writes land only in blocks PAST the registered chain (freed,
+        and overwritten in device program order if reallocated) and its
+        drained tokens fail the request-identity check."""
+        r = self._kv.reqs[slot]
+        cached = self._admission_ids(r)[:-1]
+        self._kv.register_prefix(slot, cached)
+        self._kv.release(slot)
+        self._forget_slot(slot)
+        r.preempts += 1
+        r._adm_ids = None
+        self._n_preempted += 1
+        if r._trace is not None:
+            r._trace.mark("preempted", slot=slot)
+        if self._fr is not None:
+            self._fr.record("preempt", step=self._step_idx, rid=r.rid,
+                            slot=slot, cached_tokens=int(cached.size),
+                            n_out=len(r.output_ids))
+        self._queue.appendleft(r)
+        if self._m is not None:
+            self._m.preempted.inc()
+            self._m.queue_depth.set(len(self._queue))
+            self._m.slots_occupied.set(self._kv.occupied())
+            self._m.live_tokens.set(self._kv.live_tokens())
+
+    def _maybe_preempt(self):
+        """Park low-priority resident work when a strictly higher-priority
+        waiter is blocked (no free slot, or the block pool cannot cover
+        its worst case).  Victims go lowest priority first; within a
+        class the most recently submitted loses (old work keeps
+        finishing).  Paged continuous engines only — and a strict no-op
+        while every queued priority <= every resident priority, which is
+        what keeps all-default traffic byte-identical."""
+        if not self._paged or self._policy != "continuous" \
+                or not self._queue:
+            return
+        top = max(self._queue, key=lambda q: q.priority)
+        for _ in range(self._B):
+            victims = [
+                (i, self._kv.reqs[i]) for i in range(self._B)
+                if self._kv.reqs[i] is not None and i not in self._pf
+                and self._kv.reqs[i].t_first is not None
+                and self._kv.reqs[i].priority < top.priority]
+            if not victims:
+                return
+            # is the head actually blocked?  mirror the admission math
+            # (worst-case rows minus the radix match, chunk-aligned)
+            tok = self._admission_ids(top)
+            C, P = self._kv.block, self._pchunk
+            p = int(tok.size)
+            rem = max(1, top.max_new_tokens - len(top.output_ids))
+            need = min(self._lmax, p + rem + self._headroom())
+            off0, shared = self._kv.match_prefix(tok)
+            if P > C:
+                off0 = (off0 // P) * P
+                shared = shared[:off0 // C]
+            budget = -(-need // C) - len(shared)
+            if self._kv.free_slots() and self._kv.can_reserve(budget):
+                return   # admissible as-is — nothing to displace
+            slot, _ = min(victims,
+                          key=lambda sr: (sr[1].priority, -sr[1].t_submit))
+            self._preempt_slot(slot)
 
     # -------------------------------------------------- request lifecycle
     # terminal statuses beyond "done": every path below retires through
@@ -1094,14 +1203,24 @@ class ServingEngine:
         m = self._m
         P = self._pchunk
         while free and self._queue:
-            r = self._queue[0]
+            # priority-aware head: the highest-priority waiter admits
+            # first.  max() is stable, so all-default traffic keeps the
+            # exact FIFO order (and bytes) of the pre-priority engine;
+            # the paged defer below still BREAKS, so held-back capacity
+            # protects the head's class instead of leaking to smaller
+            # later requests.  ``tok`` is the (re-)admission sequence —
+            # for a preemption resume it includes every emitted token,
+            # so the radix match re-adopts the parked chain and prefill
+            # runs only the suffix.
+            r = max(self._queue, key=lambda q: q.priority)
+            tok = self._admission_ids(r)
             off0, shared, budget, need = 0, [], 0, 0
             if self._paged:
                 C = self._kv.block
-                p = int(r.prompt_ids.size)
-                need = min(self._lmax,
-                           p + r.max_new_tokens + self._headroom())
-                off0, shared = self._kv.match_prefix(r.prompt_ids)
+                p = int(tok.size)
+                rem = max(1, r.max_new_tokens - len(r.output_ids))
+                need = min(self._lmax, p + rem + self._headroom())
+                off0, shared = self._kv.match_prefix(tok)
                 if P > C:
                     off0 = (off0 // P) * P
                     shared = shared[:off0 // C]
@@ -1111,21 +1230,35 @@ class ServingEngine:
                         self._fr.record("admit_defer", step=self._step_idx,
                                         rid=r.rid, need_blocks=budget)
                     break
-            self._queue.popleft()
+            self._queue.remove(r)
             slot = free.pop(0)
             self._kv.assign(slot, r)
-            p = int(r.prompt_ids.size)
+            p = int(tok.size)
             if self._paged:
                 self._kv.adopt_prefix(slot, shared)
                 self._kv.reserve(slot, budget)
                 self._need_rows[slot] = need
+                r._adm_ids = tok
+                self._n_prompt_tokens += p
+                self._n_reuse_tokens += off0
             if r._trace is not None:
                 r._trace.mark("prefilling", slot=slot)
             if self._fr is not None:
                 self._fr.record("admit", step=self._step_idx, rid=r.rid,
                                 slot=slot, bucket=r._bucket)
+            if r.preempts:
+                # preemption resume: the adopted chain covers [0, off0) —
+                # the suffix is the whole recompute cost
+                self._n_resume_suffix += p - off0
+                self._n_resume_total += p
+                if self._fr is not None:
+                    self._fr.record("resume", step=self._step_idx,
+                                    rid=r.rid, slot=slot,
+                                    suffix_tokens=p - off0, total_tokens=p)
+                if m is not None:
+                    m.preempt_resume_tokens.inc(p - off0)
             padded = np.zeros((-(-p // P) * P,), np.int32)
-            padded[:p] = r.prompt_ids
+            padded[:p] = tok
             if off0:
                 # prefix hit: the adopted blocks already hold rows
                 # [0, off0) — prefill starts at the suffix offset
@@ -1243,8 +1376,10 @@ class ServingEngine:
                 # publish the prefix only now that the finite check passed
                 # (registering at dispatch could publish poisoned blocks a
                 # later radix hit would silently adopt); before _emit,
-                # which may release the slot
-                self._kv.register_prefix(slot, r.prompt_ids)
+                # which may release the slot.  The ADMISSION ids, not the
+                # prompt — a preemption resume's chain also covers the
+                # tokens it re-prefilled
+                self._kv.register_prefix(slot, r._adm_ids)
             emitted += self._emit(slot, [int(fv[0])])
         return emitted
 
@@ -1328,6 +1463,7 @@ class ServingEngine:
                                 seconds=stalled, injected=True)
         self._expire_deadlines()
         self._apply_poison()
+        self._maybe_preempt()
         self._adm_wave = False
         self._admit()
         spent = self._spend_prefill()
@@ -1568,7 +1704,7 @@ class ServingEngine:
                 if self._paged:
                     # post-finite-check, pre-_emit (which may release):
                     # same registration rule as _flush_firsts
-                    self._kv.register_prefix(slot, r.prompt_ids)
+                    self._kv.register_prefix(slot, r._adm_ids)
                 self._cur[slot] = int(fv[0])
                 emitted += self._emit(slot, [int(fv[0])])
             for i in rec["live"]:
@@ -1601,7 +1737,7 @@ class ServingEngine:
                 if self._paged:
                     # post-finite-check, pre-_emit (which may release):
                     # same registration rule as _flush_firsts
-                    self._kv.register_prefix(slot, r.prompt_ids)
+                    self._kv.register_prefix(slot, r._adm_ids)
                 self._cur[slot] = int(fv[0])
                 emitted += self._emit(slot, [int(fv[0])])
             accepted = 0
@@ -1656,6 +1792,48 @@ class ServingEngine:
         if self._m is not None:
             self._m.queue_depth.set(len(self._queue))
         return {r.rid: r.status for r in self._finished}
+
+    # ------------------------------------------------- fleet introspection
+    # the surface serving/replica.py programs against: pure host reads
+    # (no device work, no allocation) a router can poll every route
+    @property
+    def kv_block(self):
+        """Paged KV block size in tokens (None on dense engines) — the
+        chunk width router-side prefix mirrors must key on."""
+        return self._kv.block if self._paged else None
+
+    def queue_depth(self):
+        """Requests waiting for a slot (the admission backlog)."""
+        return len(self._queue)
+
+    def prefix_lookup(self, tokens):
+        """Longest cached prefix (in tokens) this engine's radix map
+        holds for ``tokens`` — the router's cache-aware placement probe.
+        0 on dense engines."""
+        if not self._paged:
+            return 0
+        matched, _ = self._kv.match_prefix(
+            np.asarray(tokens, np.int32).reshape(-1))
+        return int(matched)
+
+    def stats(self):
+        """JSON-ready scheduling snapshot for replica handles/routers:
+        backlog, occupancy, and the cumulative paged prompt/reuse and
+        preemption token tallies.  Maintained unconditionally, so
+        ``instrument=False`` engines report them too."""
+        return {
+            "queue_depth": len(self._queue),
+            "slots_occupied": self._kv.occupied(),
+            "slots_total": self._B,
+            "prefill_slots": len(self._pf),
+            "inflight": 1 if self._inflight is not None else 0,
+            "live_tokens": int(self._kv.live_tokens()),
+            "prompt_tokens": self._n_prompt_tokens,
+            "prefix_reuse_tokens": self._n_reuse_tokens,
+            "preempted": self._n_preempted,
+            "preempt_resume_suffix_tokens": self._n_resume_suffix,
+            "preempt_resume_total_tokens": self._n_resume_total,
+        }
 
     # ------------------------------------------------- debug introspection
     @property

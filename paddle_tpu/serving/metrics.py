@@ -185,6 +185,19 @@ class EngineMetrics:
             "serving_prompt_tokens_total",
             "prompt tokens admitted on the paged path (prefix hit-rate "
             "denominator)", L).labels(**lbl)
+        # priority preemption (paged engines): parks, and the suffix
+        # tokens the resumes actually re-prefilled — the recompute cost
+        # the EVICTABLE park keeps small
+        self.preempted = reg.counter(
+            "serving_preempted_total",
+            "resident requests parked by priority preemption (blocks "
+            "released EVICTABLE — the radix chain survives for the "
+            "suffix-cost resume)", L).labels(**lbl)
+        self.preempt_resume_tokens = reg.counter(
+            "serving_preempt_resume_tokens_total",
+            "suffix tokens prefilled when preempted requests resumed "
+            "(the adopted prefix rows were free — this counter IS the "
+            "preemption recompute cost)", L).labels(**lbl)
         # KV quantization (kv_dtype=): an INFO gauge — one child per
         # known mode, the active one reads 1 — so a scrape (and
         # /debug/flightrecorder's kv_quant dispatch detail) states the

@@ -1,0 +1,109 @@
+"""Replica: the reviewed engine-handle surface the traffic layer uses.
+
+One :class:`Replica` wraps one :class:`~paddle_tpu.serving.engine.
+ServingEngine` and is the ONLY way the router/server layer talks to it —
+every method below delegates to a public engine API (``submit`` /
+``cancel`` / ``step`` / ``run`` / ``drain`` / ``close`` / ``stats`` /
+``prefix_lookup`` / ``slo_tracker`` / ``debug_sources``), never to a
+private attribute.  That boundary is the point: the future
+prefill/decode split replaces the engine behind this handle without the
+router noticing, and the handle stays small enough to review as an API.
+
+A Replica adds no threading, no queueing and no policy — it is a name
+plus delegation.  Scheduling stays in the engine; placement stays in the
+router.  With one replica and default priorities the handle is
+transparent: token streams through it are byte-identical to driving the
+engine directly (tested: tests/test_serving_router.py).
+"""
+from __future__ import annotations
+
+__all__ = ["Replica"]
+
+
+class Replica:
+    """A named handle on one serving engine.
+
+    ``name`` labels the replica in router metrics
+    (``serving_replica_backlog{replica=...}``) and debug snapshots; it
+    must be unique within a router.  ``engine`` is any object exposing
+    the ServingEngine surface listed in the module docstring — stubs
+    satisfy it in the router unit tests, which is exactly what makes the
+    handle an API rather than a wrapper.
+    """
+
+    def __init__(self, engine, name="replica0"):
+        self.engine = engine
+        self.name = str(name)
+
+    def __repr__(self):
+        return f"Replica({self.name!r})"
+
+    # ------------------------------------------------------------ lifecycle
+    def submit(self, request):
+        """Hand ``request`` to the engine's bounded admission queue.
+        Raises ``EngineOverloaded`` when the engine sheds it — the
+        router's cue to try the next candidate."""
+        return self.engine.submit(request)
+
+    def cancel(self, rid):
+        return self.engine.cancel(rid)
+
+    @property
+    def has_work(self):
+        return self.engine.has_work
+
+    def step(self):
+        """One scheduler iteration; returns tokens emitted."""
+        return self.engine.step()
+
+    def run(self):
+        return self.engine.run()
+
+    def drain(self):
+        return self.engine.drain()
+
+    def close(self):
+        return self.engine.close()
+
+    # ------------------------------------------------------------ placement
+    @property
+    def block_size(self):
+        """Paged KV block size in tokens (None on dense engines)."""
+        return self.engine.kv_block
+
+    def prefix_match(self, tokens):
+        """Longest prefix of ``tokens`` this replica's radix map already
+        caches, in tokens — the authoritative half of the router's
+        prefix-aware probe (the mirror is the predictive half)."""
+        return self.engine.prefix_lookup(tokens)
+
+    def queue_depth(self):
+        return self.engine.queue_depth()
+
+    def stats(self):
+        """The engine's scheduling snapshot, tagged with this replica's
+        name (JSON-ready)."""
+        s = dict(self.engine.stats())
+        s["replica"] = self.name
+        return s
+
+    def backlog(self):
+        """Queued plus resident requests — the least-backlog routing
+        score (resident work drains over the same steps queued work
+        waits on, so both load the replica)."""
+        s = self.engine.stats()
+        return s["queue_depth"] + s["slots_occupied"]
+
+    def burn_rate(self, slo_class="interactive"):
+        """The replica's windowed SLO error-budget burn for
+        ``slo_class`` — the least-backlog tiebreak (between two equally
+        loaded replicas, route away from the one already failing its
+        objective)."""
+        return self.engine.slo_tracker.burn_rate(slo_class)
+
+    # ------------------------------------------------------------ debugging
+    def debug_sources(self):
+        """The engine's ``/debug`` sources, name-prefixed so N replicas
+        coexist under one ``MetricsExporter``."""
+        return {f"{self.name}_{k}": fn
+                for k, fn in self.engine.debug_sources().items()}

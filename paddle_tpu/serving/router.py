@@ -1,0 +1,286 @@
+"""Prefix-aware request router over N serving replicas.
+
+SGLang-style cache-aware placement (the RadixAttention lineage): the
+fleet-level prefix hit rate is a PLACEMENT property — two requests
+sharing a prompt prefix only reuse KV if they land on the SAME replica.
+Round-robin splits every prefix family across the fleet and forfeits
+most of the per-replica radix cache; this router instead sends each
+request to the replica already holding the longest cached prefix of its
+prompt, and falls back to least-backlog placement (SLO burn-rate
+tiebreak) when no replica holds a meaningful match.
+
+Two sources answer the "who holds my prefix" probe:
+
+* ``Replica.prefix_match`` — the engine's own radix map, authoritative
+  but LATE: an engine registers a prefix only at first-token emission
+  (after the finite check), several scheduler steps after admission.
+* a host-side **radix mirror** per replica (token-chunk keys, the same
+  key shape as ``PagedKVCacheManager``), fed at ROUTE time with every
+  prompt the router places — predictive, so the second request of a
+  burst of identical prompts follows the first immediately instead of
+  round-robining away while the first is still prefilling.
+
+The router takes the max of both.  Placement is the only thing decided
+here — admission, scheduling and preemption stay in the engine behind
+the :class:`~paddle_tpu.serving.replica.Replica` handle.  Shed-on-
+overload rides the engine's ``EngineOverloaded``: a shed at the chosen
+replica falls through the remaining candidates in plan order, and only
+when EVERY replica sheds does the router re-raise to the caller.
+
+With one replica the plan is trivially that replica, so N=1 routing is
+byte-identical to driving the engine directly (tested).  Off-path cost
+when ``instrument=False`` and no registry: pure host dict walks — no
+metric touches, no device work.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.serving.engine import EngineOverloaded
+
+__all__ = ["Router"]
+
+# the reason label values of serving_router_requests_total, pre-registered
+# per replica at construction so a first scrape shows the full matrix
+_ROUTE_REASONS = ("prefix", "backlog", "round_robin", "shed")
+
+
+class _RadixMirror:
+    """Host-side predictive mirror of one replica's prefix map.
+
+    Same chunking rule as ``PagedKVCacheManager``: only full ``block``-
+    token chunks are matchable, keyed ``(parent, chunk) -> node``, and a
+    probe is capped at ``(len-1)//block`` chunks so the engine always has
+    at least one suffix token to prefill.  Inserted at route time; never
+    pruned — a stale entry costs one mis-routed request (the engine-side
+    probe still wins the max), not correctness."""
+
+    def __init__(self, block):
+        self.block = int(block)
+        self._node = {}
+        self._n_nodes = 0
+
+    def _chunks(self, tokens, n):
+        C = self.block
+        for k in range(n):
+            yield tuple(int(t) for t in tokens[k * C:(k + 1) * C])
+
+    def insert(self, tokens):
+        parent = -1
+        for chunk in self._chunks(tokens, len(tokens) // self.block):
+            key = (parent, chunk)
+            node = self._node.get(key)
+            if node is None:
+                self._n_nodes += 1
+                node = self._node[key] = self._n_nodes
+            parent = node
+
+    def match(self, tokens):
+        """Matched-token count (multiple of ``block``)."""
+        parent, matched = -1, 0
+        cap = max(0, (len(tokens) - 1) // self.block)
+        for chunk in self._chunks(tokens, cap):
+            node = self._node.get((parent, chunk))
+            if node is None:
+                break
+            matched += self.block
+            parent = node
+        return matched
+
+
+class Router:
+    """Fan requests across ``replicas`` (:class:`Replica` handles with
+    unique names).
+
+    ``policy``: ``"prefix"`` (cache-aware, the default) or
+    ``"round_robin"`` (the placement-oblivious A/B baseline — same shed
+    fallback, no prefix probe).  ``min_match``: the smallest prefix
+    match (tokens) worth routing on; below it placement is least-backlog
+    (default: one KV block — the smallest reusable unit).  ``registry``
+    + ``instrument`` gate the router metric children, pre-registered at
+    construction: ``serving_router_requests_total{replica,reason}``,
+    ``serving_router_prefix_hit_rate`` (fleet reuse/prompt token ratio)
+    and ``serving_replica_backlog{replica}``.
+    """
+
+    def __init__(self, replicas, policy="prefix", min_match=None,
+                 registry=None, instrument=True):
+        if policy not in ("prefix", "round_robin"):
+            raise ValueError(f"unknown router policy {policy!r}")
+        self._reps = list(replicas)
+        if not self._reps:
+            raise ValueError("Router needs at least one replica")
+        names = [rep.name for rep in self._reps]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.policy = policy
+        self._mirrors = {
+            rep.name: (_RadixMirror(rep.block_size)
+                       if rep.block_size else None)
+            for rep in self._reps}
+        blocks = [rep.block_size for rep in self._reps if rep.block_size]
+        self._min_match = (int(min_match) if min_match is not None
+                           else (min(blocks) if blocks else 1))
+        self._rr = 0
+        self._routed = {reason: 0 for reason in _ROUTE_REASONS}
+        self._requests = self._backlog_g = self._hit_rate_g = None
+        if instrument and registry is not None:
+            self._requests = registry.counter(
+                "serving_router_requests_total",
+                "requests placed by the router, by replica and reason "
+                "(prefix = cache-aware hit, backlog = least-backlog "
+                "fallback, round_robin = baseline policy, shed = every "
+                "replica refused)", ("replica", "reason"))
+            self._backlog_g = registry.gauge(
+                "serving_replica_backlog",
+                "queued + resident requests per replica (the router's "
+                "least-backlog score)", ("replica",))
+            self._hit_rate_g = registry.gauge(
+                "serving_router_prefix_hit_rate",
+                "fleet prefix hit rate: cumulative prefix-reuse tokens / "
+                "prompt tokens summed over every replica")
+            for name in names:
+                self._backlog_g.labels(replica=name).set(0)
+                for reason in _ROUTE_REASONS:
+                    self._requests.labels(replica=name, reason=reason)
+
+    # ------------------------------------------------------------ placement
+    def _plan(self, request):
+        """Ranked ``(replica, reason)`` candidates for one request.
+        Ranking never mutates router state — sheds walk the same list."""
+        by_load = sorted(
+            self._reps,
+            key=lambda rep: (rep.backlog(),
+                             rep.burn_rate(request.slo_class
+                                           or "interactive")))
+        if self.policy == "round_robin":
+            n = len(self._reps)
+            order = [self._reps[(self._rr + k) % n] for k in range(n)]
+            self._rr += 1
+            return [(rep, "round_robin") for rep in order]
+        scores = {}
+        for rep in self._reps:
+            mirror = self._mirrors[rep.name]
+            matched = rep.prefix_match(request.prompt_ids)
+            if mirror is not None:
+                matched = max(matched, mirror.match(request.prompt_ids))
+            scores[rep.name] = matched
+        best = max(scores.values())
+        if best < self._min_match:
+            return [(rep, "backlog") for rep in by_load]
+        # longest match wins; equal matches break on load; replicas with
+        # no match trail as least-backlog fallbacks for the shed walk
+        ranked = sorted(by_load, key=lambda rep: -scores[rep.name])
+        return [(rep, "prefix" if scores[rep.name] >= self._min_match
+                 else "backlog") for rep in ranked]
+
+    def submit(self, request):
+        """Place ``request`` on the best replica, falling through the
+        candidate list on ``EngineOverloaded``; re-raises only when every
+        replica sheds."""
+        plan = self._plan(request)
+        last_err = None
+        for rep, reason in plan:
+            try:
+                rep.submit(request)
+            except EngineOverloaded as e:
+                # the engine stamped status="shed"; clear it before the
+                # next candidate sees the request (status is terminal —
+                # it must describe the FINAL outcome, not the detour)
+                request.status = None
+                last_err = e
+                continue
+            mirror = self._mirrors[rep.name]
+            if mirror is not None:
+                mirror.insert(np.asarray(request.prompt_ids).reshape(-1))
+            self._routed[reason] += 1
+            if self._requests is not None:
+                self._requests.labels(replica=rep.name,
+                                      reason=reason).inc()
+            self._refresh_gauges()
+            return request
+        request.status = "shed"
+        self._routed["shed"] += 1
+        if self._requests is not None:
+            self._requests.labels(replica=plan[0][0].name,
+                                  reason="shed").inc()
+        raise last_err
+
+    def cancel(self, rid):
+        return any([rep.cancel(rid) for rep in self._reps])
+
+    # ------------------------------------------------------------ driving
+    @property
+    def has_work(self):
+        return any(rep.has_work for rep in self._reps)
+
+    def step(self):
+        """One scheduler iteration on every replica with work; returns
+        total tokens emitted."""
+        emitted = 0
+        for rep in self._reps:
+            if rep.has_work:
+                emitted += rep.step()
+        self._refresh_gauges()
+        return emitted
+
+    def run(self):
+        while self.has_work:
+            self.step()
+
+    def drain(self):
+        """Drain every replica; merged ``{rid: terminal status}``."""
+        out = {}
+        for rep in self._reps:
+            out.update(rep.drain())
+        self._refresh_gauges()
+        return out
+
+    def close(self):
+        out = {}
+        for rep in self._reps:
+            out.update(rep.close())
+        self._refresh_gauges()
+        return out
+
+    # ------------------------------------------------------------ telemetry
+    def hit_rate(self):
+        """Fleet prefix hit rate: Σ reuse tokens / Σ prompt tokens over
+        every replica (0.0 before any paged admission)."""
+        reuse = prompt = 0
+        for rep in self._reps:
+            s = rep.stats()
+            reuse += s.get("prefix_reuse_tokens", 0)
+            prompt += s.get("prompt_tokens", 0)
+        return reuse / prompt if prompt else 0.0
+
+    def _refresh_gauges(self):
+        if self._backlog_g is None:
+            return
+        for rep in self._reps:
+            self._backlog_g.labels(replica=rep.name).set(rep.backlog())
+        self._hit_rate_g.set(self.hit_rate())
+
+    def snapshot(self):
+        """JSON-ready router state for the ``/debug/router`` endpoint."""
+        return {
+            "policy": self.policy,
+            "min_match": self._min_match,
+            "routed": dict(self._routed),
+            "hit_rate": self.hit_rate(),
+            "replicas": [{
+                **rep.stats(),
+                "backlog": rep.backlog(),
+                "mirror_nodes": (
+                    self._mirrors[rep.name]._n_nodes
+                    if self._mirrors[rep.name] is not None else 0),
+            } for rep in self._reps],
+        }
+
+    def debug_sources(self):
+        """``{name: callable}`` for ``MetricsExporter``: ``/debug/router``
+        plus every replica's name-prefixed engine sources."""
+        out = {"router": self.snapshot}
+        for rep in self._reps:
+            out.update(rep.debug_sources())
+        return out

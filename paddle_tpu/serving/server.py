@@ -1,0 +1,315 @@
+"""Streaming HTTP front end for the serving stack — stdlib asyncio only.
+
+Same dependency policy as the observability exporter: nothing beyond the
+standard library, so the front end ships wherever the engine does.  One
+:class:`ServingServer` owns two threads next to the caller's:
+
+* the **event-loop thread** runs an asyncio socket server.  Handlers
+  never block (tpu-lint PTL013 polices exactly this file's failure
+  mode): a generate request is handed to the driver through a
+  thread-safe queue, its admission future awaited via
+  ``asyncio.wrap_future``, and its tokens arrive on an ``asyncio.Queue``
+  fed by ``loop.call_soon_threadsafe`` from the engine's ``stream_cb``.
+* the **driver thread** owns the router/engine: it drains the submit
+  handoff queue, steps the router while work exists, and notifies
+  handlers whose requests reached a terminal status.  Every device
+  interaction — including the engine's sanctioned blocking
+  ``_host_fetch`` sync — happens HERE, never on the event loop.
+
+API (JSON over HTTP/1.1, ``Connection: close``):
+
+``POST /generate`` — body ``{"prompt_ids": [...], "max_new_tokens": N,
+"eos_token_id"?, "deadline_ms"?, "slo_class"?, "priority"?:
+"interactive"|"batch"|int, "stream"?: bool}``.  With ``stream`` (the
+default) the response is ``application/x-ndjson``: one
+``{"rid", "token_ids"}`` line per emission batch — over the engine's
+existing ``stream_cb``, so chunk boundaries ARE the engine's emission
+boundaries — then a final ``{"done": true, "rid", "status",
+"n_tokens", "preempts"}`` line.  ``stream: false`` buffers and returns
+one JSON object.  A fleet-wide shed maps to 503, a validation error to
+400.  ``GET /healthz`` reports liveness plus the router snapshot's
+vitals.  Priority classes map onto the engine's preemption integers
+(``PRIORITY_CLASSES``); an int passes through.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import queue
+import threading
+from concurrent.futures import Future
+
+from paddle_tpu.serving.engine import EngineOverloaded, Request
+
+__all__ = ["PRIORITY_CLASSES", "ServingServer"]
+
+# request priority classes -> engine preemption priorities.  Interactive
+# traffic outranks batch by enough headroom that deployments can slot
+# custom integer tiers between them without redefining the classes.
+PRIORITY_CLASSES = {"batch": 0, "interactive": 10}
+
+_DONE = object()   # terminal sentinel on each handler's token queue
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            503: "Service Unavailable"}
+
+
+def _priority_of(value):
+    """Engine priority int for a request body's ``priority`` field."""
+    if isinstance(value, str):
+        try:
+            return PRIORITY_CLASSES[value]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority class {value!r} (known: "
+                f"{sorted(PRIORITY_CLASSES)}, or an int)") from None
+    return int(value)
+
+
+class ServingServer:
+    """Asyncio HTTP server over a :class:`~paddle_tpu.serving.router.
+    Router` (anything with ``submit``/``step``/``has_work`` works — a
+    bare :class:`Replica` drives a single engine).
+
+    ``host``/``port`` bind the listener (``port=0`` picks a free port,
+    published on ``self.port`` after ``start()``).  ``poll_interval``
+    bounds the driver thread's idle wait — the latency floor between a
+    submit landing and the driver picking it up when the fleet was
+    quiescent.  ``start()`` returns self; ``close()`` stops both
+    threads (the router/engines stay open — their lifecycle belongs to
+    whoever built them)."""
+
+    def __init__(self, router, host="127.0.0.1", port=0,
+                 poll_interval=0.002):
+        self._router = router
+        self._host = host
+        self._port = int(port)
+        self._poll = float(poll_interval)
+        self.port = None
+        self._submits = queue.Queue()
+        self._watch = {}
+        self._watch_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._ready = threading.Event()
+        self._boot_err = None
+        self._loop = None
+        self._stopping = None
+        self._aio = None
+        self._driver = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self._aio = threading.Thread(target=self._aio_main,
+                                     name="serving-http", daemon=True)
+        self._aio.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError("serving HTTP listener failed to start")
+        if self._boot_err is not None:
+            raise self._boot_err
+        self._driver = threading.Thread(target=self._drive,
+                                        name="serving-driver", daemon=True)
+        self._driver.start()
+        return self
+
+    def close(self):
+        """Stop the driver and the listener.  Idempotent.  In-flight
+        requests keep whatever tokens they have; the router and its
+        engines are left to their owner."""
+        self._stop.set()
+        self._wake.set()
+        if self._driver is not None:
+            self._driver.join(timeout=10)
+            self._driver = None
+        if self._loop is not None:
+            with contextlib.suppress(RuntimeError):   # loop already closed
+                self._loop.call_soon_threadsafe(self._stopping.set)
+            self._loop = None
+        if self._aio is not None:
+            self._aio.join(timeout=10)
+            self._aio = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------- driver thread
+    def _drive(self):
+        """The engine-owning loop: drain submit handoffs, step the
+        router while work exists, notify finished handlers.  The ONLY
+        thread that touches the router after ``start()`` — handlers
+        reach it exclusively through ``_submits``."""
+        router = self._router
+        while not self._stop.is_set():
+            busy = False
+            while True:
+                try:
+                    req, fut = self._submits.get_nowait()
+                except queue.Empty:
+                    break
+                busy = True
+                try:
+                    router.submit(req)
+                    fut.set_result(req.rid)
+                except Exception as e:
+                    self._unwatch(req)
+                    fut.set_exception(e)
+            if router.has_work:
+                busy = True
+                router.step()
+            self._notify_terminal()
+            if not busy:
+                # idle: park on the wake event (NOT time.sleep — this
+                # loop dispatches compiled steps, PTL008's domain) until
+                # a submit lands or poll_interval passes
+                self._wake.wait(timeout=self._poll)
+                self._wake.clear()
+
+    def _unwatch(self, req):
+        with self._watch_lock:
+            self._watch.pop(id(req), None)
+
+    def _notify_terminal(self):
+        """Wake every handler whose request reached a terminal status.
+        Runs on the driver thread AFTER the step that finished the
+        request, so the sentinel is scheduled behind the request's last
+        ``stream_cb`` tokens on the loop's FIFO callback queue — the
+        handler never truncates a stream."""
+        with self._watch_lock:
+            done = [w for w in self._watch.values()
+                    if w[0].status is not None]
+            for req, _, _ in done:
+                del self._watch[id(req)]
+        for _, loop, q in done:
+            loop.call_soon_threadsafe(q.put_nowait, _DONE)
+
+    # ---------------------------------------------------- event-loop thread
+    def _aio_main(self):
+        try:
+            asyncio.run(self._serve())
+        except Exception as e:
+            self._boot_err = e
+            self._ready.set()
+
+    async def _serve(self):
+        self._loop = asyncio.get_running_loop()
+        self._stopping = asyncio.Event()
+        server = await asyncio.start_server(self._handle, self._host,
+                                            self._port)
+        self.port = server.sockets[0].getsockname()[1]
+        self._ready.set()
+        async with server:
+            await self._stopping.wait()
+
+    async def _handle(self, reader, writer):
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=30)
+            except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                    ConnectionError):
+                return
+            line, _, raw_headers = head.partition(b"\r\n")
+            parts = line.decode("latin-1").split()
+            if len(parts) < 2:
+                await self._respond(writer, 400,
+                                    {"error": "malformed request line"})
+                return
+            method, path = parts[0].upper(), parts[1]
+            headers = {}
+            for h in raw_headers.split(b"\r\n"):
+                k, sep, v = h.decode("latin-1").partition(":")
+                if sep:
+                    headers[k.strip().lower()] = v.strip()
+            if method == "GET" and path == "/healthz":
+                await self._respond(writer, 200, self._health())
+            elif method == "POST" and path == "/generate":
+                n = int(headers.get("content-length", "0"))
+                body = await reader.readexactly(n) if n else b""
+                await self._generate(writer, body)
+            else:
+                await self._respond(
+                    writer, 404, {"error": f"no route {method} {path}"})
+        except ConnectionError:
+            pass   # client went away mid-write; nothing to salvage
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    def _health(self):
+        return {"ok": True,
+                "has_work": bool(self._router.has_work),
+                "policy": getattr(self._router, "policy", None)}
+
+    async def _respond(self, writer, code, obj):
+        payload = json.dumps(obj).encode()
+        writer.write(
+            (f"HTTP/1.1 {code} {_REASONS.get(code, 'OK')}\r\n"
+             "Content-Type: application/json\r\n"
+             f"Content-Length: {len(payload)}\r\n"
+             "Connection: close\r\n\r\n").encode("latin-1") + payload)
+        await writer.drain()
+
+    async def _generate(self, writer, body):
+        try:
+            spec = json.loads(body or b"{}")
+            req = Request(
+                spec["prompt_ids"], spec.get("max_new_tokens", 16),
+                eos_token_id=spec.get("eos_token_id"),
+                deadline_ms=spec.get("deadline_ms"),
+                slo_class=spec.get("slo_class"),
+                priority=_priority_of(spec.get("priority", 0)))
+        except (KeyError, TypeError, ValueError) as e:
+            await self._respond(writer, 400, {"error": str(e)})
+            return
+        stream = bool(spec.get("stream", True))
+        loop = asyncio.get_running_loop()
+        toks = asyncio.Queue()
+
+        def push(_req, new_ids, _loop=loop, _q=toks):
+            # engine thread -> event loop; list() copies before crossing
+            _loop.call_soon_threadsafe(
+                _q.put_nowait, [int(t) for t in new_ids])
+
+        req.stream_cb = push
+        fut = Future()
+        with self._watch_lock:
+            self._watch[id(req)] = (req, loop, toks)
+        self._submits.put((req, fut))
+        self._wake.set()
+        try:
+            rid = await asyncio.wrap_future(fut)
+        except EngineOverloaded as e:
+            await self._respond(writer, 503,
+                                {"error": str(e), "status": "shed"})
+            return
+        except (TypeError, ValueError) as e:
+            await self._respond(writer, 400, {"error": str(e)})
+            return
+        if stream:
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/x-ndjson\r\n"
+                         b"Cache-Control: no-store\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+        while True:
+            item = await toks.get()
+            if item is _DONE:
+                break
+            if stream:
+                writer.write(json.dumps(
+                    {"rid": rid, "token_ids": item}).encode() + b"\n")
+                await writer.drain()
+        summary = {"done": True, "rid": rid, "status": req.status,
+                   "n_tokens": len(req.output_ids),
+                   "preempts": req.preempts}
+        if stream:
+            writer.write(json.dumps(summary).encode() + b"\n")
+            await writer.drain()
+        else:
+            summary["token_ids"] = [int(t) for t in req.output_ids]
+            await self._respond(writer, 200, summary)

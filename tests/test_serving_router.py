@@ -1,0 +1,540 @@
+"""Fleet traffic layer: Replica handle, prefix-aware Router, streaming
+HTTP server, and priority preemption.
+
+Four claims under test (ISSUE 13 acceptance):
+
+* routing is a pure placement decision — with one replica the routed
+  token streams are byte-identical to driving the engine directly, and
+  the Router's policy logic is testable against duck-typed stub replicas
+  (the Replica surface is an API, not a wrapper);
+* prefix-aware placement routes to the longest cached prefix (engine
+  radix probe OR the router's predictive mirror), falls back to
+  least-backlog with an SLO burn-rate tiebreak, and walks the candidate
+  list on ``EngineOverloaded`` before re-raising;
+* priority preemption parks the lowest-priority resident slot and the
+  resume costs ONE SUFFIX PREFILL — the adopted chunks are never
+  re-prefilled (flight-recorder ``prefill_chunk`` indices prove it),
+  the warm path never retraces, and the preempted stream is
+  byte-identical to an unpreempted run;
+* the asyncio front end streams the engine's emission batches as NDJSON
+  without truncation, and the router's ``/debug/router`` snapshot rides
+  the existing MetricsExporter.
+"""
+import json
+import http.client
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import assert_no_retrace
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsExporter, MetricsRegistry
+from paddle_tpu.serving import (
+    EngineOverloaded, PRIORITY_CLASSES, Replica, Request, Router,
+    ServingEngine, ServingServer,
+)
+
+GEOM = dict(batch_size=2, max_len=128, decode_chunk=16, prefill_chunk=16,
+            instrument=False, recorder=False)
+PAGED = dict(kv_block=16, max_live_tokens=2 * 128)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, **kw):
+    cfg = dict(GEOM)
+    cfg.update(PAGED)
+    cfg.update(kw)
+    return ServingEngine(model, **cfg)
+
+
+def _prompts(rng, sizes):
+    return [rng.integers(1, 2000, size=int(s)).astype(np.int32)
+            for s in sizes]
+
+
+# ---------------------------------------------------------------- stubs
+class _StubReplica:
+    """Duck-typed Replica for router unit tests — the point of the
+    handle being an API surface is that placement logic never needs a
+    real engine behind it."""
+
+    def __init__(self, name, block_size=16, match=0, backlog=0,
+                 burn=0.0, capacity=None):
+        self.name = name
+        self.block_size = block_size
+        self._match = int(match)
+        self._backlog = int(backlog)
+        self._burn = float(burn)
+        self.capacity = capacity        # None = unbounded, 0 = always shed
+        self.accepted = []
+
+    def prefix_match(self, tokens):
+        return self._match
+
+    def backlog(self):
+        return self._backlog
+
+    def burn_rate(self, slo_class="interactive"):
+        return self._burn
+
+    def submit(self, request):
+        if self.capacity is not None and len(self.accepted) >= self.capacity:
+            request.status = "shed"   # engine stamps shed before raising
+            raise EngineOverloaded(f"{self.name} full")
+        self.accepted.append(request)
+        return request
+
+    def stats(self):
+        return {"replica": self.name, "queue_depth": self._backlog,
+                "slots_occupied": 0, "prompt_tokens": 0,
+                "prefix_reuse_tokens": 0}
+
+    has_work = False
+
+    def step(self):
+        return 0
+
+    def cancel(self, rid):
+        return False
+
+    def drain(self):
+        return {}
+
+    def close(self):
+        return {}
+
+    def debug_sources(self):
+        return {}
+
+
+def _req(n=33, rng_seed=0, **kw):
+    rng = np.random.default_rng(rng_seed)
+    return Request(rng.integers(1, 2000, size=n).astype(np.int32), 4, **kw)
+
+
+# ---------------------------------------------------------- router units
+class TestRouterPlacement:
+    def test_longest_prefix_wins(self):
+        a = _StubReplica("a", match=16)
+        b = _StubReplica("b", match=32)
+        r = Router([a, b], registry=None)
+        req = _req()
+        r.submit(req)
+        assert b.accepted == [req] and not a.accepted
+        assert r.snapshot()["routed"]["prefix"] == 1
+
+    def test_prefix_beats_backlog(self):
+        # a cached match wins even against an idle replica: recomputing
+        # the prefix costs more than queueing behind the backlog
+        a = _StubReplica("a", match=0, backlog=0)
+        b = _StubReplica("b", match=32, backlog=5)
+        r = Router([a, b], registry=None)
+        req = _req()
+        r.submit(req)
+        assert b.accepted == [req]
+
+    def test_mirror_predicts_before_engine_registers(self):
+        # engines report no match (registration is late — first-token
+        # time); the router's own mirror must still send the second
+        # identical prompt after the first
+        a = _StubReplica("a", backlog=0)
+        b = _StubReplica("b", backlog=1)
+        r = Router([a, b], registry=None)
+        first, second = _req(rng_seed=7), _req(rng_seed=7)
+        r.submit(first)
+        assert a.accepted == [first]          # least backlog
+        assert r.snapshot()["routed"]["backlog"] == 1
+        r.submit(second)
+        assert a.accepted == [first, second]  # mirror hit, not round-robin
+        assert r.snapshot()["routed"]["prefix"] == 1
+
+    def test_least_backlog_fallback(self):
+        a = _StubReplica("a", backlog=3)
+        b = _StubReplica("b", backlog=1)
+        req = _req()
+        Router([a, b], registry=None).submit(req)
+        assert b.accepted == [req]
+
+    def test_burn_rate_tiebreak(self):
+        # equal backlog: route away from the replica already burning its
+        # SLO error budget
+        a = _StubReplica("a", backlog=2, burn=0.8)
+        b = _StubReplica("b", backlog=2, burn=0.1)
+        req = _req()
+        Router([a, b], registry=None).submit(req)
+        assert b.accepted == [req]
+
+    def test_min_match_gate(self):
+        # a sub-block match is not worth routing on — least backlog wins
+        a = _StubReplica("a", match=8, backlog=5)
+        b = _StubReplica("b", match=0, backlog=0)
+        req = _req()
+        Router([a, b], registry=None).submit(req)
+        assert b.accepted == [req]
+
+    def test_round_robin_policy(self):
+        a, b = _StubReplica("a"), _StubReplica("b")
+        r = Router([a, b], policy="round_robin", registry=None)
+        reqs = [_req(rng_seed=k) for k in range(4)]
+        for q in reqs:
+            r.submit(q)
+        assert a.accepted == [reqs[0], reqs[2]]
+        assert b.accepted == [reqs[1], reqs[3]]
+        assert r.snapshot()["routed"]["round_robin"] == 4
+
+    def test_shed_falls_through_candidates(self):
+        a = _StubReplica("a", match=32, capacity=0)   # best match, but full
+        b = _StubReplica("b")
+        req = _req()
+        Router([a, b], registry=None).submit(req)
+        assert b.accepted == [req]
+        # the detour through a's shed must not leave a stale terminal
+        # status on a request that ultimately landed
+        assert req.status is None
+
+    def test_all_shed_reraises(self):
+        a = _StubReplica("a", capacity=0)
+        b = _StubReplica("b", capacity=0)
+        r = Router([a, b], registry=None)
+        req = _req()
+        with pytest.raises(EngineOverloaded):
+            r.submit(req)
+        assert req.status == "shed"
+        assert r.snapshot()["routed"]["shed"] == 1
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            Router([], registry=None)
+        with pytest.raises(ValueError):
+            Router([_StubReplica("a"), _StubReplica("a")], registry=None)
+        with pytest.raises(ValueError):
+            Router([_StubReplica("a")], policy="random", registry=None)
+
+    def test_metrics_preregistered(self):
+        # every {replica, reason} child and both gauges exist at zero
+        # BEFORE any traffic — a first scrape shows the full matrix
+        reg = MetricsRegistry()
+        a, b = _StubReplica("a"), _StubReplica("b")
+        r = Router([a, b], registry=reg)
+        prom = reg.to_prometheus()
+        for name in ("a", "b"):
+            for reason in ("prefix", "backlog", "round_robin", "shed"):
+                assert f'replica="{name}"' in prom
+                assert f'reason="{reason}"' in prom
+        assert "serving_replica_backlog" in prom
+        assert "serving_router_prefix_hit_rate" in prom
+        r.submit(_req())
+        assert reg.to_prometheus() != prom       # the placement was counted
+
+    def test_uninstrumented_router_touches_no_registry(self):
+        reg = MetricsRegistry()
+        Router([_StubReplica("a")], registry=reg, instrument=False)
+        assert reg.names() == []
+
+
+# -------------------------------------------------- routed byte-identity
+class TestRoutedByteIdentity:
+    def test_n1_routed_matches_direct(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(3)
+        sizes = [24, 33, 17]
+        prompts = _prompts(rng, sizes)
+
+        direct = ServingEngine(model, **{**GEOM, **PAGED})
+        dreqs = [Request(p, 8) for p in prompts]
+        for q in dreqs:
+            direct.submit(q)
+        direct.run()
+
+        router = Router([Replica(ServingEngine(model, **{**GEOM, **PAGED}),
+                                 name="r0")], registry=None)
+        rreqs = [Request(p, 8) for p in prompts]
+        for q in rreqs:
+            router.submit(q)
+        router.run()
+        router.drain()
+
+        for dq, rq in zip(dreqs, rreqs):
+            assert dq.status == rq.status == "done"
+            assert list(dq.output_ids) == list(rq.output_ids)
+
+    def test_replica_delegates_without_private_reachins(self):
+        # the handle's whole surface resolves against public engine API
+        model = _tiny_model()
+        rep = Replica(_engine(model), name="solo")
+        assert rep.block_size == PAGED["kv_block"]
+        assert rep.queue_depth() == 0
+        assert rep.backlog() == 0
+        assert rep.burn_rate("interactive") == 0.0
+        s = rep.stats()
+        assert s["replica"] == "solo" and s["slots_total"] == 2
+        assert set(rep.debug_sources()) == {
+            "solo_requests", "solo_flightrecorder", "solo_slo"}
+        rep.close()
+
+
+# ------------------------------------------------------------ preemption
+def _preempt_wave(eng, rng, low_new=40, hi_new=8):
+    """Fill both slots with low-priority decodes, then submit a
+    high-priority request that can only be admitted by preempting one."""
+    lows = [Request(p, low_new) for p in _prompts(rng, [24, 24])]
+    for q in lows:
+        eng.submit(q)
+    for _ in range(6):
+        eng.step()
+    hi = Request(_prompts(rng, [24])[0], hi_new, priority=5)
+    eng.submit(hi)
+    eng.run()
+    return lows, hi
+
+
+class TestPreemption:
+    def test_preempt_resume_suffix_only_and_byte_identical(self):
+        model = _tiny_model()
+        eng = _engine(model, recorder=True)
+        rng = np.random.default_rng(11)
+        lows, hi = _preempt_wave(eng, rng)
+
+        assert hi.status == "done" and len(hi.output_ids) == 8
+        assert [q.status for q in lows] == ["done", "done"]
+        # victim choice is deterministic: equal priority, most recent
+        # submit loses
+        assert [q.preempts for q in lows] == [0, 1]
+
+        evs = eng.recorder.events()
+        victim = lows[1].rid
+        pre = [e for e in evs if e["kind"] == "preempt"]
+        res = [e for e in evs if e["kind"] == "resume"]
+        assert len(pre) == 1 and pre[0]["rid"] == victim
+        assert pre[0]["cached_tokens"] > 0
+        assert len(res) == 1 and res[0]["rid"] == victim
+        # the resume cost: a strict suffix, never the full sequence
+        assert 0 < res[0]["suffix_tokens"] < res[0]["total_tokens"]
+
+        # suffix-only prefill: every chunk dispatched for the victim
+        # AFTER the preempt starts past the adopted chunks — chunk 0 is
+        # never re-prefilled
+        i_pre = evs.index(pre[0])
+        chunks = [e["chunk"] for e in evs[i_pre:]
+                  if e["kind"] == "prefill_chunk" and e["rid"] == victim]
+        assert chunks and min(chunks) >= 1
+
+        # host counters agree with the recorder
+        s = eng.stats()
+        assert s["preempted"] == 1
+        assert 0 < s["preempt_resume_suffix_tokens"] \
+            < s["preempt_resume_total_tokens"]
+
+        # byte identity: the preempted low-priority streams match an
+        # unpreempted run of the same prompts on a fresh engine
+        ref_eng = _engine(model)
+        refs = [Request(q.prompt_ids.copy(), q.max_new_tokens)
+                for q in lows]
+        for q in refs:
+            ref_eng.submit(q)
+        ref_eng.run()
+        for q, ref in zip(lows, refs):
+            assert list(q.output_ids) == list(ref.output_ids)
+        eng.close()
+        ref_eng.close()
+
+    def test_preemption_warm_path_no_retrace(self):
+        model = _tiny_model()
+        eng = _engine(model)
+        rng = np.random.default_rng(17)
+        _preempt_wave(eng, rng)              # warm: compile park/resume path
+        with assert_no_retrace():
+            lows, hi = _preempt_wave(eng, rng)
+        assert hi.status == "done"
+        assert sum(q.preempts for q in lows) >= 1
+        eng.close()
+
+    def test_default_priority_never_preempts(self):
+        model = _tiny_model()
+        eng = _engine(model)
+        rng = np.random.default_rng(23)
+        reqs = [Request(p, 8) for p in _prompts(rng, [24, 24, 24, 24])]
+        for q in reqs:
+            eng.submit(q)
+        eng.run()
+        assert all(q.preempts == 0 for q in reqs)
+        assert eng.stats()["preempted"] == 0
+        eng.close()
+
+
+# ------------------------------------------------------------ HTTP server
+def _http(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers={"Content-Type": "application/json"}
+                     if payload else {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestServingServer:
+    def test_streaming_generate_matches_direct(self):
+        model = _tiny_model()
+        router = Router([Replica(_engine(model))], registry=None)
+        srv = ServingServer(router).start()
+        try:
+            status, raw = _http(srv.port, "GET", "/healthz")
+            assert status == 200
+            hz = json.loads(raw)
+            assert hz["ok"] is True and hz["policy"] == "prefix"
+
+            rng = np.random.default_rng(5)
+            prompt = [int(t) for t in rng.integers(1, 2000, size=24)]
+            status, raw = _http(srv.port, "POST", "/generate",
+                                {"prompt_ids": prompt,
+                                 "max_new_tokens": 8,
+                                 "priority": "interactive"})
+            assert status == 200
+            lines = [json.loads(x) for x in raw.splitlines()]
+            assert lines[-1]["done"] is True
+            assert lines[-1]["status"] == "done"
+            assert lines[-1]["n_tokens"] == 8
+            streamed = [t for ln in lines[:-1] for t in ln["token_ids"]]
+            assert len(streamed) == 8
+
+            # the emission-batch stream concatenates to exactly what a
+            # direct engine run produces
+            ref_eng = _engine(model)
+            ref = Request(np.asarray(prompt, np.int32), 8)
+            ref_eng.submit(ref)
+            ref_eng.run()
+            assert streamed == [int(t) for t in ref.output_ids]
+            ref_eng.close()
+
+            # buffered (stream=false) returns the same tokens in one body
+            status, raw = _http(srv.port, "POST", "/generate",
+                                {"prompt_ids": prompt, "max_new_tokens": 8,
+                                 "stream": False})
+            assert status == 200
+            assert json.loads(raw)["token_ids"] == streamed
+        finally:
+            srv.close()
+            router.close()
+
+    def test_validation_errors(self):
+        router = Router([_StubReplica("a")], registry=None)
+        srv = ServingServer(router).start()
+        try:
+            status, raw = _http(srv.port, "POST", "/generate", {})
+            assert status == 400
+            status, raw = _http(srv.port, "POST", "/generate",
+                                {"prompt_ids": [1, 2, 3],
+                                 "priority": "nope"})
+            assert status == 400
+            assert "interactive" in json.loads(raw)["error"]
+            status, _ = _http(srv.port, "GET", "/nope")
+            assert status == 404
+        finally:
+            srv.close()
+
+    def test_priority_classes(self):
+        assert PRIORITY_CLASSES["interactive"] > PRIORITY_CLASSES["batch"]
+
+    def test_close_is_idempotent_and_joins_threads(self):
+        router = Router([_StubReplica("a")], registry=None)
+        srv = ServingServer(router).start()
+        srv.close()
+        srv.close()
+        assert not any(t.name in ("serving-http", "serving-driver")
+                       for t in threading.enumerate())
+
+
+# ------------------------------------------------------- debug endpoint
+class TestRouterDebugEndpoint:
+    def test_debug_router_rides_metrics_exporter(self):
+        reg = MetricsRegistry()
+        a, b = _StubReplica("a", match=32), _StubReplica("b")
+        router = Router([a, b], registry=reg)
+        router.submit(_req())
+        exp = MetricsExporter(registry=reg,
+                              debug_sources=router.debug_sources())
+        exp.start()
+        try:
+            status, raw = _http(exp.port, "GET", "/debug/router")
+            assert status == 200
+            snap = json.loads(raw)
+            assert snap["policy"] == "prefix"
+            assert snap["routed"]["prefix"] == 1
+            names = {r["replica"] for r in snap["replicas"]}
+            assert names == {"a", "b"}
+        finally:
+            exp.stop()
+
+
+# ------------------------------------------------------------------ soak
+def _soak(router, rng, groups=3, per_group=4, max_new=8):
+    """Open-loop burst: ``groups`` prefix families, ``per_group``
+    requests each sharing a 24-token family head, mixed priorities and
+    SLO classes."""
+    heads = _prompts(rng, [24] * groups)
+    reqs = []
+    for g, head in enumerate(heads):
+        for k in range(per_group):
+            tail = rng.integers(1, 2000, size=8 + 4 * k).astype(np.int32)
+            reqs.append(Request(
+                np.concatenate([head, tail]), max_new,
+                slo_class="interactive" if k % 2 == 0 else "batch",
+                priority=PRIORITY_CLASSES["interactive"] if k % 2 == 0
+                else PRIORITY_CLASSES["batch"]))
+    for q in reqs:
+        router.submit(q)
+    router.run()
+    return reqs
+
+
+class TestFleetSoak:
+    def _fleet(self, registry=None):
+        model = _tiny_model()
+        reps = [Replica(_engine(model), name=f"rep{i}") for i in range(2)]
+        return model, Router(reps, registry=registry)
+
+    def test_two_replica_soak_bounded(self):
+        # tier-1 variant: small burst, both replicas busy, everything
+        # retires, fleet prefix hits happen, SLO attainment is recorded
+        reg = MetricsRegistry()
+        model, router = self._fleet(registry=reg)
+        rng = np.random.default_rng(31)
+        reqs = _soak(router, rng, groups=3, per_group=3, max_new=6)
+        assert all(q.status == "done" for q in reqs)
+        assert router.hit_rate() > 0.0       # families landed together
+        snap = router.snapshot()
+        assert sum(snap["routed"].values()) == len(reqs)
+        for rep in router._reps:
+            slo = rep.engine.slo_snapshot()
+            assert slo["classes"]
+        assert "serving_router_prefix_hit_rate" in reg.to_prometheus()
+        router.close()
+
+    @pytest.mark.slow
+    def test_two_replica_soak_warm_zero_retrace(self):
+        model, router = self._fleet()
+        rng = np.random.default_rng(37)
+        _soak(router, rng, groups=2, per_group=3, max_new=6)   # warm
+        with assert_no_retrace():
+            reqs = _soak(router, rng, groups=4, per_group=4, max_new=12)
+        assert all(q.status == "done" for q in reqs)
+        assert router.hit_rate() > 0.0
+        for rep in router._reps:
+            slo = rep.engine.slo_snapshot()
+            for cls in ("interactive", "batch"):
+                assert cls in slo["classes"]
+                assert 0.0 <= rep.engine.slo_tracker.attainment(cls) <= 1.0
+        router.close()

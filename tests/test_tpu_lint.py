@@ -691,6 +691,79 @@ class TestRuleFixtures:
         for path in ("tests/helpers.py", "test_kernels.py"):
             assert [f.rule for f in lint_source(src, path=path)] == []
 
+    # PTL013 — blocking-call-in-async-handler --------------------------
+    def test_async_blocking_tp_time_sleep(self):
+        # time.sleep on the event-loop thread stalls every coroutine —
+        # the direct spelling and a from-import alias both resolve
+        assert _rules("""
+            import time
+            async def handler(writer):
+                time.sleep(0.1)
+        """) == ["PTL013"]
+        assert _rules("""
+            from time import sleep as snooze
+            async def handler(writer):
+                snooze(0.1)
+        """) == ["PTL013"]
+
+    def test_async_blocking_tp_host_fetch(self):
+        # the engine's sanctioned device sync is SANCTIONED for host
+        # step loops (PTL004) — inside an async handler the deliberate
+        # block is exactly the offense
+        assert _rules("""
+            from paddle_tpu.serving.engine import _host_fetch
+            async def handler(arr):
+                vals = _host_fetch(arr)
+                return vals
+        """) == ["PTL013"]
+
+    def test_async_blocking_tp_socket(self):
+        # blocking socket-module entry points and blocking socket
+        # methods; asyncio replaces both with streams / loop.sock_*
+        assert _rules("""
+            import socket
+            async def handler(host):
+                conn = socket.create_connection((host, 80))
+                conn.sendall(b"ping")
+                return conn.recv(1024)
+        """) == ["PTL013", "PTL013", "PTL013"]
+
+    def test_async_blocking_tn_sync_def(self):
+        # the same calls in a plain def are PTL004/PTL008's domain (and
+        # clean outside step loops) — PTL013 never fires off the loop
+        assert _rules("""
+            import time, socket
+            def worker(host):
+                time.sleep(0.1)
+                return socket.create_connection((host, 80))
+        """) == []
+
+    def test_async_blocking_tn_nested_sync_def(self):
+        # a nested plain def inside an async handler runs wherever it's
+        # CALLED (executor / driver thread) — the innermost def's
+        # asyncness decides, not any enclosing one
+        assert _rules("""
+            import time
+            async def handler(loop):
+                def blocking_probe():
+                    time.sleep(0.1)
+                    return 1
+                return await loop.run_in_executor(None, blocking_probe)
+        """) == []
+
+    def test_async_blocking_tn_awaited_idioms(self):
+        # the sanctioned spellings: asyncio.sleep, asyncio streams, and
+        # a smuggled alias of asyncio.sleep under the name time.sleep
+        # would not resolve to time.sleep
+        assert _rules("""
+            import asyncio
+            async def handler(reader, writer):
+                await asyncio.sleep(0.1)
+                data = await reader.readexactly(4)
+                writer.write(data)
+                await writer.drain()
+        """) == []
+
     # rule filtering ----------------------------------------------------
     def test_rules_filter(self):
         src = textwrap.dedent("""
