@@ -22,17 +22,23 @@ from paddle_tpu.analysis.baseline import (
     default_baseline_path, fingerprints, load_baseline, split_findings,
     write_baseline,
 )
+from paddle_tpu.analysis.config import PROFILE_TABLE, profile_of, rules_for
+from paddle_tpu.analysis.dataflow import lint_project_sources
+from paddle_tpu.analysis.fixes import fix_source, preview_diff
 from paddle_tpu.analysis.linter import (
     Finding, canonical_path, lint_file, lint_paths, lint_source,
 )
-from paddle_tpu.analysis.report import format_json, format_text
+from paddle_tpu.analysis.report import format_json, format_sarif, format_text
 from paddle_tpu.analysis.rules import RULES, Rule, rule_ids
 
 __all__ = [
     "Finding", "Rule", "RULES", "rule_ids",
-    "lint_source", "lint_file", "lint_paths", "canonical_path",
+    "lint_source", "lint_file", "lint_paths", "lint_project_sources",
+    "canonical_path",
     "fingerprints", "load_baseline", "write_baseline", "split_findings",
-    "default_baseline_path", "format_text", "format_json",
+    "default_baseline_path", "format_text", "format_json", "format_sarif",
+    "fix_source", "preview_diff",
+    "PROFILE_TABLE", "profile_of", "rules_for",
     # lazy (jax-dependent) runtime companions:
     "assert_no_retrace", "RetraceError",
     "assert_no_tracer_leak", "find_tracer_leaks", "TracerLeakError",

@@ -26,9 +26,22 @@ def _build_parser():
     p.add_argument("paths", nargs="*", default=["paddle_tpu"],
                    help="files or directories to lint "
                         "(default: paddle_tpu)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--rules", default=None, metavar="PTL001,PTL005,...",
                    help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="fan files across N worker processes "
+                        "(default: os.cpu_count(); findings are "
+                        "byte-identical to a serial run)")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the registered mechanical fixits (PTL006 "
+                        "mutable default -> None sentinel, PTL007 bare "
+                        "except -> except Exception) in place, then lint "
+                        "the fixed tree")
+    p.add_argument("--dry-run", action="store_true",
+                   help="with --fix: print the unified diff instead of "
+                        "writing files, and skip the lint pass")
     p.add_argument("--baseline", default=None, metavar="PATH",
                    help="baseline JSON (default: auto-discover "
                         f"{_baseline.BASELINE_NAME} in cwd or repo root)")
@@ -65,7 +78,16 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
-    findings = lint_paths(args.paths, rules=rules)
+    if args.dry_run and not args.fix:
+        print("tpu-lint: --dry-run requires --fix", file=sys.stderr)
+        return 2
+    if args.fix:
+        rc = _run_fix(args.paths, rules, dry_run=args.dry_run)
+        if args.dry_run:
+            return rc
+
+    jobs = args.jobs if args.jobs is not None else os.cpu_count()
+    findings = lint_paths(args.paths, rules=rules, jobs=jobs)
 
     if args.write_baseline:
         path = args.baseline or _baseline.default_baseline_path() or \
@@ -93,7 +115,32 @@ def main(argv=None):
 
     if args.format == "json":
         print(_report.format_json(findings, baselined))
+    elif args.format == "sarif":
+        print(_report.format_sarif(findings, baselined))
     else:
         print(_report.format_text(findings, baselined,
                                   verbose_baseline=args.show_baselined))
     return 1 if findings else 0
+
+
+def _run_fix(paths, rules, dry_run):
+    from paddle_tpu.analysis.fixes import fix_source, preview_diff
+    from paddle_tpu.analysis.linter import canonical_path, iter_python_files
+
+    n_fixed = n_files = 0
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            src = fh.read()
+        fixed, applied = fix_source(src, rules=set(rules) if rules else None)
+        if not applied:
+            continue
+        n_files += 1
+        n_fixed += len(applied)
+        if dry_run:
+            sys.stdout.write(preview_diff(canonical_path(path), src, fixed))
+        else:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(fixed)
+    verb = "would fix" if dry_run else "fixed"
+    print(f"tpu-lint: {verb} {n_fixed} finding(s) in {n_files} file(s)")
+    return 0

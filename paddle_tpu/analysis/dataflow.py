@@ -1,0 +1,818 @@
+"""Project-level dataflow for tpu-lint v2 (stdlib only).
+
+Three whole-program analyses layered on the per-module AST engine in
+:mod:`paddle_tpu.analysis.linter`:
+
+1. **Interprocedural traced-value propagation.**  The per-module checker
+   records a :class:`linter._CallEvent` for every call that leaves a
+   traced (jitted) context carrying a traced argument.  A worklist here
+   resolves each event to its callee — a module-level def, a ``self.``
+   method, or an imported symbol in another module — and re-runs the
+   checker over the callee body *as if it were jitted* with exactly the
+   traced parameters bound at the call site (a synthetic
+   :class:`linter._JitInfo` whose statics are the complement).  Findings
+   from these synthetic runs carry the call chain
+   (``[traced via fwd -> helper]``) and only the traced-context rules
+   (PTL001/PTL002/PTL005/PTL011) fire, so helper bodies are not
+   double-linted for host-side rules.  The worklist iterates to a
+   fixpoint over the call graph (depth-capped), deduplicating on
+   ``(module, function, traced-set)`` so diamond call patterns are
+   analyzed once.
+
+2. **Host-effect summaries.**  A per-module fixpoint computes, for every
+   non-jitted local function, whether its body (or anything it calls
+   same-module) reaches a host sync (PTL004), a blocking wait (PTL008)
+   or a compiled-step dispatch — stopping at the sanctioned
+   ``_host_fetch``/``_backoff_sleep`` helpers, whose call sites are the
+   designed exemptions.  The checker consults these summaries in host
+   step loops, so ``for ...: self._drain()`` is flagged when ``_drain``
+   hides an ``np.asarray`` two helpers down, with the witness chain in
+   the message.
+
+3. **PTL014 program-cache-key completeness** — a whole-program join of
+   picklable per-module *facts*: jitted-impl static signatures (under
+   both the def name and the ``x = _mon.wrap("...", jax.jit(fn, ...))``
+   export alias), factory cache-key tuples, and call-site knob
+   bindings.  The join runs in the parent process so ``--jobs`` workers
+   never need to share ASTs.
+
+:func:`check_thread_safety` (PTL015) also lives here: per-module, but
+class-level rather than expression-level — it needs the whole
+``ClassDef`` to learn which attributes the lock protects before it can
+judge any single write.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field, replace
+
+from paddle_tpu.analysis import config as _config
+from paddle_tpu.analysis.linter import (
+    Finding, _CallEvent, _Checker, _Collector, _JitInfo, _SYNC_HELPERS,
+    _WAIT_SANCTIONED, _call_name, _dotted, _is_step_name, _suppressed,
+    _sync_of, _wait_of, canonical_path, iter_python_files,
+)
+
+__all__ = ["lint_module_source", "lint_project_paths",
+           "lint_project_sources", "ModuleAnalysis"]
+
+# rules that make sense inside a synthetic as-if-jitted run of a helper
+# body: the traced-context rules.  Host-loop/callsite/pure-python rules
+# already fired during the helper's own module pass.
+_TRACED_RULES = frozenset({"PTL001", "PTL002", "PTL005", "PTL011"})
+
+# interprocedural worklist depth cap — far above any real helper chain,
+# guards against pathological recursion in the call graph
+_MAX_CHAIN = 10
+
+_LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+
+# container mutators that count as writes to the receiving attribute for
+# PTL015 (self._q.append(x) mutates self._q)
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "discard",
+             "remove", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault"}
+
+
+# --------------------------------------------------------------------------
+# per-module analysis container
+# --------------------------------------------------------------------------
+
+@dataclass
+class ModuleAnalysis:
+    path: str
+    source: str
+    tree: object
+    collector: object
+    lines: list
+
+
+def analyze_source(source, path, tree=None):
+    """Parse + collect one module (raises SyntaxError on bad source)."""
+    if tree is None:
+        tree = ast.parse(source)
+    return ModuleAnalysis(path, source, tree,
+                          _Collector().run(tree), source.splitlines())
+
+
+def module_name_of(path):
+    """Dotted module name for a project path (``paddle_tpu/serving/
+    engine.py`` -> ``paddle_tpu.serving.engine``)."""
+    p = str(path).replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", ".")
+
+
+# --------------------------------------------------------------------------
+# host-effect summaries (PTL004/PTL008 through helpers)
+# --------------------------------------------------------------------------
+
+@dataclass
+class _Effects:
+    sync: tuple = None    # (helper chain below this fn, witness label)
+    wait: tuple = None
+    step: tuple = None
+
+
+def _shallow_walk(fdef):
+    """Walk a function body without descending into nested defs/lambdas
+    (their effects run when *they* are called, not when ``fdef`` is)."""
+    stack = list(ast.iter_child_nodes(fdef))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def function_effects(ma):
+    """name -> _Effects for local functions whose bodies reach a host
+    sync, a blocking wait, or a compiled-step dispatch (directly or
+    through same-module callees; fixpoint with witness chains).
+    Sanctioned helper names never export effects — calling them is the
+    designed exemption."""
+    resolve = ma.collector.aliases.resolve
+    sanctioned_names = _SYNC_HELPERS | _WAIT_SANCTIONED
+    eff = {}
+    edges = {}
+    for name, fdefs in ma.collector.defs_by_name.items():
+        if name in sanctioned_names:
+            continue
+        e = eff.setdefault(name, _Effects())
+        callees = edges.setdefault(name, set())
+        for fdef in fdefs:
+            if id(fdef) in ma.collector.jitted:
+                # calling a jitted def dispatches a compiled program
+                if e.step is None:
+                    e.step = ((), f"jitted `{name}`")
+                continue
+            for node in _shallow_walk(fdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = resolve(_dotted(node.func))
+                cname = _call_name(node)
+                sync, ok = _sync_of(node, f, cname)
+                if sync is not None and not ok and e.sync is None:
+                    e.sync = ((), sync)
+                wait, ok = _wait_of(node, f, cname)
+                if wait is not None and not ok and e.wait is None:
+                    e.wait = ((), wait)
+                if cname is not None and e.step is None and (
+                        _is_step_name(cname)
+                        or cname in ma.collector.module_jitted):
+                    e.step = ((), f"{cname}()")
+                # same-module call edges: bare local names, self methods
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ma.collector.defs_by_name:
+                    callees.add(node.func.id)
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in ("self", "cls") and \
+                        node.func.attr in ma.collector.defs_by_name:
+                    callees.add(node.func.attr)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in edges.items():
+            e = eff[name]
+            for c in sorted(callees):
+                ce = eff.get(c)
+                if ce is None:
+                    continue
+                for kind in ("sync", "wait", "step"):
+                    sub = getattr(ce, kind)
+                    if sub is not None and getattr(e, kind) is None:
+                        setattr(e, kind, ((c,) + sub[0], sub[1]))
+                        changed = True
+    return {n: e for n, e in eff.items()
+            if e.sync is not None or e.wait is not None
+            or e.step is not None}
+
+
+# --------------------------------------------------------------------------
+# interprocedural traced-value propagation
+# --------------------------------------------------------------------------
+
+def _bind_traced(fdef, ev, offset):
+    """The callee params bound to traced values at this call site."""
+    a = fdef.args
+    params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    traced = set()
+    for i, is_traced in enumerate(ev.pos):
+        if is_traced and i + offset < len(params):
+            p = params[i + offset]
+            if p not in ("self", "cls"):
+                traced.add(p)
+    for k, is_traced in ev.kws:
+        if is_traced and k in params and k not in ("self", "cls"):
+            traced.add(k)
+    return frozenset(traced)
+
+
+def _check_as_traced(ma, fdef, traced, chain, enabled, sink):
+    """Run the checker over ``fdef`` as if jitted with ``traced`` params
+    (synthetic _JitInfo registered for the duration), returning
+    pragma-filtered findings annotated with the call chain.  Further
+    traced calls land in ``sink``."""
+    enabled = set(enabled) & _TRACED_RULES
+    info = _JitInfo(fdef)
+    info.static_names = {p for p in info.params() if p not in traced}
+    jitted = ma.collector.jitted
+    had, saved = id(fdef) in jitted, jitted.get(id(fdef))
+    jitted[id(fdef)] = info
+    try:
+        checker = _Checker(ma.path, ma.collector, enabled,
+                           call_sink=sink, chain=chain)
+        checker.visit(fdef)
+    finally:
+        if had:
+            jitted[id(fdef)] = saved
+        else:
+            del jitted[id(fdef)]
+    label = " [traced via " + " -> ".join(chain) + "]"
+    out = []
+    for f in checker.findings:
+        f.message += label
+        if not _suppressed(f, ma.lines):
+            out.append(f)
+    return out
+
+
+def _seen_key(ma, fdef, traced):
+    return (ma.path, fdef.lineno, fdef.name, traced)
+
+
+def _run_event_target(ma, fdef, offset, ev, enabled_for, seen,
+                      findings, work):
+    if id(fdef) in ma.collector.jitted:
+        return  # callee is itself jitted — jax nests the trace; its own
+        #         pass already analyzed it with its own statics
+    traced = _bind_traced(fdef, ev, offset)
+    if not traced:
+        return
+    key = _seen_key(ma, fdef, traced)
+    if key in seen:
+        return
+    seen.add(key)
+    sub = []
+    findings.extend(_check_as_traced(
+        ma, fdef, traced, ev.chain + (fdef.name,),
+        enabled_for(ma.path), sub))
+    work.extend(e for e in sub if len(e.chain) < _MAX_CHAIN)
+
+
+def _method_defs(ma, name):
+    out = []
+    for fdef in ma.collector.defs_by_name.get(name, ()):
+        a = fdef.args
+        params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        if params and params[0] in ("self", "cls"):
+            out.append(fdef)
+    return out
+
+
+def propagate_local(ma, events, enabled):
+    """Within-module traced propagation; returns ``(findings,
+    extern_events)`` — events targeting other modules (alias-resolved to
+    canonical dotted form) are handed back for the project phase."""
+    findings, extern, seen = [], [], set()
+    work = list(events)
+    enabled_for = lambda _path: enabled  # noqa: E731 — single module
+    while work:
+        ev = work.pop(0)
+        kind, val = ev.desc
+        if kind == "name":
+            target = ma.collector.aliases.map.get(val)
+            if target is not None:
+                if "." in target:
+                    extern.append(replace(ev, desc=("dotted", target)))
+                continue
+            fdef = ma.collector.top_defs.get(val)
+            if fdef is not None:
+                _run_event_target(ma, fdef, 0, ev, enabled_for, seen,
+                                  findings, work)
+        elif kind == "method":
+            for fdef in _method_defs(ma, val):
+                _run_event_target(ma, fdef, 1, ev, enabled_for, seen,
+                                  findings, work)
+        else:
+            extern.append(ev)
+    return findings, extern, seen
+
+
+# --------------------------------------------------------------------------
+# PTL015 — lock discipline on shared mutable state
+# --------------------------------------------------------------------------
+
+def _is_lock_value(node, resolve):
+    if isinstance(node, ast.Call):
+        f = resolve(_dotted(node.func))
+        if f is not None:
+            last = f.split(".")[-1]
+            if last in ("Lock", "RLock") and (
+                    f.startswith("threading.") or f == last):
+                return True
+    # alias to another object's lock: self._lock = registry._lock
+    # (observability/metrics.py child-metric idiom)
+    if isinstance(node, ast.Attribute) and _LOCK_NAME_RE.search(node.attr):
+        return True
+    return False
+
+
+def _self_attr_written(t):
+    """Attribute name when ``t`` is a write through ``self`` (plain
+    attribute, or an element/slice of one), else None."""
+    if isinstance(t, ast.Attribute) and \
+            isinstance(t.value, ast.Name) and t.value.id == "self":
+        return t.attr
+    if isinstance(t, ast.Subscript):
+        return _self_attr_written(t.value)
+    return None
+
+
+def check_thread_safety(ma, enabled):
+    """PTL015: in classes that own a lock AND take it (``with
+    self.<lock>:``), attributes written under the lock form the
+    *protected set*; any write to a protected attribute outside a
+    held-lock region (and outside ``__init__``) is flagged."""
+    if "PTL015" not in enabled:
+        return []
+    resolve = ma.collector.aliases.resolve
+    findings = []
+    for cls in [n for n in ast.walk(ma.tree) if isinstance(n, ast.ClassDef)]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        lock_attrs = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and (
+                                _is_lock_value(node.value, resolve)
+                                or _LOCK_NAME_RE.search(t.attr)):
+                        lock_attrs.add(t.attr)
+        if not lock_attrs:
+            continue
+        # (attr, node, holding lock name or None, method)
+        writes = []
+        took_lock = [False]
+
+        def scan(node, held, meth):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                h = held
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Attribute) and \
+                            isinstance(ctx.value, ast.Name) and \
+                            ctx.value.id == "self" and \
+                            ctx.attr in lock_attrs:
+                        h = ctx.attr
+                        took_lock[0] = True
+                for child in ast.iter_child_nodes(node):
+                    scan(child, h, meth)
+                return
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                targets = []
+            for t in targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    attr = _self_attr_written(el)
+                    if attr is not None and attr not in lock_attrs:
+                        writes.append((attr, node, held, meth))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _self_attr_written(node.func.value)
+                if attr is not None and attr not in lock_attrs:
+                    writes.append((attr, node, held, meth))
+            for child in ast.iter_child_nodes(node):
+                scan(child, held, meth)
+
+        for m in methods:
+            scan(m, None, m)
+        if not took_lock[0]:
+            continue  # lock owned but never taken here — not our idiom
+        protecting = {}
+        for attr, _node, held, _m in writes:
+            if held is not None and attr not in protecting:
+                protecting[attr] = held
+        for attr, node, held, meth in writes:
+            if held is not None or meth.name == "__init__":
+                continue
+            lock = protecting.get(attr)
+            if lock is None:
+                continue
+            findings.append(Finding(
+                "PTL015", ma.path, node.lineno, node.col_offset,
+                f"write to `self.{attr}` outside `with self.{lock}:` in "
+                f"`{cls.name}.{meth.name}` — `{attr}` is written under "
+                f"`self.{lock}` elsewhere in this class, so this "
+                "unlocked write races every locked reader/writer"))
+    return [f for f in findings if not _suppressed(f, ma.lines)]
+
+
+# --------------------------------------------------------------------------
+# PTL014 — program-cache-key completeness (picklable per-module facts)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ImplFact:
+    """A top-level jitted function with static_argnames — a compiled
+    serving impl whose statics key its program identity."""
+    module: str
+    path: str
+    name: str
+    line: int
+    statics: tuple
+    params: tuple
+
+
+@dataclass(frozen=True)
+class KeyFact:
+    """A cache-key tuple: ``K = (...)`` later used as a dict subscript or
+    ``.get`` argument inside the same factory function."""
+    path: str
+    func: str
+    line: int
+    names: frozenset  # every Name id appearing in the tuple expression
+
+
+@dataclass(frozen=True)
+class BindFact:
+    """A call (in a factory module) binding arguments to a possibly
+    imported callee; descs are ("name", id) / ("const",) / ("other",)."""
+    callee: str
+    path: str
+    line: int
+    pos: tuple
+    kws: tuple
+
+
+@dataclass
+class ModuleFacts:
+    path: str
+    module: str
+    impls: list = field(default_factory=list)
+    keys: list = field(default_factory=list)
+    binds: list = field(default_factory=list)
+
+
+def _arg_desc(node):
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Constant):
+        return ("const",)
+    return ("other",)
+
+
+def extract_cache_facts(ma):
+    """Impl/key/bind facts for PTL014.  Key and bind facts are only
+    extracted when the module actually caches programs by a tuple key —
+    everything is picklable for the --jobs workers."""
+    module = module_name_of(ma.path)
+    facts = ModuleFacts(path=ma.path, module=module)
+    for name, fdef in ma.collector.top_defs.items():
+        info = ma.collector.jitted.get(id(fdef))
+        if info is not None and info.static_names:
+            facts.impls.append(ImplFact(
+                module=module, path=ma.path, name=name, line=fdef.lineno,
+                statics=tuple(sorted(info.static_names)),
+                params=tuple(info.params())))
+    # module-level export aliases (`serving_decode = _mon.wrap("...",
+    # jax.jit(_impl, ...))`): factories import and call the EXPORT, so
+    # the impl must be findable under that name too
+    for name, info in ma.collector.module_jitted.items():
+        if name not in ma.collector.top_defs and info.static_names:
+            facts.impls.append(ImplFact(
+                module=module, path=ma.path, name=name,
+                line=info.node.lineno,
+                statics=tuple(sorted(info.static_names)),
+                params=tuple(info.params())))
+    # key tuples: N = (...) then d[N] / d.get(N) in the same function
+    for fdef in [n for n in ast.walk(ma.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        candidates = {}
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                names = frozenset(
+                    n.id for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name))
+                candidates[node.targets[0].id] = (node.lineno, names)
+        if not candidates:
+            continue
+        used = set()
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Name) and \
+                    node.slice.id in candidates:
+                used.add(node.slice.id)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "setdefault", "pop") and \
+                    node.args and isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in candidates:
+                used.add(node.args[0].id)
+        for key_name in sorted(used):
+            line, names = candidates[key_name]
+            facts.keys.append(KeyFact(
+                path=ma.path, func=fdef.name, line=line, names=names))
+    if not facts.keys:
+        return facts
+    # knob bindings: calls to local top-level defs or imported symbols
+    for node in ast.walk(ma.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = None
+        if isinstance(node.func, ast.Name):
+            target = ma.collector.aliases.map.get(node.func.id)
+            if target is not None and "." in target:
+                callee = target
+            elif node.func.id in ma.collector.top_defs:
+                callee = module + "." + node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            d = ma.collector.aliases.resolve(_dotted(node.func))
+            if d is not None and "." in d:
+                callee = d
+        if callee is None or \
+                callee.split(".")[0] in _Checker._EXTERNAL_ROOTS:
+            continue
+        pos = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                break
+            pos.append(_arg_desc(a))
+        kws = tuple((kw.arg, _arg_desc(kw.value))
+                    for kw in node.keywords if kw.arg is not None)
+        facts.binds.append(BindFact(
+            callee=callee, path=ma.path, line=node.lineno,
+            pos=tuple(pos), kws=kws))
+    return facts
+
+
+def check_cache_keys(all_facts, enabled_for, get_lines):
+    """Join impl statics against factory key tuples: every static knob
+    bound to a *variable* at an impl call site inside a caching module
+    must appear (by either the bound variable's name or the static's own
+    name — renames like ``n_steps=sync_every`` count through the local
+    variable) in the module's cache-key tuple(s)."""
+    impls = {}
+    by_bare = {}
+    for facts in all_facts:
+        for impl in facts.impls:
+            impls[impl.module + "." + impl.name] = impl
+            by_bare.setdefault(impl.name, []).append(impl)
+    findings = []
+    for facts in all_facts:
+        if not facts.keys or "PTL014" not in enabled_for(facts.path):
+            continue
+        key_names = frozenset().union(*(k.names for k in facts.keys))
+        key = min(facts.keys, key=lambda k: k.line)
+        missing = {}
+        for bf in facts.binds:
+            impl = impls.get(bf.callee)
+            if impl is None:
+                bare = by_bare.get(bf.callee.split(".")[-1])
+                impl = bare[0] if bare is not None and len(bare) == 1 \
+                    else None
+            if impl is None:
+                continue
+            for static in impl.statics:
+                desc = dict(bf.kws).get(static)
+                if desc is None and static in impl.params:
+                    i = impl.params.index(static)
+                    if i < len(bf.pos):
+                        desc = bf.pos[i]
+                if desc is None or desc[0] != "name":
+                    continue  # not passed, or not a keyable variable
+                bound = desc[1]
+                if bound in key_names or static in key_names:
+                    continue
+                missing.setdefault(bound, (static, impl, bf))
+        for bound in sorted(missing):
+            static, impl, bf = missing[bound]
+            f = Finding(
+                "PTL014", key.path, key.line, 0,
+                f"program-cache key tuple in `{key.func}` "
+                f"({key.path}:{key.line}) is missing static knob "
+                f"`{static}` of jitted `{impl.name}` "
+                f"({impl.path}:{impl.line}), bound here as `{bound}` "
+                f"({bf.path}:{bf.line}) — two configurations differing "
+                f"only in `{bound}` collide on one cache entry and "
+                "silently reuse a stale compiled program")
+            lines = get_lines(key.path)
+            if lines is None or not _suppressed(f, lines):
+                findings.append(f)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# module + project entry points
+# --------------------------------------------------------------------------
+
+def _analyze_module(source, path, enabled, tree=None):
+    """Full v2 per-module pass.  Returns ``(findings, extern_events,
+    facts, seen_keys)`` — everything but the findings is picklable input
+    to the cross-module phases."""
+    ma = analyze_source(source, path, tree=tree)
+    events = []
+    checker = _Checker(path, ma.collector, enabled, call_sink=events,
+                       effects=function_effects(ma))
+    findings = checker.check(ma.tree)
+    findings = [f for f in findings if not _suppressed(f, ma.lines)]
+    local, extern, seen = propagate_local(ma, events, enabled)
+    findings.extend(local)
+    findings.extend(check_thread_safety(ma, enabled))
+    facts = extract_cache_facts(ma)
+    return findings, extern, facts, seen
+
+
+def lint_module_source(source, path, enabled, tree=None):
+    """v2 lint of a single module in isolation (lint_source's backend):
+    within-module propagation + effects + PTL015, and PTL014 when the
+    module contains both the factory and the impls."""
+    findings, _extern, facts, _seen = _analyze_module(
+        source, path, enabled, tree=tree)
+    lines = source.splitlines()
+    findings.extend(check_cache_keys(
+        [facts], lambda _p: enabled, lambda _p: lines))
+    return findings
+
+
+class _Project:
+    """Lazy module index for the cross-module phases: parse a module at
+    most once, look it up by path or by dotted module name (with a
+    unique-basename fallback for out-of-tree fixture dirs)."""
+
+    def __init__(self, files=(), sources=None):
+        self._sources = dict(sources or {})
+        self._by_path = {}
+        self._name_to_path = {}
+        seen_base = {}
+        paths = list(self._sources) or \
+            [canonical_path(f) for f in files]
+        self._disk = {}
+        for f in files:
+            self._disk[canonical_path(f)] = f
+        for p in paths:
+            name = module_name_of(p)
+            self._name_to_path[name] = p
+            base = name.split(".")[-1]
+            seen_base.setdefault(base, []).append(p)
+        for base, ps in seen_base.items():
+            if len(ps) == 1 and base not in self._name_to_path:
+                self._name_to_path[base] = ps[0]
+
+    def by_path(self, path):
+        if path in self._by_path:
+            return self._by_path[path]
+        src = self._sources.get(path)
+        if src is None:
+            disk = self._disk.get(path, path)
+            try:
+                with open(disk, encoding="utf-8", errors="replace") as fh:
+                    src = fh.read()
+            except OSError:
+                self._by_path[path] = None
+                return None
+        try:
+            ma = analyze_source(src, path)
+        except SyntaxError:
+            ma = None
+        self._by_path[path] = ma
+        return ma
+
+    def by_module(self, dotted):
+        path = self._name_to_path.get(dotted)
+        return self.by_path(path) if path is not None else None
+
+    def lines(self, path):
+        ma = self.by_path(path)
+        return ma.lines if ma is not None else None
+
+
+def propagate_project(project, events, rules, seen):
+    """Cross-module traced propagation: resolve dotted events through the
+    module index, re-running callees as-if-jitted; callee-local
+    sub-events keep propagating until the worklist drains."""
+    findings = []
+    enabled_for = lambda p: _config.rules_for(p, rules)  # noqa: E731
+    work = sorted(events, key=lambda e: (e.home, e.line, e.col, e.desc))
+    while work:
+        ev = work.pop(0)
+        kind, val = ev.desc
+        if kind == "dotted":
+            mod, _, fn = val.rpartition(".")
+            ma = project.by_module(mod)
+            if ma is None:
+                continue
+            fdef = ma.collector.top_defs.get(fn)
+            if fdef is not None:
+                _run_event_target(ma, fdef, 0, ev, enabled_for, seen,
+                                  findings, work)
+        else:
+            ma = project.by_path(ev.home)
+            if ma is None:
+                continue
+            if kind == "name":
+                target = ma.collector.aliases.map.get(val)
+                if target is not None:
+                    if "." in target:
+                        work.append(replace(ev, desc=("dotted", target)))
+                    continue
+                fdef = ma.collector.top_defs.get(val)
+                if fdef is not None:
+                    _run_event_target(ma, fdef, 0, ev, enabled_for, seen,
+                                      findings, work)
+            else:
+                for fdef in _method_defs(ma, val):
+                    _run_event_target(ma, fdef, 1, ev, enabled_for, seen,
+                                      findings, work)
+    return findings
+
+
+def _analyze_file(task):
+    """--jobs worker: lint one file under its per-path profile.  Returns
+    only picklable values."""
+    path, rules = task
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        src = fh.read()
+    canonical = canonical_path(path)
+    enabled = _config.rules_for(canonical, rules)
+    try:
+        return _analyze_module(src, canonical, enabled)
+    except SyntaxError as e:
+        f = []
+        if "PTL000" in enabled:
+            f = [Finding("PTL000", canonical, e.lineno or 0, e.offset or 0,
+                         f"syntax error: {e.msg}")]
+        return f, [], ModuleFacts(path=canonical,
+                                  module=module_name_of(canonical)), set()
+
+
+def _join_project(results, project, rules):
+    findings, extern, all_facts, seen = [], [], [], set()
+    for file_findings, file_extern, facts, file_seen in results:
+        findings.extend(file_findings)
+        extern.extend(file_extern)
+        all_facts.append(facts)
+        seen |= set(file_seen)
+    findings.extend(propagate_project(project, extern, rules, seen))
+    findings.extend(check_cache_keys(
+        all_facts, lambda p: _config.rules_for(p, rules), project.lines))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_project_paths(paths, rules=None, jobs=None):
+    """Project-level lint (lint_paths' backend).  ``jobs`` > 1 fans the
+    per-file pass across a multiprocessing pool; the join runs in the
+    parent in file order either way, so findings are byte-identical to a
+    serial run."""
+    files = iter_python_files(paths)
+    rules_t = tuple(sorted(rules)) if rules is not None else None
+    tasks = [(f, rules_t) for f in files]
+    if jobs is not None and jobs > 1 and len(tasks) > 1:
+        import multiprocessing as mp
+        # spawn, not fork: lint_paths is callable from processes that
+        # already initialized jax (the test suite, notebook sessions),
+        # and forking a jax-threaded process can deadlock.  The workers
+        # import only the stdlib-ast side of the package, so a spawned
+        # interpreter stays light
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(min(jobs, len(tasks))) as pool:
+            results = pool.map(_analyze_file, tasks, chunksize=8)
+    else:
+        results = [_analyze_file(t) for t in tasks]
+    return _join_project(results, _Project(files=files), rules)
+
+
+def lint_project_sources(sources, rules=None):
+    """Project-level lint over in-memory ``{path: source}`` modules —
+    the fixture-friendly twin of :func:`lint_project_paths`."""
+    results = []
+    for path in sorted(sources):
+        enabled = _config.rules_for(path, rules)
+        try:
+            results.append(_analyze_module(sources[path], path, enabled))
+        except SyntaxError as e:
+            f = [Finding("PTL000", path, e.lineno or 0, e.offset or 0,
+                         f"syntax error: {e.msg}")] \
+                if "PTL000" in enabled else []
+            results.append((f, [], ModuleFacts(
+                path=path, module=module_name_of(path)), set()))
+    return _join_project(results, _Project(sources=sources), rules)

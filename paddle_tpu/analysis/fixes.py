@@ -1,0 +1,164 @@
+"""Mechanical fixits for tpu-lint ``--fix`` (stdlib only).
+
+Each fixer is registered under the ``fixit`` slug its rule carries in
+the registry (analysis/rules.py), so ``--fix`` applies exactly the
+fixes the rule table advertises:
+
+* ``mutable-default-to-none`` (PTL006): replace a list/dict/set literal
+  default with ``None`` and insert the ``if p is None: p = <literal>``
+  guard at the top of the body (after the docstring), preserving the
+  per-call-fresh semantics the original author almost never wanted to
+  share.
+* ``bare-except-to-exception`` (PTL007): rewrite ``except:`` as
+  ``except Exception:`` — same dynamic behavior for everything except
+  the KeyboardInterrupt/SystemExit it was wrongly swallowing.
+
+Fixes are source-span edits applied bottom-up, so positions stay valid;
+the result is idempotent (a fixed file re-fixes to itself) and is
+always re-parsed before being reported as changed — a fixer that would
+produce unparsable output is dropped rather than applied.
+"""
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+
+__all__ = ["FIXERS", "fix_source", "preview_diff"]
+
+_EXCEPT_RE = re.compile(r"except(\s*):")
+
+
+def _literal_text(source, node):
+    seg = ast.get_source_segment(source, node)
+    if seg is None:
+        return None
+    # normalize a multi-line default literal onto one guard line
+    return " ".join(seg.split())
+
+
+def _mutable_default_edits(source, tree):
+    """(replacements, insertions) for PTL006.
+
+    replacements: (start_line, start_col, end_line, end_col, new_text)
+    insertions:   (before_line, indent_col, text_lines)
+    All line numbers 1-based, cols 0-based, matching the ast."""
+    replacements, insertions = [], []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = node.args
+        named = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        pairs = []  # (param, default node)
+        for param, d in zip(named[len(named) - len(a.defaults):],
+                            a.defaults):
+            pairs.append((param, d))
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                pairs.append((p.arg, d))
+        local, guards = [], []
+        for param, d in pairs:
+            if not isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                continue
+            text = _literal_text(source, d)
+            if text is None:
+                continue
+            local.append((d.lineno, d.col_offset,
+                          d.end_lineno, d.end_col_offset, "None"))
+            guards.append((param, text))
+        if not guards:
+            continue
+        body = node.body
+        anchor = body[0]
+        after_doc = False
+        if isinstance(anchor, ast.Expr) and \
+                isinstance(anchor.value, ast.Constant) and \
+                isinstance(anchor.value.value, str):
+            after_doc = True
+            if len(body) > 1:
+                anchor = body[1]
+                after_doc = False
+        if anchor.lineno == node.lineno:
+            continue  # one-line `def f(): ...` body — no room for a guard
+        replacements += local
+        indent = anchor.col_offset
+        line = (anchor.end_lineno + 1) if after_doc else anchor.lineno
+        text = []
+        for param, lit in guards:
+            text.append(" " * indent + f"if {param} is None:")
+            text.append(" " * indent + f"    {param} = {lit}")
+        insertions.append((line, text))
+    return replacements, insertions
+
+
+def _bare_except_edits(source, tree):
+    replacements = []
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is not None:
+            continue
+        if not (1 <= node.lineno <= len(lines)):
+            continue
+        line = lines[node.lineno - 1]
+        m = _EXCEPT_RE.match(line[node.col_offset:])
+        if m is None:
+            continue
+        replacements.append((node.lineno, node.col_offset,
+                             node.lineno, node.col_offset + m.end(),
+                             "except Exception:"))
+    return replacements
+
+
+def fix_source(source, rules=None):
+    """Apply the registered fixits; returns ``(new_source, applied)``
+    where ``applied`` is a list of ``(rule_id, line)``.  ``rules``
+    restricts which fixers run (None = all).  Unparsable input (or a fix
+    that would make it unparsable) is returned unchanged."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source, []
+    replacements, insertions, applied = [], [], []
+    if rules is None or "PTL006" in rules:
+        rep, ins = _mutable_default_edits(source, tree)
+        replacements += [r + ("PTL006",) for r in rep]
+        insertions += ins
+    if rules is None or "PTL007" in rules:
+        replacements += [r + ("PTL007",)
+                         for r in _bare_except_edits(source, tree)]
+    if not replacements and not insertions:
+        return source, []
+    lines = source.splitlines(keepends=True)
+    # one bottom-up pass over both edit kinds: an edit only ever touches
+    # lines at/after its own position, so everything above stays valid
+    edits = [("replace",) + r for r in replacements]
+    edits += [("insert", line, -1, text) for line, text in insertions]
+    for edit in sorted(edits, key=lambda e: (e[1], e[2]), reverse=True):
+        if edit[0] == "replace":
+            _, sl, sc, el, ec, new, rule = edit
+            start = lines[sl - 1]
+            end = lines[el - 1]
+            lines[sl - 1:el] = [start[:sc] + new + end[ec:]]
+            applied.append((rule, sl))
+        else:
+            _, line, _, text = edit
+            lines[line - 1:line - 1] = [t + "\n" for t in text]
+    fixed = "".join(lines)
+    try:
+        ast.parse(fixed)
+    except SyntaxError:  # a fixer misfired — never ship broken source
+        return source, []
+    return fixed, sorted(applied, key=lambda x: (x[1], x[0]))
+
+
+def preview_diff(path, old, new):
+    """Unified diff for ``--fix --dry-run``."""
+    return "".join(difflib.unified_diff(
+        old.splitlines(keepends=True), new.splitlines(keepends=True),
+        fromfile=path, tofile=path + " (fixed)"))
+
+
+FIXERS = {
+    "mutable-default-to-none": "PTL006",
+    "bare-except-to-exception": "PTL007",
+}

@@ -1,17 +1,29 @@
 """tpu-lint AST engine (stdlib ``ast`` only — no third-party deps).
 
-One pass per module:
+Per module (v1, still available via ``interprocedural=False``):
 
 1. **Collect** — import aliases (so ``np``/``jnp``/``from jax import jit``
    all resolve to canonical dotted names), every function definition, and
    the set of *jitted* functions: decorated with ``jax.jit``/``pjit``/
-   ``functionalize`` (directly or through ``functools.partial``) or wrapped
-   by a ``x = jax.jit(fn, ...)`` assignment.  Static argument coverage
-   (``static_argnums``/``static_argnames``) is extracted per wrapper, so a
-   jitted function's *traced* parameters are known by name.
+   ``functionalize`` (directly or through ``functools.partial``), wrapped
+   by a ``x = jax.jit(fn, ...)`` assignment, or wrapped one call deep
+   (``x = _mon.wrap("name", jax.jit(fn, ...))`` — the serving-export
+   idiom).  Static and donated argument coverage (``static_argnums``/
+   ``static_argnames``/``donate_argnums``/``donate_argnames``) is
+   extracted per wrapper, so a jitted function's *traced* and *donated*
+   parameters are known by name.
 2. **Check** — a context-stack walk emits findings for the rule set in
    :mod:`paddle_tpu.analysis.rules` (trace-hygiene rules fire only inside
    jitted bodies; loop/call-site rules fire everywhere else).
+
+v2 (the default) layers project-level dataflow on top — see
+:mod:`paddle_tpu.analysis.dataflow`: calls leaving a jitted body with
+traced arguments are recorded as *call events* and the callee is
+re-analyzed as-if-jitted for those arguments (fixpoint over the call
+graph, within and across modules), per-function host-effect summaries
+let PTL004/PTL008 see syncs hidden behind helpers, and the
+whole-program view powers PTL014 (program-cache-key completeness) and
+PTL015 (lock discipline).
 
 Suppression: a finding whose first source line carries
 ``# tpu-lint: ignore`` (all rules) or ``# tpu-lint: ignore[PTL001,PTL005]``
@@ -28,7 +40,7 @@ from dataclasses import dataclass, field
 from paddle_tpu.analysis.rules import RULES
 
 __all__ = ["Finding", "lint_source", "lint_file", "lint_paths",
-           "canonical_path"]
+           "canonical_path", "iter_python_files"]
 
 _PRAGMA_RE = re.compile(
     r"#\s*tpu-lint:\s*ignore(?:\[([A-Za-z0-9_,\s]*)\])?")
@@ -95,6 +107,17 @@ _ASYNC_SOCKET_METHODS = {"accept", "recv", "recv_into", "recvfrom",
 # dispatch loop (`serving_prefill_chunk` under `prefill_budget`) — a host
 # sync inside either serializes the pipeline the same way
 _STEP_NAME_RE = re.compile(r"(^|_)(steps?|prefill_chunk)($|_)")
+# ...but a *constructor* of a step program is not a dispatch: names like
+# `build_train_step` / `_ensure_train_step` return the compiled callable
+# instead of running it, so they must not export a step effect through
+# the v2 summaries (the seed tree's Engine._build is the motivating case)
+_BUILDER_NAME_RE = re.compile(r"(^|_)(build|make|create|ensure|compile)"
+                              r"(_|$)")
+
+
+def _is_step_name(name):
+    return (_STEP_NAME_RE.search(name) is not None
+            and _BUILDER_NAME_RE.search(name) is None)
 # per-request identifiers fed to `.labels(...)` inside step loops
 # (PTL009): every unique value mints a fresh metric child, so a
 # rid/uuid-valued label grows series cardinality with traffic.  Matched
@@ -227,11 +250,23 @@ class _JitInfo:
     node: object                      # the FunctionDef
     static_names: set = field(default_factory=set)
     static_nums: set = field(default_factory=set)
+    donate_names: set = field(default_factory=set)
+    donate_nums: set = field(default_factory=set)
     arg_offset: int = 0               # 1 when wrapped as a bound method
 
     def params(self):
         a = self.node.args
         return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+    def donated_positions(self):
+        """Donated call-argument indices (``donate_argnums`` are already
+        in that space; ``donate_argnames`` map through the param list)."""
+        pos = set(self.donate_nums)
+        params = self.params()
+        for n in self.donate_names:
+            if n in params:
+                pos.add(params.index(n) - self.arg_offset)
+        return pos
 
     def traced_params(self):
         ps = self.params()
@@ -254,24 +289,29 @@ class _JitInfo:
 
 def _static_from_kwargs(keywords, info):
     for kw in keywords:
-        if kw.arg == "static_argnames":
+        if kw.arg in ("static_argnames", "donate_argnames"):
             v = _literal(kw.value)
+            dst = info.static_names if kw.arg == "static_argnames" \
+                else info.donate_names
             if isinstance(v, str):
-                info.static_names.add(v)
+                dst.add(v)
             elif isinstance(v, (tuple, list)):
-                info.static_names.update(x for x in v if isinstance(x, str))
-        elif kw.arg == "static_argnums":
+                dst.update(x for x in v if isinstance(x, str))
+        elif kw.arg in ("static_argnums", "donate_argnums"):
             v = _literal(kw.value)
+            dst = info.static_nums if kw.arg == "static_argnums" \
+                else info.donate_nums
             if isinstance(v, int):
-                info.static_nums.add(v)
+                dst.add(v)
             elif isinstance(v, (tuple, list)):
-                info.static_nums.update(x for x in v if isinstance(x, int))
+                dst.update(x for x in v if isinstance(x, int))
 
 
 class _Collector:
     def __init__(self):
         self.aliases = _Aliases()
         self.defs_by_name = {}        # name -> [FunctionDef]
+        self.top_defs = {}            # module-level name -> FunctionDef
         self.jitted = {}              # id(FunctionDef) -> _JitInfo
         self.module_jitted = {}       # module-level callable name -> _JitInfo
         self._pending = []            # (Assign node, top_level) — resolved
@@ -282,6 +322,8 @@ class _Collector:
     # defs ---------------------------------------------------------------
     def _handle_def(self, node, top_level):
         self.defs_by_name.setdefault(node.name, []).append(node)
+        if top_level:
+            self.top_defs[node.name] = node
         info = None
         for dec in node.decorator_list:
             cand = self._wrapper_info(dec, node)
@@ -314,10 +356,23 @@ class _Collector:
     # assignments of the form  x = jax.jit(fn, ...) ----------------------
     def _resolve_assign(self, node, top_level):
         value = node.value
-        if not isinstance(value, ast.Call) or not value.args:
+        if not isinstance(value, ast.Call):
             return
-        if not _is_jit_wrapper(self.aliases.resolve(_dotted(value.func))):
-            return
+        if not (value.args and _is_jit_wrapper(
+                self.aliases.resolve(_dotted(value.func)))):
+            # see through ONE wrapping call — the serving-export idiom
+            # `x = _mon.wrap("name", jax.jit(fn, static_argnames=...))`
+            # still jit-wraps `fn`, and its statics/donations key the
+            # module-level program cache exactly like a bare jit
+            inner = None
+            for a in list(value.args) + [kw.value for kw in value.keywords]:
+                if isinstance(a, ast.Call) and a.args and _is_jit_wrapper(
+                        self.aliases.resolve(_dotted(a.func))):
+                    inner = a
+                    break
+            if inner is None:
+                return
+            value = inner
         wrapped, offset = value.args[0], 0
         name = None
         if isinstance(wrapped, ast.Name):
@@ -364,6 +419,64 @@ class _Collector:
 # checking pass
 # --------------------------------------------------------------------------
 
+@dataclass(frozen=True)
+class _CallEvent:
+    """A call that leaves a traced context with traced arguments.
+
+    Picklable (no AST references) so multiprocessing workers can hand
+    cross-module events back to the parent, which re-analyzes the callee
+    as-if-jitted for the traced parameters (analysis/dataflow.py).
+    """
+    desc: tuple       # ("name", n) | ("method", n) | ("dotted", canonical)
+    pos: tuple        # per-positional-arg: does it carry a traced name?
+    kws: tuple        # ((kwarg name, carries-traced), ...)
+    chain: tuple      # call chain so far, ending at the enclosing context
+    home: str         # path of the module the call appears in
+    line: int
+    col: int
+
+
+def _call_name(node):
+    """Surface name of a call target (attribute attr or bare id)."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _sync_of(node, f, name):
+    """PTL004 classification of a call: ``(sync_label, sanctioned)``.
+
+    ``f`` is the alias-resolved dotted target, ``name`` the surface name.
+    Sanction follows the RESOLVED name — see _SYNC_HELPERS."""
+    sync = None
+    if f in _SYNC_NP:
+        sync = "np." + f.split(".")[-1] + "()"
+    elif f == "jax.device_get":
+        sync = "jax.device_get()"
+    elif name in _SYNC_HELPERS:
+        sync = name + "()"
+    elif isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _SYNC_METHODS:
+        sync = "." + node.func.attr + "()"
+    sanctioned = name in _SYNC_HELPERS and (
+        f is None or f.split(".")[-1] in _SYNC_HELPERS)
+    return sync, sanctioned
+
+
+def _wait_of(node, f, name):
+    """PTL008 classification of a call: ``(wait_label, sanctioned)``."""
+    wait = None
+    if f == "time.sleep":
+        wait = "time.sleep()"
+    elif name in _WAIT_SANCTIONED:
+        wait = name + "()"
+    sanctioned = name in _WAIT_SANCTIONED and (
+        f is None or f.split(".")[-1] in _WAIT_SANCTIONED)
+    return wait, sanctioned
+
+
 @dataclass
 class _Loop:
     node: object
@@ -375,7 +488,8 @@ class _Loop:
 
 
 class _Checker:
-    def __init__(self, path, collector, enabled):
+    def __init__(self, path, collector, enabled, *, call_sink=None,
+                 effects=None, chain=()):
         self.path = path
         self.c = collector
         self.enabled = enabled
@@ -383,6 +497,15 @@ class _Checker:
         self.jit_stack = []           # [(JitInfo, traced_name_set)]
         self.loop_stack = []          # [_Loop] — outside jit bodies only
         self.async_stack = []         # [(is_async_def, name)] — PTL013
+        self.donate_stack = []        # per-def [(call, name, callee)] PTL016
+        # v2 hooks (analysis/dataflow.py): call_sink collects _CallEvents
+        # leaving traced contexts; effects maps local function names to
+        # host-effect summaries (sync/wait/step reached through helpers);
+        # chain is the interprocedural call path when this checker runs a
+        # callee as-if-jitted (empty for the base per-module pass)
+        self.call_sink = call_sink
+        self.effects = effects
+        self.chain = tuple(chain)
         # PTL012 exempts test files: a tests/ path component or a
         # test_-prefixed basename (hard-coded interpret=True is exactly
         # how kernel tests pin the emulated path)
@@ -410,7 +533,22 @@ class _Checker:
         tr = self._traced()
         if not tr:
             return set()
-        return self._names_in(node) & tr
+        # occurrences under a static attribute (`x.shape[1]`,
+        # `params["embed"].dtype`) are compile-time metadata, not the
+        # traced VALUE — `int(block_table.shape[1])` is the sanctioned
+        # way to read a dimension and must not count as concretization
+        found = set()
+
+        def walk(n):
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                return
+            if isinstance(n, ast.Name) and n.id in tr:
+                found.add(n.id)
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(node)
+        return found
 
     # branch-test offenders: traced names used OUTSIDE guard predicates,
     # static attrs (.shape/.dtype) and `is None` comparisons
@@ -437,6 +575,16 @@ class _Checker:
                 return
             if isinstance(node, ast.Compare) and all(
                     isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, True)
+                return
+            # `"lm_head" in params` — a string constant can only test
+            # pytree STRUCTURE (dict-key membership), which specializes
+            # at trace time exactly like an isinstance/shape guard
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    isinstance(node.left, ast.Constant) and \
+                    isinstance(node.left.value, str):
                 for child in ast.iter_child_nodes(node):
                     walk(child, True)
                 return
@@ -499,11 +647,15 @@ class _Checker:
         # enclosing async def"
         self.async_stack.append(
             (isinstance(node, ast.AsyncFunctionDef), node.name))
+        self.donate_stack.append([])
         decorators = set(map(id, node.decorator_list))
         for child in ast.iter_child_nodes(node):
             if id(child) in decorators:
                 continue
             self.visit(child)
+        donated = self.donate_stack.pop()
+        if donated:
+            self._donated_reuse(node, donated)
         self.async_stack.pop()
         if pushed:
             self.jit_stack.pop()
@@ -656,13 +808,136 @@ class _Checker:
     def _visit_Call(self, node):
         if self.jit_stack:
             self._call_in_jit(node)
+            self._record_call_event(node)
         else:
             if self.async_stack and self.async_stack[-1][0]:
                 self._call_in_async(node)
             self._call_in_host(node)
+        self._donate_track(node)
         self._call_site(node)
         self._pallas_interpret(node)
         self.generic(node)
+
+    # v2: record calls that leave a traced context with traced arguments,
+    # so dataflow.py can analyze the callee as-if-jitted for them.  Kept
+    # cheap and targeted: resolvable local defs, self/cls methods, and
+    # project-dotted targets only — stdlib/jax/numpy roots never resolve
+    # to project modules and are dropped at the source.
+    _EXTERNAL_ROOTS = {
+        "jax", "numpy", "math", "functools", "itertools", "time", "os",
+        "re", "typing", "collections", "random", "threading", "asyncio",
+        "logging", "json", "socket", "dataclasses", "enum", "abc",
+        "contextlib", "struct", "uuid", "warnings", "sys", "io",
+    }
+
+    def _record_call_event(self, node):
+        if self.call_sink is None:
+            return
+        func = node.func
+        desc = None
+        if isinstance(func, ast.Name):
+            n = func.id
+            if n in _GUARD_CALLS or n in _CONCRETE_BUILTINS:
+                return
+            target = self.c.aliases.map.get(n)
+            if target is not None:
+                if "." not in target or \
+                        target.split(".")[0] in self._EXTERNAL_ROOTS:
+                    return
+                desc = ("dotted", target)
+            elif n in self.c.top_defs:
+                desc = ("name", n)
+            else:
+                return
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in ("self", "cls"):
+            if func.attr not in self.c.defs_by_name:
+                return
+            desc = ("method", func.attr)
+        else:
+            d = self.resolve(func)
+            if d is None or "." not in d or \
+                    d.split(".")[0] in self._EXTERNAL_ROOTS:
+                return
+            desc = ("dotted", d)
+        pos = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                break
+            pos.append(bool(self._traced_in(a)))
+        kws = tuple((kw.arg, bool(self._traced_in(kw.value)))
+                    for kw in node.keywords if kw.arg is not None)
+        if not (any(pos) or any(t for _, t in kws)):
+            return
+        chain = self.chain or (self.jit_stack[0][0].node.name,)
+        self.call_sink.append(_CallEvent(
+            desc=desc, pos=tuple(pos), kws=kws, chain=chain,
+            home=self.path, line=node.lineno, col=node.col_offset))
+
+    # PTL016: a bare variable fed to a donated position of a jitted call
+    # is dead — XLA may alias its buffer for outputs.  Track per function,
+    # then flag the first read after the donating call unless the call's
+    # own statement (or any later statement before the read) rebinds it.
+    def _donate_track(self, node):
+        if "PTL016" not in self.enabled or not self.donate_stack:
+            return
+        if not isinstance(node.func, ast.Name):
+            return
+        info = self.c.module_jitted.get(node.func.id)
+        if info is None or not (info.donate_names or info.donate_nums):
+            return
+        dpos = info.donated_positions()
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i in dpos and isinstance(a, ast.Name):
+                self.donate_stack[-1].append((node, a.id, node.func.id))
+        for kw in node.keywords:
+            if kw.arg in info.donate_names and \
+                    isinstance(kw.value, ast.Name):
+                self.donate_stack[-1].append(
+                    (node, kw.value.id, node.func.id))
+
+    def _donated_reuse(self, fdef, entries):
+        for call, name, callee in entries:
+            if self._rebinds_through(fdef, call, name):
+                continue
+            end = (call.end_lineno, call.end_col_offset)
+            after = sorted(
+                (n for n in ast.walk(fdef)
+                 if isinstance(n, ast.Name) and n.id == name
+                 and (n.lineno, n.col_offset) > end),
+                key=lambda n: (n.lineno, n.col_offset))
+            for n in after:
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    break
+                self.emit("PTL016", n,
+                          f"`{name}` is read after being passed to a "
+                          f"donated argument of jitted `{callee}` "
+                          f"(donated at line {call.lineno}) — XLA may "
+                          "have reused its buffer for the outputs; "
+                          f"rebind the result (`{name} = {callee}(...)`)"
+                          " or drop the donation")
+                break
+
+    @staticmethod
+    def _rebinds_through(fdef, call, name):
+        """True when the statement containing ``call`` rebinds ``name``
+        (the sanctioned drain idiom ``caches = step(params, caches)``)."""
+        for st in ast.walk(fdef):
+            if isinstance(st, ast.Assign):
+                targets = st.targets
+            elif isinstance(st, (ast.AugAssign, ast.AnnAssign,
+                                 ast.NamedExpr)):
+                targets = [st.target]
+            else:
+                continue
+            bound = {n.id for t in targets for n in ast.walk(t)
+                     if isinstance(n, ast.Name)}
+            if name in bound and any(ch is call for ch in ast.walk(st)):
+                return True
+        return False
 
     # PTL013: blocking calls on the event-loop thread
     def _call_in_async(self, node):
@@ -762,17 +1037,29 @@ class _Checker:
 
     def _call_in_host(self, node):
         f = self.resolve(node.func)
-        name = None
-        if isinstance(node.func, ast.Attribute):
-            name = node.func.attr
-        elif isinstance(node.func, ast.Name):
-            name = node.func.id
+        name = _call_name(node)
         if self.loop_stack:
             rec = self.loop_stack[-1]
-            if name is not None and (_STEP_NAME_RE.search(name)
-                                     or name in self.c.module_jitted):
-                for r in self.loop_stack:
-                    r.has_step = True
+            # v2 effect summaries: a call to a LOCAL function (bare name
+            # or self/cls method) inherits the sync/wait/step effects its
+            # body reaches through any depth of same-module helpers
+            eff = None
+            if self.effects is not None and name is not None and (
+                    isinstance(node.func, ast.Name)
+                    or (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in ("self", "cls"))):
+                eff = self.effects.get(name)
+            direct_step = name is not None and (
+                _is_step_name(name) or name in self.c.module_jitted)
+            is_step = direct_step or (eff is not None
+                                      and eff.step is not None)
+            if is_step:
+                # mark ONLY the innermost loop: a sync in an OUTER loop
+                # runs once per many steps — that is the amortized
+                # pattern PTL004 recommends, not a violation
+                rec.has_step = True
+            if direct_step:
                 # PTL010: host-built list operands fed to the step itself
                 # — their length becomes the operand shape
                 for v in list(node.args) + [kw.value
@@ -780,36 +1067,34 @@ class _Checker:
                     what = self._host_list_operand(v)
                     if what is not None:
                         rec.raggeds.append((node, what))
-            sync = None
-            if f in _SYNC_NP:
-                sync = "np." + f.split(".")[-1] + "()"
-            elif f == "jax.device_get":
-                sync = "jax.device_get()"
-            elif name in _SYNC_HELPERS:
-                sync = name + "()"
-            elif isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _SYNC_METHODS:
-                sync = "." + node.func.attr + "()"
             # sanction through the RESOLVED name, not the surface one: a
             # genuine host_fetch helper (unresolvable call targets get the
             # benefit of the doubt) is the designed drain point; an import
             # alias of numpy.asarray/np.array resolves elsewhere and is
             # recorded like any raw sync
-            sanctioned = name in _SYNC_HELPERS and (
-                f is None or f.split(".")[-1] in _SYNC_HELPERS)
+            sync, sanctioned = _sync_of(node, f, name)
             if sync is not None and not sanctioned:
                 rec.syncs.append((node, sync))
+            elif sync is None and eff is not None and eff.sync is not None \
+                    and not is_step:
+                # a call carrying BOTH step and sync effects (train_batch,
+                # engine.step) is a self-contained dispatch+readback unit
+                # — the readback lives in the callee's body where the
+                # callee's author can see and amortize it; the loop author
+                # cannot hoist it, so don't charge the call site
+                chain, witness = eff.sync
+                rec.syncs.append((node, "{}() (reaches {} via {})".format(
+                    name, witness, " -> ".join((name,) + chain))))
             # PTL008: blocking waits, sanctioned through the same
             # resolved-name logic as the host_fetch exemption above
-            wait = None
-            if f == "time.sleep":
-                wait = "time.sleep()"
-            elif name in _WAIT_SANCTIONED:
-                wait = name + "()"
-            wait_ok = name in _WAIT_SANCTIONED and (
-                f is None or f.split(".")[-1] in _WAIT_SANCTIONED)
+            wait, wait_ok = _wait_of(node, f, name)
             if wait is not None and not wait_ok:
                 rec.waits.append((node, wait))
+            elif wait is None and eff is not None and eff.wait is not None \
+                    and not is_step:
+                chain, witness = eff.wait
+                rec.waits.append((node, "{}() (reaches {} via {})".format(
+                    name, witness, " -> ".join((name,) + chain))))
             # PTL009: per-request identifiers minted into metric labels
             if name == "labels" and isinstance(node.func, ast.Attribute):
                 for v in list(node.args) + [kw.value
@@ -961,8 +1246,17 @@ def _suppressed(finding, lines):
     return finding.rule in ids
 
 
-def lint_source(source, path="<string>", rules=None):
-    """Lint one python source string; returns a list of Findings."""
+def lint_source(source, path="<string>", rules=None, interprocedural=True):
+    """Lint one python source string; returns a list of Findings.
+
+    ``interprocedural=True`` (the default) runs the v2 within-module
+    dataflow pass on top of the v1 walk: traced-value facts propagate
+    through same-module helper calls (PTL001/PTL002/PTL005/PTL011 fire
+    through indirection, findings carry the call chain), host-effect
+    summaries let PTL004/PTL008 see syncs behind helpers, and the
+    dataflow-backed rules (PTL014/PTL015) run.  ``interprocedural=False``
+    is the v1 single-module pass, kept for comparison and bisection.
+    """
     enabled = set(rules) if rules is not None else set(RULES)
     try:
         tree = ast.parse(source)
@@ -971,23 +1265,32 @@ def lint_source(source, path="<string>", rules=None):
             return []
         return [Finding("PTL000", path, e.lineno or 0, e.offset or 0,
                         f"syntax error: {e.msg}")]
-    collector = _Collector().run(tree)
-    findings = _Checker(path, collector, enabled).check(tree)
-    lines = source.splitlines()
-    findings = [f for f in findings if not _suppressed(f, lines)]
+    if not interprocedural:
+        collector = _Collector().run(tree)
+        findings = _Checker(path, collector, enabled).check(tree)
+        lines = source.splitlines()
+        findings = [f for f in findings if not _suppressed(f, lines)]
+    else:
+        from paddle_tpu.analysis import dataflow as _dataflow
+        findings = _dataflow.lint_module_source(
+            source, path, enabled, tree=tree)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
 
-def lint_file(path, rules=None):
+def lint_file(path, rules=None, interprocedural=True):
+    from paddle_tpu.analysis.config import rules_for
     with open(path, encoding="utf-8", errors="replace") as fh:
         src = fh.read()
-    return lint_source(src, path=canonical_path(path), rules=rules)
+    canonical = canonical_path(path)
+    return lint_source(src, path=canonical,
+                       rules=sorted(rules_for(canonical, rules)),
+                       interprocedural=interprocedural)
 
 
-def lint_paths(paths, rules=None):
-    """Lint files/directories (recursing into ``*.py``); returns findings
-    sorted by (path, line, col, rule)."""
+def iter_python_files(paths):
+    """Expand files/directories into the sorted ``*.py`` file list the
+    tree lint walks (``__pycache__`` pruned)."""
     files = []
     for p in paths:
         if os.path.isdir(p):
@@ -997,8 +1300,17 @@ def lint_paths(paths, rules=None):
                              for n in sorted(names) if n.endswith(".py"))
         else:
             files.append(p)
-    findings = []
-    for f in files:
-        findings.extend(lint_file(f, rules=rules))
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    return files
+
+
+def lint_paths(paths, rules=None, jobs=None):
+    """Project-level lint of files/directories (recursing into ``*.py``).
+
+    Runs the per-module pass on every file (fanned across a
+    multiprocessing pool when ``jobs`` > 1 — findings are identical to
+    the serial order), then the cross-module phases: traced-value
+    propagation through imported helpers and the PTL014 program-cache-key
+    audit.  Per-path rule profiles (analysis/config.py) apply.  Returns
+    findings sorted by (path, line, col, rule)."""
+    from paddle_tpu.analysis import dataflow as _dataflow
+    return _dataflow.lint_project_paths(paths, rules=rules, jobs=jobs)

@@ -1,11 +1,17 @@
-"""Reporters: human text and machine JSON (the CI-consumable shape)."""
+"""Reporters: human text, machine JSON, and SARIF 2.1.0 (the shape CI
+annotation renderers and editors consume)."""
 from __future__ import annotations
 
 import json
 
 from paddle_tpu.analysis.rules import RULES
 
-__all__ = ["format_text", "format_json", "format_rule_table"]
+__all__ = ["format_text", "format_json", "format_sarif",
+           "format_rule_table"]
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
 
 
 def format_text(new, baselined=(), verbose_baseline=False):
@@ -48,6 +54,70 @@ def format_json(new, baselined=()):
             "errors": sum(1 for f in new if f.severity == "error"),
             "warnings": sum(1 for f in new if f.severity == "warning"),
         },
+        # full rule inventory, so downstream dashboards can render
+        # zero-count rules; must agree with --list-rules and the SARIF
+        # driver.rules block (tier-1 asserts this)
+        "rules": sorted(RULES),
+    }
+    return json.dumps(payload, indent=1)
+
+
+def _sarif_result(finding, fingerprint, suppressed=False):
+    result = {
+        "ruleId": finding.rule,
+        "level": "error" if finding.severity == "error" else "warning",
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(finding.line, 1),
+                           "startColumn": finding.col + 1},
+            },
+        }],
+        "partialFingerprints": {"tpuLint/v1": fingerprint},
+    }
+    if suppressed:
+        # baselined findings ride along as externally-suppressed results
+        # so SARIF viewers show the debt without failing the gate
+        result["suppressions"] = [{"kind": "external",
+                                   "justification": "tpu-lint baseline"}]
+    return result
+
+
+def format_sarif(new, baselined=()):
+    """SARIF 2.1.0 log: one run, the full rule inventory on the driver,
+    new findings as results and baselined ones as suppressed results."""
+    from paddle_tpu.analysis.baseline import fingerprints
+
+    rules = []
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        rules.append({
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": r.description},
+            "help": {"text": r.hint},
+            "defaultConfiguration": {
+                "level": "error" if r.severity == "error" else "warning"},
+        })
+    results = [_sarif_result(f, fp)
+               for f, fp in zip(new, fingerprints(list(new)))]
+    results += [_sarif_result(f, fp, suppressed=True)
+                for f, fp in zip(baselined, fingerprints(list(baselined)))]
+    payload = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpu-lint",
+                "semanticVersion": "2.0.0",
+                "rules": rules,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
     }
     return json.dumps(payload, indent=1)
 

@@ -28,6 +28,9 @@ class Rule:
     severity: str
     description: str
     hint: str
+    # registered mechanical fixit slug (applied by ``--fix`` via
+    # paddle_tpu.analysis.fixes.FIXERS); empty = no safe auto-fix
+    fixit: str = ""
 
 
 _RULE_LIST = [
@@ -95,12 +98,14 @@ _RULE_LIST = [
         "mutable default argument (list/dict/set literal) — shared across "
         "calls",
         "default to None and construct inside the body",
+        fixit="mutable-default-to-none",
     ),
     Rule(
         "PTL007", "bare-except", WARNING,
         "bare `except:` — swallows KeyboardInterrupt/SystemExit and masks "
         "trace-time errors",
         "catch Exception (or the specific error) instead",
+        fixit="bare-except-to-exception",
     ),
     Rule(
         "PTL008", "blocking-wait-in-step-loop", WARNING,
@@ -184,6 +189,46 @@ _RULE_LIST = [
         "syncs to the engine driver thread (run_in_executor / a "
         "thread-safe handoff queue) and await the result; use asyncio "
         "streams or loop.sock_* for socket I/O",
+    ),
+    Rule(
+        "PTL014", "program-cache-key-completeness", ERROR,
+        "a static knob bound at a jitted impl's call site inside a "
+        "program-cache factory (a function that stores compiled programs "
+        "in a dict keyed by a tuple) is missing from the cache-key tuple "
+        "— two configurations differing only in that knob collide on the "
+        "same cache entry and silently reuse a stale compiled program "
+        "(the worst silent-wrong-answer class this repo has).  Checked "
+        "project-wide: impl `static_argnames` are read from the defining "
+        "module (models/llama_decode.py), key tuples from the factory "
+        "module (serving/sharding.py `serving_tp_programs`)",
+        "add the knob to the program-cache key tuple — ROADMAP's standing "
+        "note: every new static axis (kernel impl, weight dtype, sampler, "
+        "adapter set) extends the key rather than forking a dispatch seam",
+    ),
+    Rule(
+        "PTL015", "unsynchronized-shared-state", WARNING,
+        "write to a `self.*` attribute that is written under `with "
+        "self.<lock>:` elsewhere in the same lock-owning class, but here "
+        "outside any held-lock region (and outside `__init__`) — the "
+        "engine driver thread, the asyncio server and the router all "
+        "touch these objects concurrently, so the unlocked write races "
+        "every locked reader/writer of the same attribute",
+        "wrap the write in `with self.<lock>:` (the "
+        "observability/metrics.py idiom), or do it in `__init__` before "
+        "the object is shared; if the path is genuinely single-threaded, "
+        "suppress with a justified `# tpu-lint: ignore[PTL015]` pragma",
+    ),
+    Rule(
+        "PTL016", "donated-buffer-reuse", ERROR,
+        "a variable passed to a `donate_argnums`/`donate_argnames` "
+        "position of a jitted call is read again later in the same "
+        "function without being rebound — donation hands the buffer to "
+        "XLA, which may alias it for outputs, so the later read can see "
+        "garbage on TPU (and quietly works on CPU, where donation is "
+        "ignored, hiding the bug until deployment)",
+        "rebind the variable to the call's result "
+        "(`caches = step(params, caches)` — the engine's drain idiom), "
+        "or stop donating that argument",
     ),
 ]
 

@@ -27,23 +27,27 @@ class Generator:
         self._key = None
 
     def manual_seed(self, s):
-        self._seed = int(s)
-        self._key = None
+        with self._lock:
+            self._seed = int(s)
+            self._key = None
         return self
 
     def initial_seed(self):
         return self._seed
 
     def get_state(self):
-        self._ensure()
-        return self._key
+        with self._lock:
+            self._ensure()
+            return self._key
 
     def set_state(self, state):
-        self._key = state
+        with self._lock:
+            self._key = state
 
     def _ensure(self):
+        # caller holds self._lock (non-reentrant, so it can't re-take it)
         if self._key is None:
-            self._key = jax.random.key(self._seed)
+            self._key = jax.random.key(self._seed)  # tpu-lint: ignore[PTL015]
 
     def next_key(self):
         with self._lock:

@@ -15,8 +15,9 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.analysis import (
-    RULES, fingerprints, lint_paths, lint_source, load_baseline,
-    split_findings, write_baseline,
+    RULES, fingerprints, fix_source, format_json, format_sarif,
+    lint_paths, lint_project_sources, lint_source, load_baseline,
+    preview_diff, profile_of, rules_for, split_findings, write_baseline,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1012,3 +1013,750 @@ class TestRuntime:
             return x * 2 + 1
 
         assert find_tracer_leaks(clean, jnp.ones((2,))) == []
+
+# ---------------------------------------------------------------------------
+# v2: interprocedural traced-value propagation
+# ---------------------------------------------------------------------------
+
+class TestInterprocedural:
+    HELPER_ITEM = textwrap.dedent("""
+        import jax
+
+        def helper(v):
+            return v.item()
+
+        @jax.jit
+        def fwd(x):
+            return helper(x)
+    """)
+
+    def test_one_hop_flagged_by_v2_not_v1(self):
+        # the acceptance fixture: a jitted body calling a helper that
+        # concretizes its traced arg — invisible to the v1 single-pass
+        # walk, flagged with the call chain by the v2 dataflow pass
+        v1 = lint_source(self.HELPER_ITEM, path="m.py",
+                         interprocedural=False)
+        assert v1 == []
+        (f,) = lint_source(self.HELPER_ITEM, path="m.py")
+        assert f.rule == "PTL001"
+        assert "[traced via fwd -> helper]" in f.message
+        assert f.line == 5  # anchored at the offending line in the HELPER
+
+    def test_two_hops(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def inner(v):
+                if v:
+                    return 1
+                return 0
+
+            def outer(v):
+                return inner(v)
+
+            @jax.jit
+            def fwd(x):
+                return outer(x)
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL002"
+        assert "[traced via fwd -> outer -> inner]" in f.message
+
+    def test_static_arg_not_propagated(self):
+        src = textwrap.dedent("""
+            import jax
+            import functools
+
+            def helper(v):
+                return int(v)
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def fwd(x, n):
+                return helper(n) + x
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_static_attr_laundering_through_call(self):
+        # `x.shape[0]` / `params["w"].dtype` are compile-time metadata:
+        # passing them to a helper must not mark its param traced
+        src = textwrap.dedent("""
+            import jax
+
+            def helper(n, dt):
+                if dt == "int8":
+                    return int(n)
+                return n
+
+            @jax.jit
+            def fwd(x, params):
+                return helper(x.shape[0], params["w"].dtype)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_pragma_on_callee_line_suppresses(self):
+        src = self.HELPER_ITEM.replace(
+            "return v.item()",
+            "return v.item()  # tpu-lint: ignore[PTL001]")
+        assert lint_source(src, path="m.py") == []
+
+    def test_cross_module_propagation(self):
+        files = {
+            "pkg/ops.py": textwrap.dedent("""
+                def helper(v):
+                    return v.item()
+            """),
+            "pkg/model.py": textwrap.dedent("""
+                import jax
+                from pkg.ops import helper
+
+                @jax.jit
+                def fwd(x):
+                    return helper(x)
+            """),
+        }
+        findings = lint_project_sources(files)
+        (f,) = [f for f in findings if f.rule == "PTL001"]
+        assert f.path == "pkg/ops.py"
+        assert "[traced via fwd -> helper]" in f.message
+
+    def test_effect_summary_host_sync(self):
+        # PTL004 sees a sync hidden behind a helper, with a witness chain
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def drain(h):
+                return np.asarray(h)
+
+            def serve(step, batches):
+                for b in batches:
+                    out = step(b)
+                    drain(out)
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL004"
+        assert "reaches np.asarray() via drain" in f.message
+
+    def test_step_plus_sync_call_not_charged(self):
+        # a callee that BOTH dispatches the step and reads back is a
+        # self-contained unit — its caller's loop is not the violation
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def train_step(b):
+                loss = _step(b)
+                return np.asarray(loss)
+
+            def fit(batches):
+                for b in batches:
+                    train_step(b)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_outer_loop_sync_amortized_over_inner_steps(self):
+        # sync once per epoch around an inner step loop is the pattern
+        # PTL004 RECOMMENDS; only the innermost dispatching loop counts
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def fit(epochs, batches, evaluate):
+                for epoch in range(epochs):
+                    for b in batches:
+                        loss = train_step(b)
+                    np.asarray(loss)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_builder_name_is_not_a_dispatch(self):
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def refresh(self):
+                build_train_step(self)
+
+            def loop(items):
+                for it in items:
+                    refresh(it)
+                    np.asarray(it)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL014: program-cache-key completeness
+# ---------------------------------------------------------------------------
+
+class TestPTL014:
+    IMPLS = textwrap.dedent("""
+        import functools
+        import jax
+
+        def _decode_impl(params, caches, cfg, n_steps, attn_impl):
+            return caches
+
+        serving_decode = _mon.wrap("serving_decode", jax.jit(
+            _decode_impl,
+            static_argnames=("cfg", "n_steps", "attn_impl"),
+            donate_argnames=("caches",)))
+    """)
+
+    def _factory(self, key_line):
+        return textwrap.dedent("""
+            from pkg.impls import serving_decode
+
+            _PROGRAMS = {}
+
+            def tp_programs(mesh, cfg, sync_every, attn_impl):
+                key = %s
+                hit = _PROGRAMS.get(key)
+                if hit is not None:
+                    return hit
+
+                def run(params, caches):
+                    return serving_decode(params, caches, cfg,
+                                          n_steps=sync_every,
+                                          attn_impl=attn_impl)
+                _PROGRAMS[key] = run
+                return run
+        """) % key_line
+
+    def test_complete_key_clean(self):
+        files = {"pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._factory(
+                     "(mesh, cfg, sync_every, attn_impl)")}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+    def test_missing_axis_exactly_one_finding(self):
+        # the acceptance proof: drop ONE axis from the key tuple -> one
+        # finding naming the knob and both file locations
+        files = {"pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._factory(
+                     "(mesh, cfg, sync_every)")}
+        found = [f for f in lint_project_sources(files)
+                 if f.rule == "PTL014"]
+        assert len(found) == 1
+        (f,) = found
+        assert f.path == "pkg/factory.py"
+        assert "`attn_impl`" in f.message
+        assert "pkg/impls.py" in f.message and "pkg/factory.py" in f.message
+
+    def test_renamed_binding_counts(self):
+        # `n_steps=sync_every` binds the static through a rename: either
+        # the param name or the bound local in the key satisfies the axis
+        files = {"pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._factory(
+                     "(mesh, cfg, n_steps, attn_impl)")}
+        found = [f for f in lint_project_sources(files)
+                 if f.rule == "PTL014"]
+        assert [("sync_every" in f.message or "n_steps" in f.message)
+                for f in found] == []
+
+    def test_const_bound_static_is_exempt(self):
+        # a knob bound to a literal at the call site cannot vary, so it
+        # does not need a key axis
+        factory = self._factory("(mesh, cfg, sync_every)").replace(
+            "attn_impl=attn_impl", "attn_impl='fused'")
+        files = {"pkg/impls.py": self.IMPLS, "pkg/factory.py": factory}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+    def test_pragma_suppresses(self):
+        factory = self._factory("(mesh, cfg, sync_every)").replace(
+            "key = (mesh, cfg, sync_every)",
+            "key = (mesh, cfg, sync_every)"
+            "  # tpu-lint: ignore[PTL014]")
+        files = {"pkg/impls.py": self.IMPLS, "pkg/factory.py": factory}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+
+# ---------------------------------------------------------------------------
+# PTL015: unsynchronized shared state in lock-owning classes
+# ---------------------------------------------------------------------------
+
+class TestPTL015:
+    def test_unlocked_write_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._vals = {}
+
+                def add(self, k, v):
+                    with self._lock:
+                        self._vals[k] = v
+
+                def reset(self):
+                    self._vals = {}
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL015"
+        assert "`_vals`" in f.message and "reset" in f.message
+
+    def test_mutator_method_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class Buf:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def flush(self):
+                    with self._lock:
+                        out, self._items = self._items, []
+                    return out
+
+                def push(self, x):
+                    self._items.append(x)
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL015"
+        assert "`_items`" in f.message
+
+    def test_init_and_locked_writes_tn(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_unprotected_attr_tn(self):
+        # an attr never written under the lock is not in the protected
+        # set — no claim about it
+        src = textwrap.dedent("""
+            import threading
+
+            class Mixed:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._hot = {}
+                    self.label = ""
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._hot[k] = v
+
+                def rename(self, s):
+                    self.label = s
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_lockless_class_tn(self):
+        src = textwrap.dedent("""
+            class Plain:
+                def __init__(self):
+                    self._vals = {}
+
+                def reset(self):
+                    self._vals = {}
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_pragma_suppresses(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._vals = {}
+
+                def add(self, k, v):
+                    with self._lock:
+                        self._vals[k] = v
+
+                def reset_unshared(self):
+                    self._vals = {}  # tpu-lint: ignore[PTL015]
+        """)
+        assert lint_source(src, path="m.py") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL016: donated-buffer reuse
+# ---------------------------------------------------------------------------
+
+class TestPTL016:
+    def test_read_after_donation_tp(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnames=("caches",))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                return caches.shape
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL016"
+        assert "`caches`" in f.message and "step" in f.message
+
+    def test_donate_argnums_kwarg_tp(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnums=(1,))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                return len(caches)
+        """)
+        assert [f.rule for f in lint_source(src, path="m.py")] == ["PTL016"]
+
+    def test_rebind_through_call_tn(self):
+        # the serving idiom: the donating call's own statement rebinds
+        # the name, so every later read sees the fresh buffer
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnames=("caches",))
+
+            def drive(params, caches):
+                caches = step(params, caches)
+                return caches
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_rebind_before_read_tn(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnames=("caches",))
+
+            def drive(params, caches, fresh):
+                out = step(params, caches)
+                caches = fresh
+                return caches
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_non_donated_arg_tn(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnames=("caches",))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                return params
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_pragma_suppresses(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def _impl(params, caches):
+                return caches
+
+            step = jax.jit(_impl, donate_argnames=("caches",))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                return caches.shape  # tpu-lint: ignore[PTL016]
+        """)
+        assert lint_source(src, path="m.py") == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 reporter
+# ---------------------------------------------------------------------------
+
+class TestSarif:
+    DIRTY2 = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return int(x)
+    """)
+
+    def _log(self, new, baselined=()):
+        return json.loads(format_sarif(new, baselined))
+
+    def test_schema_shape(self):
+        # golden schema-shape: the envelope keys a SARIF consumer
+        # requires, in the exact places it requires them
+        findings = lint_source(self.DIRTY2, path="pkg/f.py")
+        log = self._log(findings)
+        assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+        assert log["version"] == "2.1.0"
+        (run,) = log["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "tpu-lint"
+        assert {r["id"] for r in driver["rules"]} == set(RULES)
+        for r in driver["rules"]:
+            assert r["shortDescription"]["text"]
+            assert r["fullDescription"]["text"]
+            assert r["defaultConfiguration"]["level"] in ("error",
+                                                          "warning")
+        assert run["columnKind"] == "utf16CodeUnits"
+        (res,) = run["results"]
+        assert res["ruleId"] == "PTL001" and res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "pkg/f.py"
+        assert loc["region"]["startLine"] == 6
+        assert loc["region"]["startColumn"] >= 1
+        assert "suppressions" not in res
+
+    def test_fingerprints_match_baseline(self):
+        findings = lint_source(self.DIRTY2, path="pkg/f.py")
+        log = self._log(findings)
+        (res,) = log["runs"][0]["results"]
+        assert res["partialFingerprints"]["tpuLint/v1"] == \
+            fingerprints(findings)[0]
+
+    def test_baselined_as_suppressed(self):
+        findings = lint_source(self.DIRTY2, path="pkg/f.py")
+        log = self._log([], baselined=findings)
+        (res,) = log["runs"][0]["results"]
+        assert res["suppressions"] == [
+            {"kind": "external", "justification": "tpu-lint baseline"}]
+
+    def test_cli_sarif(self, tmp_path):
+        mod = tmp_path / "dirty.py"
+        mod.write_text(self.DIRTY2)
+        r = _run_cli([str(mod), "--format", "sarif", "--no-baseline"])
+        assert r.returncode == 1
+        log = json.loads(r.stdout)
+        assert log["version"] == "2.1.0"
+        assert len(log["runs"][0]["results"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# --fix: mechanical fixits
+# ---------------------------------------------------------------------------
+
+class TestFix:
+    def test_mutable_default_roundtrip(self):
+        src = ("def f(a, b=[], c={'k': 1}):\n"
+               "    b.append(a)\n"
+               "    return b, c\n")
+        fixed, applied = fix_source(src)
+        assert [r for r, _ in applied] == ["PTL006", "PTL006"]
+        assert "b=None" in fixed and "c=None" in fixed
+        assert "if b is None:" in fixed and "if c is None:" in fixed
+        # behavior preserved: fresh literal per call
+        ns = {}
+        exec(fixed, ns)
+        assert ns["f"](1) == ([1], {"k": 1})
+        assert ns["f"](2) == ([2], {"k": 1})  # no shared default
+        # and the finding is actually gone
+        assert "PTL006" not in [f.rule
+                                for f in lint_source(fixed, path="m.py")]
+
+    def test_docstring_and_kwonly(self):
+        src = ('def f(*, xs=[]):\n'
+               '    """doc."""\n'
+               '    return xs\n')
+        fixed, _ = fix_source(src)
+        lines = fixed.splitlines()
+        assert lines[1] == '    """doc."""'
+        assert lines[2] == "    if xs is None:"
+
+    def test_bare_except_roundtrip(self):
+        src = ("try:\n    x = 1\nexcept:\n    pass\n")
+        fixed, applied = fix_source(src)
+        assert applied == [("PTL007", 3)]
+        assert "except Exception:" in fixed
+        assert lint_source(fixed, path="m.py") == []
+
+    def test_idempotent(self):
+        src = ("def f(b=[]):\n"
+               "    try:\n"
+               "        return b\n"
+               "    except:\n"
+               "        raise\n")
+        once, applied = fix_source(src)
+        assert len(applied) == 2
+        twice, applied2 = fix_source(once)
+        assert twice == once and applied2 == []
+
+    def test_one_liner_skipped(self):
+        src = "def f(b=[]): return b\n"
+        fixed, applied = fix_source(src)
+        assert fixed == src and applied == []
+
+    def test_unparsable_untouched(self):
+        src = "def f(:\n"
+        assert fix_source(src) == (src, [])
+
+    def test_rule_filter(self):
+        src = ("def f(b=[]):\n"
+               "    try:\n"
+               "        return b\n"
+               "    except:\n"
+               "        raise\n")
+        fixed, applied = fix_source(src, rules={"PTL007"})
+        assert [r for r, _ in applied] == ["PTL007"]
+        assert "b=[]" in fixed
+
+    def test_cli_fix_writes(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def f(b=[]):\n    return b\n")
+        r = _run_cli([str(mod), "--fix", "--no-baseline"])
+        assert r.returncode == 0, r.stderr
+        assert "fixed 1 finding(s) in 1 file(s)" in r.stdout
+        assert "b=None" in mod.read_text()
+
+    def test_cli_dry_run_diff(self, tmp_path):
+        mod = tmp_path / "m.py"
+        before = "def f(b=[]):\n    return b\n"
+        mod.write_text(before)
+        r = _run_cli([str(mod), "--fix", "--dry-run", "--no-baseline"])
+        assert r.returncode == 0, r.stderr
+        assert "-def f(b=[]):" in r.stdout
+        assert "+def f(b=None):" in r.stdout
+        assert "would fix 1 finding(s)" in r.stdout
+        assert mod.read_text() == before  # nothing written
+
+    def test_cli_dry_run_requires_fix(self, tmp_path):
+        r = _run_cli([str(tmp_path), "--dry-run"])
+        assert r.returncode == 2 and "--dry-run requires --fix" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# --jobs: parallel linting must be byte-identical to serial
+# ---------------------------------------------------------------------------
+
+class TestParallel:
+    def test_serial_parallel_identical(self, tmp_path):
+        mods = {
+            "a.py": "def f(b=[]):\n    return b\n",
+            "b.py": "try:\n    x = 1\nexcept:\n    pass\n",
+            "c.py": ("import jax\n\n"
+                     "def helper(v):\n    return v.item()\n\n"
+                     "@jax.jit\ndef fwd(x):\n    return helper(x)\n"),
+            "d.py": "x = (\n",  # syntax error
+            "e.py": "y = 1\n",
+        }
+        for name, src in mods.items():
+            (tmp_path / name).write_text(src)
+        serial = lint_paths([str(tmp_path)], jobs=1)
+        parallel = lint_paths([str(tmp_path)], jobs=4)
+        assert [f.as_dict() for f in serial] == \
+            [f.as_dict() for f in parallel]
+        assert {f.rule for f in serial} >= {"PTL000", "PTL001", "PTL006",
+                                            "PTL007"}
+
+    def test_parallel_tree_matches_serial(self):
+        tree = os.path.join(REPO, "paddle_tpu", "serving")
+        serial = lint_paths([tree], jobs=1)
+        parallel = lint_paths([tree], jobs=2)
+        assert [f.as_dict() for f in serial] == \
+            [f.as_dict() for f in parallel]
+
+    def test_cli_jobs(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def f(b=[]):\n    return b\n")
+        r = _run_cli([str(mod), "--jobs", "2", "--no-baseline"])
+        assert r.returncode == 1
+        assert "PTL006" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# per-path profiles: relaxed rule sets for tests/ and bench scripts
+# ---------------------------------------------------------------------------
+
+class TestProfiles:
+    def test_profile_selection(self):
+        assert profile_of("tests/test_serving.py") == "tests"
+        assert profile_of("test_x.py") == "tests"
+        assert profile_of("tests/conftest.py") == "tests"
+        assert profile_of("bench.py") == "bench"
+        assert profile_of("bench_sweep.py") == "bench"
+        assert profile_of("paddle_tpu/serving/engine.py") == "default"
+
+    def test_relaxed_rules(self):
+        full = rules_for("paddle_tpu/x.py", None)
+        relaxed = rules_for("tests/test_x.py", None)
+        assert full == set(RULES)
+        assert full - relaxed == {"PTL004", "PTL008", "PTL009"}
+        # explicit --rules still intersects with the profile
+        assert rules_for("tests/test_x.py", ["PTL004", "PTL001"]) == \
+            {"PTL001"}
+
+    def test_step_loop_sync_allowed_in_tests(self, tmp_path):
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def loop(xs):
+                for x in xs:
+                    out = train_step(x)
+                    np.asarray(out)
+        """)
+        prod = tmp_path / "prod.py"
+        prod.write_text(src)
+        test = tmp_path / "test_loop.py"
+        test.write_text(src)
+        assert [f.rule for f in lint_paths([str(prod)])] == ["PTL004"]
+        assert lint_paths([str(test)]) == []
+
+    def test_extended_tree_gate(self):
+        # the whole-repo gate: paddle_tpu strict, tests/ + bench*.py
+        # under their relaxed profiles — all clean with no baseline debt
+        paths = [os.path.join(REPO, "paddle_tpu"),
+                 os.path.join(REPO, "tests"),
+                 os.path.join(REPO, "bench.py"),
+                 os.path.join(REPO, "bench_sweep.py")]
+        findings = lint_paths(paths)
+        msgs = [f"{f.path}:{f.line}: {f.rule} {f.message}"
+                for f in findings]
+        assert not findings, "\n".join(msgs)
+
+
+# ---------------------------------------------------------------------------
+# rule-inventory agreement + self-lint
+# ---------------------------------------------------------------------------
+
+class TestRuleInventory:
+    def test_reporters_agree_with_list_rules(self):
+        r = _run_cli(["--list-rules"])
+        assert r.returncode == 0
+        cli_rules = {line.split()[0] for line in r.stdout.splitlines()[1:]
+                     if line.strip()}
+        json_rules = set(json.loads(format_json([]))["rules"])
+        sarif_rules = {rule["id"] for rule in json.loads(
+            format_sarif([]))["runs"][0]["tool"]["driver"]["rules"]}
+        assert cli_rules == json_rules == sarif_rules == set(RULES)
+
+    def test_fixit_slugs_registered(self):
+        from paddle_tpu.analysis.fixes import FIXERS
+        advertised = {r.fixit for r in RULES.values() if r.fixit}
+        assert advertised == set(FIXERS)
+        for slug, rid in FIXERS.items():
+            assert RULES[rid].fixit == slug
+
+    def test_self_lint_all_rules(self):
+        # the linter's own package, every rule enabled, no profile
+        # relaxation and no baseline — it must hold itself to v2
+        pkg = os.path.join(REPO, "paddle_tpu", "analysis")
+        findings = lint_paths([pkg], rules=sorted(RULES))
+        msgs = [f"{f.path}:{f.line}: {f.rule} {f.message}"
+                for f in findings]
+        assert not findings, "\n".join(msgs)
