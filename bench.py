@@ -954,6 +954,164 @@ def bench_serving_router(n_requests=64, n_replicas=2, batch=8):
     }
 
 
+def bench_serving_disagg(n_requests=32, batch=8):
+    """Disaggregated prefill/decode A/B (round 18, serving/disagg.py):
+    one colocated paged engine vs a 1-prefill + 1-decode split
+    (DisaggCoordinator over InProcessTransport) on the same mixed
+    long-prompt workload, decode geometry identical.
+
+    The headline is the admission-interference tax on the loop that owns
+    the decodes: per-token step latency — time spent inside the
+    token-emitting engine's own ``step()`` calls per token drained —
+    sampled while ANY request in the system is between submit and first
+    token (an admission/prefill window).  For the colocated engine that
+    loop dispatches prefill chunks and decodes together, so admission
+    windows inflate its per-token cost; for the split, the decode
+    worker's dispatch loop never sees a prefill chunk (migrations land
+    in the coordinator pump, between steps), so
+    ``serving_disagg_adm_tpot_p95_ms`` must land BELOW
+    ``serving_colocated_adm_tpot_p95_ms``.  Step time, not wall-clock
+    arrival gaps, because in-process both workers share one host thread
+    — wall-clock would charge the prefill worker's chunks to decode
+    tokens, an artifact a two-host deployment doesn't have.
+
+    The cost side is the migration itself: ``serving_kv_transfer_p50_ms``
+    (block-chain export -> transport -> import, off the coordinator's own
+    histogram) — and since the first token is emitted BEFORE the
+    transfer is paid (it rides the handoff), the TTFT gate is
+    ``serving_disagg_ttft_p95_ms`` showing no regression over colocated
+    beyond noise + transfer cost."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.serving import (DecodeWorker, DisaggCoordinator,
+                                    PrefillWorker, Request, ServingEngine)
+
+    small = os.environ.get("BENCH_SERVING_SMALL") == "1"
+    if small:
+        n_requests, batch, lmax, kvb = min(n_requests, 24), 4, 512, 64
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=688,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=2, max_position_embeddings=lmax,
+            dtype="float32",
+        )
+        o_lo, o_hi = 16, 33
+    else:
+        lmax, kvb = 2048, 256
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=lmax,
+            dtype="bfloat16",
+        )
+        o_lo, o_hi = 64, 129
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(23)
+    # long-prompt-heavy mix: prompts at 25-50% of max_len keep chunked
+    # prefills in flight throughout the run, so admission windows overlap
+    # most of the decode work — the interference-visible regime
+    p_lens = rng.integers(lmax // 4, lmax // 2 + 1, n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, int(p)) for p in p_lens]
+    olens = rng.integers(o_lo, o_hi, n_requests)
+    total_new = int(olens.sum())
+    geom = dict(batch_size=batch, max_len=lmax, sync_every=4,
+                decode_chunk=kvb, prefill_chunk=kvb,
+                prompt_buckets=[lmax // 4, lmax // 2],
+                kv_block=kvb, max_live_tokens=batch * lmax,
+                instrument=False, recorder=False)
+
+    def drive(system, decode_engine):
+        events = []                       # (t_emit, n_tokens)
+
+        def cb(r, toks):
+            events.append((time.perf_counter(), len(toks)))
+        steps = []                        # decode-loop step (t0, t1)
+        inner = decode_engine.step
+
+        def timed_step():
+            s0 = time.perf_counter()
+            out = inner()
+            steps.append((s0, time.perf_counter()))
+            return out
+        decode_engine.step = timed_step
+        reqs = [Request(p, int(o), stream_cb=cb)
+                for p, o in zip(prompts, olens)]
+        for q in reqs:
+            system.submit(q)
+        t0 = time.perf_counter()
+        system.run()
+        dt = time.perf_counter() - t0
+        system.close()
+        # admission windows: submit -> first token, any request
+        windows = [(q.t_submit, q.t_first) for q in reqs
+                   if q.t_first is not None]
+        # Per-token step latency: sync_every batches drains, so charge
+        # the decode-loop step time ACCUMULATED since the last drain to
+        # the tokens that drain releases; a sample is admission-active
+        # when its drain lands inside some request's submit->first
+        # window (the only time colocated steps carry prefill chunks).
+        samples, acc, i = [], 0.0, 0
+        for s0, s1 in steps:
+            acc += s1 - s0
+            toks = in_window = 0
+            while i < len(events) and events[i][0] < s0:
+                i += 1          # emitted outside the decode loop
+                                # (disagg first tokens ride the handoff)
+            while i < len(events) and events[i][0] <= s1:
+                toks += events[i][1]
+                if any(w0 <= events[i][0] <= w1 for w0, w1 in windows):
+                    in_window += events[i][1]
+                i += 1
+            if toks:
+                if in_window:
+                    samples.extend([acc / toks] * in_window)
+                acc = 0.0
+        ttfts = [q.t_first - q.t_submit for q in reqs
+                 if q.t_first is not None]
+        return dt, samples, ttfts
+
+    def colocated():
+        eng = ServingEngine(model, **geom)
+        return drive(eng, eng)
+
+    reg = MetricsRegistry()
+
+    def disagg(measured):
+        pf = PrefillWorker(model, **geom)
+        dec = DecodeWorker(model, **geom)
+        return drive(
+            DisaggCoordinator(pf, dec,
+                              registry=reg if measured else None,
+                              instrument=measured),
+            dec.engine)
+
+    colocated()                      # warm the compiled programs
+    dt_co, adm_co, ttft_co = colocated()
+    disagg(False)
+    dt_dg, adm_dg, ttft_dg = disagg(True)
+
+    xfer = reg.get("serving_kv_transfer_seconds").labels(
+        coordinator="disagg0")
+    migrations = int(xfer.count)
+    return {
+        "serving_disagg_requests": n_requests,
+        "serving_disagg_migrations": migrations,
+        "serving_colocated_adm_tpot_p95_ms": round(
+            float(np.percentile(adm_co, 95)) * 1e3, 2) if adm_co else None,
+        "serving_disagg_adm_tpot_p95_ms": round(
+            float(np.percentile(adm_dg, 95)) * 1e3, 2) if adm_dg else None,
+        "serving_kv_transfer_p50_ms": round(
+            xfer.percentile(50) * 1e3, 2) if xfer.count else None,
+        "serving_colocated_ttft_p95_ms": round(
+            float(np.percentile(ttft_co, 95)) * 1e3, 1),
+        "serving_disagg_ttft_p95_ms": round(
+            float(np.percentile(ttft_dg, 95)) * 1e3, 1),
+        "serving_disagg_tok_per_sec": round(total_new / dt_dg, 1),
+        "serving_colocated_tok_per_sec": round(total_new / dt_co, 1),
+    }
+
+
 def bench_longseq(seqs=(16384, 32768), iters=3):
     """Long-context flash attention (VERDICT r4 next-round #7): causal
     fwd+bwd MFU of the streamed-KV Pallas kernels at 16k/32k tokens on one
@@ -1240,8 +1398,8 @@ def main():
     only = os.environ.get("BENCH_ONLY")  # e.g. "bench_serving": one table
     fns = (bench_resnet50, bench_bert, bench_moe, bench_decode,
            bench_serving, bench_serving_paged, bench_serving_router,
-           bench_longseq, bench_llama_long, bench_eager,
-           bench_collectives)
+           bench_serving_disagg, bench_longseq, bench_llama_long,
+           bench_eager, bench_collectives)
     if only:
         out = {}
         for fn in fns:

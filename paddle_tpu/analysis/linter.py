@@ -85,6 +85,19 @@ _SYNC_HELPERS = {"host_fetch", "_host_fetch"}
 # `from time import sleep as _backoff_sleep` alias resolves to
 # time.sleep and stays flagged.
 _WAIT_SANCTIONED = {"backoff_sleep", "_backoff_sleep"}
+# blocking KV-leaf transfers inside step loops (PTL017): a migration
+# chain moving through a transport `.send`/`.recv` (or a raw
+# `jax.device_get` of cache leaves) between compiled dispatches
+# serializes every live slot behind one request's handoff.  The
+# sanctioned seam is a helper named like the disagg coordinator's pump
+# (`kv_transfer`), resolved the same way as _SYNC_HELPERS; transfers
+# only count when an argument mentions the cache/block vocabulary — a
+# socket `.recv()` in a step loop is PTL008/PTL013's problem, not a KV
+# migration
+_TRANSFER_METHODS = {"send", "recv"}
+_TRANSFER_SANCTIONED = {"kv_transfer", "_kv_transfer"}
+_KV_LEAF_RE = re.compile(
+    r"(^|_)(kv|caches?|blocks?|chains?|leaf|leaves)($|_)", re.IGNORECASE)
 # blocking calls inside `async def` bodies (PTL013): one blocked
 # coroutine stalls every request the event loop is serving.  time.sleep
 # and the sanctioned sync/wait helpers are resolved exactly like
@@ -477,6 +490,38 @@ def _wait_of(node, f, name):
     return wait, sanctioned
 
 
+def _kv_leaf_args(node):
+    """Whether any argument expression of ``node`` names a KV-leaf-ish
+    value (cache/block/chain/leaf vocabulary in a Name or attribute)."""
+    for v in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(v):
+            if isinstance(sub, ast.Name) and _KV_LEAF_RE.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    _KV_LEAF_RE.search(sub.attr):
+                return True
+    return False
+
+
+def _transfer_of(node, f, name):
+    """PTL017 classification of a call: ``(transfer_label, sanctioned)``.
+
+    Same shape as ``_sync_of``: the label is the offending spelling, and
+    sanction follows the RESOLVED name so an import alias of a raw
+    primitive cannot smuggle itself in under `kv_transfer`."""
+    transfer = None
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _TRANSFER_METHODS and _kv_leaf_args(node):
+        transfer = "." + node.func.attr + "()"
+    elif f == "jax.device_get" and _kv_leaf_args(node):
+        transfer = "jax.device_get()"
+    elif name in _TRANSFER_SANCTIONED:
+        transfer = name + "()"
+    sanctioned = name in _TRANSFER_SANCTIONED and (
+        f is None or f.split(".")[-1] in _TRANSFER_SANCTIONED)
+    return transfer, sanctioned
+
+
 @dataclass
 class _Loop:
     node: object
@@ -485,6 +530,7 @@ class _Loop:
     waits: list = field(default_factory=list)
     labels: list = field(default_factory=list)
     raggeds: list = field(default_factory=list)
+    transfers: list = field(default_factory=list)
 
 
 class _Checker:
@@ -691,6 +737,14 @@ class _Checker:
                           f"`{what}` inside a loop that dispatches a "
                           "compiled step stalls the host while the device "
                           "idles")
+            for call, what in rec.transfers:
+                self.emit("PTL017", call,
+                          f"`{what}` moves KV cache leaves inside a loop "
+                          "that dispatches a compiled step — the blocking "
+                          "transfer serializes every live slot behind one "
+                          "request's migration; stage it through the "
+                          "sanctioned kv_transfer/drain seam outside the "
+                          "dispatch loop")
             for call, ident in rec.labels:
                 self.emit("PTL009", call,
                           f"`.labels(...)` fed per-request identifier "
@@ -709,6 +763,7 @@ class _Checker:
             self.loop_stack[-1].waits.extend(rec.waits)
             self.loop_stack[-1].labels.extend(rec.labels)
             self.loop_stack[-1].raggeds.extend(rec.raggeds)
+            self.loop_stack[-1].transfers.extend(rec.transfers)
 
     def _loop_targets(self):
         names = set()
@@ -1095,6 +1150,12 @@ class _Checker:
                 chain, witness = eff.wait
                 rec.waits.append((node, "{}() (reaches {} via {})".format(
                     name, witness, " -> ".join((name,) + chain))))
+            # PTL017: blocking KV-leaf transfers, direct spellings only
+            # (the migration pump is a coordinator-level seam, not a
+            # helper chain), sanctioned through the same resolved name
+            transfer, transfer_ok = _transfer_of(node, f, name)
+            if transfer is not None and not transfer_ok:
+                rec.transfers.append((node, transfer))
             # PTL009: per-request identifiers minted into metric labels
             if name == "labels" and isinstance(node.func, ast.Attribute):
                 for v in list(node.args) + [kw.value

@@ -230,6 +230,22 @@ _RULE_LIST = [
         "(`caches = step(params, caches)` — the engine's drain idiom), "
         "or stop donating that argument",
     ),
+    Rule(
+        "PTL017", "blocking-kv-transfer-in-step-loop", WARNING,
+        "a transport `.send`/`.recv` (or raw `jax.device_get`) of KV "
+        "cache leaves inside a loop that also dispatches compiled steps "
+        "— the blocking transfer of one request's migration chain "
+        "serializes every live slot's decode behind it, the exact "
+        "interference disaggregation exists to remove; transfers are "
+        "recognized when an argument names the cache/block vocabulary, "
+        "and helpers resolving to `kv_transfer` are the sanctioned "
+        "async/drain seam (serving/disagg.py stages migrations in the "
+        "coordinator's pump, outside both workers' dispatch loops)",
+        "move the transfer out of the dispatch loop (stage it in a "
+        "coordinator pump between steps), or route it through a "
+        "`kv_transfer` helper that overlaps the copy with dispatched "
+        "work",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

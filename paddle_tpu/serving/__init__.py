@@ -3,9 +3,17 @@ scheduling) over the compiled static-cache decode path, plus the
 reliability layer around it: deadlines/cancellation, bounded-queue load
 shedding (``EngineOverloaded``), poison-request quarantine, dispatch
 retry with backoff, and the deterministic fault-injection harness
-(``FaultPlan``) — and the fleet traffic layer above it: the
+(``FaultPlan``) — the fleet traffic layer above it: the
 :class:`Replica` engine handle, the prefix-aware :class:`Router`, and
-the stdlib asyncio streaming :class:`ServingServer`."""
+the stdlib asyncio streaming :class:`ServingServer` — and the
+disaggregated prefill/decode split (:class:`DisaggCoordinator` over
+:class:`PrefillWorker`/:class:`DecodeWorker` with a paged-KV-block
+:class:`KVTransport` handoff), which presents the same engine surface
+so replicas and routers compose over it unchanged."""
+from paddle_tpu.serving.disagg import (
+    DecodeWorker, DisaggCoordinator, InProcessTransport, KVTransport,
+    PickleTransport, PrefillWorker,
+)
 from paddle_tpu.serving.engine import (
     EngineOverloaded, Request, ServingEngine,
 )
@@ -16,6 +24,9 @@ from paddle_tpu.serving.replica import Replica
 from paddle_tpu.serving.router import Router
 from paddle_tpu.serving.server import PRIORITY_CLASSES, ServingServer
 
-__all__ = ["EngineOverloaded", "FaultPlan", "InjectedDispatchError",
-           "InjectedStreamCbError", "PRIORITY_CLASSES", "Replica",
-           "Request", "Router", "ServingEngine", "ServingServer"]
+__all__ = ["DecodeWorker", "DisaggCoordinator", "EngineOverloaded",
+           "FaultPlan", "InProcessTransport", "InjectedDispatchError",
+           "InjectedStreamCbError", "KVTransport",
+           "PRIORITY_CLASSES", "PickleTransport", "PrefillWorker",
+           "Replica", "Request", "Router", "ServingEngine",
+           "ServingServer"]

@@ -1,0 +1,671 @@
+"""Disaggregated prefill/decode serving: dedicated worker roles with a
+paged-KV-block handoff.
+
+Prefill is compute-bound (one long arithmetic-dense pass over the prompt)
+and decode is HBM-bound (one token of compute per step against the whole
+cache); co-scheduling them on one mesh makes each the other's noisy
+neighbor — the ``serving_tpot_during_admission_seconds`` histogram
+measures exactly this tax, and chunked prefill only *budgets* around it.
+DistServe and Mooncake showed the capacity architecture that removes it:
+split the two phases onto dedicated workers and make the KV cache the
+transfer unit.  The paged block pool (serving/kv_cache.py) makes that
+nearly free here, because block-table indirection means a KV handoff
+changes operand *values*, never program shapes:
+
+* A **PrefillWorker** owns admission and runs ONLY
+  ``serving_prefill_chunk`` programs (``ServingEngine(prefill_only=
+  True)`` — a decode dispatch on it is a hard error).  Every request it
+  accepts carries ``max_new_tokens=1``: the final prefill chunk's argmax
+  IS its first token, after which the request retires and its block
+  chain is exported.
+* A **DecodeWorker** owns a block pool plus the decode/spec dispatch and
+  accepts migrated requests through ``ServingEngine.adopt_prefilled``:
+  imported blocks are spliced under a fresh slot's table row, the decode
+  carry is seeded (cur = first token, length = prompt) exactly where a
+  local prefill would have left it, and from the next dispatch on the
+  slot is indistinguishable from a locally prefilled one — the
+  byte-identity AND zero-retrace argument in one.
+* A **KVTransport** ships a completed request's block chain — the
+  ``[n_blocks, C, Hkv, D]`` data leaves plus ``[n_blocks, C, Hkv]`` int8
+  scale leaves per layer — between pools.  ``InProcessTransport`` is the
+  device-to-device ``device_put`` path (CI-testable on one process);
+  ``PickleTransport`` serializes the same leaves to bytes, proving the
+  interface is process-boundary-ready (the 2-proc × 4-device machinery
+  in tests/test_multiprocess_mesh.py is the eventual target).
+* The **DisaggCoordinator** glues them behind the SAME engine surface
+  ``serving/replica.py`` programs against (submit/cancel/step/run/drain/
+  close/stats/prefix_lookup/...), so the router and the HTTP front end
+  compose over a disaggregated deployment unchanged:
+  ``Replica(DisaggCoordinator(...))`` just works.
+
+TTFT rides the handoff: the first token is emitted on the caller's
+request the moment the prefill worker surfaces it — BEFORE the transfer
+is paid — so disaggregation adds nothing to time-to-first-token, while
+decode TPOT is freed from admission interference entirely.
+
+The transfer itself must never serialize a worker's step loop — a
+blocking ``send``/``recv`` between compiled dispatches stalls every
+live slot behind one request's migration.  Here all transport calls sit
+in the coordinator's pump, OUTSIDE both workers' dispatch loops; the
+tpu-lint PTL017 rule polices the anti-pattern in tree code.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+
+from .engine import EngineOverloaded, Request, ServingEngine
+from .kv_cache import KVPoolExhausted
+from .metrics import DisaggMetrics
+
+__all__ = [
+    "KVTransport",
+    "InProcessTransport",
+    "PickleTransport",
+    "PrefillWorker",
+    "DecodeWorker",
+    "DisaggCoordinator",
+]
+
+_LOG = logging.getLogger(__name__)
+
+
+def chain_nbytes(leaves):
+    """Wire size of a transfer chain: summed ``nbytes`` over every data
+    and scale leaf (the int8 pool's per-layer ``(data, scale)`` tuples
+    count both)."""
+    total = 0
+    for k, v in leaves:
+        for leaf in (k, v):
+            if isinstance(leaf, tuple):
+                total += int(leaf[0].nbytes) + int(leaf[1].nbytes)
+            else:
+                total += int(leaf.nbytes)
+    return total
+
+
+class KVTransport:
+    """Moves one request's exported block chain between KV pools.
+
+    ``send`` is called on the prefill side with the chain's per-layer
+    ``(k, v)`` transfer leaves (``PagedKVCacheManager.export_chain``
+    output — already materialized copies, independent of the source
+    pool) and returns ``(handle, nbytes)``: an opaque ticket plus the
+    bytes that hit the wire.  ``recv`` redeems the handle on the decode
+    side into leaves ready for ``import_chain``.  The split is what
+    makes the interface process-boundary-ready: a real multi-host
+    transport resolves the handle remotely; in-process ones just carry
+    the leaves through.
+
+    Transports are invoked from the coordinator's migration pump, never
+    from inside a worker's step-dispatch loop — a blocking transfer
+    there stalls every live slot behind one migration (tpu-lint
+    PTL017)."""
+
+    def send(self, rid, leaves):
+        raise NotImplementedError
+
+    def recv(self, handle):
+        raise NotImplementedError
+
+
+class InProcessTransport(KVTransport):
+    """Device-to-device handoff for workers sharing one process: one
+    ``jax.device_put`` per leaf.  With ``shardings`` — the ``(data,
+    scale)`` pair from ``serving.sharding.kv_transfer_shardings`` — each
+    leaf is placed directly under the decode pool's head-sharded layout,
+    so a TP decode worker splices without a resharding copy; without, the
+    default-device copy preserves single-device semantics."""
+
+    def __init__(self, shardings=None):
+        if shardings is None:
+            self._data = self._scale = None
+        else:
+            self._data, self._scale = shardings
+
+    def _put(self, leaf):
+        if isinstance(leaf, tuple):
+            if self._data is None:
+                return (jax.device_put(leaf[0]), jax.device_put(leaf[1]))
+            return (jax.device_put(leaf[0], self._data),
+                    jax.device_put(leaf[1], self._scale))
+        if self._data is None:
+            return jax.device_put(leaf)
+        return jax.device_put(leaf, self._data)
+
+    def send(self, rid, leaves):
+        out = [(self._put(k), self._put(v)) for k, v in leaves]
+        return out, chain_nbytes(leaves)
+
+    def recv(self, handle):
+        return handle
+
+
+class PickleTransport(KVTransport):
+    """Bytes-serializing stub: leaves are pulled to host numpy, pickled,
+    and round-tripped through an actual ``bytes`` blob — the degenerate
+    one-process form of a socket/RDMA transport, proving nothing in the
+    migration path assumes device-to-device reachability.  The decode
+    side re-uploads during ``import_chain``'s pool scatter, so the
+    leaves come back as numpy and that is fine."""
+
+    def send(self, rid, leaves):
+        def host(leaf):
+            if isinstance(leaf, tuple):
+                return (np.asarray(leaf[0]), np.asarray(leaf[1]))
+            return np.asarray(leaf)
+        blob = pickle.dumps(
+            (rid, [(host(k), host(v)) for k, v in leaves]),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        return blob, len(blob)
+
+    def recv(self, handle):
+        _, leaves = pickle.loads(handle)
+        return leaves
+
+
+class PrefillWorker:
+    """Admission + chunked prefill, nothing else: wraps a
+    ``ServingEngine(prefill_only=True)`` whose every request carries
+    ``max_new_tokens=1``.  When a request's final chunk lands, the
+    engine's ``on_prefilled`` hook fires with the slot still mapped —
+    the coordinator exports the block chain right there, then the
+    request retires on the engine's normal path and its blocks recycle.
+
+    ``mode`` is pinned to ``"greedy"``: the only token a prefill worker
+    ever produces is the final chunk's argmax, which is identical under
+    greedy and speculative decoding — spec workers pair a greedy
+    prefill worker with a spec decode worker."""
+
+    def __init__(self, model, name="prefill0", **engine_kw):
+        engine_kw.setdefault("mode", "greedy")
+        engine_kw["prefill_only"] = True
+        engine_kw["on_prefilled"] = self._fire
+        self.name = name
+        self.detokenizer = engine_kw.get("detokenizer")
+        self._sink = None  # bound by the coordinator
+        self.engine = ServingEngine(model, **engine_kw)
+
+    def _fire(self, request, slot, first):
+        if self._sink is not None:
+            self._sink(self, request, slot, first)
+
+    def backlog(self):
+        s = self.engine.stats()
+        return s["queue_depth"] + s["slots_occupied"]
+
+
+class DecodeWorker:
+    """The decode half: a plain paged continuous-batching engine that
+    never sees a prompt — requests enter through
+    ``ServingEngine.adopt_prefilled`` with their first token and their
+    imported block chain, and leave through the engine's ordinary
+    retire paths.  Spec decoding, int8 KV, preemption and deadlines all
+    apply unchanged."""
+
+    def __init__(self, model, name="decode0", **engine_kw):
+        self.name = name
+        self.engine = ServingEngine(model, **engine_kw)
+        if self.engine.kv_block is None:
+            raise ValueError(
+                "DecodeWorker requires a paged engine (kv_block=): the "
+                "block pool is the migration transfer unit")
+
+    def backlog(self):
+        return self.engine.stats()["slots_occupied"]
+
+
+class _Ticket:
+    """One migration in flight: the request's first token plus the
+    transport handle its chain rode out on."""
+
+    __slots__ = ("rid", "first", "handle", "n_blocks", "nbytes", "sent_s")
+
+    def __init__(self, rid, first, handle, n_blocks, nbytes, sent_s):
+        self.rid = rid
+        self.first = first
+        self.handle = handle
+        self.n_blocks = n_blocks
+        self.nbytes = nbytes
+        self.sent_s = sent_s
+
+
+class _FleetSLO:
+    """Aggregated SLO view over the decode engines' trackers (decode
+    owns retirement, so that is where attainment is observed).  The
+    router reads one number — worst-case burn rate across the fleet."""
+
+    def __init__(self, trackers):
+        self._trackers = [t for t in trackers if t is not None]
+
+    def observe(self, request):
+        if self._trackers:
+            self._trackers[0].observe(request)
+
+    def burn_rate(self, slo_class="interactive"):
+        if not self._trackers:
+            return 0.0
+        return max(t.burn_rate(slo_class) for t in self._trackers)
+
+
+class DisaggCoordinator:
+    """Drives a prefill/decode split behind the single-engine surface
+    ``serving/replica.py`` expects, so the router and HTTP server
+    compose over it unchanged::
+
+        pw = PrefillWorker(model, kv_block=16, **geom)
+        dw = DecodeWorker(model, kv_block=16, **geom)
+        coord = DisaggCoordinator(pw, dw)
+        coord.submit(Request(prompt, max_new_tokens=64))
+        coord.run()                      # or: Router([Replica(coord)])
+
+    Lifecycle of one request: ``submit`` validates it against the decode
+    fleet (``adoption_viable`` — a request that could never fit must
+    shed at the front door, not abort mid-migration), then enters a
+    ``max_new_tokens=1`` *shadow* with the same rid into the least-
+    backlogged prefill worker.  When the shadow's final chunk lands the
+    ``on_prefilled`` hook emits the first token on the CALLER's request
+    immediately — TTFT rides the handoff, the transfer is paid after —
+    exports the block chain and ``transport.send``s it.  The migration
+    pump then places each pending chain on a decode worker gated by
+    ``can_adopt`` (a False defers to the next step; capacity arrives as
+    decode slots retire), redeems the handle and splices via
+    ``adopt_prefilled``.  Tokens 2..N stream from the decode engine's
+    ordinary paths.  Cancellation/expiry between handoff and adoption
+    aborts the migration (``serving_migrations_total{outcome=
+    "aborted"}``); the imported-side rollback is ``import_chain``'s.
+
+    Byte identity with the colocated engine holds per request (greedy
+    and spec, f32 and int8 KV): the adopted slot enters the decode
+    dispatch with the same cur/length/block-table VALUES a local prefill
+    would have produced, under unchanged program shapes — which is also
+    why the warm decode worker never retraces across migrations."""
+
+    def __init__(self, prefill, decode, transport=None, name="disagg0",
+                 registry=None, instrument=True):
+        self._prefill = (list(prefill)
+                         if isinstance(prefill, (list, tuple))
+                         else [prefill])
+        self._decode = (list(decode)
+                        if isinstance(decode, (list, tuple))
+                        else [decode])
+        if not self._prefill or not self._decode:
+            raise ValueError("DisaggCoordinator needs at least one "
+                             "prefill and one decode worker")
+        blocks = {w.engine.kv_block
+                  for w in self._prefill + self._decode}
+        if None in blocks or len(blocks) != 1:
+            raise ValueError(
+                "all workers must run paged KV with one common block "
+                f"size (the transfer unit); got {sorted(map(str, blocks))}")
+        for w in self._prefill:
+            w._sink = self._on_prefilled
+        self.name = name
+        self._transport = transport if transport is not None \
+            else InProcessTransport()
+        self._m = DisaggMetrics(registry, name) if instrument else None
+        self._users = {}      # rid -> caller Request, until terminal
+        self._shadows = {}    # rid -> (shadow Request, PrefillWorker)
+        self._owner = {}      # rid -> DecodeWorker, after adoption
+        self._migrating = deque()
+        self._finished = []
+        self._rids = set()
+        self._next_rid = 0
+        self._slo = _FleetSLO([w.engine.slo_tracker for w in self._decode])
+        self._n_ok = 0
+        self._n_aborted = 0
+        self._hook_emitted = 0
+        self._adopted = 0
+
+    # ------------------------------------------------------------ submit
+    def submit(self, request):
+        """Admit ``request`` into the split: decode-side viability check,
+        then a ``max_new_tokens=1`` shadow with the same rid into the
+        least-backlogged prefill worker.  Raises ``ValueError`` for
+        requests that could never fit either side and propagates
+        ``EngineOverloaded`` (status ``"shed"``) from the prefill
+        worker's bounded admission queue."""
+        if not any(w.engine.adoption_viable(request) for w in self._decode):
+            raise ValueError(
+                "request can never fit any decode worker (prompt bucket "
+                "/ max_len budget): prefilling it would strand a "
+                "migration")
+        rid_given = request.rid is not None
+        if rid_given and request.rid in self._rids:
+            raise ValueError(
+                f"rid {request.rid!r} is already in use by another "
+                "request on this coordinator")
+        rid = request.rid if rid_given else self._next_rid
+        shadow = Request(request.prompt_ids, 1, rid=rid,
+                         deadline_ms=request.deadline_ms,
+                         slo_class=request.slo_class,
+                         priority=request.priority)
+        worker = min(self._prefill, key=lambda w: w.backlog())
+        try:
+            worker.engine.submit(shadow)
+        except EngineOverloaded:
+            # mirror the engine's shed contract on the caller's request:
+            # a shed request never consumed coordinator state
+            request.status = "shed"
+            raise
+        if rid_given:
+            if isinstance(rid, int):
+                self._next_rid = max(self._next_rid, rid + 1)
+        else:
+            request.rid = rid
+            self._next_rid += 1
+        self._rids.add(rid)
+        request.t_submit = shadow.t_submit
+        if request.deadline_ms is not None:
+            request._t_deadline = request.t_submit \
+                + request.deadline_ms / 1e3
+        self._users[rid] = request
+        self._shadows[rid] = (shadow, worker)
+        return request
+
+    # ----------------------------------------------------------- handoff
+    def _on_prefilled(self, worker, shadow, slot, first):
+        """The prefill engine's completion hook: fires inside its
+        first-token flush with the chain still mapped.  Emit the first
+        token on the caller's request NOW (TTFT never waits on the
+        transfer), then export and send the chain — unless the token
+        already completed the request, in which case there is nothing
+        to migrate."""
+        user = self._users.get(shadow.rid)
+        if user is None or user.done:
+            return  # cancelled between dispatch and flush: chain recycles
+        self._emit_first(user, int(first), worker)
+        if user.done:
+            return
+        kv = worker.engine.kv_manager
+        chain = kv.block_chain(shadow.rid)
+        t0 = time.perf_counter()
+        leaves = kv.export_chain(chain)
+        handle, nbytes = self._transport.send(shadow.rid, leaves)
+        sent_s = time.perf_counter() - t0
+        if self._m is not None:
+            self._m.transfer_bytes.inc(nbytes)
+        rec = worker.engine.recorder
+        if rec is not None:
+            rec.record("migrate_out", rid=shadow.rid,
+                       n_blocks=len(chain), bytes=nbytes)
+        self._migrating.append(_Ticket(shadow.rid, int(first), handle,
+                                       len(chain), nbytes, sent_s))
+
+    def _emit_first(self, user, first, worker):
+        user.output_ids.append(first)
+        user.t_first = time.perf_counter()
+        self._hook_emitted += 1
+        if worker.detokenizer is not None:
+            user.text = worker.detokenizer(list(user.output_ids))
+        if user.stream_cb is not None:
+            try:
+                user.stream_cb(user, [first])
+            except Exception as e:
+                if not user._cb_err_logged:
+                    user._cb_err_logged = True
+                    _LOG.warning(
+                        "stream_cb for request %r raised %s: %s",
+                        user.rid, type(e).__name__, e)
+        if len(user.output_ids) >= user.max_new_tokens or (
+                user.eos_token_id is not None
+                and first == int(user.eos_token_id)):
+            self._retire_waiting(user, "done")
+
+    def _retire_waiting(self, user, status):
+        """Finalize a request the decode fleet never owned: done at the
+        first token, or cancelled/expired between handoff and adoption."""
+        user.status = status
+        user.done = True
+        user.t_done = time.perf_counter()
+        self._users.pop(user.rid, None)
+        self._finished.append(user)
+        self._slo.observe(user)
+
+    def _abort(self, ticket):
+        self._n_aborted += 1
+        if self._m is not None:
+            self._m.migration("aborted")
+
+    # -------------------------------------------------------------- step
+    def step(self):
+        """One coordinator iteration: step the prefill fleet (handoffs
+        fire inside, emitting first tokens), propagate shadow failures,
+        pump pending migrations onto decode workers, step the decode
+        fleet.  Returns tokens emitted on caller requests."""
+        self._hook_emitted = 0
+        for w in self._prefill:
+            if w.engine.has_work:
+                w.engine.step()
+        emitted = self._hook_emitted
+        self._harvest_shadows()
+        self._pump_migrations()
+        for w in self._decode:
+            if w.engine.has_work:
+                emitted += w.engine.step()
+        self._collect()
+        self._update_gauges()
+        return emitted
+
+    def _harvest_shadows(self):
+        """Drop retired shadows; a shadow that retired with anything but
+        ``"done"`` (timed out mid-prefill, poisoned, cancelled) never
+        reached the handoff — propagate its terminal status to the
+        caller's request."""
+        for rid in list(self._shadows):
+            shadow, _ = self._shadows[rid]
+            if not shadow.done:
+                continue
+            del self._shadows[rid]
+            if shadow.status == "done":
+                continue
+            user = self._users.get(rid)
+            if user is not None and not user.done:
+                self._retire_waiting(user, shadow.status)
+
+    def _pump_migrations(self):
+        """Place pending chains, FIFO: abort dead ones (cancelled /
+        past-deadline), defer those no decode worker can adopt yet, and
+        splice the rest (``transport.recv`` + ``adopt_prefilled``) onto
+        the least-loaded worker that has room."""
+        self._adopted = 0
+        keep = deque()
+        now = time.perf_counter()
+        while self._migrating:
+            t = self._migrating.popleft()
+            user = self._users.get(t.rid)
+            if user is None or user.done:
+                self._abort(t)
+                continue
+            if user._t_deadline is not None and now > user._t_deadline:
+                self._retire_waiting(user, "timed_out")
+                self._abort(t)
+                continue
+            cands = [w for w in self._decode if w.engine.can_adopt(user)]
+            if not cands:
+                keep.append(t)
+                continue
+            w = min(cands, key=lambda c: c.backlog())
+            t1 = time.perf_counter()
+            try:
+                leaves = self._transport.recv(t.handle)
+                slot = w.engine.adopt_prefilled(user, t.first, leaves)
+            except (EngineOverloaded, KVPoolExhausted):
+                keep.append(t)  # raced with the gate: retry next step
+                continue
+            self._owner[t.rid] = w
+            self._adopted += 1
+            self._n_ok += 1
+            if self._m is not None:
+                self._m.transfer_seconds.observe(
+                    t.sent_s + (time.perf_counter() - t1))
+                self._m.migration("ok")
+            rec = w.engine.recorder
+            if rec is not None:
+                rec.record("migrate_in", rid=t.rid, slot=slot,
+                           n_blocks=t.n_blocks, bytes=t.nbytes)
+        self._migrating = keep
+
+    def _collect(self):
+        """Sweep caller requests the decode fleet finished into the
+        coordinator's completion list (the engines stamped status /
+        t_done on the shared Request objects)."""
+        for rid in list(self._users):
+            u = self._users[rid]
+            if u.done:
+                del self._users[rid]
+                self._owner.pop(rid, None)
+                self._finished.append(u)
+
+    def _update_gauges(self):
+        if self._m is None:
+            return
+        self._m.prefill_backlog.set(
+            sum(w.backlog() for w in self._prefill))
+        self._m.decode_backlog.set(
+            sum(w.backlog() for w in self._decode)
+            + len(self._migrating))
+
+    # -------------------------------------------------- run / drain / close
+    @property
+    def has_work(self):
+        return (bool(self._shadows) or bool(self._migrating)
+                or any(w.engine.has_work
+                       for w in self._prefill + self._decode))
+
+    def run(self):
+        """Drive ``step()`` to quiescence; returns finished requests in
+        completion order.  A migration no decode worker can EVER place
+        (pool smaller than one request's budget) raises instead of
+        spinning — ``submit``'s viability gate makes this unreachable
+        for sanely sized pools."""
+        while self.has_work:
+            self.step()
+            if (self._migrating and self._adopted == 0
+                    and not self._shadows
+                    and not any(w.engine.has_work
+                                for w in self._prefill + self._decode)):
+                raise RuntimeError(
+                    f"{len(self._migrating)} migration(s) pending but "
+                    "every decode worker is idle and none can adopt — "
+                    "decode pool too small for the request's budget")
+        return self._finished
+
+    def drain(self):
+        """Run to quiescence, then return ``{rid: terminal status}`` —
+        the graceful-shutdown half of ``close()``."""
+        self.run()
+        return {r.rid: r.status for r in self._finished}
+
+    def close(self):
+        """Abort outstanding work cleanly: close the prefill fleet
+        (queued/mid-prefill shadows cancel, propagating to their
+        callers), abort pending migrations, close the decode fleet.
+        Idempotent; returns ``{rid: terminal status}``."""
+        for w in self._prefill:
+            w.engine.close()
+        self._harvest_shadows()
+        while self._migrating:
+            t = self._migrating.popleft()
+            user = self._users.get(t.rid)
+            self._abort(t)
+            if user is not None and not user.done:
+                self._retire_waiting(user, "cancelled")
+        for w in self._decode:
+            w.engine.close()
+        self._collect()
+        for rid in list(self._users):  # defensive: nothing should remain
+            self._retire_waiting(self._users[rid], "cancelled")
+        self._update_gauges()
+        return {r.rid: r.status for r in self._finished}
+
+    def cancel(self, rid):
+        """Cancel ``rid`` wherever it is: shadow mid-prefill, chain
+        mid-migration, or adopted on a decode worker.  Returns True if
+        found live."""
+        sh = self._shadows.get(rid)
+        if sh is not None:
+            shadow, worker = sh
+            found = worker.engine.cancel(rid)
+            self._harvest_shadows()
+            return found
+        for t in self._migrating:
+            if t.rid == rid:
+                self._migrating.remove(t)
+                self._abort(t)
+                user = self._users.get(rid)
+                if user is not None and not user.done:
+                    self._retire_waiting(user, "cancelled")
+                return True
+        w = self._owner.get(rid)
+        if w is not None:
+            found = w.engine.cancel(rid)
+            self._collect()
+            return found
+        return False
+
+    # ------------------------------------------------- fleet introspection
+    @property
+    def kv_block(self):
+        return self._decode[0].engine.kv_block
+
+    @property
+    def slo_tracker(self):
+        return self._slo
+
+    def queue_depth(self):
+        """Work admitted but not yet decoding: prefill backlogs plus
+        chains awaiting adoption."""
+        return (sum(w.engine.queue_depth() for w in self._prefill)
+                + len(self._migrating))
+
+    def prefix_lookup(self, tokens):
+        """Longest cached prefix across the PREFILL fleet — that is the
+        side where a hit skips work (adoption always imports the full
+        chain)."""
+        return max(w.engine.prefix_lookup(tokens) for w in self._prefill)
+
+    def stats(self):
+        """One engine-shaped snapshot over the split (the keys
+        ``Replica``/``Router`` read, aggregated), plus migration
+        counters.  Prompt/reuse tallies come from the prefill side only
+        — adoption re-counts prompt tokens on the decode engines and
+        double-counting would skew the router's placement signal."""
+        ps = [w.engine.stats() for w in self._prefill]
+        ds = [w.engine.stats() for w in self._decode]
+        return {
+            "queue_depth": self.queue_depth(),
+            "slots_occupied": sum(s["slots_occupied"] for s in ds),
+            "slots_total": sum(s["slots_total"] for s in ds),
+            "prefill_slots": sum(s["slots_occupied"] for s in ps),
+            "inflight": sum(s["inflight"] for s in ps + ds),
+            "live_tokens": sum(s["live_tokens"] for s in ps + ds),
+            "prompt_tokens": sum(s["prompt_tokens"] for s in ps),
+            "prefix_reuse_tokens": sum(s["prefix_reuse_tokens"]
+                                       for s in ps),
+            "preempted": sum(s["preempted"] for s in ds),
+            "preempt_resume_suffix_tokens":
+                sum(s["preempt_resume_suffix_tokens"] for s in ds),
+            "preempt_resume_total_tokens":
+                sum(s["preempt_resume_total_tokens"] for s in ds),
+            "prefill_workers": len(self._prefill),
+            "decode_workers": len(self._decode),
+            "migrations_ok": self._n_ok,
+            "migrations_aborted": self._n_aborted,
+            "migrations_pending": len(self._migrating),
+        }
+
+    def debug_sources(self):
+        """Worker-prefixed union of every engine's debug endpoints."""
+        out = {}
+        for w in self._prefill + self._decode:
+            for key, fn in w.engine.debug_sources().items():
+                out[f"{w.name}_{key}"] = fn
+        return out

@@ -391,11 +391,39 @@ class ServingEngine:
                  max_live_tokens=None, kv_dtype=None, mesh=None,
                  tp_axis="mp", max_pending=None, retry_attempts=3,
                  retry_backoff=0.05, faults=None, recorder=True,
-                 slo=None, attn_impl=None, weight_dtype=None):
+                 slo=None, attn_impl=None, weight_dtype=None,
+                 prefill_only=False, on_prefilled=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
             raise ValueError(f"unknown policy {policy!r}")
+        # prefill/decode disaggregation seams (serving/disagg.py).  A
+        # prefill-only engine owns admission + chunked prefill and NEVER
+        # dispatches a decode program: every request carries max_new=1
+        # (the first token is the prefill's own pick), pipelining is
+        # forced off so the synchronous first-token flush retires each
+        # slot before any decode dispatch could include it, and the
+        # paged admission budget shrinks to the prompt's own blocks.
+        # ``on_prefilled(request, slot, first)`` fires after the finite
+        # check + radix registration and BEFORE the slot is released —
+        # the window where the block chain is still mapped and
+        # exportable.
+        if prefill_only:
+            if kv_block is None:
+                raise ValueError(
+                    "prefill_only requires paged KV (kv_block=): the "
+                    "block chain is the migration transfer unit")
+            if mode != "greedy":
+                raise ValueError(
+                    "prefill_only engines never decode — spec drafting "
+                    "belongs to the decode worker")
+            pipeline = False
+        elif on_prefilled is not None:
+            raise ValueError(
+                "on_prefilled is the prefill_only completion hook — "
+                "construct the engine with prefill_only=True")
+        self._prefill_only = bool(prefill_only)
+        self._on_prefilled = on_prefilled
         if mesh is not None and tp_axis not in mesh.axis_names:
             raise ValueError(
                 f"mesh has no axis {tp_axis!r} (axes: {mesh.axis_names})")
@@ -663,6 +691,11 @@ class ServingEngine:
         return 2 * per if self._pipeline else per
 
     def submit(self, request):
+        if self._prefill_only and request.max_new_tokens != 1:
+            raise ValueError(
+                "prefill-only engine: requests carry max_new_tokens=1 "
+                "(the prefill's own first token) — decode belongs to a "
+                f"decode worker, got max_new={request.max_new_tokens}")
         p = int(request.prompt_ids.size)
         i = bisect.bisect_left(self._buckets, p)
         if i == len(self._buckets):
@@ -1220,6 +1253,12 @@ class ServingEngine:
                 p = int(tok.size)
                 rem = max(1, r.max_new_tokens - len(r.output_ids))
                 need = min(self._lmax, p + rem + self._headroom())
+                if self._prefill_only:
+                    # no decode ever writes past the prompt here: the
+                    # chain budget is exactly the prompt's own blocks,
+                    # which is the capacity win admission throughput
+                    # rides on a dedicated prefill worker
+                    need = p
                 off0, shared = self._kv.match_prefix(tok)
                 if P > C:
                     off0 = (off0 // P) * P
@@ -1291,6 +1330,136 @@ class ServingEngine:
             m.queue_depth.set(len(self._queue))
             m.slots_occupied.set(self._kv.occupied())
             m.live_tokens.set(self._kv.live_tokens())
+
+    # ---------------------------------------------- disaggregated adoption
+    # the decode-worker half of a prefill/decode split (serving/disagg.py):
+    # a request whose prefill ran on ANOTHER engine enters here with its
+    # first token and its exported block chain, bypassing _admit/_pf
+    # entirely.  From the next decode dispatch on, the slot is
+    # indistinguishable from a locally prefilled one — same cur / length /
+    # block-table VALUES, no new shapes — which is both the byte-identity
+    # and the zero-retrace argument for migration.
+
+    def can_adopt(self, request):
+        """Whether ``adopt_prefilled`` would succeed right now: a free
+        slot plus pool capacity for the imported chain AND the decode
+        growth budget.  The coordinator gates on this BEFORE paying for
+        a transfer — a deferred migration costs nothing."""
+        if not self._paged or self._policy != "continuous" \
+                or self._prefill_only:
+            return False
+        if not self._kv.free_slots():
+            return False
+        p = int(request.prompt_ids.size)
+        rem = max(1, request.max_new_tokens - len(request.output_ids))
+        need = min(self._lmax, p + rem + self._headroom())
+        return self._kv.can_reserve(-(-need // self._kv.block))
+
+    def adoption_viable(self, request):
+        """The static half of ``can_adopt``: could this request EVER fit
+        this engine (prompt bucket exists, worst-case rows within
+        ``max_len``)?  The coordinator sheds statically-impossible
+        requests at submit time — a ``can_adopt`` False only ever means
+        *defer and retry*, never *abort*."""
+        p = int(request.prompt_ids.size)
+        if bisect.bisect_left(self._buckets, p) == len(self._buckets):
+            return False
+        return p + request.max_new_tokens + self._headroom() <= self._lmax
+
+    def adopt_prefilled(self, request, first, leaves):
+        """Admit ``request`` with its prefill already done elsewhere:
+        import the transfer ``leaves`` into fresh pool blocks, splice
+        them under a free slot's table row, and seed the decode carry
+        (cur = ``first``, length = prompt) exactly where a local prefill
+        would have left it.  The request must already hold its first
+        token — the coordinator emits it at migration start, so TTFT
+        rides the handoff, never the adoption.  Raises on capacity
+        (callers gate on ``can_adopt``); a failed import rolls its
+        blocks back (kv_cache.import_chain).  Returns the slot."""
+        if not self._paged or self._policy != "continuous":
+            raise ValueError(
+                "adopt_prefilled requires a paged continuous engine "
+                "(the block pool IS the migration transfer unit)")
+        if self._prefill_only:
+            raise ValueError("a prefill-only engine cannot adopt decode "
+                             "work")
+        if not request.output_ids:
+            raise ValueError("adopt_prefilled: the request must already "
+                             "hold its migrated first token")
+        free = self._kv.free_slots()
+        if not free:
+            raise EngineOverloaded("no free slot to adopt into")
+        tok = request.prompt_ids
+        p = int(tok.size)
+        i = bisect.bisect_left(self._buckets, p)
+        if i == len(self._buckets):
+            raise ValueError(
+                f"prompt length {p} exceeds the largest prompt bucket "
+                f"{self._buckets[-1]}")
+        request._bucket = self._buckets[i]
+        rem = max(1, request.max_new_tokens - len(request.output_ids))
+        need = min(self._lmax, p + rem + self._headroom())
+        # rid bookkeeping mirrors submit(): the coordinator's rid is
+        # kept, so flight-recorder events correlate across both workers
+        if request.rid is None:
+            request.rid = self._next_rid
+            self._next_rid += 1
+        else:
+            if request.rid in self._rids:
+                raise ValueError(
+                    f"rid {request.rid!r} is already in use by another "
+                    "request on this engine")
+            if isinstance(request.rid, int):
+                self._next_rid = max(self._next_rid, request.rid + 1)
+        self._rids.add(request.rid)
+        if request.t_submit is None:
+            request.t_submit = time.perf_counter()
+        if request.deadline_ms is not None \
+                and request._t_deadline is None:
+            request._t_deadline = request.t_submit \
+                + request.deadline_ms / 1e3
+        slot = free[0]
+        blocks = self._kv.import_chain(leaves)  # all-or-nothing
+        self._kv.assign(slot, request)
+        self._kv.splice_chain(slot, blocks)
+        self._kv.reserve(slot, -(-need // self._kv.block) - len(blocks))
+        self._need_rows[slot] = need
+        self._kv.lengths[slot] = p
+        request._adm_ids = tok
+        self._n_prompt_tokens += p
+        self._cur[slot] = int(first)
+        self._adm_pending.add(slot)
+        if self._mode == "spec":
+            # rebuild the draft-history row the final prefill chunk
+            # would have written: prompt at [0, p), first at p, frontier
+            # p + 1.  Draft quality only — emission is always the verify
+            # forward's own picks, so output bytes never depend on it
+            row = np.zeros((self._lmax,), np.int32)
+            w = min(p, self._lmax)
+            row[:w] = tok[:w]
+            if p < self._lmax:
+                row[p] = int(first)
+            self._hist = self._hist.at[slot].set(jnp.asarray(row))
+            self._hist_len = self._hist_len.at[slot].set(p + 1)
+        # the imported chain is as good as a local prefill's (its finite
+        # check passed before export): publish it so later identical
+        # prompts on THIS worker reuse it — prefix reuse survives
+        # migration
+        self._kv.register_prefix(slot, tok)
+        if self._fr is not None:
+            tr = RequestTrace(request.rid)
+            request._trace = tr
+            with self._trace_lock:
+                self._traces[request.rid] = tr
+                while len(self._traces) > self._trace_cap:
+                    self._traces.popitem(last=False)
+            tr.mark("decoding", slot=slot)
+        if self._m is not None:
+            self._m.admitted.inc()
+            self._m.prompt_tokens.inc(p)
+            self._m.slots_occupied.set(self._kv.occupied())
+            self._m.live_tokens.set(self._kv.live_tokens())
+        return slot
 
     def _spend_prefill(self):
         """Dispatch up to ``prefill_budget`` prompt chunks across the
@@ -1380,6 +1549,11 @@ class ServingEngine:
                 # prompt — a preemption resume's chain also covers the
                 # tokens it re-prefilled
                 self._kv.register_prefix(slot, r._adm_ids)
+            if self._on_prefilled is not None:
+                # disagg handoff: the chain is registered and still
+                # mapped — the coordinator exports it here; _emit
+                # (max_new=1) then retires the slot on the normal path
+                self._on_prefilled(r, slot, int(fv[0]))
             emitted += self._emit(slot, [int(fv[0])])
         return emitted
 
@@ -1521,6 +1695,10 @@ class ServingEngine:
         live = [i for i in range(self._B) if self._decodable(i)]
         if not live:
             return emitted
+        if self._prefill_only:
+            raise RuntimeError(
+                "prefill-only engine reached a decode dispatch — a "
+                "resident request survived its first-token flush")
         self._ensure_decode_rows(live)
         active = np.array([self._decodable(i) for i in range(self._B)])
         dev_len = self._kv.device_lengths(active)
@@ -1834,6 +2012,13 @@ class ServingEngine:
             "preempt_resume_suffix_tokens": self._n_resume_suffix,
             "preempt_resume_total_tokens": self._n_resume_total,
         }
+
+    @property
+    def kv_manager(self):
+        """The engine's KV cache manager — the paged block-pool surface
+        ``serving/disagg.py`` exports/imports block chains through
+        (``block_chain`` / ``export_chain``)."""
+        return self._kv
 
     # ------------------------------------------------- debug introspection
     @property

@@ -385,6 +385,106 @@ class PagedKVCacheManager(KVCacheManager):
             else:                   # lost the race: keep the rest private
                 break
 
+    # ------------------------------------------------- block-chain transfer
+    # The prefill/decode split (serving/disagg.py) ships a finished
+    # request's KV as its BLOCK CHAIN: export gathers the chain's rows out
+    # of every pool leaf ([n, C, Hkv, D] data + [n, C, Hkv] int8 scales —
+    # the head axis stays at index 2, so the TP pool pspec applies to the
+    # transfer leaves unchanged), import scatters them into freshly
+    # allocated blocks of ANOTHER pool, and splice maps those blocks under
+    # a fresh slot's table row.  Block-table indirection is what makes the
+    # handoff shape-free: the decode programs see new table VALUES, never
+    # new shapes, so a migrated request decodes with zero retraces.
+
+    def block_chain(self, rid):
+        """The physical block ids backing request ``rid``'s mapped chain,
+        logical order.  Public accessor for export / accounting tests —
+        disagg code never walks ``block_tables``/``_mapped`` directly."""
+        for slot, r in enumerate(self.reqs):
+            if r is not None and r.rid == rid:
+                return [int(self.block_tables[slot, w])
+                        for w in range(self._mapped[slot])]
+        raise KeyError(f"no resident request with rid {rid!r}")
+
+    def export_chain(self, blocks):
+        """Gather chain ``blocks``'s rows out of every pool leaf ->
+        per-layer ``(k, v)`` transfer leaves (``[n, C, Hkv, D]`` data,
+        plus ``[n, C, Hkv]`` scales on int8 pools).  An eager device
+        gather: the copies are materialized in device program order, so
+        the source blocks may be released (and even rewritten by later
+        dispatches) immediately after this returns."""
+        for b in blocks:
+            self._check_block(int(b))
+        ids = jnp.asarray(np.asarray(blocks, np.int32))
+
+        def take(leaf):
+            if isinstance(leaf, tuple):
+                return (leaf[0][ids], leaf[1][ids])
+            return leaf[ids]
+        return [(take(k), take(v)) for k, v in self.caches]
+
+    def import_chain(self, leaves):
+        """Scatter transfer ``leaves`` (``export_chain``'s output, one
+        ``(k, v)`` per layer) into freshly allocated blocks of THIS pool;
+        returns the new block ids (each refcount 1, owned by the caller
+        until spliced or freed).  All-or-nothing: if the pool cannot
+        cover the whole chain, every partially allocated block is
+        returned to the free list and ``KVPoolExhausted`` propagates —
+        the migration abort path leaks nothing."""
+        if len(leaves) != len(self.caches):
+            raise ValueError(
+                f"import_chain: {len(leaves)} layers of transfer leaves "
+                f"for a {len(self.caches)}-layer pool")
+        if isinstance(leaves[0][0], tuple) != isinstance(
+                self.caches[0][0], tuple):
+            raise ValueError(
+                "import_chain: transfer-leaf structure does not match "
+                "this pool's KV quantization (int8 pools carry "
+                "(data, scale) leaf pairs) — source and destination "
+                "engines must use the same kv_dtype")
+        k0 = leaves[0][0]
+        n = (k0[0] if isinstance(k0, tuple) else k0).shape[0]
+        blocks = []
+        try:
+            for _ in range(n):
+                blocks.append(self.alloc_block())
+        except KVPoolExhausted:
+            for b in blocks:
+                self.free_block(b)
+            raise
+        ids = jnp.asarray(np.asarray(blocks, np.int32))
+
+        def put(pool, leaf):
+            if isinstance(pool, tuple):
+                return (pool[0].at[ids].set(leaf[0].astype(pool[0].dtype)),
+                        pool[1].at[ids].set(leaf[1].astype(pool[1].dtype)))
+            return pool.at[ids].set(leaf.astype(pool.dtype))
+        self.caches = [(put(kc, lk), put(vc, lv))
+                       for (kc, vc), (lk, lv) in zip(self.caches, leaves)]
+        return blocks
+
+    def splice_chain(self, slot, blocks):
+        """Map imported ``blocks`` at the head of fresh ``slot``'s chain
+        (the decode-side half of a migration).  Unlike ``adopt_prefix``
+        the blocks are already OWNED (refcount 1 from ``import_chain``),
+        so ownership transfers instead of bumping — a block someone else
+        still references cannot be spliced."""
+        if self._mapped[slot]:
+            raise ValueError(
+                f"splice_chain: slot {slot} already maps "
+                f"{self._mapped[slot]} blocks")
+        for b in blocks:
+            b = int(b)
+            self._check_block(b)
+            if self.refcnt[b] != 1:
+                raise ValueError(
+                    f"splice_chain: block {b} has refcount "
+                    f"{int(self.refcnt[b])}, expected exclusive ownership "
+                    "(1) from import_chain")
+        for w, b in enumerate(blocks):
+            self.block_tables[slot, w] = int(b)
+        self._mapped[slot] = len(blocks)
+
     # -------------------------------------------------------------- slots
     def release(self, slot):
         """Retire ``slot``: unreference its whole chain (shared prefix

@@ -14,7 +14,7 @@ from __future__ import annotations
 from paddle_tpu.observability.metrics import get_registry
 from paddle_tpu.observability.trace import span
 
-__all__ = ["EngineMetrics"]
+__all__ = ["EngineMetrics", "DisaggMetrics"]
 
 
 class EngineMetrics:
@@ -316,3 +316,46 @@ class EngineMetrics:
         total = self.spec_drafted.value
         if total:
             self.spec_accept_rate.set(self.spec_accepted.value / total)
+
+
+class DisaggMetrics:
+    """One DisaggCoordinator's migration series (serving/disagg.py),
+    keyed by the coordinator's ``name`` label — a fleet of disagg cells
+    stays separable in one scrape.  Every series (and every known label
+    child) is pre-registered at construction, the registry convention:
+    a scrape before the first migration shows the full zero-valued set."""
+
+    def __init__(self, registry, name):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        L = ("coordinator",)
+        lbl = {"coordinator": name}
+        self.transfer_seconds = reg.histogram(
+            "serving_kv_transfer_seconds",
+            "one migration's KV handoff: block-chain export on the "
+            "prefill pool through import into the decode pool",
+            L).labels(**lbl)
+        self.transfer_bytes = reg.counter(
+            "serving_kv_transfer_bytes_total",
+            "KV cache bytes shipped prefill -> decode (data + int8 "
+            "scale leaves, every layer)", L).labels(**lbl)
+        self._migrations = reg.counter(
+            "serving_migrations_total",
+            "prefill -> decode migrations by outcome: ok (spliced and "
+            "decoding) or aborted (cancelled/expired before adoption)",
+            ("coordinator", "outcome"))
+        for outcome in ("ok", "aborted"):
+            self._migrations.labels(coordinator=name, outcome=outcome)
+        self.prefill_backlog = reg.gauge(
+            "serving_prefill_worker_backlog",
+            "requests queued or resident across the prefill workers",
+            L).labels(**lbl)
+        self.decode_backlog = reg.gauge(
+            "serving_decode_worker_backlog",
+            "requests resident across the decode workers plus "
+            "migrations awaiting adoption", L).labels(**lbl)
+        self._name = name
+
+    def migration(self, outcome):
+        self._migrations.labels(
+            coordinator=self._name, outcome=outcome).inc()

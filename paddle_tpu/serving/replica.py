@@ -5,9 +5,11 @@ ServingEngine` and is the ONLY way the router/server layer talks to it —
 every method below delegates to a public engine API (``submit`` /
 ``cancel`` / ``step`` / ``run`` / ``drain`` / ``close`` / ``stats`` /
 ``prefix_lookup`` / ``slo_tracker`` / ``debug_sources``), never to a
-private attribute.  That boundary is the point: the future
-prefill/decode split replaces the engine behind this handle without the
-router noticing, and the handle stays small enough to review as an API.
+private attribute.  That boundary is the point: the prefill/decode
+split (serving/disagg.py) replaces the engine behind this handle
+without the router noticing — ``Replica(DisaggCoordinator(...))`` is
+exactly how a disaggregated deployment enters a router — and the handle
+stays small enough to review as an API.
 
 A Replica adds no threading, no queueing and no policy — it is a name
 plus delegation.  Scheduling stays in the engine; placement stays in the

@@ -61,8 +61,8 @@ from paddle_tpu.models.llama_decode import (
 )
 
 __all__ = ["match_partition_rules", "llama_tp_rules", "kv_cache_pspec",
-           "kv_scale_pspec", "shard_decode_params", "serving_tp_programs",
-           "TPPrograms"]
+           "kv_scale_pspec", "kv_transfer_shardings",
+           "shard_decode_params", "serving_tp_programs", "TPPrograms"]
 
 
 def _path_str(path):
@@ -137,6 +137,18 @@ def kv_scale_pspec(axis="mp"):
     each chip holds exactly the scales for its own heads and the in-loop
     dequant stays collective-free like the data read."""
     return PS(None, None, axis)
+
+
+def kv_transfer_shardings(mesh, axis="mp"):
+    """Placement for migration transfer leaves (serving/disagg.py): a
+    block chain's ``[n_blocks, C, Hkv, D]`` data leaves keep the head
+    axis at index 2 — exactly the pool layout — so the pool specs apply
+    to the transfer unchanged, and an ``InProcessTransport.send`` onto a
+    TP decode worker lands each leaf already head-sharded: the splice is
+    a sharded scatter with no resharding copy.  Returns ``(data_sharding,
+    scale_sharding)``; pass both to the transport."""
+    return (NamedSharding(mesh, kv_cache_pspec(axis)),
+            NamedSharding(mesh, kv_scale_pspec(axis)))
 
 
 def _tp_geometry_check(params, mesh, axis):

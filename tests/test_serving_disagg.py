@@ -1,0 +1,399 @@
+"""Disaggregated prefill/decode serving (serving/disagg.py).
+
+The acceptance properties on the CPU mesh:
+
+* a request prefilled on the PrefillWorker and decoded on the
+  DecodeWorker produces BYTE-IDENTICAL output to the colocated engine,
+  across greedy/spec x f32/int8 KV and both shipped transports;
+* the block-chain transfer unit is sound: export/import round-trips
+  leaf values exactly, imported blocks arrive refcount-1 and splice
+  under a fresh table row, radix registration survives migration (a
+  migrated chain serves later prefix hits on the decode side), and the
+  pool-exhaustion abort path releases every partially imported block;
+* the WARM decode worker adopts a staggered migration wave at ZERO
+  retraces — the handoff changes block-table values, never shapes;
+* the DisaggCoordinator satisfies the engine surface Replica expects,
+  so PR 12's router composes over a disaggregated deployment unchanged.
+"""
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import assert_no_retrace
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.serving import (
+    DecodeWorker, DisaggCoordinator, InProcessTransport, PickleTransport,
+    PrefillWorker, Replica, Request, Router, ServingEngine,
+)
+from paddle_tpu.serving.kv_cache import KVPoolExhausted, PagedKVCacheManager
+
+GEOM = dict(batch_size=3, max_len=128, decode_chunk=16, prefill_chunk=16,
+            instrument=False, recorder=False, kv_block=16,
+            max_live_tokens=3 * 128)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _prompts(rng, sizes):
+    return [rng.integers(1, 2000, size=int(s)).astype(np.int32)
+            for s in sizes]
+
+
+def _split(model, transport=None, pf=None, dw=None, **kw):
+    cfg = dict(GEOM)
+    cfg.update(kw)
+    pcfg = dict(cfg)
+    pcfg.update(pf or {})
+    pcfg.pop("mode", None)
+    pcfg.pop("spec_k", None)
+    dcfg = dict(cfg)
+    dcfg.update(dw or {})
+    return DisaggCoordinator(PrefillWorker(model, **pcfg),
+                             DecodeWorker(model, **dcfg),
+                             transport=transport, instrument=False)
+
+
+# ---------------------------------------------------------------------------
+# block-chain transfer units (pure manager — no engine, no decode programs)
+# ---------------------------------------------------------------------------
+
+def _mgr(**kw):
+    d = dict(n_layers=2, batch_size=2, max_len=32, num_kv_heads=1,
+             head_dim=4, dtype="float32", block=8, max_live_tokens=64)
+    d.update(kw)
+    return PagedKVCacheManager(**d)
+
+
+def _req(rid):
+    return types.SimpleNamespace(rid=rid)
+
+
+def _fill_chain(m, slot, rows, rid="src", seed=0):
+    """Assign + grow a chain and write recognizable values into every
+    mapped block row of every leaf; returns the chain's block ids."""
+    m.assign(slot, _req(rid))
+    m.ensure_rows(slot, rows)
+    chain = m.block_chain(rid)
+    rng = np.random.default_rng(seed)
+    ids = np.asarray(chain, np.int32)
+
+    def paint(leaf):
+        vals = rng.standard_normal((len(chain),) + leaf.shape[1:])
+        return leaf.at[ids].set(vals.astype(leaf.dtype))
+    m.caches = [(paint(k), paint(v)) for k, v in m.caches]
+    return chain
+
+
+class TestChainTransfer:
+    def test_block_chain_accessor(self):
+        m = _mgr()
+        m.assign(0, _req("a"))
+        m.ensure_rows(0, 20)  # ceil(20/8) = 3 blocks
+        chain = m.block_chain("a")
+        assert chain == [int(m.block_tables[0, w]) for w in range(3)]
+        assert all(m.refcnt[b] == 1 for b in chain)
+        with pytest.raises(KeyError, match="rid"):
+            m.block_chain("nope")
+
+    def test_export_import_roundtrips_values(self):
+        src, dst = _mgr(), _mgr()
+        chain = _fill_chain(src, 0, 24)
+        leaves = src.export_chain(chain)
+        got = dst.import_chain(leaves)
+        assert len(got) == len(chain)
+        assert all(dst.refcnt[b] == 1 for b in got)
+        for (ks, vs), (kd, vd) in zip(src.caches, dst.caches):
+            np.testing.assert_array_equal(
+                np.asarray(ks)[chain], np.asarray(kd)[got])
+            np.testing.assert_array_equal(
+                np.asarray(vs)[chain], np.asarray(vd)[got])
+
+    def test_export_survives_source_release(self):
+        # the export is a materialized copy: releasing (and repainting)
+        # the source blocks after export must not corrupt the transfer
+        src, dst = _mgr(), _mgr()
+        chain = _fill_chain(src, 0, 24)
+        leaves = src.export_chain(chain)
+        want = [np.asarray(k)[chain] for k, _ in src.caches]
+        src.release(0)
+        src.caches = [(k.at[:].set(0.0), v.at[:].set(0.0))
+                      for k, v in src.caches]
+        got = dst.import_chain(leaves)
+        for w, (kd, _) in zip(want, dst.caches):
+            np.testing.assert_array_equal(w, np.asarray(kd)[got])
+
+    def test_splice_and_release_recycle(self):
+        src, dst = _mgr(), _mgr()
+        chain = _fill_chain(src, 0, 24)
+        free0 = dst.free_count()
+        got = dst.import_chain(src.export_chain(chain))
+        dst.assign(0, _req("mig"))
+        dst.splice_chain(0, got)
+        assert dst.block_chain("mig") == got
+        assert dst.free_count() == free0 - len(got)
+        dst.release(0)  # unregistered chain -> straight back to free
+        assert dst.free_count() == free0
+
+    def test_splice_requires_exclusive_ownership(self):
+        src, dst = _mgr(), _mgr()
+        chain = _fill_chain(src, 0, 16)
+        got = dst.import_chain(src.export_chain(chain))
+        dst.refcnt[got[0]] += 1  # simulate a concurrent owner
+        dst.assign(0, _req("x"))
+        with pytest.raises(ValueError, match="exclusive ownership"):
+            dst.splice_chain(0, got)
+
+    def test_exhaustion_abort_releases_partial_import(self):
+        src = _mgr()
+        chain = _fill_chain(src, 0, 32)  # 4 blocks
+        leaves = src.export_chain(chain)
+        dst = _mgr()  # 8 blocks total
+        held = [dst.alloc_block() for _ in range(6)]  # only 2 left
+        free0 = dst.free_count()
+        with pytest.raises(KVPoolExhausted):
+            dst.import_chain(leaves)
+        assert dst.free_count() == free0  # partial allocs rolled back
+        # the prefill side is untouched by a failed import — its chain
+        # still releases cleanly (the migration-abort no-leak property)
+        src.release(0)
+        assert src.free_count() == src.num_blocks
+        for b in held:
+            dst.free_block(b)
+
+    def test_radix_registration_survives_migration(self):
+        src, dst = _mgr(), _mgr()
+        toks = np.arange(1, 25, dtype=np.int32)  # 24 tokens, 3 blocks
+        chain = _fill_chain(src, 0, toks.size)
+        src.register_prefix(0, toks)
+        got = dst.import_chain(src.export_chain(chain))
+        dst.assign(0, _req("mig"))
+        dst.splice_chain(0, got)
+        dst.register_prefix(0, toks)
+        # full-block shareable prefix: (24-1)//8 = 2 blocks = 16 tokens
+        matched, blocks = dst.match_prefix(toks)
+        assert matched == 16
+        assert blocks == got[:2]
+        # the migrated chain is adoptable on the DESTINATION pool
+        dst.assign(1, _req("hit"))
+        dst.adopt_prefix(1, blocks)
+        assert all(dst.refcnt[b] == 2 for b in blocks)
+
+    def test_quantization_mismatch_raises(self):
+        src = _mgr()
+        chain = _fill_chain(src, 0, 16)
+        dst = _mgr(dtype="int8")
+        with pytest.raises(ValueError, match="kv_dtype"):
+            dst.import_chain(src.export_chain(chain))
+
+    def test_import_layer_count_mismatch_raises(self):
+        src = _mgr()
+        chain = _fill_chain(src, 0, 16)
+        dst = _mgr(n_layers=3)
+        with pytest.raises(ValueError, match="layers"):
+            dst.import_chain(src.export_chain(chain))
+
+
+# ---------------------------------------------------------------------------
+# disagg vs colocated byte-identity
+# ---------------------------------------------------------------------------
+
+class TestDisaggByteIdentity:
+    @pytest.mark.parametrize("mode", ["greedy", "spec"])
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_matches_colocated(self, mode, kv_dtype):
+        model = _tiny_model()
+        rng = np.random.default_rng(11)
+        prompts = _prompts(rng, [21, 37, 9, 30])
+        extra = dict(kv_dtype=kv_dtype)
+        if mode == "spec":
+            extra.update(mode="spec", spec_k=4)
+
+        eng = ServingEngine(model, **{**GEOM, **extra})
+        base = [eng.submit(Request(p, 12)) for p in prompts]
+        eng.run()
+
+        coord = _split(model, dw=extra, pf=dict(kv_dtype=kv_dtype))
+        dis = [coord.submit(Request(p, 12)) for p in prompts]
+        coord.run()
+
+        assert coord.stats()["migrations_ok"] == len(prompts)
+        for b, d in zip(base, dis):
+            assert b.status == d.status == "done"
+            assert list(b.output_ids) == list(d.output_ids)
+        eng.close()
+        coord.close()
+
+    def test_matches_over_pickle_transport(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(5)
+        prompts = _prompts(rng, [24, 33, 17])
+
+        eng = ServingEngine(model, **GEOM)
+        base = [eng.submit(Request(p, 10)) for p in prompts]
+        eng.run()
+
+        coord = _split(model, transport=PickleTransport())
+        dis = [coord.submit(Request(p, 10)) for p in prompts]
+        coord.run()
+        for b, d in zip(base, dis):
+            assert list(b.output_ids) == list(d.output_ids)
+        eng.close()
+        coord.close()
+
+    def test_first_token_rides_handoff(self):
+        # max_new=1 completes AT the handoff: no migration is ever paid
+        model = _tiny_model()
+        rng = np.random.default_rng(9)
+        coord = _split(model)
+        reqs = [coord.submit(Request(p, 1))
+                for p in _prompts(rng, [12, 28])]
+        coord.run()
+        s = coord.stats()
+        assert all(r.status == "done" and len(r.output_ids) == 1
+                   for r in reqs)
+        assert s["migrations_ok"] == 0 and s["migrations_aborted"] == 0
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# zero retraces across a staggered migration wave
+# ---------------------------------------------------------------------------
+
+class TestWarmMigrationNoRetrace:
+    def test_staggered_wave_zero_retraces(self):
+        model = _tiny_model()
+        coord = _split(model)
+        rng = np.random.default_rng(13)
+
+        def wave(seed):
+            rng = np.random.default_rng(seed)
+            reqs = [Request(p, 8) for p in _prompts(rng, [21, 34, 9, 27])]
+            # staggered: later submits land while earlier requests are
+            # mid-prefill / mid-migration / decoding
+            for q in reqs[:2]:
+                coord.submit(q)
+            for _ in range(3):
+                coord.step()
+            for q in reqs[2:]:
+                coord.submit(q)
+            coord.run()
+            return reqs
+
+        wave(1)  # warm every program: prefill chunks, migration, decode
+        with assert_no_retrace():
+            reqs = wave(2)
+        assert all(r.status == "done" for r in reqs)
+        assert coord.stats()["migrations_ok"] >= 6
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# the Replica/Router contract over a DisaggCoordinator
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorSurface:
+    def test_router_over_coordinator_byte_identity(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(3)
+        prompts = _prompts(rng, [24, 33, 17])
+
+        direct = ServingEngine(model, **GEOM)
+        dreqs = [Request(p, 8) for p in prompts]
+        for q in dreqs:
+            direct.submit(q)
+        direct.run()
+
+        router = Router([Replica(_split(model), name="d0")], registry=None)
+        rreqs = [Request(p, 8) for p in prompts]
+        for q in rreqs:
+            router.submit(q)
+        router.run()
+        router.drain()
+
+        for dq, rq in zip(dreqs, rreqs):
+            assert dq.status == rq.status == "done"
+            assert list(dq.output_ids) == list(rq.output_ids)
+        router.close()
+        direct.close()
+
+    def test_replica_surface_resolves(self):
+        model = _tiny_model()
+        rep = Replica(_split(model), name="disagg")
+        assert rep.block_size == GEOM["kv_block"]
+        assert rep.queue_depth() == 0
+        assert rep.backlog() == 0
+        assert rep.burn_rate("interactive") == 0.0
+        s = rep.stats()
+        assert s["replica"] == "disagg"
+        assert s["slots_total"] == GEOM["batch_size"]
+        srcs = rep.debug_sources()
+        assert any(k.endswith("prefill0_requests") for k in srcs)
+        assert any(k.endswith("decode0_flightrecorder") for k in srcs)
+        rep.close()
+
+    def test_prefix_reuse_survives_on_prefill_side(self):
+        model = _tiny_model()
+        coord = _split(model)
+        rng = np.random.default_rng(17)
+        p = _prompts(rng, [40])[0]
+        coord.submit(Request(p.copy(), 6))
+        coord.run()
+        assert coord.prefix_lookup(p) > 0  # registered at first token
+        coord.submit(Request(p.copy(), 6))
+        coord.run()
+        assert coord.stats()["prefix_reuse_tokens"] > 0
+        coord.close()
+
+    def test_cancel_mid_flight_and_close(self):
+        model = _tiny_model()
+        coord = _split(model)
+        rng = np.random.default_rng(19)
+        reqs = [coord.submit(Request(p, 32))
+                for p in _prompts(rng, [20, 26])]
+        assert coord.cancel(reqs[0].rid) is True
+        coord.step()
+        assert reqs[0].status == "cancelled"
+        statuses = coord.close()
+        assert statuses[reqs[0].rid] == "cancelled"
+        assert reqs[1].status in ("cancelled", "done")
+        assert coord.cancel("unknown") is False
+
+    def test_shadow_rids_correlate(self):
+        # the same rid names the request on both sides of the split, so
+        # flight-recorder migrate_out/migrate_in events correlate
+        model = _tiny_model()
+        reg = MetricsRegistry()
+        pw = PrefillWorker(model, **{**GEOM, "recorder": True})
+        dw = DecodeWorker(model, **{**GEOM, "recorder": True})
+        coord = DisaggCoordinator(pw, dw, registry=reg)
+        q = coord.submit(Request(np.arange(1, 30, dtype=np.int32), 6,
+                                 rid="req-42"))
+        coord.run()
+        assert q.status == "done"
+        outs = [e for e in pw.engine.recorder.snapshot()["events"]
+                if e["kind"] == "migrate_out"]
+        ins = [e for e in dw.engine.recorder.snapshot()["events"]
+               if e["kind"] == "migrate_in"]
+        assert [e["rid"] for e in outs] == ["req-42"]
+        assert [e["rid"] for e in ins] == ["req-42"]
+        assert outs[0]["n_blocks"] == ins[0]["n_blocks"] > 0
+        assert outs[0]["bytes"] == ins[0]["bytes"] > 0
+        # pre-registered disagg metric series exist with zero/observed
+        # values (dashboards see stable names before the first migration)
+        text = reg.to_prometheus()
+        assert "serving_kv_transfer_seconds" in text
+        assert "serving_kv_transfer_bytes_total" in text
+        assert "serving_migrations_total" in text
+        assert 'outcome="ok"' in text and 'outcome="aborted"' in text
+        assert "serving_prefill_worker_backlog" in text
+        assert "serving_decode_worker_backlog" in text
+        coord.close()

@@ -1484,6 +1484,114 @@ class TestPTL016:
 
 
 # ---------------------------------------------------------------------------
+# PTL017: blocking KV transfer in a step-dispatch loop
+# ---------------------------------------------------------------------------
+
+class TestPTL017:
+    def test_transport_send_in_step_loop_tp(self):
+        src = textwrap.dedent("""
+            def drive(transport, reqs, params, caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    transport.send(r.rid, caches)
+        """)
+        (f,) = lint_source(src, path="m.py")
+        assert f.rule == "PTL017"
+        assert ".send()" in f.message and "kv_transfer" in f.message
+
+    def test_transport_recv_of_chain_tp(self):
+        src = textwrap.dedent("""
+            def drive(transport, handles, params):
+                for h in handles:
+                    leaves = transport.recv(chain_handle(h))
+                    out = decode_step(params, leaves)
+        """)
+        assert [f.rule for f in lint_source(src, path="m.py")] \
+            == ["PTL017"]
+
+    def test_device_get_of_cache_leaves_tp(self):
+        # a raw device_get of cache leaves is BOTH the generic host sync
+        # (PTL004) and a blocking KV transfer (PTL017) — the second
+        # finding names the migration-specific fix
+        src = textwrap.dedent("""
+            import jax
+
+            def drive(reqs, params, kv_caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    host = jax.device_get(kv_caches)
+        """)
+        assert [f.rule for f in lint_source(src, path="m.py")] \
+            == ["PTL004", "PTL017"]
+
+    def test_outer_loop_propagates_tp(self):
+        # transfer in an inner non-step loop still serializes the outer
+        # step loop — same propagation as PTL004 syncs
+        src = textwrap.dedent("""
+            def drive(transport, waves, params, caches):
+                for wave in waves:
+                    out = decode_step(params, wave)
+                    for r in wave:
+                        transport.send(r, caches)
+        """)
+        assert [f.rule for f in lint_source(src, path="m.py")] \
+            == ["PTL017"]
+
+    def test_socket_recv_not_kv_tn(self):
+        # a socket .recv() in a step loop moves no KV leaves — it is
+        # PTL008/PTL013's territory, not a migration anti-pattern
+        src = textwrap.dedent("""
+            def drive(sock, reqs, params):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    data = sock.recv(4096)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_no_step_dispatch_tn(self):
+        # the coordinator pump: transfers in a loop with NO step
+        # dispatch are the sanctioned staging pattern
+        src = textwrap.dedent("""
+            def pump(transport, tickets, caches):
+                for t in tickets:
+                    leaves = transport.recv(t.handle)
+                    caches.append(leaves)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_sanctioned_helper_tn(self):
+        src = textwrap.dedent("""
+            def drive(reqs, params, caches, kv_transfer):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    kv_transfer(r, caches)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_aliased_primitive_not_sanctioned_tp(self):
+        # sanction follows the RESOLVED name: importing a raw sync
+        # primitive as `kv_transfer` does not launder the transfer
+        src = textwrap.dedent("""
+            from jax import device_get as kv_transfer
+
+            def drive(reqs, params, caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    host = kv_transfer(caches)
+        """)
+        assert "PTL017" in [f.rule for f in lint_source(src, path="m.py")]
+
+    def test_pragma_suppresses(self):
+        src = textwrap.dedent("""
+            def drive(transport, reqs, params, caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    transport.send(r.rid, caches)  # tpu-lint: ignore[PTL017]
+        """)
+        assert lint_source(src, path="m.py") == []
+
+
+# ---------------------------------------------------------------------------
 # SARIF 2.1.0 reporter
 # ---------------------------------------------------------------------------
 
