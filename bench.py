@@ -318,7 +318,18 @@ def bench_serving(n_requests=64, batch=8):
     measurement noise) and a ``metrics`` key carrying the continuous
     run's full ``MetricsRegistry.snapshot()`` so every BENCH_r*.json row
     records the series (phase histograms, SLO attainment, reliability
-    counters) its headline numbers were derived from."""
+    counters) its headline numbers were derived from.
+
+    Round 19 adds the fused-prefill A/B (ops/prefill_attention_pallas.py,
+    keyed through the serving/program_key.py registry):
+    ``serving_fused_prefill_speedup`` (the reference chunked
+    read + quantize-append vs the single fused kernel on the long-prompt
+    paged-int8 workload; ratio-only off-chip, where the kernel runs
+    under interpret emulation), ``serving_adm_tpot_p95_ms_{unfused,fused}``
+    (round 10's admission-interference p95 for both arms), and the TP
+    row gains ``serving_tp_overlap_speedup`` (the same mesh run with
+    each layer's row-parallel psum split into two overlapped segments —
+    byte-identical math, ratio-only on the host mesh)."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import MetricsRegistry
     from paddle_tpu.serving import (EngineOverloaded, FaultPlan, Request,
@@ -489,6 +500,15 @@ def bench_serving(n_requests=64, batch=8):
         dt_t1, _, _ = run("continuous", "greedy", m=tp_model)
         run("continuous", "greedy", m=tp_model, mesh=mesh)   # warm mesh
         dt_tn, _, _ = run("continuous", "greedy", m=tp_model, mesh=mesh)
+        # round 19 — overlapped row-parallel psum: the same mesh run with
+        # each layer's output-feature reduction split into 2 segments so
+        # the collective overlaps the remaining matmul work.  Host
+        # collectives don't overlap, so off-chip this is a ratio-only
+        # smoke column (byte-identical math is pinned by
+        # tests/test_serving_prefill_fused.py)
+        run("continuous", "greedy", m=tp_model, mesh=mesh, tp_overlap=2)
+        dt_to, _, _ = run("continuous", "greedy", m=tp_model, mesh=mesh,
+                          tp_overlap=2)
         # per-shard analytic bytes/token: replicated params read in full
         # on every chip, sharded params and the head-sharded KV at 1/N
         tp_params, _ = _decode_params_of(tp_model, lmax)
@@ -514,6 +534,7 @@ def bench_serving(n_requests=64, batch=8):
                 ((repl_b + shard_b / n_tp) / batch
                  + tp_kv_row * float(np.mean(plens + olens / 2)) / n_tp)
                 / 1e9, 4),
+            "serving_tp_overlap_speedup": round(dt_tn / dt_to, 2),
         }
     # A/B 5 (round 12) — degraded-mode smoke: the standard workload under
     # a seeded fault plan + bounded queue; goodput counts only requests
@@ -609,6 +630,27 @@ def bench_serving(n_requests=64, batch=8):
     w_tok_bf16 = w_elems * 2 / batch
     w_tok_w8 = (w_elems + 2 * w_scales) / batch
 
+    # A/B 9 (round 19) — fused chunked-prefill kernel
+    # (ops/prefill_attention_pallas.py): the long-prompt chunked-admission
+    # workload (A/B 3's lp_reqs) on a paged int8 pool with
+    # prefill_chunk == kv_block == decode_chunk so the fused path's
+    # alignment contract holds for every admission chunk.
+    # prefill_impl=None is the reference chunked read + quantize-append;
+    # "pallas" fuses the causal-masked chunk attention WITH the int8
+    # quantize-on-append into one kernel launch.  Off the chip the kernel
+    # runs under interpret emulation, so only the ratio carries
+    # cross-round meaning; the admission-interference p95 (the round-10
+    # metric) rides along for both arms — the fused kernel must not give
+    # back the stall-free admission chunking bought.
+    fp_kw = dict(reqs=list(lp_reqs), prefill_chunk=pchunk,
+                 decode_chunk=pchunk, kv_block=pchunk,
+                 max_live_tokens=batch * lmax, kv_dtype="int8")
+    run("continuous", "greedy", **fp_kw)             # warm reference arm
+    dt_pu, _, reg_pu = run("continuous", "greedy", **fp_kw)
+    run("continuous", "greedy", prefill_impl="pallas", **fp_kw)
+    dt_pf, _, reg_pf = run("continuous", "greedy",
+                           prefill_impl="pallas", **fp_kw)
+
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
@@ -695,6 +737,13 @@ def bench_serving(n_requests=64, batch=8):
         "serving_hbm_gb_per_tok_w_bf16": w_tok_bf16 / 1e9,
         "serving_hbm_gb_per_tok_w8": w_tok_w8 / 1e9,
         "serving_w8_bytes_ratio": round(w_tok_w8 / w_tok_bf16, 4),
+        # fused-prefill A/B (round 19): wall-clock ratio on the
+        # long-prompt paged-int8 workload, plus admission-interference
+        # p95 for both arms (the fused kernel keeps decode TPOT bounded
+        # while admissions stream through it)
+        "serving_fused_prefill_speedup": round(dt_pu / dt_pf, 2),
+        "serving_adm_tpot_p95_ms_unfused": adm_tpot_p95_ms(reg_pu),
+        "serving_adm_tpot_p95_ms_fused": adm_tpot_p95_ms(reg_pf),
         # flight-recorder overhead (round 13): recorder-on (the default,
         # dt_c) vs recorder-off on the same warm programs
         "serving_recorder_overhead_pct": round(

@@ -168,6 +168,7 @@ def sweep_kv_dtype(iters=20, n_steps=8):
     from paddle_tpu.models.llama_decode import (
         _decode_params_of, serving_decode_steps)
     from paddle_tpu.ops.decode_attention import init_kv_cache
+    from paddle_tpu.serving.program_key import ProgramKey
 
     lmax, batch, chunk = 2048, 8, 256
     cfg = LlamaConfig(
@@ -192,15 +193,16 @@ def sweep_kv_dtype(iters=20, n_steps=8):
             caches = [init_kv_cache(batch, lmax, nkv, hd, kvd)
                       for _ in range(cfg.num_hidden_layers)]
             kv_dtype = kvd if kvd == "int8" else None
+            pk = ProgramKey(kv_dtype=kv_dtype)
             toks, _, caches = serving_decode_steps(
                 params, key, cur, caches, lengths,
-                n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype)
+                n_steps=n_steps, chunk_size=chunk, program_key=pk)
             np.asarray(toks)  # compile + settle
             t0 = time.perf_counter()
             for _ in range(iters):
                 toks, _, caches = serving_decode_steps(
                     params, key, cur, caches, lengths,
-                    n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype)
+                    n_steps=n_steps, chunk_size=chunk, program_key=pk)
             np.asarray(toks)
             dt = (time.perf_counter() - t0) / (iters * n_steps)
             per_tok = 2 if kvd == "bfloat16" else 1  # data bytes/elt
@@ -234,6 +236,7 @@ def sweep_attn_impl(iters=20, n_steps=8):
     from paddle_tpu.models.llama_decode import (
         _decode_params_of, serving_decode_steps)
     from paddle_tpu.ops.decode_attention import init_kv_cache
+    from paddle_tpu.serving.program_key import ProgramKey
 
     lmax, batch, chunk = 2048, 8, 256
     cfg = LlamaConfig(
@@ -259,17 +262,16 @@ def sweep_attn_impl(iters=20, n_steps=8):
                 caches = [init_kv_cache(batch, lmax, nkv, hd, kvd)
                           for _ in range(cfg.num_hidden_layers)]
                 kv_dtype = kvd if kvd == "int8" else None
+                pk = ProgramKey(kv_dtype=kv_dtype, attn_impl=impl)
                 toks, _, caches = serving_decode_steps(
                     params, key, cur, caches, lengths,
-                    n_steps=n_steps, chunk_size=chunk, kv_dtype=kv_dtype,
-                    attn_impl=impl)
+                    n_steps=n_steps, chunk_size=chunk, program_key=pk)
                 np.asarray(toks)  # compile + settle
                 t0 = time.perf_counter()
                 for _ in range(iters):
                     toks, _, caches = serving_decode_steps(
                         params, key, cur, caches, lengths,
-                        n_steps=n_steps, chunk_size=chunk,
-                        kv_dtype=kv_dtype, attn_impl=impl)
+                        n_steps=n_steps, chunk_size=chunk, program_key=pk)
                 np.asarray(toks)
                 dt = (time.perf_counter() - t0) / (iters * n_steps)
                 label = "pallas" if impl == "pallas" else "reference"
@@ -342,6 +344,68 @@ def sweep_prefill_chunk(n_requests=24):
     return rows
 
 
+PREFILL_IMPLS = [None, "pallas"]
+
+
+def sweep_prefill_impl(n_requests=24):
+    """Prefill-implementation sweep for the fused Pallas chunked-prefill
+    kernel: end-to-end time and TPOT-p95-during-admission of the same
+    long-prompt-heavy paged serving run as the prefill-chunk sweep, at
+    each ``prefill_impl`` (reference dense fold + scatter append vs the
+    fused attention+append kernel) crossed with the KV-storage dtype.
+    The fused x int8 cell is the headline: quantize-on-append happens
+    inside the kernel, so the separate scatter pass disappears."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.serving import Request, ServingEngine
+
+    lmax, batch, pchunk = 2048, 8, 256
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    plens = rng.integers(1024, 1793, n_requests)
+    olens = rng.integers(64, 129, n_requests)
+    reqs = [(np.tile(rng.integers(0, cfg.vocab_size, 32),
+                     p // 32 + 1)[:p], int(o)) for p, o in zip(plens, olens)]
+    total_new = int(olens.sum())
+
+    def run(impl, kv_dtype):
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=batch, max_len=lmax,
+                            sync_every=4, registry=reg,
+                            kv_block=pchunk, prefill_chunk=pchunk,
+                            prefill_budget=2, prefill_impl=impl,
+                            kv_dtype=kv_dtype)
+        for p, o in reqs:
+            eng.submit(Request(p, o))
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        h = reg.get("serving_tpot_during_admission_seconds").labels(
+            policy="continuous")
+        p95 = round(h.percentile(95) * 1e3, 1) if h.count else None
+        return dt, p95
+
+    rows = []
+    for kv_dtype in (None, "int8"):
+        for impl in PREFILL_IMPLS:
+            run(impl, kv_dtype)  # warm this configuration's programs
+            dt, p95 = run(impl, kv_dtype)
+            label = "pallas" if impl == "pallas" else "reference"
+            kvd = kv_dtype or "bf16"
+            rows.append({"variant": f"prefill_impl_{kvd}_{label}",
+                         "e2e_s": round(dt, 2),
+                         "tok_per_sec": round(total_new / dt, 1),
+                         "adm_tpot_p95_ms": p95})
+            gc.collect()
+    return rows
+
+
 def main():
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "bench_sweep.jsonl")
@@ -366,6 +430,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "attn_impl":
         for rec in sweep_attn_impl():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "prefill_impl":
+        for rec in sweep_prefill_impl():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

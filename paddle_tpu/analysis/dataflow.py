@@ -460,6 +460,18 @@ class BindFact:
     kws: tuple
 
 
+@dataclass(frozen=True)
+class RegistryFact:
+    """A declarative static-axis registry: a module-level
+    ``PROGRAM_AXES = (StaticAxis("name", ...), ...)`` tuple.  Its axis
+    names are the single source of truth for program-identity knobs —
+    a cache key either carries the whole ``program_key`` or every axis."""
+    module: str
+    path: str
+    line: int
+    axes: tuple
+
+
 @dataclass
 class ModuleFacts:
     path: str
@@ -467,6 +479,7 @@ class ModuleFacts:
     impls: list = field(default_factory=list)
     keys: list = field(default_factory=list)
     binds: list = field(default_factory=list)
+    registries: list = field(default_factory=list)
 
 
 def _arg_desc(node):
@@ -500,6 +513,35 @@ def extract_cache_facts(ma):
                 line=info.node.lineno,
                 statics=tuple(sorted(info.static_names)),
                 params=tuple(info.params())))
+    # static-axis registries: module-level PROGRAM_AXES tuples of
+    # StaticAxis(...) rows — the axis name is the first positional string
+    # constant or the name= kwarg.  Extracted unconditionally (the
+    # registry module itself caches nothing).
+    for node in ma.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PROGRAM_AXES" and \
+                isinstance(node.value, ast.Tuple):
+            axes = []
+            for elt in node.value.elts:
+                if not isinstance(elt, ast.Call):
+                    continue
+                name = None
+                if elt.args and isinstance(elt.args[0], ast.Constant) and \
+                        isinstance(elt.args[0].value, str):
+                    name = elt.args[0].value
+                else:
+                    for kw in elt.keywords:
+                        if kw.arg == "name" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                isinstance(kw.value.value, str):
+                            name = kw.value.value
+                if name:
+                    axes.append(name)
+            if axes:
+                facts.registries.append(RegistryFact(
+                    module=module, path=ma.path, line=node.lineno,
+                    axes=tuple(axes)))
     # key tuples: N = (...) then d[N] / d.get(N) in the same function
     for fdef in [n for n in ast.walk(ma.tree)
                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
@@ -569,13 +611,23 @@ def check_cache_keys(all_facts, enabled_for, get_lines):
     bound to a *variable* at an impl call site inside a caching module
     must appear (by either the bound variable's name or the static's own
     name — renames like ``n_steps=sync_every`` count through the local
-    variable) in the module's cache-key tuple(s)."""
+    variable) in the module's cache-key tuple(s).
+
+    When the project declares a static-axis registry (a module-level
+    ``PROGRAM_AXES`` tuple), it is the single source of truth: a key
+    tuple that carries ``program_key`` (directly, or through the variable
+    bound to an impl's ``program_key`` argument) covers every axis at
+    once; a key that instead hand-threads a *subset* of the registry's
+    axis names gets one finding per missing axis."""
     impls = {}
     by_bare = {}
+    registries = []
     for facts in all_facts:
         for impl in facts.impls:
             impls[impl.module + "." + impl.name] = impl
             by_bare.setdefault(impl.name, []).append(impl)
+        registries.extend(facts.registries)
+    reg_axes = frozenset(a for r in registries for a in r.axes)
     findings = []
     for facts in all_facts:
         if not facts.keys or "PTL014" not in enabled_for(facts.path):
@@ -614,6 +666,34 @@ def check_cache_keys(all_facts, enabled_for, get_lines):
                 f"({bf.path}:{bf.line}) — two configurations differing "
                 f"only in `{bound}` collide on one cache entry and "
                 "silently reuse a stale compiled program")
+            lines = get_lines(key.path)
+            if lines is None or not _suppressed(f, lines):
+                findings.append(f)
+        # registry completeness: hand-threading SOME axis names without
+        # carrying the program_key means every axis NOT in the tuple can
+        # never fork the cache entry
+        if not reg_axes:
+            continue
+        covered = "program_key" in key_names or any(
+            kw == "program_key" and desc[0] == "name" and
+            desc[1] in key_names
+            for bf in facts.binds for kw, desc in bf.kws)
+        overlap = key_names & reg_axes
+        if covered or not overlap:
+            continue
+        reg = registries[0]
+        for axis in sorted(reg_axes - key_names):
+            f = Finding(
+                "PTL014", key.path, key.line, 0,
+                f"program-cache key tuple in `{key.func}` "
+                f"({key.path}:{key.line}) hand-threads registry axes "
+                f"({', '.join(sorted(overlap))}) but is missing axis "
+                f"`{axis}` of the static-axis registry PROGRAM_AXES "
+                f"({reg.path}:{reg.line}) — carry the whole "
+                "`program_key` (one registry value keys every axis) or "
+                "add the missing axis; a partial hand-threaded key lets "
+                f"two configurations differing only in `{axis}` collide "
+                "on one cache entry")
             lines = get_lines(key.path)
             if lines is None or not _suppressed(f, lines):
                 findings.append(f)

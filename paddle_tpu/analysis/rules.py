@@ -200,10 +200,17 @@ _RULE_LIST = [
         "(the worst silent-wrong-answer class this repo has).  Checked "
         "project-wide: impl `static_argnames` are read from the defining "
         "module (models/llama_decode.py), key tuples from the factory "
-        "module (serving/sharding.py `serving_tp_programs`)",
-        "add the knob to the program-cache key tuple — ROADMAP's standing "
-        "note: every new static axis (kernel impl, weight dtype, sampler, "
-        "adapter set) extends the key rather than forking a dispatch seam",
+        "module (serving/sharding.py `serving_tp_programs`).  When the "
+        "project declares a static-axis registry (a module-level "
+        "`PROGRAM_AXES` tuple — serving/program_key.py), it is the single "
+        "source of truth: a key that carries the `program_key` covers "
+        "every axis at once, while a key hand-threading a subset of the "
+        "registry's axis names is flagged once per missing axis",
+        "carry the whole `program_key` in the cache-key tuple (the "
+        "registry value keys every axis), or add the missing knob — "
+        "ROADMAP's standing note: every new static axis (kernel impl, "
+        "weight dtype, sampler, adapter set) extends the registry rather "
+        "than forking a dispatch seam",
     ),
     Rule(
         "PTL015", "unsynchronized-shared-state", WARNING,

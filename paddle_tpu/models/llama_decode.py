@@ -137,14 +137,42 @@ def quantize_decode_weights(params, weight_dtype="int8"):
     return out
 
 
-def _mm(x, lp, name):
+def _mm(x, lp, name, tp_overlap=None):
     """``x @ lp[name]`` with transparent dequant-in-matmul: when the layer
     dict carries a sibling ``name + "_scale"`` leaf (quantize_decode_weights)
     the int8 weight is cast into the activation dtype and the per-output-
     channel scale is applied to the product.  A pytree-STRUCTURE branch, so
-    each program specializes at trace time (same idiom as ``_lm_logits``)."""
+    each program specializes at trace time (same idiom as ``_lm_logits``).
+
+    ``tp_overlap`` (static, int >= 2) splits the matmul into that many
+    segments along the OUTPUT-feature axis.  Applied to the row-parallel
+    weights (wo/down, input axis sharded under TP), each segment carries
+    its own partial product — GSPMD then materializes one psum per
+    segment instead of one bulk reduction, so segment ``i``'s collective
+    can overlap segment ``i+1``'s matmul (Wang et al.-style decomposition
+    at the sharding layer, no manual collective code).  Every output
+    element is the SAME dot product over the same K order, so the
+    segmented result is byte-identical to the unsegmented one — the TP
+    parity cell pins that.  Segmentation is skipped when the output width
+    does not divide evenly (never silently wrong, just unsegmented)."""
     w = lp[name]
     s = lp.get(name + "_scale")
+    if tp_overlap is not None and int(tp_overlap) >= 2:
+        n = int(tp_overlap)
+        width = w.shape[1]
+        if width % n == 0:
+            seg = width // n
+            parts = []
+            for i in range(n):
+                wi = jax.lax.slice_in_dim(w, i * seg, (i + 1) * seg, axis=1)
+                if s is None:
+                    parts.append(x @ wi)
+                else:
+                    si = jax.lax.slice_in_dim(s, i * seg, (i + 1) * seg,
+                                              axis=0)
+                    parts.append((x @ wi.astype(x.dtype))
+                                 * si.astype(x.dtype))
+            return jnp.concatenate(parts, axis=-1)
     if s is None:
         return x @ w
     return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
@@ -178,13 +206,16 @@ def _rope_at(q, k, cos_t, sin_t, positions):
 
 
 def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
-                chunk_size=None, block_tables=None, attn_impl=None):
+                chunk_size=None, block_tables=None, attn_impl=None,
+                tp_overlap=None):
     """One decoder layer over T new tokens with the static cache.
     h [B, T, hidden] -> (h', k_cache', v_cache').  ``chunk_size`` (static)
     selects the length-adaptive chunked cache read in decode_attention;
     ``block_tables [B, W]`` (traced) switches the caches to the paged
     pool geometry; ``attn_impl`` (static) selects the fused Pallas cache
-    read (ops/paged_attention_pallas.py) vs the reference chunked loop."""
+    read (ops/paged_attention_pallas.py) vs the reference chunked loop;
+    ``tp_overlap`` (static) segments the row-parallel wo/down matmuls so
+    their TP psums can overlap compute (byte-identical math)."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
@@ -196,10 +227,10 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
     out, k_cache, v_cache, _ = decode_attention(
         q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size,
         block_table=block_tables, attn_impl=attn_impl)
-    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo")
+    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo", tp_overlap=tp_overlap)
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + _mm(jax.nn.silu(_mm(x2, lp, "gate")) * _mm(x2, lp, "up"),
-                lp, "down")
+                lp, "down", tp_overlap=tp_overlap)
     return h, k_cache, v_cache
 
 
@@ -213,7 +244,8 @@ def _lm_logits(params, h):
 
 
 def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
-             chunk_size=None, block_tables=None, attn_impl=None):
+             chunk_size=None, block_tables=None, attn_impl=None,
+             tp_overlap=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
@@ -230,7 +262,8 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
         h, kc, vc = _layer_step(lp, cfg, h, kc, vc, lengths, cos_t, sin_t,
                                 chunk_size=chunk_size,
                                 block_tables=block_tables,
-                                attn_impl=attn_impl)
+                                attn_impl=attn_impl,
+                                tp_overlap=tp_overlap)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
     if last_idx is not None:
@@ -242,21 +275,21 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
 
 
 def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None,
-                  block_tables=None, attn_impl=None):
+                  block_tables=None, attn_impl=None, tp_overlap=None):
     """tokens [B, T] -> (logits_last [B, V], caches', lengths + T)."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=True,
                     chunk_size=chunk_size, block_tables=block_tables,
-                    attn_impl=attn_impl)
+                    attn_impl=attn_impl, tp_overlap=tp_overlap)
 
 
 def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None,
-                      block_tables=None, attn_impl=None):
+                      block_tables=None, attn_impl=None, tp_overlap=None):
     """Logits for EVERY input position [B, T, V] — the verification pass
     of speculative decoding needs the target's next-token distribution
     after each drafted token."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=False,
                     chunk_size=chunk_size, block_tables=block_tables,
-                    attn_impl=attn_impl)
+                    attn_impl=attn_impl, tp_overlap=tp_overlap)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -502,26 +535,32 @@ _spec_ngram_jit = _mon.wrap("spec_ngram_decode", _spec_ngram_jit)
 # masked_lengths): a dead slot's offset is lmax, so its cache writes drop and
 # its state survives the step untouched.
 #
-# ``kv_dtype`` (static on all four entry points) names the cache storage
-# dtype — "int8" selects the quantized (data, scale) cache.  Only the
-# prefill-slot program consumes it (mini-cache allocation); on the others
-# the cache PYTREE STRUCTURE already carries it, and the static arg exists
-# so the program identity states its quantization mode explicitly — one
-# extra program variant per engine, zero retraces past warmup.
-#
-# ``attn_impl`` (static, same four entry points) selects the cache-read
-# implementation — "pallas" routes decode_attention through the fused
-# kernel (ops/paged_attention_pallas.py), None/"reference" keeps the
-# bitwise chunked loop.  ``weight_dtype`` is the kv_dtype of the WEIGHT
-# axis: the params pytree structure already carries the quantization
-# (sibling "<name>_scale" leaves, quantize_decode_weights), so the static
-# arg is identity-only — the program key states its weight mode explicitly
-# instead of relying on treedef hashing alone.
+# ``program_key`` (static on all four entry points) is the ONE static
+# knob object: a frozen serving/program_key.py ``ProgramKey`` carrying
+# every registry axis — attn_impl (the fused decode cache read),
+# prefill_impl (the fused prefill attention + append), kv_dtype (cache
+# storage; only the prefill-slot program consumes the value, for its
+# mini-cache allocation — elsewhere the cache pytree structure already
+# carries it and the axis is program identity), weight_dtype (identity-
+# only: the params pytree's sibling "_scale" leaves carry the actual
+# quantization) and tp_overlap (row-parallel psum segmentation).  The
+# impls read the axes by attribute (duck-typed, so this module never
+# imports the serving package); validation lives in ProgramKey itself.
+# Adding a static knob = adding one registry axis — never editing these
+# static_argnames lists again (tpu-lint PTL014 polices the consumers).
+
+def _pk_axis(program_key, name):
+    """Read one registry axis off a ``program_key`` static (duck-typed:
+    ``None`` means every axis at its default, and this module stays free
+    of a serving-package import — serving/program_key.py documents the
+    axes; ProgramKey validates them at construction)."""
+    return getattr(program_key, name, None) if program_key is not None \
+        else None
+
 
 def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
                                hist=None, hist_len=None, with_hist=False,
-                               chunk_size=None, kv_dtype=None,
-                               attn_impl=None, weight_dtype=None):
+                               chunk_size=None, program_key=None):
     """Admit ONE request: prefill its prompt, insert into the batch cache.
 
     ``tokens [1, Tpad]`` is the right-padded prompt (Tpad = the engine's
@@ -541,19 +580,21 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
     retrace-free) and the updated caches; with ``with_hist`` the slot's
     prompt-lookup history row is rebuilt in the same program.
 
-    ``kv_dtype`` (static) selects the cache storage dtype — "int8" makes
-    the mini caches quantized ``(data, scale)`` pairs matching the batch
-    cache's structure, so insertion moves both leaves."""
+    ``program_key.kv_dtype`` selects the cache storage dtype — "int8"
+    makes the mini caches quantized ``(data, scale)`` pairs matching the
+    batch cache's structure, so insertion moves both leaves."""
     _mon.mark_trace("serving_prefill_slot")
     t = tokens.shape[1]
     nh, nkv, hd, eps = cfg
+    kv_dtype = _pk_axis(program_key, "kv_dtype")
     dtype = kv_dtype if kv_dtype is not None else params["embed"].dtype
     mini = [init_kv_cache(1, t, nkv, hd, dtype)
             for _ in params["layers"]]
     logits, mini, _ = _forward(
         params, cfg, tokens, mini, jnp.zeros((1,), jnp.int32),
         last_only=True, last_idx=jnp.clip(prompt_len - 1, 0, t - 1),
-        chunk_size=chunk_size, attn_impl=attn_impl)
+        chunk_size=chunk_size, attn_impl=_pk_axis(program_key, "attn_impl"),
+        tp_overlap=_pk_axis(program_key, "tp_overlap"))
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [1]
     ok = jnp.all(jnp.isfinite(logits), axis=-1)                 # [1]
     slot = slot.astype(jnp.int32)
@@ -587,18 +628,19 @@ def _serving_prefill_slot_impl(params, cfg, tokens, prompt_len, caches, slot,
 # shardings — one body, one ``mark_trace`` name, two placement strategies.
 serving_prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
     _serving_prefill_slot_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype",
-                     "attn_impl", "weight_dtype"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "program_key"),
     donate_argnames=("caches", "hist")))
 
 
 def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
                          cos_t, sin_t, chunk_size=None, block_tables=None,
-                         attn_impl=None):
+                         attn_impl=None, prefill_impl=None, tp_overlap=None):
     """One decoder layer over a [1, P] prompt chunk, writing/reading the
     SLOT'S rows of the shared batch cache (ops.slot_prefill_attention) —
     the chunked-prefill twin of ``_layer_step``, which operates on whole
-    per-batch caches at per-batch offsets."""
+    per-batch caches at per-batch offsets.  ``prefill_impl`` (static)
+    selects the fused attention + quantize-on-append Pallas kernel
+    (ops/prefill_attention_pallas.py) vs the reference scatter + read."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
@@ -609,19 +651,19 @@ def _layer_prefill_chunk(lp, cfg, h, k_cache, v_cache, slot, offset,
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache = slot_prefill_attention(
         q, k, v, k_cache, v_cache, slot, offset, chunk_size=chunk_size,
-        block_table=block_tables, attn_impl=attn_impl)
-    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo")
+        block_table=block_tables, attn_impl=attn_impl,
+        prefill_impl=prefill_impl)
+    h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo", tp_overlap=tp_overlap)
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + _mm(jax.nn.silu(_mm(x2, lp, "gate")) * _mm(x2, lp, "up"),
-                lp, "down")
+                lp, "down", tp_overlap=tp_overlap)
     return h, k_cache, v_cache
 
 
 def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
                                 caches, slot, hist=None, hist_len=None,
                                 with_hist=False, chunk_size=None,
-                                block_tables=None, kv_dtype=None,
-                                attn_impl=None, weight_dtype=None):
+                                block_tables=None, program_key=None):
     """Process the next ``[1, P]`` chunk of an admitted prompt against the
     slot's rows of the batch cache — ONE compiled program for every prompt
     length (``P`` is the only shape; ``offset``, ``prompt_len`` and
@@ -665,10 +707,12 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
     cos_t, sin_t = params["_rope"]
     new_caches = []
     for lp, (kc, vc) in zip(params["layers"], caches):
-        h, kc, vc = _layer_prefill_chunk(lp, cfg, h, kc, vc, slot, offset,
-                                         cos_t, sin_t, chunk_size=chunk_size,
-                                         block_tables=block_tables,
-                                         attn_impl=attn_impl)
+        h, kc, vc = _layer_prefill_chunk(
+            lp, cfg, h, kc, vc, slot, offset, cos_t, sin_t,
+            chunk_size=chunk_size, block_tables=block_tables,
+            attn_impl=_pk_axis(program_key, "attn_impl"),
+            prefill_impl=_pk_axis(program_key, "prefill_impl"),
+            tp_overlap=_pk_axis(program_key, "tp_overlap"))
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], eps)
     last_rel = jnp.clip(prompt_len - 1 - offset, 0, t - 1)  # [1]
@@ -696,15 +740,13 @@ def _serving_prefill_chunk_impl(params, cfg, tokens, offset, prompt_len,
 
 serving_prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
     _serving_prefill_chunk_impl,
-    static_argnames=("cfg", "with_hist", "chunk_size", "kv_dtype",
-                     "attn_impl", "weight_dtype"),
+    static_argnames=("cfg", "with_hist", "chunk_size", "program_key"),
     donate_argnames=("caches", "hist")))
 
 
 def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
                                n_steps=1, chunk_size=None,
-                               block_tables=None, kv_dtype=None,
-                               attn_impl=None, weight_dtype=None):
+                               block_tables=None, program_key=None):
     """``n_steps`` greedy tokens for every slot in ONE compiled program
     (an inner lax.scan amortizes the host dispatch; the scheduler trades
     admission latency against dispatch overhead via ``sync_every``).
@@ -724,7 +766,8 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
         logits, caches, lengths = _forward_step(
             params, cfg, tok[:, None], caches, lengths,
             chunk_size=chunk_size, block_tables=block_tables,
-            attn_impl=attn_impl)
+            attn_impl=_pk_axis(program_key, "attn_impl"),
+            tp_overlap=_pk_axis(program_key, "tp_overlap"))
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
         return (nxt, ok, caches, lengths), nxt
@@ -738,15 +781,13 @@ def _serving_decode_steps_impl(params, cfg, cur, caches, dev_lengths,
 
 serving_decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
     _serving_decode_steps_impl,
-    static_argnames=("cfg", "n_steps", "chunk_size", "kv_dtype",
-                     "attn_impl", "weight_dtype"),
+    static_argnames=("cfg", "n_steps", "chunk_size", "program_key"),
     donate_argnames=("caches",)))
 
 
 def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
                             hist_len, active, spec_k=4, chunk_size=None,
-                            block_tables=None, kv_dtype=None,
-                            attn_impl=None, weight_dtype=None):
+                            block_tables=None, program_key=None):
     """One prompt-lookup speculative round per slot: draft ``spec_k``
     tokens from the history, verify in one target forward, accept the
     longest matched prefix — the SAME _ngram_draft/_verify_and_emit
@@ -772,7 +813,9 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
     toks = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, k+1]
     logits, caches, _ = _forward_step_all(
         params, cfg, toks, caches, dev_lengths, chunk_size=chunk_size,
-        block_tables=block_tables, attn_impl=attn_impl)
+        block_tables=block_tables,
+        attn_impl=_pk_axis(program_key, "attn_impl"),
+        tp_overlap=_pk_axis(program_key, "tp_overlap"))
     ok = jnp.all(jnp.isfinite(logits), axis=(-2, -1))        # [B]
     # per-step emission buffer: offsets 0, bound k+1 -> _verify_and_emit's
     # out IS the accepted-prefix block for this round
@@ -793,8 +836,7 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
 
 serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(
     _serving_spec_step_impl,
-    static_argnames=("cfg", "spec_k", "chunk_size", "kv_dtype",
-                     "attn_impl", "weight_dtype")))
+    static_argnames=("cfg", "spec_k", "chunk_size", "program_key")))
 
 
 def _decode_params_of(model, lmax):

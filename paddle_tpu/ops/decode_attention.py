@@ -469,9 +469,9 @@ def _attend_dispatch(qg, k_cache, v_cache, lengths, q_pos, scale, layout,
             "fallback on unsupported geometry)")
     if attn_impl == "pallas":
         from paddle_tpu.ops.paged_attention_pallas import (
-            fused_decode_attention, fused_supported, warn_fallback,
+            fused_decode_attention, fused_decode_supported, warn_fallback,
         )
-        reason = fused_supported(layout, attn_bias, chunk_size, lmax)
+        reason = fused_decode_supported(layout, attn_bias, chunk_size, lmax)
         if reason is None:
             return fused_decode_attention(
                 qg, k_cache, v_cache, lengths, scale, int(chunk_size),
@@ -565,9 +565,45 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, lengths, scale=None,
     return out, k_cache, v_cache, lengths + t
 
 
+def _prefill_dispatch(q, k_new, v_new, k_cache, v_cache, slot, offset,
+                      scale, chunk_size, lmax, block_table, prefill_impl,
+                      where):
+    """Select the prefill implementation for one admission chunk.
+
+    ``prefill_impl`` (static): ``None`` / ``"reference"`` keep the
+    existing scatter + chunked-read path BITWISE unchanged (return
+    ``None`` so the caller runs it); ``"pallas"`` selects the fused
+    attention + quantize-on-append kernel
+    (ops/prefill_attention_pallas.py) when the geometry supports it and
+    falls back with a once-per-process (call-site, reason) log when it
+    does not — a prefill downgrade is keyed separately from any decode
+    downgrade, so neither silences the other."""
+    if prefill_impl not in (None, "reference", "pallas"):
+        raise ValueError(
+            f"{where}: unknown prefill_impl {prefill_impl!r} — supported: "
+            "'reference' (scatter + chunked read, the default), 'pallas' "
+            "(the fused prefill-attention + KV-append kernel, reference "
+            "fallback on unsupported geometry)")
+    if prefill_impl != "pallas":
+        return None
+    from paddle_tpu.ops.prefill_attention_pallas import (
+        fused_prefill_attention, fused_prefill_supported,
+    )
+    from paddle_tpu.ops.paged_attention_pallas import warn_fallback
+    t = q.shape[1]
+    reason = fused_prefill_supported(chunk_size, lmax,
+                                     t, block_table is not None)
+    if reason is None:
+        return fused_prefill_attention(
+            q, k_new, v_new, k_cache, v_cache, slot, offset, scale,
+            int(chunk_size), block_table=block_table)
+    warn_fallback(where, f"prefill: {reason}", knob="prefill_impl")
+    return None
+
+
 def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
                            scale=None, chunk_size=None, block_table=None,
-                           attn_impl=None):
+                           attn_impl=None, prefill_impl=None):
     """Chunked-prefill attention for ONE slot of the batch cache.
 
     The serving engine's chunked admission path processes a prompt in
@@ -598,6 +634,14 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
     ``slot``), so no dense per-slot view is materialized.  Requires
     ``chunk_size == C``, like ``decode_attention``.
 
+    ``prefill_impl`` (static): ``None``/``"reference"`` keep the
+    scatter + chunked-read path bitwise unchanged; ``"pallas"`` fuses
+    the chunk's attention WITH its quantize-on-append into one Pallas
+    kernel (ops/prefill_attention_pallas.py) when the geometry supports
+    it, reference fallback (logged once per process per reason)
+    otherwise.  ``attn_impl`` keeps selecting the cache-READ kernel on
+    the reference path.
+
     q [1, P, H, D]; k_new/v_new [1, P, Hkv, D]; caches [B, Lmax, Hkv, D].
     Returns (out [1, P, H, D], k_cache', v_cache').
     """
@@ -622,12 +666,21 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         blk = _kv_data(k_cache).shape[1]
         if chunk_size is None or int(chunk_size) != blk:
             raise ValueError(
-                f"slot_prefill_attention: paged caches require chunk_size "
-                f"== pool block size ({blk}), got {chunk_size}")
+                f"slot_prefill_attention: paged caches require "
+                f"chunk_size == kv_block (the pool block size): got "
+                f"chunk_size={chunk_size!r} with kv_block={blk} — the "
+                "chunked loop IS the paged read, so the read chunk and "
+                "the pool block must coincide")
         w = block_table.shape[1]
         # the slot's [1, W] table row (slot < B: no clamping)
         trow = jax.lax.dynamic_slice(
             block_table.astype(jnp.int32), (slot, jnp.int32(0)), (1, w))
+        fused = _prefill_dispatch(
+            q, k_new, v_new, k_cache, v_cache, slot, offset, scale,
+            int(chunk_size), w * blk, trow, prefill_impl,
+            "slot_prefill_attention")
+        if fused is not None:
+            return fused
         k_cache = _append(k_cache, k_new, offset[None], "blhd", trow)
         v_cache = _append(v_cache, v_new, offset[None], "blhd", trow)
         qg = q.reshape(1, t, hkv, g, d).transpose(0, 2, 3, 1, 4) \
@@ -640,6 +693,12 @@ def slot_prefill_attention(q, k_new, v_new, k_cache, v_cache, slot, offset,
         out = out.transpose(0, 3, 1, 2, 4).reshape(1, t, h, d) \
             .astype(q.dtype)
         return out, k_cache, v_cache
+
+    fused = _prefill_dispatch(
+        q, k_new, v_new, k_cache, v_cache, slot, offset, scale,
+        chunk_size, lmax, None, prefill_impl, "slot_prefill_attention")
+    if fused is not None:
+        return fused
 
     # scatter the chunk's rows into the slot (drop past capacity); int8
     # caches quantize the chunk here and scatter data + scales at the

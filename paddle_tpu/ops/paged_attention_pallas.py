@@ -42,9 +42,10 @@ head), vLLM-PagedAttention-style:
   — interpret mode silently ships a ~100x slower kernel).
 
 Geometry the kernel does NOT cover falls back to the bitwise reference
-path: ``fused_supported`` returns the reason and ``warn_fallback`` logs
-it once per process (a silent fallback would ship while_loop speed under
-an ``attn_impl="pallas"`` flag).
+path: ``fused_decode_supported`` returns the reason and ``warn_fallback``
+logs it once per process per (call-site, reason) — a silent fallback
+would ship while_loop speed under an ``attn_impl="pallas"`` flag, and a
+shared key would let a decode downgrade silence a later prefill one.
 """
 from __future__ import annotations
 
@@ -55,7 +56,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["fused_decode_attention", "fused_supported", "warn_fallback"]
+__all__ = ["fused_decode_attention", "fused_decode_supported",
+           "fused_supported", "warn_fallback"]
 
 _NEG_INF = -1e30
 
@@ -67,9 +69,14 @@ _LOG = logging.getLogger(__name__)
 _warned = set()
 
 
-def fused_supported(layout, attn_bias, chunk_size, lmax):
-    """Geometry gate for the fused kernel: ``None`` when supported, else
-    a human-readable reason string (the fallback log line).
+def fused_decode_supported(layout, attn_bias, chunk_size, lmax):
+    """Geometry gate for the fused DECODE kernel: ``None`` when
+    supported, else a human-readable reason string (the fallback log
+    line).  The prefill kernel has its own gate —
+    ops/prefill_attention_pallas.py ``fused_prefill_supported`` — with
+    prefill-specific reasons, so a decode downgrade and a prefill
+    downgrade are distinct ``warn_fallback`` keys and neither silences
+    the other.
 
     The kernel covers the serving hot path — ``blhd`` caches (dense or
     paged), no additive bias, a chunked read whose chunk divides the
@@ -89,15 +96,23 @@ def fused_supported(layout, attn_bias, chunk_size, lmax):
     return None
 
 
-def warn_fallback(where, reason):
-    """Log the fused->reference downgrade once per process per reason."""
+#: Back-compat alias (pre-split name); the decode gate is the one this
+#: module owns.
+fused_supported = fused_decode_supported
+
+
+def warn_fallback(where, reason, knob="attn_impl"):
+    """Log the fused->reference downgrade once per process per
+    (call-site, reason) key: a prefill fallback at one call site is
+    never silenced by an earlier decode fallback at another."""
     key = (where, reason)
     if key not in _warned:
         _warned.add(key)
         _LOG.warning(
-            "%s: attn_impl='pallas' requested but unsupported — %s; "
-            "falling back to the reference chunked read (bitwise the "
-            "attn_impl=None path, logged once per process)", where, reason)
+            "%s: %s='pallas' requested but unsupported — %s; "
+            "falling back to the reference path (bitwise the "
+            "%s=None path, logged once per process)",
+            where, knob, reason, knob)
 
 
 def _fused_kernel(*refs, chunk, lmax, t, group, scale, quant, paged):

@@ -392,6 +392,7 @@ class ServingEngine:
                  tp_axis="mp", max_pending=None, retry_attempts=3,
                  retry_backoff=0.05, faults=None, recorder=True,
                  slo=None, attn_impl=None, weight_dtype=None,
+                 prefill_impl=None, tp_overlap=None,
                  prefill_only=False, on_prefilled=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -526,6 +527,32 @@ class ServingEngine:
                 "is unsupported)")
         self._attn_impl = attn_impl
         self._attn_label = "fused" if attn_impl == "pallas" else "reference"
+        # prefill_impl: chunked-prefill implementation.  None/"reference"
+        # keeps the dense fold + scatter append; "pallas" fuses the
+        # causal-masked chunk attention WITH the (quantize-on-)append into
+        # one kernel (ops/prefill_attention_pallas.py), falling back
+        # per-call when the chunk geometry is unsupported.
+        if prefill_impl not in (None, "reference", "pallas"):
+            raise ValueError(
+                f"ServingEngine: unknown prefill_impl {prefill_impl!r} — "
+                "supported: None (reference), 'reference', 'pallas' "
+                "(fused prefill+append kernel, falls back per-call when "
+                "the chunk geometry is unsupported)")
+        self._prefill_impl = prefill_impl
+        self._prefill_label = ("fused" if prefill_impl == "pallas"
+                               else "reference")
+        # tp_overlap: split the row-parallel projections (wo/down) into N
+        # output-feature segments so each segment's psum can overlap the
+        # next segment's matmul.  None/0 keeps the single fused matmul;
+        # int >= 2 is the segment count (byte-identical outputs — the
+        # per-element dot products are unchanged, only issue order moves).
+        if tp_overlap is not None:
+            if isinstance(tp_overlap, bool) or not isinstance(
+                    tp_overlap, int) or tp_overlap < 2:
+                raise ValueError(
+                    f"ServingEngine: tp_overlap must be None or an int "
+                    f">= 2 (segment count), got {tp_overlap!r}")
+        self._tp_overlap = tp_overlap
         # weight_dtype: decode matmul WEIGHT storage.  "int8" swaps the
         # seven projection weights for symmetric per-output-channel
         # quantized copies with f16 scales (quantize_decode_weights) —
@@ -540,6 +567,16 @@ class ServingEngine:
             # any mesh placement so the int8 leaves shard directly
             self._params = quantize_decode_weights(
                 self._params, self._weight_dtype)
+        # the declarative program identity: every static kernel/precision
+        # knob flows through this ONE frozen registry value — the four
+        # serving impls, the TP program cache and the jit static axes all
+        # consume it instead of hand-threaded per-impl keyword lists
+        # (serving/program_key.py re-validates each axis on construction)
+        from paddle_tpu.serving.program_key import ProgramKey
+        self._pk = ProgramKey(
+            attn_impl=self._attn_impl, prefill_impl=self._prefill_impl,
+            kv_dtype=self._kv_dtype, weight_dtype=self._weight_dtype,
+            tp_overlap=self._tp_overlap)
         dtype = (self._kv_dtype if self._kv_dtype is not None
                  else self._params["embed"].dtype)
         # mesh=None: single-device engine, module-level jitted programs,
@@ -567,8 +604,7 @@ class ServingEngine:
                 len(self._params["layers"]), sync_every=self._sync,
                 spec_k=self._spec_k, with_hist=mode == "spec",
                 chunk_size=self._chunk, paged=self._paged,
-                kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
-                weight_dtype=self._weight_dtype)
+                program_key=self._pk)
             cache_sharding = self._tp.cache_sharding
             scale_sharding = self._tp.scale_sharding
         if self._paged:
@@ -587,6 +623,8 @@ class ServingEngine:
         if self._m is not None:
             self._m.set_kv_quant(self._kvq)
             self._m.set_decode_kernel(self._attn_label)
+            self._m.set_prefill_kernel(self._prefill_label)
+            self._m.set_tp_overlap(self._tp_overlap or 0)
             self._m.set_weight_quant(self._wq_label)
             if self._q8:
                 # analytic per-context-token KV traffic at int8: 1 data
@@ -1109,8 +1147,7 @@ class ServingEngine:
             self._params, self._cfg, cur, self._kv.caches, dev_len,
             n_steps=self._sync, chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
-            weight_dtype=self._weight_dtype)
+            program_key=self._pk)
 
     def _call_spec(self, cur, dev_len, active):
         if self._tp is not None:
@@ -1127,8 +1164,7 @@ class ServingEngine:
             self._hist, self._hist_len, active, spec_k=self._spec_k,
             chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
-            weight_dtype=self._weight_dtype)
+            program_key=self._pk)
 
     def _call_prefill_slot(self, tokens, prompt_len, slot):
         if self._tp is not None:
@@ -1139,8 +1175,7 @@ class ServingEngine:
             self._params, self._cfg, tokens, prompt_len, self._kv.caches,
             slot, hist=self._hist, hist_len=self._hist_len,
             with_hist=self._mode == "spec", chunk_size=self._chunk,
-            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
-            weight_dtype=self._weight_dtype)
+            program_key=self._pk)
 
     def _call_prefill_chunk(self, tokens, offset, prompt_len, slot):
         if self._tp is not None:
@@ -1159,8 +1194,7 @@ class ServingEngine:
             hist_len=self._hist_len, with_hist=self._mode == "spec",
             chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            kv_dtype=self._kv_dtype, attn_impl=self._attn_impl,
-            weight_dtype=self._weight_dtype)
+            program_key=self._pk)
 
     def _admit(self):
         free = self._kv.free_slots()
@@ -1707,6 +1741,7 @@ class ServingEngine:
                             mode=self._mode, n_live=len(live),
                             kv_quant=self._kvq,
                             attn_impl=self._attn_label,
+                            prefill_impl=self._prefill_label,
                             weight_dtype=self._wq_label)
         if self._mode == "greedy":
             def go(attempt):
@@ -1779,6 +1814,7 @@ class ServingEngine:
                             mode=self._mode, n_live=len(live),
                             pipelined=True, kv_quant=self._kvq,
                             attn_impl=self._attn_label,
+                            prefill_impl=self._prefill_label,
                             weight_dtype=self._wq_label)
         active = np.array([self._decodable(i) for i in range(self._B)])
         host_len = self._kv.device_lengths(active)

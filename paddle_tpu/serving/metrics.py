@@ -230,6 +230,24 @@ class EngineMetrics:
             ("policy", "impl"))
         for impl in ("reference", "fused"):
             self._decode_kernel.labels(policy=policy, impl=impl).set(0)
+        # prefill-kernel selection (prefill_impl=) mirrors the decode
+        # info gauge, and tp_overlap is a plain valued gauge — the
+        # segment count itself (0 = single fused matmul, no overlap)
+        self._prefill_kernel = reg.gauge(
+            "serving_prefill_kernel",
+            "chunked-prefill implementation info gauge: 'fused' (the "
+            "Pallas prefill+append kernel) or 'reference' (the dense "
+            "fold + scatter append); the active child reads 1",
+            ("policy", "impl"))
+        for impl in ("reference", "fused"):
+            self._prefill_kernel.labels(policy=policy, impl=impl).set(0)
+        self._tp_overlap_mode = reg.gauge(
+            "serving_tp_overlap_mode",
+            "row-parallel TP overlap segment count: 0 when the "
+            "per-layer psum runs as one fused reduction, N>=2 when the "
+            "wo/down matmuls are split into N output-feature segments "
+            "so each segment's collective overlaps the next matmul",
+            L).labels(**lbl)
         self._weight_quant_mode = reg.gauge(
             "serving_weight_quant_mode",
             "decode matmul weight quantization mode info gauge: the "
@@ -269,6 +287,17 @@ class EngineMetrics:
         for i in ("reference", "fused"):
             self._decode_kernel.labels(policy=self._policy, impl=i).set(
                 1 if i == impl else 0)
+
+    def set_prefill_kernel(self, impl):
+        """Point the prefill-kernel info gauge at ``impl`` ('reference'
+        or 'fused') — the engine calls it once at construction."""
+        for i in ("reference", "fused"):
+            self._prefill_kernel.labels(policy=self._policy, impl=i).set(
+                1 if i == impl else 0)
+
+    def set_tp_overlap(self, segments):
+        """Record the TP-overlap segment count (0 = overlap off)."""
+        self._tp_overlap_mode.set(int(segments))
 
     def set_weight_quant(self, mode):
         """Point the weight-quant info gauge at ``mode`` ('off' or

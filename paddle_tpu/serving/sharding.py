@@ -210,13 +210,13 @@ class TPPrograms:
 
     def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
                  sync_every, spec_k, with_hist, chunk_size, paged=False,
-                 kv_dtype=None, attn_impl=None, weight_dtype=None):
+                 program_key=None):
         repl = NamedSharding(mesh, PS())
         pshard = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, PS))
         dsh = NamedSharding(mesh, kv_cache_pspec(axis))
-        quant = kv_dtype == "int8"
+        quant = getattr(program_key, "kv_dtype", None) == "int8"
         ssh = NamedSharding(mesh, kv_scale_pspec(axis)) if quant else None
         # int8 caches are nested (data, scale) leaves: the sharding pytree
         # mirrors that structure, scales head-sharded on their own (3-axis)
@@ -240,8 +240,7 @@ class TPPrograms:
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
                     n_steps=sync_every, chunk_size=chunk_size,
-                    block_tables=tables, kv_dtype=kv_dtype,
-                    attn_impl=attn_impl, weight_dtype=weight_dtype)
+                    block_tables=tables, program_key=program_key)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl, repl),
@@ -253,8 +252,7 @@ class TPPrograms:
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
                     active, spec_k=spec_k, chunk_size=chunk_size,
-                    block_tables=tables, kv_dtype=kv_dtype,
-                    attn_impl=attn_impl, weight_dtype=weight_dtype)
+                    block_tables=tables, program_key=program_key)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -268,7 +266,7 @@ class TPPrograms:
                     params, cfg, tokens, offset, prompt_len, caches, slot,
                     hist=hist, hist_len=hist_len, with_hist=with_hist,
                     chunk_size=chunk_size, block_tables=tables,
-                    kv_dtype=kv_dtype)
+                    program_key=program_key)
             self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
                 pchunk,
                 in_shardings=(pshard, repl, repl, repl, cshard, repl,
@@ -280,8 +278,7 @@ class TPPrograms:
                 return _serving_decode_steps_impl(
                     params, cfg, cur, caches, dev_lengths,
                     n_steps=sync_every, chunk_size=chunk_size,
-                    kv_dtype=kv_dtype, attn_impl=attn_impl,
-                    weight_dtype=weight_dtype)
+                    program_key=program_key)
             self.decode_steps = _mon.wrap("serving_decode_steps", jax.jit(
                 decode,
                 in_shardings=(pshard, repl, cshard, repl),
@@ -293,8 +290,7 @@ class TPPrograms:
                 return _serving_spec_step_impl(
                     params, cfg, cur, caches, dev_lengths, hist, hist_len,
                     active, spec_k=spec_k, chunk_size=chunk_size,
-                    kv_dtype=kv_dtype, attn_impl=attn_impl,
-                    weight_dtype=weight_dtype)
+                    program_key=program_key)
             self.spec_step = _mon.wrap("serving_spec_step", jax.jit(
                 spec,
                 in_shardings=(pshard, repl, cshard, repl, repl, repl,
@@ -307,8 +303,7 @@ class TPPrograms:
                 return _serving_prefill_chunk_impl(
                     params, cfg, tokens, offset, prompt_len, caches, slot,
                     hist=hist, hist_len=hist_len, with_hist=with_hist,
-                    chunk_size=chunk_size, kv_dtype=kv_dtype,
-                    attn_impl=attn_impl, weight_dtype=weight_dtype)
+                    chunk_size=chunk_size, program_key=program_key)
             self.prefill_chunk = _mon.wrap("serving_prefill_chunk", jax.jit(
                 pchunk,
                 in_shardings=(pshard, repl, repl, repl, cshard, repl,
@@ -320,8 +315,7 @@ class TPPrograms:
             return _serving_prefill_slot_impl(
                 params, cfg, tokens, prompt_len, caches, slot,
                 hist=hist, hist_len=hist_len, with_hist=with_hist,
-                chunk_size=chunk_size, kv_dtype=kv_dtype,
-                attn_impl=attn_impl, weight_dtype=weight_dtype)
+                chunk_size=chunk_size, program_key=program_key)
         self.prefill_slot = _mon.wrap("serving_prefill_slot", jax.jit(
             pslot,
             in_shardings=(pshard, repl, repl, cshard, repl, hshard, repl),
@@ -337,19 +331,23 @@ _PROGRAMS = {}
 
 def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
                         sync_every, spec_k, with_hist, chunk_size,
-                        paged=False, kv_dtype=None, attn_impl=None,
-                        weight_dtype=None):
-    """Cached ``TPPrograms`` factory (see class docstring)."""
+                        paged=False, program_key=None):
+    """Cached ``TPPrograms`` factory (see class docstring).
+
+    ``program_key`` is the frozen :class:`~paddle_tpu.serving.program_key.
+    ProgramKey` of static kernel/precision axes — one hashable value in
+    the cache key covers every registry axis (attn_impl, prefill_impl,
+    kv_dtype, weight_dtype, tp_overlap), so two engines differing in any
+    axis compile separate program families while identical engines share.
+    """
     leaves, treedef = jax.tree_util.tree_flatten(
         param_specs, is_leaf=lambda x: isinstance(x, PS))
     key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
-           sync_every, spec_k, with_hist, chunk_size, paged, kv_dtype,
-           attn_impl, weight_dtype)
+           sync_every, spec_k, with_hist, chunk_size, paged, program_key)
     progs = _PROGRAMS.get(key)
     if progs is None:
         progs = _PROGRAMS[key] = TPPrograms(
             mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
             spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size,
-            paged=paged, kv_dtype=kv_dtype, attn_impl=attn_impl,
-            weight_dtype=weight_dtype)
+            paged=paged, program_key=program_key)
     return progs

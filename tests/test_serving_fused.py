@@ -230,7 +230,7 @@ class TestFallbackSelection:
         for a, b_ in zip(ref, got):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
         msgs = [r.getMessage() for r in caplog.records
-                if "falling back to the reference chunked read" in
+                if "falling back to the reference path" in
                 r.getMessage()]
         assert len(msgs) == 1
         assert "chunk_size=None" in msgs[0]

@@ -1269,6 +1269,113 @@ class TestPTL014:
         assert [f for f in lint_project_sources(files)
                 if f.rule == "PTL014"] == []
 
+    # -- static-axis registry: PROGRAM_AXES is the single source of truth
+
+    REGISTRY = textwrap.dedent("""
+        PROGRAM_AXES = (
+            StaticAxis("attn_impl", None, "which attention kernel"),
+            StaticAxis(name="kv_dtype", default=None, doc="KV storage"),
+            StaticAxis("tp_overlap", None, "psum segmentation",
+                       kind="segments"),
+        )
+    """)
+
+    IMPLS_PK = textwrap.dedent("""
+        import jax
+
+        def _decode_impl(params, caches, cfg, n_steps, program_key):
+            return caches
+
+        serving_decode = _mon.wrap("serving_decode", jax.jit(
+            _decode_impl,
+            static_argnames=("cfg", "n_steps", "program_key"),
+            donate_argnames=("caches",)))
+    """)
+
+    def _registry_factory(self, params, key_line, call_tail):
+        return textwrap.dedent("""
+            from pkg.impls import serving_decode
+
+            _PROGRAMS = {}
+
+            def tp_programs(%s):
+                key = %s
+                hit = _PROGRAMS.get(key)
+                if hit is not None:
+                    return hit
+
+                def run(params, caches):
+                    return serving_decode(params, caches, cfg,
+                                          %s)
+                _PROGRAMS[key] = run
+                return run
+        """) % (params, key_line, call_tail)
+
+    def test_registry_program_key_covers_every_axis(self):
+        # one `program_key` in the key tuple = the whole registry keyed
+        files = {"pkg/program_key.py": self.REGISTRY,
+                 "pkg/impls.py": self.IMPLS_PK,
+                 "pkg/factory.py": self._registry_factory(
+                     "mesh, cfg, sync_every, program_key",
+                     "(mesh, cfg, sync_every, program_key)",
+                     "n_steps=sync_every, program_key=program_key")}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+    def test_registry_subset_one_finding_per_missing_axis(self):
+        # hand-threading attn_impl alone: kv_dtype and tp_overlap can
+        # never fork the cache entry -> one finding each, naming the
+        # axis and the registry location
+        files = {"pkg/program_key.py": self.REGISTRY,
+                 "pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._registry_factory(
+                     "mesh, cfg, attn_impl",
+                     "(mesh, cfg, attn_impl)",
+                     "n_steps=4, attn_impl=attn_impl")}
+        found = sorted([f for f in lint_project_sources(files)
+                        if f.rule == "PTL014"],
+                       key=lambda f: f.message)
+        assert len(found) == 2
+        assert "`kv_dtype`" in found[0].message
+        assert "`tp_overlap`" in found[1].message
+        for f in found:
+            assert f.path == "pkg/factory.py"
+            assert "PROGRAM_AXES" in f.message
+            assert "pkg/program_key.py" in f.message
+
+    def test_registry_full_hand_threaded_set_clean(self):
+        # every registry axis present by name: complete, if inelegant
+        files = {"pkg/program_key.py": self.REGISTRY,
+                 "pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._registry_factory(
+                     "mesh, cfg, attn_impl, kv_dtype, tp_overlap",
+                     "(mesh, cfg, attn_impl, kv_dtype, tp_overlap)",
+                     "n_steps=4, attn_impl=attn_impl")}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+    def test_registry_unrelated_key_not_flagged(self):
+        # a cache keyed on NO registry axis (a different subsystem's
+        # cache) is outside the registry's jurisdiction
+        files = {"pkg/program_key.py": self.REGISTRY,
+                 "pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": self._factory(
+                     "(mesh, cfg, sync_every, attn_impl)").replace(
+                         "attn_impl", "impl_choice")}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
+    def test_registry_subset_pragma_suppresses(self):
+        factory = self._registry_factory(
+            "mesh, cfg, attn_impl",
+            "(mesh, cfg, attn_impl)  # tpu-lint: ignore[PTL014]",
+            "n_steps=4, attn_impl=attn_impl")
+        files = {"pkg/program_key.py": self.REGISTRY,
+                 "pkg/impls.py": self.IMPLS,
+                 "pkg/factory.py": factory}
+        assert [f for f in lint_project_sources(files)
+                if f.rule == "PTL014"] == []
+
 
 # ---------------------------------------------------------------------------
 # PTL015: unsynchronized shared state in lock-owning classes
