@@ -1443,12 +1443,127 @@ def bench_collectives():
         return {"allreduce_gbps": None, "allreduce_error": str(e)[:120]}
 
 
+def bench_serving_fleet(n_requests=24, batch=4):
+    """Multi-process disaggregated fleet (round 17, serving/launch.py):
+    a config-launched 2-process 1P+1D deployment over a real UDS
+    ``SocketTransport``, vs the colocated single-process engine on the
+    same workload and geometry.
+
+    What crossing a process boundary costs, measured where it is paid:
+
+    * ``serving_fleet_kv_transfer_p50_ms`` — block-chain handoff over
+      the wire (framed send -> reassembled recv), off the DECODE
+      worker's own histogram (it owns the t_begin->adopt clock);
+    * ``serving_fleet_overlap_stall_p50_ms`` — how long an arrived
+      chain waited while the decode step loop had a slot free: ~0 means
+      the background streamer really does overlap decode steps, the
+      PTL017 seam doing its job across processes;
+    * ``serving_fleet_adm_tpot_p95_ms`` — per-token inter-arrival
+      latency at the PARENT for tokens landing while any request is
+      between submit and first token.  The decode engine's own
+      ``tpot_admission`` histogram is structurally empty out here —
+      adoption is a block-table splice, never a prefill chunk, so the
+      decode loop has no admission windows at all (that IS the
+      disaggregation win); what is left to measure is whether the
+      parent-visible stream stutters during admission, wire and all;
+    * ``serving_fleet_ttft_p95_ms`` — first token rides the control
+      plane (emitted before the transfer is paid), so TTFT carries one
+      socket round-trip, not one chain transfer.
+
+    The fleet model is pinned to the ``tiny`` preset (the only spec the
+    worker process bootstraps), so cross-arm comparisons are overhead
+    ratios, not absolute throughput."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (FleetConfig, Request, ServingEngine,
+                                    launch)
+
+    if os.environ.get("BENCH_SERVING_SMALL") == "1":
+        n_requests = min(n_requests, 12)
+    geom = dict(batch_size=batch, max_len=128, decode_chunk=16,
+                prefill_chunk=16, kv_block=16,
+                max_live_tokens=batch * 128,
+                instrument=False, recorder=False)
+    rng = np.random.default_rng(29)
+    p_lens = rng.integers(24, 64, n_requests)
+    prompts = [rng.integers(1, 255, int(p)).astype(np.int32)
+               for p in p_lens]
+    olens = rng.integers(12, 25, n_requests)
+    total_new = int(olens.sum())
+
+    def colocated():
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(dtype="float32"))
+        model.eval()
+        eng = ServingEngine(model, **geom)
+        reqs = [eng.submit(Request(p, int(o)))
+                for p, o in zip(prompts, olens)]
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        eng.close()
+        return dt, reqs
+
+    dt_co, _ = colocated()
+    dt_co, _ = colocated()     # second run: programs warm
+
+    cfg = FleetConfig(engine=geom, n_prefill=1, n_decode=1,
+                      heartbeat_s=1.0, ready_timeout_s=300)
+    with launch(cfg, instrument=False) as fleet:
+        coord = fleet.coordinator
+        # warm the worker programs off the clock
+        warm = [coord.submit(Request(p, 4)) for p in prompts[:batch]]
+        coord.run(stall_timeout=300)
+        assert all(r.status == "done" for r in warm)
+
+        events = []                       # (t_arrival, n_tokens)
+
+        def cb(r, toks):
+            events.append((time.perf_counter(), len(toks)))
+
+        reqs = [coord.submit(Request(p, int(o), stream_cb=cb))
+                for p, o in zip(prompts, olens)]
+        t0 = time.perf_counter()
+        coord.run(stall_timeout=300)
+        dt_fl = time.perf_counter() - t0
+        dstats = fleet.handles["decode0"].request(
+            {"cmd": "stats"})["stats"]
+        fleet.close()
+
+    ttfts = [r.t_first - r.t_submit for r in reqs
+             if r.t_first is not None]
+    windows = [(r.t_submit, r.t_first) for r in reqs
+               if r.t_first is not None]
+    adm_samples = []
+    for (t_prev, _), (t_cur, n) in zip(events, events[1:]):
+        if n and any(w0 <= t_cur <= w1 for w0, w1 in windows):
+            adm_samples.extend([(t_cur - t_prev) / n] * n)
+    adm = (float(np.percentile(adm_samples, 95))
+           if adm_samples else None)
+    return {
+        "serving_fleet_requests": n_requests,
+        "serving_fleet_ttft_p95_ms": round(
+            float(np.percentile(ttfts, 95)) * 1e3, 1),
+        "serving_fleet_adm_tpot_p95_ms": round(adm * 1e3, 2)
+        if adm is not None else None,
+        "serving_fleet_kv_transfer_p50_ms": round(
+            dstats["kv_transfer_p50_s"] * 1e3, 2)
+        if dstats.get("kv_transfer_p50_s") else None,
+        "serving_fleet_overlap_stall_p50_ms": round(
+            dstats["overlap_stall_p50_s"] * 1e3, 3)
+        if dstats.get("overlap_stall_p50_s") is not None else None,
+        "serving_fleet_tok_per_sec": round(total_new / dt_fl, 1),
+        "serving_fleet_colocated_tok_per_sec": round(
+            total_new / dt_co, 1),
+    }
+
+
 def main():
     only = os.environ.get("BENCH_ONLY")  # e.g. "bench_serving": one table
     fns = (bench_resnet50, bench_bert, bench_moe, bench_decode,
            bench_serving, bench_serving_paged, bench_serving_router,
-           bench_serving_disagg, bench_longseq, bench_llama_long,
-           bench_eager, bench_collectives)
+           bench_serving_disagg, bench_serving_fleet, bench_longseq,
+           bench_llama_long, bench_eager, bench_collectives)
     if only:
         out = {}
         for fn in fns:

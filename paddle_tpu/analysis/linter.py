@@ -90,12 +90,15 @@ _WAIT_SANCTIONED = {"backoff_sleep", "_backoff_sleep"}
 # `jax.device_get` of cache leaves) between compiled dispatches
 # serializes every live slot behind one request's handoff.  The
 # sanctioned seam is a helper named like the disagg coordinator's pump
-# (`kv_transfer`), resolved the same way as _SYNC_HELPERS; transfers
-# only count when an argument mentions the cache/block vocabulary — a
-# socket `.recv()` in a step loop is PTL008/PTL013's problem, not a KV
-# migration
+# (`kv_transfer`) or the socket transport's background-thread streamer /
+# non-blocking inbox drain (`kv_transfer_send` / `kv_transfer_recv`,
+# serving/transport.py), resolved the same way as _SYNC_HELPERS;
+# transfers only count when an argument mentions the cache/block
+# vocabulary — a socket `.recv()` in a step loop is PTL008/PTL013's
+# problem, not a KV migration
 _TRANSFER_METHODS = {"send", "recv"}
-_TRANSFER_SANCTIONED = {"kv_transfer", "_kv_transfer"}
+_TRANSFER_SANCTIONED = {"kv_transfer", "_kv_transfer",
+                        "kv_transfer_send", "kv_transfer_recv"}
 _KV_LEAF_RE = re.compile(
     r"(^|_)(kv|caches?|blocks?|chains?|leaf|leaves)($|_)", re.IGNORECASE)
 # blocking calls inside `async def` bodies (PTL013): one blocked

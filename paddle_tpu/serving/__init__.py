@@ -9,7 +9,11 @@ the stdlib asyncio streaming :class:`ServingServer` — and the
 disaggregated prefill/decode split (:class:`DisaggCoordinator` over
 :class:`PrefillWorker`/:class:`DecodeWorker` with a paged-KV-block
 :class:`KVTransport` handoff), which presents the same engine surface
-so replicas and routers compose over it unchanged."""
+so replicas and routers compose over it unchanged.  The multi-process
+layer on top: :class:`SocketTransport` (serving/transport.py) carries
+block chains over UDS/TCP, ``paddle_tpu.serving.worker`` runs one
+worker per process, and :func:`launch` (serving/launch.py) turns a
+declarative :class:`FleetConfig` into a running, drainable fleet."""
 from paddle_tpu.serving.disagg import (
     DecodeWorker, DisaggCoordinator, InProcessTransport, KVTransport,
     PickleTransport, PrefillWorker,
@@ -20,13 +24,18 @@ from paddle_tpu.serving.engine import (
 from paddle_tpu.serving.faults import (
     FaultPlan, InjectedDispatchError, InjectedStreamCbError,
 )
+from paddle_tpu.serving.launch import (
+    Fleet, FleetConfig, FleetCoordinator, launch,
+)
 from paddle_tpu.serving.replica import Replica
 from paddle_tpu.serving.router import Router
 from paddle_tpu.serving.server import PRIORITY_CLASSES, ServingServer
+from paddle_tpu.serving.transport import SocketTransport
 
 __all__ = ["DecodeWorker", "DisaggCoordinator", "EngineOverloaded",
-           "FaultPlan", "InProcessTransport", "InjectedDispatchError",
+           "FaultPlan", "Fleet", "FleetConfig", "FleetCoordinator",
+           "InProcessTransport", "InjectedDispatchError",
            "InjectedStreamCbError", "KVTransport",
            "PRIORITY_CLASSES", "PickleTransport", "PrefillWorker",
            "Replica", "Request", "Router", "ServingEngine",
-           "ServingServer"]
+           "ServingServer", "SocketTransport", "launch"]

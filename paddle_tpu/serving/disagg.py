@@ -29,9 +29,10 @@ changes operand *values*, never program shapes:
   ``[n_blocks, C, Hkv, D]`` data leaves plus ``[n_blocks, C, Hkv]`` int8
   scale leaves per layer — between pools.  ``InProcessTransport`` is the
   device-to-device ``device_put`` path (CI-testable on one process);
-  ``PickleTransport`` serializes the same leaves to bytes, proving the
-  interface is process-boundary-ready (the 2-proc × 4-device machinery
-  in tests/test_multiprocess_mesh.py is the eventual target).
+  ``serving/transport.py``'s ``SocketTransport`` is the real bytes-on-a-
+  wire path (UDS/TCP, background-thread streaming so the transfer
+  overlaps decode steps); ``PickleTransport`` survives as a test-only
+  fallback that round-trips the same socket framing through a blob.
 * The **DisaggCoordinator** glues them behind the SAME engine surface
   ``serving/replica.py`` programs against (submit/cancel/step/run/drain/
   close/stats/prefix_lookup/...), so the router and the HTTP front end
@@ -53,7 +54,6 @@ tpu-lint PTL017 rule polices the anti-pattern in tree code.
 from __future__ import annotations
 
 import logging
-import pickle
 import time
 from collections import deque
 
@@ -61,7 +61,8 @@ import numpy as np
 
 import jax
 
-from .engine import EngineOverloaded, Request, ServingEngine
+from .engine import (EngineOverloaded, Request, ServingEngine,
+                     _backoff_sleep)
 from .kv_cache import KVPoolExhausted
 from .metrics import DisaggMetrics
 
@@ -115,6 +116,18 @@ class KVTransport:
     def recv(self, handle):
         raise NotImplementedError
 
+    def ready(self, handle):
+        """True when ``recv(handle)`` would return without blocking.
+        In-process transports complete at ``send``; a wire transport
+        overrides this so the coordinator's pump can defer an unarrived
+        chain instead of stalling the step loop on it."""
+        return True
+
+    def transfer_seconds(self, handle):
+        """Observed wire time for a completed transfer, or None when the
+        transport has no independent clock (in-process handoffs)."""
+        return None
+
 
 class InProcessTransport(KVTransport):
     """Device-to-device handoff for workers sharing one process: one
@@ -149,25 +162,33 @@ class InProcessTransport(KVTransport):
 
 
 class PickleTransport(KVTransport):
-    """Bytes-serializing stub: leaves are pulled to host numpy, pickled,
-    and round-tripped through an actual ``bytes`` blob — the degenerate
-    one-process form of a socket/RDMA transport, proving nothing in the
-    migration path assumes device-to-device reachability.  The decode
-    side re-uploads during ``import_chain``'s pool scatter, so the
-    leaves come back as numpy and that is fine."""
+    """DEPRECATED test-only fallback: one chain round-tripped through an
+    actual ``bytes`` blob in one process — proving nothing in the
+    migration path assumes device-to-device reachability, without
+    sockets.  The framing IS ``serving/transport.py``'s wire codec
+    (``encode_chain``/``decode_chain``), so there is exactly one
+    serialization path and ``nbytes`` is the same framed wire size
+    ``SocketTransport`` accounts; real deployments use
+    ``SocketTransport`` (this class logs a one-time pointer there).
+    The decode side re-uploads during ``import_chain``'s pool scatter,
+    so the leaves come back as numpy and that is fine."""
+
+    _warned = False
 
     def send(self, rid, leaves):
-        def host(leaf):
-            if isinstance(leaf, tuple):
-                return (np.asarray(leaf[0]), np.asarray(leaf[1]))
-            return np.asarray(leaf)
-        blob = pickle.dumps(
-            (rid, [(host(k), host(v)) for k, v in leaves]),
-            protocol=pickle.HIGHEST_PROTOCOL)
+        if not PickleTransport._warned:
+            PickleTransport._warned = True
+            _LOG.warning(
+                "PickleTransport is deprecated to a test-only fallback: "
+                "use serving.transport.SocketTransport for anything that "
+                "crosses a process boundary")
+        from .transport import encode_chain
+        blob = encode_chain(rid, leaves)
         return blob, len(blob)
 
     def recv(self, handle):
-        _, leaves = pickle.loads(handle)
+        from .transport import decode_chain
+        _, leaves, _ = decode_chain(handle)
         return leaves
 
 
@@ -224,9 +245,13 @@ class DecodeWorker:
 
 class _Ticket:
     """One migration in flight: the request's first token plus the
-    transport handle its chain rode out on."""
+    transport handle its chain rode out on.  ``stall_since`` is stamped
+    the first time a decode worker had capacity but the chain's bytes
+    were still on the wire — the transfer-induced stall the overlap
+    design exists to keep at zero."""
 
-    __slots__ = ("rid", "first", "handle", "n_blocks", "nbytes", "sent_s")
+    __slots__ = ("rid", "first", "handle", "n_blocks", "nbytes", "sent_s",
+                 "stall_since")
 
     def __init__(self, rid, first, handle, n_blocks, nbytes, sent_s):
         self.rid = rid
@@ -235,6 +260,7 @@ class _Ticket:
         self.n_blocks = n_blocks
         self.nbytes = nbytes
         self.sent_s = sent_s
+        self.stall_since = None
 
 
 class _FleetSLO:
@@ -289,7 +315,7 @@ class DisaggCoordinator:
     why the warm decode worker never retraces across migrations."""
 
     def __init__(self, prefill, decode, transport=None, name="disagg0",
-                 registry=None, instrument=True):
+                 registry=None, instrument=True, faults=None):
         self._prefill = (list(prefill)
                          if isinstance(prefill, (list, tuple))
                          else [prefill])
@@ -323,6 +349,20 @@ class DisaggCoordinator:
         self._n_aborted = 0
         self._hook_emitted = 0
         self._adopted = 0
+        self._faults = faults
+        self._dead = set()      # worker names declared dead
+        self._step_idx = 0
+        self._attempt = {}      # root rid -> resume attempts so far
+        self._proxy = {}        # attempt rid -> root caller Request
+        self._active = {}       # root rid -> live attempt rid
+        self._stall_t0 = None   # run()'s no-progress clock
+
+    # -------------------------------------------------------- live fleet
+    def _live_prefill(self):
+        return [w for w in self._prefill if w.name not in self._dead]
+
+    def _live_decode(self):
+        return [w for w in self._decode if w.name not in self._dead]
 
     # ------------------------------------------------------------ submit
     def submit(self, request):
@@ -332,10 +372,12 @@ class DisaggCoordinator:
         requests that could never fit either side and propagates
         ``EngineOverloaded`` (status ``"shed"``) from the prefill
         worker's bounded admission queue."""
-        if not any(w.engine.adoption_viable(request) for w in self._decode):
+        live = self._live_decode()
+        if not live or not any(w.engine.adoption_viable(request)
+                               for w in live):
             raise ValueError(
-                "request can never fit any decode worker (prompt bucket "
-                "/ max_len budget): prefilling it would strand a "
+                "request can never fit any live decode worker (prompt "
+                "bucket / max_len budget): prefilling it would strand a "
                 "migration")
         rid_given = request.rid is not None
         if rid_given and request.rid in self._rids:
@@ -347,7 +389,10 @@ class DisaggCoordinator:
                          deadline_ms=request.deadline_ms,
                          slo_class=request.slo_class,
                          priority=request.priority)
-        worker = min(self._prefill, key=lambda w: w.backlog())
+        live_prefill = self._live_prefill()
+        if not live_prefill:
+            raise ValueError("no live prefill worker to admit into")
+        worker = min(live_prefill, key=lambda w: w.backlog())
         try:
             worker.engine.submit(shadow)
         except EngineOverloaded:
@@ -421,13 +466,31 @@ class DisaggCoordinator:
 
     def _retire_waiting(self, user, status):
         """Finalize a request the decode fleet never owned: done at the
-        first token, or cancelled/expired between handoff and adoption."""
+        first token, or cancelled/expired between handoff and adoption.
+        A resume attempt finalizes its ROOT request — the caller only
+        ever sees the Request they submitted."""
         user.status = status
         user.done = True
         user.t_done = time.perf_counter()
         self._users.pop(user.rid, None)
+        root = self._proxy.pop(user.rid, None)
+        if root is not None:
+            self._finalize_root(root, status)
+            return
         self._finished.append(user)
         self._slo.observe(user)
+
+    def _finalize_root(self, root, status, observe=True):
+        """Stamp a terminal status on a resume attempt's root request.
+        ``observe=False`` when the decode engine already observed SLO
+        attainment on the attempt (avoids double counting)."""
+        self._active.pop(root.rid, None)
+        root.status = status
+        root.done = True
+        root.t_done = time.perf_counter()
+        self._finished.append(root)
+        if observe:
+            self._slo.observe(root)
 
     def _abort(self, ticket):
         self._n_aborted += 1
@@ -440,14 +503,18 @@ class DisaggCoordinator:
         fire inside, emitting first tokens), propagate shadow failures,
         pump pending migrations onto decode workers, step the decode
         fleet.  Returns tokens emitted on caller requests."""
+        self._step_idx += 1
+        if self._faults is not None:
+            for name in self._faults.worker_kills_due(self._step_idx):
+                self.kill_worker(name)
         self._hook_emitted = 0
-        for w in self._prefill:
+        for w in self._live_prefill():
             if w.engine.has_work:
                 w.engine.step()
         emitted = self._hook_emitted
         self._harvest_shadows()
         self._pump_migrations()
-        for w in self._decode:
+        for w in self._live_decode():
             if w.engine.has_work:
                 emitted += w.engine.step()
         self._collect()
@@ -472,12 +539,15 @@ class DisaggCoordinator:
 
     def _pump_migrations(self):
         """Place pending chains, FIFO: abort dead ones (cancelled /
-        past-deadline), defer those no decode worker can adopt yet, and
-        splice the rest (``transport.recv`` + ``adopt_prefilled``) onto
-        the least-loaded worker that has room."""
+        past-deadline), defer those no decode worker can adopt yet OR
+        whose bytes are still on the wire (``transport.ready`` — the
+        step loop never blocks on a transfer), and splice the rest
+        (``transport.recv`` + ``adopt_prefilled``) onto the least-loaded
+        worker that has room."""
         self._adopted = 0
         keep = deque()
         now = time.perf_counter()
+        live = self._live_decode()
         while self._migrating:
             t = self._migrating.popleft()
             user = self._users.get(t.rid)
@@ -488,11 +558,24 @@ class DisaggCoordinator:
                 self._retire_waiting(user, "timed_out")
                 self._abort(t)
                 continue
-            cands = [w for w in self._decode if w.engine.can_adopt(user)]
+            if not live:
+                # every decode worker is dead: terminal, never hang
+                self._retire_waiting(user, "cancelled")
+                self._abort(t)
+                continue
+            cands = [w for w in live if w.engine.can_adopt(user)]
             if not cands:
                 keep.append(t)
                 continue
+            if not self._transport.ready(t.handle):
+                # capacity is waiting on the wire — the stall the
+                # background sender exists to keep at zero
+                if t.stall_since is None:
+                    t.stall_since = time.perf_counter()
+                keep.append(t)
+                continue
             w = min(cands, key=lambda c: c.backlog())
+            wire_s = self._transport.transfer_seconds(t.handle)
             t1 = time.perf_counter()
             try:
                 leaves = self._transport.recv(t.handle)
@@ -505,7 +588,11 @@ class DisaggCoordinator:
             self._n_ok += 1
             if self._m is not None:
                 self._m.transfer_seconds.observe(
-                    t.sent_s + (time.perf_counter() - t1))
+                    t.sent_s + (wire_s or 0.0)
+                    + (time.perf_counter() - t1))
+                self._m.overlap_stall.observe(
+                    0.0 if t.stall_since is None
+                    else time.perf_counter() - t.stall_since)
                 self._m.migration("ok")
             rec = w.engine.recorder
             if rec is not None:
@@ -516,21 +603,166 @@ class DisaggCoordinator:
     def _collect(self):
         """Sweep caller requests the decode fleet finished into the
         coordinator's completion list (the engines stamped status /
-        t_done on the shared Request objects)."""
+        t_done on the shared Request objects).  A finished resume
+        attempt finalizes its root instead — the engine already streamed
+        its tokens onto the root via the forwarding callback and
+        observed SLO attainment on the attempt."""
         for rid in list(self._users):
             u = self._users[rid]
             if u.done:
                 del self._users[rid]
                 self._owner.pop(rid, None)
-                self._finished.append(u)
+                root = self._proxy.pop(rid, None)
+                if root is not None:
+                    self._finalize_root(root, u.status, observe=False)
+                else:
+                    self._finished.append(u)
+
+    # ------------------------------------------------------ worker death
+    def kill_worker(self, name):
+        """Declare the named worker dead (FaultPlan ``worker_kill`` seam;
+        callable directly in tests).  Its engine is never touched again
+        — a dead process answers nothing — and every in-flight request
+        it held is recovered: shadows resubmit to a surviving prefill
+        worker, adopted decode streams re-prefill their suffix (prompt +
+        all emitted tokens) through ``_reprefill``.  Requests that no
+        survivor can host retire with a clean terminal status; nothing
+        ever hangs on a corpse.  Returns True if the name was a live
+        worker."""
+        w = next((x for x in self._prefill + self._decode
+                  if x.name == name and x.name not in self._dead), None)
+        if w is None:
+            return False
+        self._dead.add(name)
+        _LOG.warning("disagg worker %r died; recovering its in-flight "
+                     "requests", name)
+        if w in self._prefill:
+            self._reassign_shadows(w)
+        else:
+            self._recover_orphans(w)
+        return True
+
+    def _reassign_shadows(self, dead):
+        """Shadows the dead prefill worker held (queued or mid-prefill)
+        restart from scratch on the least-backlogged survivor — prefill
+        produced nothing externally visible yet, so a fresh shadow with
+        the same rid is byte-identical."""
+        for rid in list(self._shadows):
+            shadow, worker = self._shadows[rid]
+            if worker is not dead:
+                continue
+            del self._shadows[rid]
+            user = self._users.get(rid)
+            if user is None or user.done:
+                continue
+            live = self._live_prefill()
+            if not live:
+                self._retire_waiting(user, "cancelled")
+                continue
+            replacement = Request(shadow.prompt_ids, 1, rid=rid,
+                                  slo_class=shadow.slo_class,
+                                  priority=shadow.priority)
+            target = min(live, key=lambda w: w.backlog())
+            try:
+                target.engine.submit(replacement)
+            except EngineOverloaded:
+                self._retire_waiting(user, "shed")
+                continue
+            replacement._t_deadline = user._t_deadline
+            self._shadows[rid] = (replacement, target)
+
+    def _recover_orphans(self, dead):
+        """Requests the dead decode worker owned lose their KV blocks
+        with the process; the radix story makes recovery a suffix
+        prefill — re-prefill prompt + every emitted token, whose final
+        chunk's argmax IS the next token of the uninterrupted greedy
+        stream (the preemption-resume identity, engine
+        ``_admission_ids``)."""
+        for rid, owner in list(self._owner.items()):
+            if owner is not dead:
+                continue
+            self._owner.pop(rid)
+            user = self._users.get(rid)
+            if user is None or user.done:
+                continue
+            self._users.pop(rid)
+            self._reprefill(user)
+
+    def _reprefill(self, user):
+        """Resume an orphaned stream as a fresh attempt: a new derived
+        rid (engines never recycle rids), prompt' = prompt + emitted
+        tokens, max_new' = remaining budget.  The attempt's emissions
+        forward onto the root request, so the caller's stream continues
+        byte-identically; terminal statuses finalize the root."""
+        root = self._proxy.pop(user.rid, None) or user
+        self._active.pop(root.rid, None)
+        k = len(root.output_ids)
+        remaining = root.max_new_tokens - k
+        if remaining <= 0:
+            self._finalize_root(root, "done")
+            return
+        attempt = self._attempt.get(root.rid, 0) + 1
+        self._attempt[root.rid] = attempt
+        arid = f"{root.rid}~r{attempt}"
+        prompt = np.concatenate(
+            [np.asarray(root.prompt_ids, dtype=np.int32).ravel(),
+             np.asarray(root.output_ids, dtype=np.int32).ravel()])
+        resume = Request(prompt, remaining, rid=arid,
+                         eos_token_id=root.eos_token_id,
+                         stream_cb=self._forward_cb(root),
+                         slo_class=root.slo_class,
+                         priority=root.priority)
+        resume._t_deadline = root._t_deadline
+        live = self._live_prefill()
+        if not live or not any(w.engine.adoption_viable(resume)
+                               for w in self._live_decode()):
+            self._finalize_root(root, "cancelled")
+            return
+        shadow = Request(prompt, 1, rid=arid, slo_class=root.slo_class,
+                         priority=root.priority)
+        target = min(live, key=lambda w: w.backlog())
+        try:
+            target.engine.submit(shadow)
+        except EngineOverloaded:
+            self._finalize_root(root, "shed")
+            return
+        shadow._t_deadline = root._t_deadline
+        self._rids.add(arid)
+        self._users[arid] = resume
+        self._shadows[arid] = (shadow, target)
+        self._proxy[arid] = root
+        self._active[root.rid] = arid
+        if self._m is not None:
+            self._m.orphan_reprefills.inc()
+        _LOG.info("re-prefilling orphaned request %r as %r (%d tokens "
+                  "already emitted, %d remaining)", root.rid, arid, k,
+                  remaining)
+
+    def _forward_cb(self, root):
+        """A resume attempt's stream_cb: splice its emissions onto the
+        root request (output_ids, first-token stamp, caller callback)."""
+        def cb(req, new_ids):
+            root.output_ids.extend(int(i) for i in new_ids)
+            if root.t_first is None:
+                root.t_first = req.t_first
+            if root.stream_cb is not None:
+                try:
+                    root.stream_cb(root, new_ids)
+                except Exception as e:
+                    if not root._cb_err_logged:
+                        root._cb_err_logged = True
+                        _LOG.warning(
+                            "stream_cb for request %r raised %s: %s",
+                            root.rid, type(e).__name__, e)
+        return cb
 
     def _update_gauges(self):
         if self._m is None:
             return
         self._m.prefill_backlog.set(
-            sum(w.backlog() for w in self._prefill))
+            sum(w.backlog() for w in self._live_prefill()))
         self._m.decode_backlog.set(
-            sum(w.backlog() for w in self._decode)
+            sum(w.backlog() for w in self._live_decode())
             + len(self._migrating))
 
     # -------------------------------------------------- run / drain / close
@@ -538,24 +770,42 @@ class DisaggCoordinator:
     def has_work(self):
         return (bool(self._shadows) or bool(self._migrating)
                 or any(w.engine.has_work
-                       for w in self._prefill + self._decode))
+                       for w in self._live_prefill()
+                       + self._live_decode()))
 
-    def run(self):
+    def run(self, stall_timeout=30.0):
         """Drive ``step()`` to quiescence; returns finished requests in
-        completion order.  A migration no decode worker can EVER place
-        (pool smaller than one request's budget) raises instead of
-        spinning — ``submit``'s viability gate makes this unreachable
-        for sanely sized pools."""
+        completion order.  Two stuck shapes are distinguished: chains
+        whose bytes are still on the wire wait (``_backoff_sleep`` — the
+        sanctioned pause — under ``stall_timeout``), while a migration
+        no decode worker can EVER place (pool smaller than one request's
+        budget) raises immediately — ``submit``'s viability gate makes
+        the latter unreachable for sanely sized pools."""
         while self.has_work:
             self.step()
-            if (self._migrating and self._adopted == 0
+            if not (self._migrating and self._adopted == 0
                     and not self._shadows
                     and not any(w.engine.has_work
-                                for w in self._prefill + self._decode)):
+                                for w in self._live_prefill()
+                                + self._live_decode())):
+                self._stall_t0 = None
+                continue
+            in_flight = [t for t in self._migrating
+                         if not self._transport.ready(t.handle)]
+            if not in_flight:
                 raise RuntimeError(
                     f"{len(self._migrating)} migration(s) pending but "
                     "every decode worker is idle and none can adopt — "
                     "decode pool too small for the request's budget")
+            if self._stall_t0 is None:
+                self._stall_t0 = time.perf_counter()
+            elif time.perf_counter() - self._stall_t0 > stall_timeout:
+                raise RuntimeError(
+                    f"{len(in_flight)} migration chain(s) still on the "
+                    f"wire after {stall_timeout:.0f}s with the fleet "
+                    "idle — transport stalled or sender died")
+            _backoff_sleep(0.002)
+        self._stall_t0 = None
         return self._finished
 
     def drain(self):
@@ -569,7 +819,7 @@ class DisaggCoordinator:
         (queued/mid-prefill shadows cancel, propagating to their
         callers), abort pending migrations, close the decode fleet.
         Idempotent; returns ``{rid: terminal status}``."""
-        for w in self._prefill:
+        for w in self._live_prefill():
             w.engine.close()
         self._harvest_shadows()
         while self._migrating:
@@ -578,7 +828,7 @@ class DisaggCoordinator:
             self._abort(t)
             if user is not None and not user.done:
                 self._retire_waiting(user, "cancelled")
-        for w in self._decode:
+        for w in self._live_decode():
             w.engine.close()
         self._collect()
         for rid in list(self._users):  # defensive: nothing should remain
@@ -588,8 +838,10 @@ class DisaggCoordinator:
 
     def cancel(self, rid):
         """Cancel ``rid`` wherever it is: shadow mid-prefill, chain
-        mid-migration, or adopted on a decode worker.  Returns True if
-        found live."""
+        mid-migration, adopted on a decode worker, or resumed under a
+        derived attempt rid after a worker death.  Returns True if found
+        live."""
+        rid = self._active.get(rid, rid)
         sh = self._shadows.get(rid)
         if sh is not None:
             shadow, worker = sh
@@ -623,14 +875,15 @@ class DisaggCoordinator:
     def queue_depth(self):
         """Work admitted but not yet decoding: prefill backlogs plus
         chains awaiting adoption."""
-        return (sum(w.engine.queue_depth() for w in self._prefill)
+        return (sum(w.engine.queue_depth() for w in self._live_prefill())
                 + len(self._migrating))
 
     def prefix_lookup(self, tokens):
-        """Longest cached prefix across the PREFILL fleet — that is the
-        side where a hit skips work (adoption always imports the full
-        chain)."""
-        return max(w.engine.prefix_lookup(tokens) for w in self._prefill)
+        """Longest cached prefix across the live PREFILL fleet — that is
+        the side where a hit skips work (adoption always imports the
+        full chain)."""
+        return max((w.engine.prefix_lookup(tokens)
+                    for w in self._live_prefill()), default=0)
 
     def stats(self):
         """One engine-shaped snapshot over the split (the keys
@@ -638,8 +891,8 @@ class DisaggCoordinator:
         counters.  Prompt/reuse tallies come from the prefill side only
         — adoption re-counts prompt tokens on the decode engines and
         double-counting would skew the router's placement signal."""
-        ps = [w.engine.stats() for w in self._prefill]
-        ds = [w.engine.stats() for w in self._decode]
+        ps = [w.engine.stats() for w in self._live_prefill()]
+        ds = [w.engine.stats() for w in self._live_decode()]
         return {
             "queue_depth": self.queue_depth(),
             "slots_occupied": sum(s["slots_occupied"] for s in ds),
@@ -655,8 +908,10 @@ class DisaggCoordinator:
                 sum(s["preempt_resume_suffix_tokens"] for s in ds),
             "preempt_resume_total_tokens":
                 sum(s["preempt_resume_total_tokens"] for s in ds),
-            "prefill_workers": len(self._prefill),
-            "decode_workers": len(self._decode),
+            "prefill_workers": len(self._live_prefill()),
+            "decode_workers": len(self._live_decode()),
+            "workers_dead": len(self._dead),
+            "orphan_reprefills": sum(self._attempt.values()),
             "migrations_ok": self._n_ok,
             "migrations_aborted": self._n_aborted,
             "migrations_pending": len(self._migrating),

@@ -27,6 +27,13 @@ in prose:
 * **stream_cb crashes** — ``cb_crash_steps``: ``maybe_crash_stream_cb``
   raises ``InjectedStreamCbError`` inside the engine's emission callback
   guard, proving a crashing user callback is counted and survived.
+* **worker deaths** — ``worker_kill`` maps ``step -> worker name`` (or a
+  tuple of names): at that coordinator step the named fleet worker is
+  declared dead (``DisaggCoordinator(faults=...)`` drops it mid-stream;
+  the multi-process launcher SIGKILLs the actual process).  The
+  coordinator must recover every in-flight request — orphaned decode
+  streams resume as a suffix prefill of prompt + emitted tokens — and
+  never hang.
 
 ``stats`` counts every fault actually fired, so a bench/test can assert
 the plan executed (a plan whose faults never fire proves nothing).
@@ -57,7 +64,8 @@ class FaultPlan:
 
     def __init__(self, seed=0, dispatch_error_steps=(),
                  dispatch_error_rate=0.0, dispatch_error_attempts=1,
-                 poison=None, slow_steps=None, cb_crash_steps=()):
+                 poison=None, slow_steps=None, cb_crash_steps=(),
+                 worker_kill=None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.dispatch_error_steps = set(dispatch_error_steps)
@@ -66,11 +74,14 @@ class FaultPlan:
         self.poison = dict(poison or {})            # rid -> step index
         self.slow_steps = dict(slow_steps or {})    # step index -> seconds
         self.cb_crash_steps = set(cb_crash_steps)
+        self.worker_kill = dict(worker_kill or {})  # step -> name(s)
+        self._killed_steps = set()
         self._poisoned = set()
         self._rate_drawn = {}                       # step -> bool (memoized)
         self._fired = {}                            # step -> errors raised
         self.stats = {"dispatch_errors": 0, "poisoned": 0,
-                      "slow_steps": 0, "cb_crashes": 0}
+                      "slow_steps": 0, "cb_crashes": 0,
+                      "worker_kills": 0}
 
     # ------------------------------------------------------- dispatch faults
     def _step_faulty(self, step):
@@ -130,6 +141,26 @@ class FaultPlan:
         time.sleep(float(s))
         return float(s)
 
+    # --------------------------------------------------------- worker deaths
+    def worker_kills_due(self, step):
+        """Worker names scheduled to die at or before ``step`` that have
+        not fired yet (fires once per scheduled step).  The at-or-before
+        semantics mean a kill scheduled for a step the driver skipped
+        (e.g. the coordinator quiesced early) still lands on the next
+        probe instead of silently never firing."""
+        names = []
+        for due in sorted(self.worker_kill):
+            if due > step or due in self._killed_steps:
+                continue
+            self._killed_steps.add(due)
+            victim = self.worker_kill[due]
+            if isinstance(victim, (list, tuple, set)):
+                names.extend(victim)
+            else:
+                names.append(victim)
+        self.stats["worker_kills"] += len(names)
+        return names
+
     # -------------------------------------------------------- introspection
     def snapshot(self):
         """JSON-ready plan summary for the engine's ``/debug/*`` views:
@@ -143,6 +174,10 @@ class FaultPlan:
             "poison": dict(self.poison),
             "slow_steps": dict(self.slow_steps),
             "cb_crash_steps": sorted(self.cb_crash_steps),
+            "worker_kill": {
+                int(k): (sorted(v) if isinstance(v, (list, tuple, set))
+                         else v)
+                for k, v in self.worker_kill.items()},
             "stats": dict(self.stats),
         }
 

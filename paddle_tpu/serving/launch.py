@@ -1,0 +1,910 @@
+"""Declarative fleet deployment: one validated config -> a running
+multi-process disaggregated serving fleet.
+
+The config names everything a deployment varies — the P:D worker ratio,
+engine geometry, decode mode (greedy/spec) and KV dtype, transport
+scheme (UDS or TCP) and endpoints, router policy, platform/device
+shape — and ``launch()`` turns it into processes: spawn each
+``paddle_tpu.serving.worker`` with the config on disk, gate on every
+worker's ``ready`` event (a worker that dies during bringup fails the
+launch with its log tail, not a hang), and hand back a ``Fleet`` whose
+``FleetCoordinator`` speaks the same Replica-shaped surface
+(submit/step/run/drain/close/stats) as the in-process
+``DisaggCoordinator`` — so the same config drives tier-1 tests, the
+bench, soaks, and a real deployment.
+
+Shutdown is graceful by default: ``drain`` commands let residents
+finish, SIGTERM flips stragglers into their drain path, SIGKILL is the
+deadline fallback.  Worker death mid-flight (crash or
+``FaultPlan(worker_kill=...)``, which here SIGKILLs the actual process)
+is recovered the same way the in-process coordinator does it: requests
+still in prefill resubmit to a survivor; adopted decode streams
+re-prefill their suffix (prompt + every emitted token) under a derived
+attempt rid — the preemption-resume identity makes the continuation
+byte-identical — and ``serving_worker_restarts_total`` /
+``serving_orphan_reprefills_total`` count the recoveries.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from collections import deque
+
+import numpy as np
+
+from .engine import EngineOverloaded, _backoff_sleep
+from .metrics import DisaggMetrics
+from .worker import FrameReader, pump_socket, send_msg
+
+__all__ = ["FleetConfig", "Fleet", "FleetCoordinator", "launch"]
+
+_LOG = logging.getLogger(__name__)
+
+_PLATFORMS = ("cpu", "tpu")
+_TRANSPORTS = ("uds", "tcp")
+_ROUTER_POLICIES = ("least_backlog",)
+_UDS_PATH_MAX = 107  # sun_path limit (Linux): bind() fails past this
+_MAX_REPREFILLS = 8  # resume attempts per request before giving up
+
+
+class FleetConfig:
+    """Everything ``launch()`` needs, validated up front.  ``engine``
+    is the geometry dict every worker's ``ServingEngine`` receives
+    (batch_size/max_len/kv_block/...); ``prefill``/``decode`` are
+    per-role overrides (decode owns ``mode``/``spec_k``/``kv_dtype``)."""
+
+    def __init__(self, *, engine, model=None, n_prefill=1, n_decode=1,
+                 prefill=None, decode=None, platform="cpu",
+                 devices_per_worker=1, transport="uds",
+                 host="127.0.0.1", base_port=0,
+                 router_policy="least_backlog", workdir=None,
+                 heartbeat_s=1.0, ready_timeout_s=120.0,
+                 drain_timeout_s=30.0, restart_dead_workers=False,
+                 adoption_timeout_s=20.0, name="fleet0"):
+        self.engine = dict(engine)
+        self.model = dict(model or {"kind": "llama", "preset": "tiny",
+                                    "dtype": "float32", "seed": 0})
+        self.n_prefill = int(n_prefill)
+        self.n_decode = int(n_decode)
+        self.prefill = dict(prefill or {})
+        self.decode = dict(decode or {})
+        self.platform = platform
+        self.devices_per_worker = int(devices_per_worker)
+        self.transport = transport
+        self.host = host
+        self.base_port = int(base_port)
+        self.router_policy = router_policy
+        self.workdir = workdir
+        self.heartbeat_s = float(heartbeat_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.restart_dead_workers = bool(restart_dead_workers)
+        self.adoption_timeout_s = float(adoption_timeout_s)
+        self.name = name
+
+    # ---------------------------------------------------------- validation
+    def validate(self):
+        """Raise one aggregated ``ValueError`` naming every problem —
+        a config rejected at validate() never spawned half a fleet."""
+        errs = []
+        if self.n_prefill < 1:
+            errs.append(f"n_prefill must be >= 1 (got {self.n_prefill})")
+        if self.n_decode < 1:
+            errs.append(f"n_decode must be >= 1 (got {self.n_decode})")
+        kvb = self.engine.get("kv_block")
+        if not kvb:
+            errs.append("engine.kv_block is required: the paged block "
+                        "pool is the migration transfer unit")
+        maxlen = self.engine.get("max_len")
+        if not maxlen:
+            errs.append("engine.max_len is required")
+        if kvb and maxlen and maxlen % kvb:
+            errs.append(f"engine.max_len ({maxlen}) must be a multiple "
+                        f"of engine.kv_block ({kvb})")
+        if not self.engine.get("batch_size"):
+            errs.append("engine.batch_size is required")
+        if self.platform not in _PLATFORMS:
+            errs.append(f"platform must be one of {_PLATFORMS} "
+                        f"(got {self.platform!r})")
+        if self.transport not in _TRANSPORTS:
+            errs.append(f"transport must be one of {_TRANSPORTS} "
+                        f"(got {self.transport!r})")
+        if self.transport == "tcp" and self.base_port <= 0:
+            errs.append("tcp transport needs base_port > 0")
+        if self.router_policy not in _ROUTER_POLICIES:
+            errs.append(f"router_policy must be one of {_ROUTER_POLICIES} "
+                        f"(got {self.router_policy!r})")
+        if self.devices_per_worker < 1:
+            errs.append("devices_per_worker must be >= 1")
+        if self.heartbeat_s <= 0:
+            errs.append("heartbeat_s must be > 0")
+        if self.adoption_timeout_s <= 0:
+            errs.append("adoption_timeout_s must be > 0")
+        if self.model.get("kind", "llama") != "llama" or \
+                self.model.get("preset", "tiny") != "tiny":
+            errs.append(f"unsupported model spec {self.model!r} "
+                        "(kind='llama', preset='tiny')")
+        if self.decode.get("mode") == "spec" and \
+                int(self.decode.get("spec_k", 0)) < 1:
+            errs.append("decode.mode='spec' needs decode.spec_k >= 1")
+        if self.transport == "uds" and self.workdir is not None:
+            probe = os.path.join(self.workdir, "kv-decode99.sock")
+            if len(probe) > _UDS_PATH_MAX:
+                errs.append(
+                    f"workdir {self.workdir!r} pushes UDS paths past the "
+                    f"{_UDS_PATH_MAX}-char sun_path limit")
+        if errs:
+            raise ValueError("invalid FleetConfig: " + "; ".join(errs))
+        return self
+
+    # -------------------------------------------------------------- naming
+    def worker_names(self):
+        return ([f"prefill{i}" for i in range(self.n_prefill)]
+                + [f"decode{i}" for i in range(self.n_decode)])
+
+    def kv_endpoint(self, decode_name, workdir):
+        if self.transport == "uds":
+            return f"unix:{os.path.join(workdir, f'kv-{decode_name}.sock')}"
+        idx = int(decode_name[len("decode"):])
+        return f"tcp:{self.host}:{self.base_port + idx}"
+
+    # ----------------------------------------------------------- serialize
+    def to_dict(self):
+        return {
+            "name": self.name, "model": dict(self.model),
+            "engine": dict(self.engine),
+            "n_prefill": self.n_prefill, "n_decode": self.n_decode,
+            "prefill": dict(self.prefill), "decode": dict(self.decode),
+            "platform": self.platform,
+            "devices_per_worker": self.devices_per_worker,
+            "transport": self.transport, "host": self.host,
+            "base_port": self.base_port,
+            "router_policy": self.router_policy,
+            "workdir": self.workdir,
+            "heartbeat_s": self.heartbeat_s,
+            "ready_timeout_s": self.ready_timeout_s,
+            "drain_timeout_s": self.drain_timeout_s,
+            "restart_dead_workers": self.restart_dead_workers,
+            "adoption_timeout_s": self.adoption_timeout_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+    @classmethod
+    def from_file(cls, path):
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+
+class RemoteWorkerHandle:
+    """The parent's view of one worker process: the Popen handle, the
+    control socket, and an incremental reader that separates command
+    replies from spontaneous events."""
+
+    def __init__(self, name, role, proc, sock, log_path):
+        self.name = name
+        self.role = role
+        self.proc = proc
+        self.log_path = log_path
+        self.sock = sock
+        sock.setblocking(False)
+        self._reader = FrameReader()
+        self._events = deque()
+        self._replies = {}
+        self._next_req = 0
+        self.ready_info = None
+        self.last_hb = time.monotonic()
+        self.drained = False
+        self.dead = False
+        self.recovered = False  # parent already ran death recovery
+
+    def _pump(self):
+        if self._reader.eof:
+            return
+        for msg in pump_socket(self.sock, self._reader):
+            if "reply" in msg:
+                self._replies[msg["reply"]] = msg
+                continue
+            ev = msg.get("ev")
+            if ev == "hb":
+                self.last_hb = time.monotonic()
+                continue
+            if ev == "ready":
+                self.ready_info = msg
+                continue
+            if ev == "drained":
+                self.drained = True
+                continue
+            self._events.append(msg)
+
+    def poll_events(self):
+        self._pump()
+        out = list(self._events)
+        self._events.clear()
+        return out
+
+    def request(self, msg, timeout=30.0):
+        """Synchronous command round-trip; events arriving meanwhile are
+        buffered for the next ``poll_events``."""
+        req = self._next_req
+        self._next_req += 1
+        msg = dict(msg, req=req)
+        self.sock.setblocking(True)
+        try:
+            send_msg(self.sock, msg)
+        finally:
+            self.sock.setblocking(False)
+        deadline = time.monotonic() + timeout
+        while req not in self._replies:
+            if not self.alive():
+                raise ConnectionError(
+                    f"worker {self.name} died mid-request")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"worker {self.name} did not answer {msg.get('cmd')!r} "
+                    f"within {timeout:.0f}s")
+            self._pump()
+            if req not in self._replies:
+                _backoff_sleep(0.002)
+        return self._replies.pop(req)
+
+    def alive(self):
+        if self.dead:
+            return False
+        self._pump()
+        if self.proc.poll() is not None or self._reader.eof:
+            self.dead = True
+            return False
+        return True
+
+    def kill(self):
+        self.dead = True
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
+    def close_sock(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def log_tail(self, n=30):
+        try:
+            with open(self.log_path, errors="replace") as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return "<no log>"
+
+
+class FleetCoordinator:
+    """Drives the remote fleet through the Replica-shaped surface: the
+    parent routes submits, splices worker event streams onto the
+    callers' Request objects, and recovers worker deaths.  Unlike
+    ``DisaggCoordinator`` it owns no engine — recovery is pure rid
+    bookkeeping: a dead decode worker's orphans resubmit as a suffix
+    prefill of prompt + emitted tokens under a derived attempt rid, and
+    the resumed stream forwards onto the root request."""
+
+    def __init__(self, config, handles, registry=None, instrument=True,
+                 faults=None):
+        self._cfg = config
+        self.name = config.name
+        self._handles = {h.name: h for h in handles}
+        self._m = (DisaggMetrics(registry, config.name)
+                   if instrument else None)
+        self._faults = faults
+        self._users = {}       # wire rid -> root caller Request
+        self._route = {}       # wire rid -> {"p","d","state","meta"}
+        self._proxy = {}       # attempt rid -> root rid
+        self._active = {}      # root rid -> live attempt rid
+        self._attempt = {}
+        self._finished = []
+        self._rids = set()
+        self._next_rid = 0
+        self._step_idx = 0
+        self._n_events = 0
+        self._respawn_idx = 0
+
+    # ----------------------------------------------------------- topology
+    def _live(self, role):
+        return [h for h in self._handles.values()
+                if h.role == role and h.alive()]
+
+    def _load(self, name, state):
+        return sum(1 for r in self._route.values()
+                   if r[state] == name and r["state"] != "done")
+
+    # ------------------------------------------------------------- submit
+    def submit(self, request):
+        prefills = self._live("prefill")
+        decodes = self._live("decode")
+        if not prefills or not decodes:
+            raise RuntimeError("fleet has no live prefill/decode worker")
+        rid_given = request.rid is not None
+        if rid_given and request.rid in self._rids:
+            raise ValueError(f"rid {request.rid!r} already in use")
+        rid = request.rid if rid_given else self._next_rid
+        if not rid_given:
+            request.rid = rid
+            self._next_rid += 1
+        elif isinstance(rid, int):
+            self._next_rid = max(self._next_rid, rid + 1)
+        p = min(prefills, key=lambda h: self._load(h.name, "p"))
+        d = min(decodes, key=lambda h: self._load(h.name, "d"))
+        self._send_submit(p, d, rid, request.prompt_ids,
+                          request.max_new_tokens, request)
+        self._rids.add(rid)
+        request.t_submit = time.perf_counter()
+        if request.deadline_ms is not None:
+            request._t_deadline = request.t_submit \
+                + request.deadline_ms / 1e3
+        self._users[rid] = request
+        return request
+
+    def _send_submit(self, p, d, wire_rid, prompt, max_new, root):
+        reply = p.request({
+            "cmd": "submit", "rid": wire_rid,
+            "prompt": [int(i) for i in np.asarray(prompt).ravel()],
+            "max_new": int(max_new),
+            "eos": (int(root.eos_token_id)
+                    if root.eos_token_id is not None else None),
+            "slo_class": root.slo_class,
+            "priority": root.priority,
+            "decode": d.name,
+        })
+        if not reply.get("ok"):
+            if reply.get("etype") == "EngineOverloaded":
+                root.status = "shed"
+                raise EngineOverloaded(reply.get("error", "shed"))
+            raise ValueError(reply.get("error", "submit rejected"))
+        self._route[wire_rid] = {"p": p.name, "d": d.name,
+                                 "state": "prefill"}
+
+    # -------------------------------------------------------------- events
+    def _finalize(self, rid, status):
+        user = self._users.pop(rid, None)
+        route = self._route.get(rid)
+        if route is not None:
+            route["state"] = "done"
+        self._proxy.pop(rid, None)
+        if user is None or user.done:
+            return
+        self._active.pop(getattr(user, "rid"), None)
+        user.status = status
+        user.done = True
+        user.t_done = time.perf_counter()
+        self._finished.append(user)
+
+    def _emit(self, root, ids):
+        root.output_ids.extend(int(i) for i in ids)
+        if root.t_first is None:
+            root.t_first = time.perf_counter()
+        if root.stream_cb is not None:
+            try:
+                root.stream_cb(root, list(ids))
+            except Exception as e:  # noqa: BLE001 — caller's bug, not ours
+                if not root._cb_err_logged:
+                    root._cb_err_logged = True
+                    _LOG.warning("stream_cb for %r raised %s: %s",
+                                 root.rid, type(e).__name__, e)
+
+    def _on_event(self, h, msg):
+        self._n_events += 1
+        ev = msg["ev"]
+        rid = msg.get("rid")
+        root = self._users.get(rid) if rid is not None else None
+        if ev == "first":
+            if root is None or root.done:
+                return 0
+            self._emit(root, [msg["token"]])
+            route = self._route.get(rid)
+            if msg.get("final") or len(root.output_ids) >= \
+                    root.max_new_tokens:
+                self._finalize(rid, "done")
+            elif root.eos_token_id is not None and \
+                    int(msg["token"]) == int(root.eos_token_id):
+                self._finalize(rid, "done")
+            else:
+                dh = self._handles.get(route["d"]) if route else None
+                if dh is None or not dh.alive():
+                    # The chain was shipped to a worker that died after the
+                    # sender connected: a small chain fits in the kernel
+                    # send buffer, so send() "succeeds" and no xfer_err
+                    # ever fires.  Nobody will adopt it — resume as a
+                    # suffix prefill on a live pair instead.
+                    _LOG.warning("KV chain for %r handed to dead worker "
+                                 "%s — re-prefilling", rid,
+                                 route["d"] if route else "?")
+                    if self._m is not None:
+                        self._m.migration("aborted")
+                    self._reprefill(rid)
+                    return 1
+                if route is not None:
+                    route["state"] = "handoff"
+                    route["handoff_t0"] = time.monotonic()
+                if msg.get("nbytes") and self._m is not None:
+                    self._m.transfer_bytes.inc(int(msg["nbytes"]))
+            return 1
+        if ev == "tokens":
+            if root is None or root.done:
+                return 0
+            self._emit(root, msg["ids"])
+            return len(msg["ids"])
+        if ev == "adopted":
+            route = self._route.get(rid)
+            if route is not None:
+                route["state"] = "decode"
+            if self._m is not None:
+                self._m.migration("ok")
+            return 0
+        if ev == "retired":
+            self._finalize(rid, msg["status"])
+            return 0
+        if ev == "shadow_failed":
+            self._finalize(rid, msg["status"])
+            return 0
+        if ev == "xfer_err":
+            _LOG.warning("KV transfer for %r failed on %s: %s — "
+                         "re-prefilling", rid, h.name, msg.get("error"))
+            if self._m is not None:
+                self._m.migration("aborted")
+            self._reprefill(rid)
+            return 0
+        return 0
+
+    # -------------------------------------------------------- worker death
+    def kill_worker(self, name):
+        """SIGKILL the named worker process (FaultPlan ``worker_kill``
+        lands here): death detection + recovery happen on the next
+        ``step``."""
+        h = self._handles.get(name)
+        if h is None or h.dead:
+            return False
+        _LOG.warning("killing fleet worker %s (pid %s)", name, h.proc.pid)
+        h.kill()
+        return True
+
+    def _on_death(self, h):
+        _LOG.warning("fleet worker %s died; recovering its requests "
+                     "(log tail:\n%s)", h.name, h.log_tail(5))
+        if self._cfg.restart_dead_workers:
+            self._respawn(h)
+        for rid, route in list(self._route.items()):
+            if route["state"] == "done":
+                continue
+            if route["p"] == h.name and route["state"] == "prefill":
+                self._reprefill(rid)
+            elif route["d"] == h.name and route["state"] in ("handoff",
+                                                             "decode"):
+                self._reprefill(rid)
+
+    def _respawn(self, h):
+        try:
+            nh = self._fleet.respawn(h.name)
+        except Exception as e:  # noqa: BLE001 — respawn is best-effort
+            _LOG.warning("respawn of %s failed: %s", h.name, e)
+            return
+        self._handles[h.name] = nh
+        if self._m is not None:
+            self._m.worker_restarts.inc()
+
+    def _reprefill(self, rid):
+        """Resume an orphaned request as a suffix prefill: prompt' =
+        prompt + every emitted token, budget' = what remains, routed
+        under a derived attempt rid to live workers.  No survivor that
+        can host it -> clean terminal status, never a hang."""
+        root = self._users.pop(rid, None)
+        route = self._route.get(rid)
+        if route is not None:
+            route["state"] = "done"
+        self._proxy.pop(rid, None)
+        if root is None or root.done:
+            return
+        self._active.pop(root.rid, None)
+        k = len(root.output_ids)
+        remaining = root.max_new_tokens - k
+        if remaining <= 0:
+            root.status = "done"
+            root.done = True
+            root.t_done = time.perf_counter()
+            self._finished.append(root)
+            return
+        prefills = self._live("prefill")
+        decodes = self._live("decode")
+        if not prefills or not decodes:
+            root.status = "cancelled"
+            root.done = True
+            root.t_done = time.perf_counter()
+            self._finished.append(root)
+            return
+        n = self._attempt.get(root.rid, 0) + 1
+        self._attempt[root.rid] = n
+        if n > _MAX_REPREFILLS:
+            # A request that keeps losing its worker is shedding load the
+            # fleet can't absorb — terminate it cleanly rather than storm
+            # the prefill plane with resume attempts.
+            _LOG.warning("request %r exhausted %d resume attempts; "
+                         "cancelling", root.rid, _MAX_REPREFILLS)
+            root.status = "cancelled"
+            root.done = True
+            root.t_done = time.perf_counter()
+            self._finished.append(root)
+            return
+        arid = f"{root.rid}~r{n}"
+        prompt = np.concatenate(
+            [np.asarray(root.prompt_ids, dtype=np.int32).ravel(),
+             np.asarray(root.output_ids, dtype=np.int32).ravel()])
+        p = min(prefills, key=lambda h: self._load(h.name, "p"))
+        d = min(decodes, key=lambda h: self._load(h.name, "d"))
+        try:
+            self._send_submit(p, d, arid, prompt, remaining, root)
+        except (EngineOverloaded, ValueError, ConnectionError,
+                TimeoutError) as e:
+            _LOG.warning("re-prefill of %r failed (%s); retiring", rid, e)
+            root.status = "cancelled"
+            root.done = True
+            root.t_done = time.perf_counter()
+            self._finished.append(root)
+            return
+        self._rids.add(arid)
+        self._users[arid] = root
+        self._proxy[arid] = root.rid
+        self._active[root.rid] = arid
+        if self._m is not None:
+            self._m.orphan_reprefills.inc()
+        _LOG.info("re-prefilled orphan %r as %r (%d emitted, %d left)",
+                  root.rid, arid, k, remaining)
+
+    # ---------------------------------------------------------------- step
+    def step(self):
+        self._step_idx += 1
+        if self._faults is not None:
+            for name in self._faults.worker_kills_due(self._step_idx):
+                self.kill_worker(name)
+        emitted = 0
+        for h in list(self._handles.values()):
+            if not h.alive():
+                if not h.recovered:
+                    h.recovered = True
+                    for msg in h.poll_events():  # drain final events first
+                        emitted += self._on_event(h, msg)
+                    self._on_death(h)
+                continue
+            for msg in h.poll_events():
+                emitted += self._on_event(h, msg)
+        emitted += self._sweep_handoffs()
+        return emitted
+
+    def _sweep_handoffs(self):
+        """Re-prefill chains whose adoption ack never came.  The wire
+        gives no delivery guarantee — a chain written into a dying
+        worker's socket buffer 'sends' cleanly and then evaporates, and
+        a respawn under the same name makes the target look healthy.
+        The decode worker's ``adopted`` event is the real ack; a route
+        stuck in handoff past the deadline lost its chain."""
+        deadline = self._cfg.adoption_timeout_s
+        moved = 0
+        for rid, route in list(self._route.items()):
+            if route["state"] != "handoff":
+                continue
+            t0 = route.get("handoff_t0")
+            if t0 is None or time.monotonic() - t0 < deadline:
+                continue
+            _LOG.warning("KV chain for %r unadopted after %.0fs — "
+                         "re-prefilling", rid, deadline)
+            dh = self._handles.get(route["d"])
+            if dh is not None and dh.alive():
+                try:  # best-effort: free the chain if it did land
+                    dh.request({"cmd": "cancel", "rid": rid}, timeout=5.0)
+                except (OSError, TimeoutError, RuntimeError):
+                    pass
+            if self._m is not None:
+                self._m.migration("aborted")
+            self._reprefill(rid)
+            moved += 1
+        return moved
+
+    @property
+    def has_work(self):
+        return bool(self._users)
+
+    def run(self, stall_timeout=120.0):
+        last_progress = time.monotonic()
+        while self.has_work:
+            before = self._n_events + len(self._finished)
+            self.step()
+            if self._n_events + len(self._finished) != before:
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > stall_timeout:
+                raise RuntimeError(
+                    f"fleet made no progress for {stall_timeout:.0f}s "
+                    f"with {len(self._users)} request(s) outstanding")
+            else:
+                _backoff_sleep(0.003)
+        return self._finished
+
+    def drain(self):
+        self.run()
+        return {r.rid: r.status for r in self._finished}
+
+    def cancel(self, rid):
+        wire = self._active.get(rid, rid)
+        route = self._route.get(wire)
+        if route is None or route["state"] == "done":
+            return False
+        target = route["p"] if route["state"] == "prefill" else route["d"]
+        h = self._handles.get(target)
+        found = False
+        if h is not None and h.alive():
+            try:
+                found = bool(h.request({"cmd": "cancel", "rid": wire},
+                                       timeout=10.0).get("found"))
+            except (ConnectionError, TimeoutError):
+                pass
+        self._finalize(wire, "cancelled")
+        return found
+
+    # ---------------------------------------------------------------- stats
+    def stats(self):
+        out = {"inflight": len(self._users),
+               "finished": len(self._finished),
+               "orphan_reprefills": sum(self._attempt.values()),
+               "workers_dead": sum(1 for h in self._handles.values()
+                                   if h.dead),
+               "workers": {}}
+        for h in self._handles.values():
+            if not h.alive():
+                out["workers"][h.name] = {"dead": True}
+                continue
+            try:
+                out["workers"][h.name] = h.request(
+                    {"cmd": "stats"}, timeout=30.0)["stats"]
+            except (ConnectionError, TimeoutError):
+                out["workers"][h.name] = {"dead": True}
+        return out
+
+    def queue_depth(self):
+        return sum(1 for r in self._route.values()
+                   if r["state"] in ("prefill", "handoff"))
+
+    # ---------------------------------------------------------------- close
+    def close(self, drain_timeout=None):
+        timeout = (self._cfg.drain_timeout_s
+                   if drain_timeout is None else drain_timeout)
+        for h in self._handles.values():
+            if h.alive():
+                try:
+                    h.request({"cmd": "close"}, timeout=5.0)
+                except (ConnectionError, TimeoutError):
+                    pass
+        # grace: a closing worker drains its residents and exits on its
+        # own; SIGTERM is for stragglers, SIGKILL for the truly stuck
+        deadline = time.monotonic() + timeout
+        pending = [h for h in self._handles.values()
+                   if h.proc.poll() is None]
+        while pending and time.monotonic() < deadline - timeout / 2:
+            pending = [h for h in pending if h.proc.poll() is None]
+            if pending:
+                time.sleep(0.02)
+        for h in pending:
+            try:
+                h.proc.terminate()
+            except OSError:
+                pass
+        for h in self._handles.values():
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                h.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                _LOG.warning("worker %s ignored SIGTERM; killing", h.name)
+                h.kill()
+                h.proc.wait(timeout=5.0)
+            h.close_sock()
+        for rid in list(self._users):
+            self._finalize(rid, "cancelled")
+        return {r.rid: r.status for r in self._finished}
+
+
+class Fleet:
+    """A running deployment: the config, the worker handles, and the
+    coordinator.  Context-manager friendly; ``close()`` is the graceful
+    drain."""
+
+    def __init__(self, config, coordinator, handles, workdir,
+                 own_workdir):
+        self.config = config
+        self.coordinator = coordinator
+        self.handles = handles
+        self.workdir = workdir
+        self._own_workdir = own_workdir
+        coordinator._fleet = self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def respawn(self, name):
+        """Spawn a replacement process for a dead worker under the same
+        name (its control path and KV endpoint are reused)."""
+        role = "prefill" if name.startswith("prefill") else "decode"
+        idx = int(name[len(role):])
+        # Unlink the corpse's socket paths before spawning: a SIGKILLed
+        # worker's listeners can linger for a few ms and accept a connect
+        # into their doomed backlog.  Once the names are gone, connects
+        # fail fast until the replacement binds fresh inodes.
+        for stale in (os.path.join(self.workdir, f"{name}.ctl"),
+                      self.config.kv_endpoint(name, self.workdir)):
+            if stale.startswith("unix:"):
+                stale = stale[len("unix:"):]
+            if os.path.sep in stale:
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+        proc, log_path = _spawn_worker(
+            self.workdir, role, idx, platform=self.config.platform,
+            devices_per_worker=self.config.devices_per_worker)
+        handle = _connect_worker(self.config, name, role, proc, log_path,
+                                 self.workdir)
+        self.handles[name] = handle
+        return handle
+
+    def close(self):
+        statuses = self.coordinator.close()
+        if self._own_workdir:
+            import shutil
+            shutil.rmtree(self.workdir, ignore_errors=True)
+        return statuses
+
+
+def _tail(log_path, n=30):
+    try:
+        with open(log_path, errors="replace") as f:
+            return "".join(f.readlines()[-n:])
+    except OSError:
+        return "<no log>"
+
+
+def _spawn_worker(workdir, role, idx, platform="cpu",
+                  devices_per_worker=1):
+    log_path = os.path.join(workdir, f"{role}{idx}.log")
+    env = dict(os.environ)
+    # the platform/device shape must be pinned BEFORE the child's
+    # imports can initialize a jax backend — env is the only channel
+    # that beats `python -m`'s package import
+    env["JAX_PLATFORMS"] = platform
+    if platform == "cpu" and devices_per_worker > 1:
+        env["JAX_NUM_CPU_DEVICES"] = str(devices_per_worker)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.worker",
+         os.path.join(workdir, "fleet.json"), role, str(idx)],
+        stdout=logf, stderr=subprocess.STDOUT, env=env, cwd=repo)
+    logf.close()
+    return proc, log_path
+
+
+def _connect_worker(config, name, role, proc, log_path, workdir):
+    """Connect to a spawned worker's control socket and wait for its
+    ``ready`` event; raises with the worker's log tail on failure."""
+    ctl_path = os.path.join(workdir, f"{name}.ctl")
+    deadline = time.monotonic() + config.ready_timeout_s
+    while True:
+        sock = None
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {name} exited rc={proc.returncode} "
+                    f"during bringup; log tail:\n" + _tail(log_path))
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                sock.connect(ctl_path)
+                break
+            except (FileNotFoundError, ConnectionRefusedError):
+                sock.close()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet worker {name} never bound its control "
+                        f"socket within {config.ready_timeout_s:.0f}s; "
+                        f"log tail:\n" + _tail(log_path))
+                time.sleep(0.05)
+        handle = RemoteWorkerHandle(name, role, proc, sock, log_path)
+        while handle.ready_info is None:
+            if handle._reader.eof and proc.poll() is None:
+                # Connected to a predecessor's dying listener (its socket
+                # accepts for a few ms after SIGKILL) — the replacement
+                # process is alive, so reconnect to its fresh socket.
+                sock.close()
+                handle = None
+                break
+            if not handle.alive():
+                raise RuntimeError(
+                    f"fleet worker {name} died before ready "
+                    f"(rc={proc.returncode}); log tail:\n"
+                    + handle.log_tail())
+            if time.monotonic() > deadline:
+                handle.kill()
+                raise RuntimeError(
+                    f"fleet worker {name} never sent ready within "
+                    f"{config.ready_timeout_s:.0f}s; log tail:\n"
+                    + handle.log_tail())
+            handle._pump()
+            time.sleep(0.02)
+        if handle is not None:
+            return handle
+
+
+def launch(config, registry=None, instrument=True, faults=None):
+    """Validate ``config``, spawn the fleet, gate on readiness, return a
+    ``Fleet``.  Any bringup failure kills every spawned process and
+    raises with the offender's log tail."""
+    config.validate()
+    own_workdir = config.workdir is None
+    workdir = config.workdir or tempfile.mkdtemp(prefix="ptfleet-")
+    os.makedirs(workdir, exist_ok=True)
+
+    names = config.worker_names()
+    cfg_blob = config.to_dict()
+    cfg_blob["endpoints"] = {
+        n: config.kv_endpoint(n, workdir)
+        for n in names if n.startswith("decode")}
+    cfg_blob["control"] = {
+        n: os.path.join(workdir, f"{n}.ctl") for n in names}
+    for pth in cfg_blob["control"].values():
+        if len(pth) > _UDS_PATH_MAX:
+            raise ValueError(
+                f"control socket path {pth!r} exceeds the "
+                f"{_UDS_PATH_MAX}-char sun_path limit")
+    with open(os.path.join(workdir, "fleet.json"), "w") as f:
+        json.dump(cfg_blob, f, indent=2, sort_keys=True)
+
+    procs = []
+    handles = {}
+    try:
+        for name in names:
+            role = "prefill" if name.startswith("prefill") else "decode"
+            idx = int(name[len(role):])
+            proc, log_path = _spawn_worker(
+                workdir, role, idx, platform=config.platform,
+                devices_per_worker=config.devices_per_worker)
+            procs.append((name, role, proc, log_path))
+        for name, role, proc, log_path in procs:
+            handles[name] = _connect_worker(config, name, role, proc,
+                                            log_path, workdir)
+    except Exception:
+        for _, _, proc, _ in procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        if own_workdir:
+            import shutil
+            shutil.rmtree(workdir, ignore_errors=True)
+        raise
+
+    coord = FleetCoordinator(config, handles.values(), registry=registry,
+                             instrument=instrument, faults=faults)
+    return Fleet(config, coord, handles, workdir, own_workdir)

@@ -383,6 +383,19 @@ class DisaggMetrics:
             "serving_decode_worker_backlog",
             "requests resident across the decode workers plus "
             "migrations awaiting adoption", L).labels(**lbl)
+        self.worker_restarts = reg.counter(
+            "serving_worker_restarts_total",
+            "worker processes respawned after a death was detected "
+            "(fleet launcher / FaultPlan worker_kill)", L).labels(**lbl)
+        self.orphan_reprefills = reg.counter(
+            "serving_orphan_reprefills_total",
+            "requests orphaned by a decode-worker death and resumed as "
+            "a suffix prefill (prompt + emitted tokens)", L).labels(**lbl)
+        self.overlap_stall = reg.histogram(
+            "serving_kv_transfer_overlap_stall_seconds",
+            "time a migration spent holding up an available decode slot "
+            "because its chain bytes were still on the wire (0 = the "
+            "transfer fully overlapped decode steps)", L).labels(**lbl)
         self._name = name
 
     def migration(self, outcome):

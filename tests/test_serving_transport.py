@@ -1,0 +1,462 @@
+"""Cross-process KV transport (serving/transport.py) + the in-process
+half of the fleet layer (serving/launch.py config validation, FaultPlan
+worker kills against the in-process DisaggCoordinator).
+
+The acceptance properties on the CPU mesh:
+
+* the wire codec round-trips every chain shape the pool can produce —
+  f32 and int8 ``(data, scale)`` leaves, with metadata — and its
+  analytic ``chain_wire_nbytes`` matches the encoded blob byte for
+  byte, so transfer accounting never drifts from reality;
+* corrupt/truncated wire bytes FAIL LOUDLY (``ValueError``), never
+  produce a silently wrong chain;
+* a ``SocketTransport`` loopback (a real UDS between sender and
+  receiver halves) delivers value-identical leaves, and a pool-geometry
+  mismatch is rejected at connect-time handshake, before any chain
+  moves;
+* the disaggregated coordinator over a socket is BYTE-IDENTICAL to the
+  colocated engine across greedy/spec x f32/int8 — same invariant the
+  in-process transports already prove, now over a wire;
+* PickleTransport is demoted to a deprecated fallback that routes
+  through the same codec (one serialization path, identical nbytes);
+* FaultPlan worker kills: a decode worker dying mid-stream loses no
+  request — orphans resume as suffix prefills byte-identically when a
+  survivor exists, and terminate cleanly (never hang) when none does.
+"""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.serving import (
+    DecodeWorker, DisaggCoordinator, FaultPlan, FleetConfig,
+    PickleTransport, PrefillWorker, Request, ServingEngine,
+    SocketTransport,
+)
+from paddle_tpu.serving.kv_cache import PagedKVCacheManager
+from paddle_tpu.serving.transport import (
+    chain_wire_nbytes, decode_chain, encode_chain, parse_endpoint,
+    pool_spec,
+)
+
+GEOM = dict(batch_size=3, max_len=128, decode_chunk=16, prefill_chunk=16,
+            instrument=False, recorder=False, kv_block=16,
+            max_live_tokens=3 * 128)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _prompts(rng, sizes):
+    return [rng.integers(1, 2000, size=int(s)).astype(np.int32)
+            for s in sizes]
+
+
+def _mgr(**kw):
+    d = dict(n_layers=2, batch_size=2, max_len=32, num_kv_heads=1,
+             head_dim=4, dtype="float32", block=8, max_live_tokens=64)
+    d.update(kw)
+    return PagedKVCacheManager(**d)
+
+
+def _chain_leaves(n_blocks=3, quantized=False, seed=0):
+    """Synthetic export_chain-shaped leaves: per layer (k, v), each a
+    ``[n_blocks, C, Hkv, D]`` array or an int8 ``(data, scale)`` pair."""
+    rng = np.random.default_rng(seed)
+
+    def leaf():
+        if quantized:
+            data = rng.integers(-127, 128, size=(n_blocks, 8, 1, 4),
+                                dtype=np.int8)
+            scale = rng.standard_normal(
+                (n_blocks, 8, 1, 1)).astype(np.float32)
+            return data, scale
+        return rng.standard_normal((n_blocks, 8, 1, 4)).astype(np.float32)
+
+    return [(leaf(), leaf()) for _ in range(2)]
+
+
+def _assert_leaves_equal(a, b):
+    assert len(a) == len(b)
+    for (ka, va), (kb, vb) in zip(a, b):
+        for la, lb in ((ka, kb), (va, vb)):
+            if isinstance(la, tuple):
+                np.testing.assert_array_equal(np.asarray(la[0]),
+                                              np.asarray(lb[0]))
+                np.testing.assert_array_equal(np.asarray(la[1]),
+                                              np.asarray(lb[1]))
+            else:
+                np.testing.assert_array_equal(np.asarray(la),
+                                              np.asarray(lb))
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+class TestWireCodec:
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_roundtrip(self, quantized):
+        leaves = _chain_leaves(quantized=quantized)
+        meta = {"prompt": [1, 2, 3], "max_new": 8, "first": 42}
+        blob = encode_chain("r1", leaves, meta=meta)
+        rid, got, gmeta = decode_chain(blob)
+        assert rid == "r1"
+        assert gmeta == meta
+        _assert_leaves_equal(leaves, got)
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("chunk", [64, 1 << 20])
+    def test_nbytes_is_exact(self, quantized, chunk):
+        leaves = _chain_leaves(quantized=quantized)
+        meta = {"first": 7}
+        blob = encode_chain(5, leaves, meta=meta, chunk=chunk)
+        assert len(blob) == chain_wire_nbytes(5, leaves, meta=meta,
+                                              chunk=chunk)
+
+    def test_small_chunk_roundtrip(self):
+        # chunking is a wire detail: a 64-byte chunk stream reassembles
+        # to the same chain as one giant frame
+        leaves = _chain_leaves()
+        a = decode_chain(encode_chain("r", leaves, chunk=64))
+        b = decode_chain(encode_chain("r", leaves, chunk=1 << 20))
+        assert a[0] == b[0]
+        _assert_leaves_equal(a[1], b[1])
+
+    def test_truncation_raises(self):
+        blob = encode_chain("r", _chain_leaves())
+        # cut inside the header, inside data frames, and before the
+        # trailer: every prefix must fail loudly
+        for frac in (0.1, 0.5, 0.9, 0.999):
+            cut = max(1, int(len(blob) * frac))
+            with pytest.raises(ValueError):
+                decode_chain(blob[:cut])
+
+    def test_trailing_garbage_raises(self):
+        blob = encode_chain("r", _chain_leaves())
+        with pytest.raises(ValueError):
+            decode_chain(blob + b"\x00\x00\x00\x01X")
+
+    def test_not_a_chain_raises(self):
+        with pytest.raises(ValueError):
+            decode_chain(b"definitely not frames")
+
+    def test_parse_endpoint(self):
+        assert parse_endpoint("unix:/tmp/x.sock") == ("unix",
+                                                      "/tmp/x.sock")
+        assert parse_endpoint("tcp:127.0.0.1:5501") == \
+            ("tcp", ("127.0.0.1", 5501))
+        with pytest.raises(ValueError):
+            parse_endpoint("carrier-pigeon:coop7")
+
+
+# ---------------------------------------------------------------------------
+# socket loopback + handshake
+# ---------------------------------------------------------------------------
+
+class TestSocketTransport:
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_loopback_value_identity(self, tmp_path, quantized):
+        mgr = _mgr(dtype="int8" if quantized else "float32")
+        t = SocketTransport.loopback(pool_spec(mgr), dir=str(tmp_path))
+        try:
+            leaves = _chain_leaves(quantized=quantized)
+            handle, nbytes = t.send("r9", leaves,
+                                    meta={"first": 3})
+            assert handle == "r9"
+            assert nbytes == chain_wire_nbytes("r9", leaves,
+                                               meta={"first": 3})
+            got = t.recv(handle, timeout=20.0)
+            _assert_leaves_equal(leaves, got)
+            st = t.stats()
+            assert st["sent_chains"] == 1
+            assert st["recv_chains"] == 1
+            # sent/recv count raw chain payload; the framed wire size
+            # (what send() returns) adds header/trailer overhead on top
+            assert st["recv_bytes"] == st["sent_bytes"]
+            assert 0 < st["recv_bytes"] < nbytes
+        finally:
+            t.close()
+
+    def test_kv_transfer_recv_drains_with_meta(self, tmp_path):
+        mgr = _mgr()
+        t = SocketTransport.loopback(pool_spec(mgr), dir=str(tmp_path))
+        try:
+            leaves = _chain_leaves()
+            t.send("a", leaves, meta={"first": 1})
+            t.send("b", leaves, meta={"first": 2})
+            t.flush(timeout=20.0)
+            deadline = 200
+            entries = []
+            while len(entries) < 2 and deadline:
+                entries.extend(t.kv_transfer_recv())
+                deadline -= 1
+            assert [e["rid"] for e in entries] == ["a", "b"]
+            assert [e["meta"]["first"] for e in entries] == [1, 2]
+            assert all(e["t_done"] >= e["t_begin"] for e in entries)
+        finally:
+            t.close()
+
+    def test_handshake_rejects_pool_mismatch(self, tmp_path):
+        spec = pool_spec(_mgr())
+        path = os.path.join(str(tmp_path), "kv.sock")
+        rx = SocketTransport.listen(f"unix:{path}", spec)
+        try:
+            bad = dict(spec, block=32)
+            with pytest.raises(ValueError, match="block"):
+                SocketTransport.connect(f"unix:{path}", bad, timeout=5.0)
+            ok = SocketTransport.connect(f"unix:{path}", dict(spec),
+                                         timeout=5.0)
+            ok.close()
+        finally:
+            rx.close()
+
+    def test_send_only_and_recv_only_guards(self, tmp_path):
+        spec = pool_spec(_mgr())
+        path = os.path.join(str(tmp_path), "kv.sock")
+        rx = SocketTransport.listen(f"unix:{path}", spec)
+        tx = SocketTransport.connect(f"unix:{path}", spec, timeout=5.0)
+        try:
+            with pytest.raises(RuntimeError, match="cannot send"):
+                rx.send("r", _chain_leaves())
+            with pytest.raises(RuntimeError, match="cannot recv"):
+                tx.recv("r", timeout=0.1)
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_no_listener_times_out(self, tmp_path):
+        spec = pool_spec(_mgr())
+        with pytest.raises(TimeoutError, match="no listener"):
+            SocketTransport.connect(
+                f"unix:{tmp_path}/nobody.sock", spec, timeout=0.3)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated coordinator over the socket
+# ---------------------------------------------------------------------------
+
+def _split(model, transport=None, pf=None, dw=None, faults=None, **kw):
+    cfg = dict(GEOM)
+    cfg.update(kw)
+    pcfg = dict(cfg)
+    pcfg.update(pf or {})
+    pcfg.pop("mode", None)
+    pcfg.pop("spec_k", None)
+    dcfg = dict(cfg)
+    dcfg.update(dw or {})
+    return DisaggCoordinator(PrefillWorker(model, **pcfg),
+                             DecodeWorker(model, **dcfg),
+                             transport=transport, instrument=False,
+                             faults=faults)
+
+
+def _colocated_reference(model, prompts, max_new=12, **kw):
+    cfg = dict(GEOM)
+    cfg.update(kw)
+    eng = ServingEngine(model, **cfg)
+    reqs = [eng.submit(Request(p, max_new)) for p in prompts]
+    eng.run()
+    eng.close()
+    return [list(r.output_ids) for r in reqs]
+
+
+class TestDisaggOverSocket:
+    @pytest.mark.parametrize("mode", ["greedy", "spec"])
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_matches_colocated(self, tmp_path, mode, kv_dtype):
+        model = _tiny_model()
+        rng = np.random.default_rng(3)
+        prompts = _prompts(rng, [21, 37, 9, 50])
+        extra = dict(kv_dtype=kv_dtype)
+        if mode == "spec":
+            extra.update(mode="spec", spec_k=2)
+        ref = _colocated_reference(model, prompts, **extra)
+
+        pcfg = dict(GEOM, kv_dtype=kv_dtype)
+        dcfg = dict(GEOM)
+        dcfg.update(extra)
+        pw = PrefillWorker(model, **pcfg)
+        dw = DecodeWorker(model, **dcfg)
+        kvx = SocketTransport.loopback(pool_spec(dw.engine.kv_manager),
+                                       dir=str(tmp_path))
+        coord = DisaggCoordinator(pw, dw, transport=kvx,
+                                  instrument=False)
+        got = [coord.submit(Request(p, 12)) for p in prompts]
+        coord.run()
+        coord.close()
+        assert [list(r.output_ids) for r in got] == ref
+        assert all(r.status == "done" for r in got)
+        assert kvx.stats()["sent_chains"] >= len(prompts)
+
+    def test_transfer_never_blocks_step_loop(self, tmp_path):
+        # the enqueue path must return before the bytes move: send N
+        # chains back to back and only then ask the receiver for them
+        mgr = _mgr()
+        t = SocketTransport.loopback(pool_spec(mgr), dir=str(tmp_path),
+                                     chunk=256)
+        try:
+            leaves = _chain_leaves(n_blocks=4)
+            handles = [t.send(i, leaves)[0] for i in range(6)]
+            for h in handles:
+                _assert_leaves_equal(leaves, t.recv(h, timeout=20.0))
+        finally:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# PickleTransport: deprecated fallback through the same codec
+# ---------------------------------------------------------------------------
+
+class TestPickleFallback:
+    def test_routes_through_wire_codec(self):
+        leaves = _chain_leaves()
+        t = PickleTransport()
+        handle, nbytes = t.send("r2", leaves)
+        assert isinstance(handle, bytes)
+        assert nbytes == len(handle)
+        assert nbytes == chain_wire_nbytes("r2", leaves)
+        _assert_leaves_equal(leaves, t.recv(handle))
+
+    def test_deprecation_logged_once(self, caplog):
+        PickleTransport._warned = False
+        t = PickleTransport()
+        with caplog.at_level(logging.WARNING,
+                             logger="paddle_tpu.serving.disagg"):
+            t.send("a", _chain_leaves())
+            t.send("b", _chain_leaves())
+        hits = [r for r in caplog.records if "deprecated" in r.message]
+        assert len(hits) == 1
+        assert "SocketTransport" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# fleet config validation (no processes spawned)
+# ---------------------------------------------------------------------------
+
+class TestFleetConfigValidation:
+    def _ok(self, **kw):
+        d = dict(engine=dict(GEOM))
+        d.update(kw)
+        return FleetConfig(**d)
+
+    def test_valid_passes_and_roundtrips(self):
+        cfg = self._ok().validate()
+        clone = FleetConfig.from_dict(cfg.to_dict()).validate()
+        assert clone.to_dict() == cfg.to_dict()
+        assert cfg.worker_names() == ["prefill0", "decode0"]
+
+    def test_errors_are_aggregated(self):
+        bad = FleetConfig(engine={"batch_size": 0, "max_len": 100,
+                                  "kv_block": 16},
+                          n_prefill=0, platform="abacus",
+                          transport="tcp", base_port=0)
+        with pytest.raises(ValueError) as ei:
+            bad.validate()
+        msg = str(ei.value)
+        for frag in ("n_prefill", "batch_size", "multiple",
+                     "platform", "base_port"):
+            assert frag in msg
+
+    def test_kv_block_is_required(self):
+        with pytest.raises(ValueError, match="kv_block"):
+            FleetConfig(engine={"batch_size": 2,
+                                "max_len": 128}).validate()
+
+    def test_spec_needs_k(self):
+        with pytest.raises(ValueError, match="spec_k"):
+            self._ok(decode={"mode": "spec"}).validate()
+
+    def test_model_whitelist(self):
+        with pytest.raises(ValueError, match="unsupported model"):
+            self._ok(model={"kind": "gpt", "preset": "xl"}).validate()
+
+    def test_uds_path_limit(self):
+        with pytest.raises(ValueError, match="sun_path"):
+            self._ok(workdir="/tmp/" + "x" * 120).validate()
+
+    def test_adoption_timeout_positive(self):
+        with pytest.raises(ValueError, match="adoption_timeout"):
+            self._ok(adoption_timeout_s=0).validate()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan worker kills (in-process coordinator)
+# ---------------------------------------------------------------------------
+
+class TestWorkerKill:
+    def test_orphans_resume_byte_identically(self, tmp_path):
+        # 1 prefill + 2 decode workers; kill one decode mid-stream: its
+        # orphans re-prefill (prompt + emitted tokens) onto the survivor
+        # and every stream matches the colocated engine byte for byte
+        model = _tiny_model()
+        rng = np.random.default_rng(7)
+        prompts = _prompts(rng, [21, 37, 9, 28, 45])
+        ref = _colocated_reference(model, prompts, max_new=16)
+
+        pf = PrefillWorker(model, **{k: v for k, v in GEOM.items()})
+        d0 = DecodeWorker(model, name="d0", **GEOM)
+        d1 = DecodeWorker(model, name="d1", **GEOM)
+        reg = MetricsRegistry()
+        fp = FaultPlan(worker_kill={8: "d0"})
+        coord = DisaggCoordinator(pf, [d0, d1], registry=reg,
+                                  faults=fp)
+        got = [coord.submit(Request(p, 16)) for p in prompts]
+        coord.run()
+        coord.close()
+        assert [list(r.output_ids) for r in got] == ref
+        assert all(r.status == "done" for r in got)
+        st = coord.stats()
+        assert st["workers_dead"] == 1
+        assert st["orphan_reprefills"] >= 1
+        assert fp.stats["worker_kills"] == 1
+        prom = reg.to_prometheus()
+        assert "serving_orphan_reprefills_total" in prom
+        assert "serving_worker_restarts_total" in prom
+
+    def test_no_survivor_terminates_cleanly(self):
+        # the only decode worker dies: every stream must reach a clean
+        # terminal status and run() must RETURN — never hang
+        model = _tiny_model()
+        rng = np.random.default_rng(11)
+        prompts = _prompts(rng, [21, 37])
+        pf = PrefillWorker(model, **GEOM)
+        d0 = DecodeWorker(model, name="d0", **GEOM)
+        fp = FaultPlan(worker_kill={6: "d0"})
+        coord = DisaggCoordinator(pf, [d0], instrument=False, faults=fp)
+        got = [coord.submit(Request(p, 16)) for p in prompts]
+        coord.run()
+        coord.close()
+        assert all(r.done for r in got)
+        assert all(r.status in ("done", "cancelled") for r in got)
+        # submits after total decode loss are refused, not queued forever
+        with pytest.raises(ValueError, match="live"):
+            coord.submit(Request(prompts[0], 4))
+
+    def test_prefill_death_resubmits_shadow(self):
+        # kill a prefill worker while its shadows are queued: they move
+        # to a surviving prefill worker and complete byte-identically
+        model = _tiny_model()
+        rng = np.random.default_rng(13)
+        prompts = _prompts(rng, [21, 37, 9])
+        ref = _colocated_reference(model, prompts, max_new=12)
+        p0 = PrefillWorker(model, name="p0", **GEOM)
+        p1 = PrefillWorker(model, name="p1", **GEOM)
+        d0 = DecodeWorker(model, name="d0", **GEOM)
+        fp = FaultPlan(worker_kill={1: "p0"})
+        coord = DisaggCoordinator([p0, p1], [d0], instrument=False,
+                                  faults=fp)
+        got = [coord.submit(Request(p, 12)) for p in prompts]
+        coord.run()
+        coord.close()
+        assert [list(r.output_ids) for r in got] == ref
+        assert all(r.status == "done" for r in got)

@@ -1697,6 +1697,35 @@ class TestPTL017:
         """)
         assert lint_source(src, path="m.py") == []
 
+    def test_kv_transfer_send_recv_sanctioned_tn(self):
+        # the SocketTransport seam (serving/transport.py): the worker
+        # pump calls kv_transfer_recv / the background streamer calls
+        # kv_transfer_send inside loops that also dispatch — both ride
+        # the sanctioned-name list
+        src = textwrap.dedent("""
+            def pump(kvx, params, reqs, caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    for entry in kvx.kv_transfer_recv():
+                        caches.append(entry)
+                    kvx.kv_transfer_send(r.rid, caches)
+        """)
+        assert lint_source(src, path="m.py") == []
+
+    def test_aliased_socket_recv_not_sanctioned_tp(self):
+        # resolved-name semantics again: importing a raw transfer as
+        # `kv_transfer_recv` does not launder it — the tail of the
+        # RESOLVED name (device_get) is what the sanction list sees
+        src = textwrap.dedent("""
+            from jax import device_get as kv_transfer_recv
+
+            def drive(reqs, params, caches):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    host = kv_transfer_recv(caches)
+        """)
+        assert "PTL017" in [f.rule for f in lint_source(src, path="m.py")]
+
 
 # ---------------------------------------------------------------------------
 # SARIF 2.1.0 reporter
