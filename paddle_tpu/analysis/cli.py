@@ -37,7 +37,8 @@ def _build_parser():
     p.add_argument("--fix", action="store_true",
                    help="apply the registered mechanical fixits (PTL006 "
                         "mutable default -> None sentinel, PTL007 bare "
-                        "except -> except Exception) in place, then lint "
+                        "except -> except Exception, PTL020 leaked "
+                        "thread -> daemon=True) in place, then lint "
                         "the fixed tree")
     p.add_argument("--dry-run", action="store_true",
                    help="with --fix: print the unified diff instead of "

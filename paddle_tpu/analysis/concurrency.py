@@ -1,0 +1,847 @@
+"""tpu-lint v3 concurrency pass (stdlib only).
+
+PR 17 made the serving stack genuinely concurrent — a background
+chunk-streaming sender thread in the socket transport, per-worker
+control/heartbeat threads, the fleet spawn monitor — and this module
+gives the linter the matching vocabulary.  Four rules, built on a
+**lock-acquisition graph** layered over the same call-graph resolution
+the v2 dataflow pass uses:
+
+* **PTL018 lock-order-inversion** — per-function lock facts record every
+  acquisition (``with lock:`` items in order, ``.acquire()``/
+  ``.release()`` spans) together with the locks already held, plus every
+  resolvable call made under a lock.  The project join closes the call
+  graph (locks passed as arguments substitute into the callee), builds
+  the ordered-pair graph, and reports any pair acquired in both orders —
+  with BOTH witness chains in the message.
+* **PTL019 blocking-call-under-lock** — host fetch/device sync,
+  ``time.sleep``, blocking socket ops, ``queue.Queue`` get/put without a
+  timeout, and ``.join()`` while any lock is held, directly or through
+  resolved callees (witness chain printed).  ``Condition.wait`` is the
+  sanctioned handoff — it releases the lock — and never fires.
+* **PTL020 thread-lifecycle** — a non-daemon ``threading.Thread``
+  started but never joined anywhere in its owning scope (interpreter
+  exit hangs on it), or any ``.start()`` inside a step-dispatch loop
+  (thread-per-step).  The first shape has a mechanical ``--fix``:
+  add ``daemon=True`` to the constructor.
+* **PTL021 unbounded-queue-in-step-loop** — a ``queue.Queue()`` with no
+  ``maxsize`` fed (``.put``) from a loop that also dispatches compiled
+  steps: no backpressure, unbounded host growth.
+
+Everything per-module is extracted into picklable :class:`FuncLocks`
+facts (the PTL014 pattern) so ``--jobs`` workers stay AST-free across
+the process boundary; the PTL018/PTL019 join runs in the parent and is
+byte-identical serial or parallel.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from paddle_tpu.analysis.linter import (
+    Finding, _ASYNC_SOCKET_METHODS, _Checker, _SYNC_HELPERS, _SYNC_NP,
+    _call_name, _dotted, _is_step_name, _suppressed,
+)
+
+__all__ = ["FuncLocks", "collect_lock_facts", "check_concurrency",
+           "check_thread_lifecycle", "check_queue_discipline",
+           "thread_daemon_fix_edits"]
+
+# threading constructors whose result is a lock for ordering purposes.
+# Condition matters most: the transport's `self._cv` guards the sender
+# queue and PTL015's name heuristic never saw it.
+_LOCK_CTOR_LAST = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_QUEUE_CTOR_LAST = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+# lockish spellings accepted for attributes/locals that are USED as
+# locks (`with self._cv:`) without a visible constructor in scope
+_LOCKISH_RE = re.compile(r"(lock|mutex|cv|cond|condition|sem|semaphore)$",
+                         re.IGNORECASE)
+# blocking socket methods under a lock: the v2 async catalog plus
+# connect (same host-blocking shape outside async bodies)
+_BLOCKING_SOCKET_METHODS = _ASYNC_SOCKET_METHODS | {"connect"}
+# device-sync methods that block the host until the device flushes
+_SYNC_ATTRS = {"block_until_ready", "item", "numpy"}
+# interprocedural closure caps — far above any real chain
+_MAX_DEPTH = 6
+
+
+def _is_ctor(node, resolve, last_set):
+    if not isinstance(node, ast.Call):
+        return False
+    f = resolve(_dotted(node.func))
+    if f is None:
+        return False
+    last = f.split(".")[-1]
+    head = f.split(".")[0]
+    return last in last_set and head in ("threading", "queue", last)
+
+
+# --------------------------------------------------------------------------
+# picklable per-module lock facts
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FuncLocks:
+    """Lock-relevant events of one function/method body.
+
+    Tokens are scope-local spellings canonicalized at join time:
+    ``self.X`` (instance attr), ``g:N`` (module-global lock), ``l:N``
+    (function-local constructor), ``p:N`` (parameter — a lock only when
+    lockish-named or substituted from a call site's argument).
+    """
+    module: str
+    path: str
+    cls: str          # owning class name, "" for top-level
+    name: str
+    params: tuple     # parameter names in order (incl. self/cls)
+    acquires: tuple   # (held_tokens, token, line, col)
+    blocks: tuple     # (held_tokens, label, line, col)
+    calls: tuple      # (held_tokens, desc, lock_args, line, col)
+    #   desc: ("name", n) | ("method", n) | ("dotted", canonical)
+    #   lock_args: ((pos_index | kwarg_name, caller_token), ...)
+
+
+def _class_lock_attrs(cls_node, resolve):
+    """Instance attributes of ``cls_node`` assigned a lock constructor."""
+    attrs = set()
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_ctor(node.value, resolve, _LOCK_CTOR_LAST):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                attrs.add(t.attr)
+    return attrs
+
+
+def _module_lock_names(tree, resolve):
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                _is_ctor(node.value, resolve, _LOCK_CTOR_LAST):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+class _FnScan:
+    """One pass over a function body collecting lock facts and queue
+    blocking ops.  Nested defs are skipped — their events belong to the
+    nested function's own facts."""
+
+    def __init__(self, ma, module, fdef, cls_name, ctor_attrs,
+                 global_locks, queue_tokens):
+        self.ma = ma
+        self.module = module
+        self.resolve = ma.collector.aliases.resolve
+        self.fdef = fdef
+        self.cls = cls_name
+        self.ctor_attrs = ctor_attrs      # class lock attrs by ctor
+        self.global_locks = global_locks  # module-level lock names
+        self.queues = queue_tokens        # token -> (bounded, line)
+        a = fdef.args
+        self.params = tuple(p.arg for p in
+                            list(a.posonlyargs) + list(a.args))
+        self.local_locks = set()
+        self.local_alias = {}             # local name -> token
+        self.acquires, self.blocks, self.calls = [], [], []
+        self._prepass()
+
+    # -- token model --------------------------------------------------
+
+    def _prepass(self):
+        """Local lock constructors and aliases (``lk = self._lock``)."""
+        for node in ast.walk(self.fdef):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            if _is_ctor(node.value, self.resolve, _LOCK_CTOR_LAST):
+                self.local_locks.add(t.id)
+            else:
+                tok = self._token(node.value, aliasing=True)
+                if tok is not None:
+                    self.local_alias[t.id] = tok
+
+    def _token(self, node, aliasing=False):
+        """Lock token for an expression, or None."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            if node.attr in self.ctor_attrs or \
+                    _LOCKISH_RE.search(node.attr):
+                return "self." + node.attr
+            return None
+        if isinstance(node, ast.Name):
+            n = node.id
+            if n in self.local_locks:
+                return "l:" + n
+            if not aliasing and n in self.local_alias:
+                return self.local_alias[n]
+            if n in self.global_locks:
+                return "g:" + n
+            if n in self.params:
+                return "p:" + n
+            # a lock imported from another project module: canonical
+            # identity lives with the DEFINING module, so both sides of
+            # a cross-module inversion meet on one node.  Gated on a
+            # lockish name — an arbitrary imported object is not a lock.
+            target = self.ma.collector.aliases.map.get(n)
+            if target is not None and "." in target and \
+                    target.split(".")[0] not in _Checker._EXTERNAL_ROOTS \
+                    and (_LOCKISH_RE.search(n) or
+                         _LOCKISH_RE.search(target.rsplit(".", 1)[1])):
+                return "i:" + target
+            if not aliasing and _LOCKISH_RE.search(n):
+                return "g:" + n
+        return None
+
+    def _queue_token(self, node):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            tok = "self." + node.attr
+        elif isinstance(node, ast.Name):
+            tok = node.id
+        else:
+            return None
+        return tok if tok in self.queues else None
+
+    # -- walk ----------------------------------------------------------
+
+    def run(self):
+        held = []
+        for child in ast.iter_child_nodes(self.fdef):
+            self._visit(child, held)
+        return FuncLocks(
+            module=self.module, path=self.ma.path, cls=self.cls,
+            name=self.fdef.name, params=self.params,
+            acquires=tuple(self.acquires), blocks=tuple(self.blocks),
+            calls=tuple(self.calls))
+
+    def _visit(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                ctx = item.context_expr
+                tok = self._token(ctx)
+                if tok is not None:
+                    self.acquires.append((tuple(held), tok,
+                                          ctx.lineno, ctx.col_offset))
+                    held.append(tok)
+                    pushed += 1
+                else:
+                    self._visit(ctx, held)
+            for st in node.body:
+                self._visit(st, held)
+            del held[len(held) - pushed:]
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _call(self, node, held):
+        cname = _call_name(node)
+        # explicit acquire()/release() spans on a known lock
+        if isinstance(node.func, ast.Attribute) and \
+                cname in ("acquire", "release"):
+            tok = self._token(node.func.value)
+            if tok is not None:
+                if cname == "acquire":
+                    self.acquires.append((tuple(held), tok,
+                                          node.lineno, node.col_offset))
+                    held.append(tok)
+                elif tok in held:
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i] == tok:
+                            del held[i]
+                            break
+                return
+        label = self._blocking_of(node, cname)
+        if label is not None:
+            self.blocks.append((tuple(held), label,
+                                node.lineno, node.col_offset))
+            return
+        desc = self._call_desc(node)
+        if desc is not None:
+            lock_args = []
+            for i, a in enumerate(node.args):
+                tok = self._token(a)
+                if tok is not None:
+                    lock_args.append((i, tok))
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                tok = self._token(kw.value)
+                if tok is not None:
+                    lock_args.append((kw.arg, tok))
+            self.calls.append((tuple(held), desc, tuple(lock_args),
+                               node.lineno, node.col_offset))
+
+    def _blocking_of(self, node, cname):
+        f = self.resolve(_dotted(node.func))
+        if f == "time.sleep":
+            return "time.sleep()"
+        if f in _SYNC_NP:
+            return "np." + f.split(".")[-1] + "()"
+        if f == "jax.device_get":
+            return "jax.device_get()"
+        if cname in _SYNC_HELPERS:
+            return cname + "()"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        attr = node.func.attr
+        if attr in _SYNC_ATTRS:
+            return "." + attr + "()"
+        if attr in _BLOCKING_SOCKET_METHODS:
+            return "." + attr + "()"
+        if attr == "join" and not node.args and \
+                not isinstance(node.func.value, ast.Constant):
+            return ".join()"
+        if attr in ("get", "put"):
+            qtok = self._queue_token(node.func.value)
+            if qtok is not None and self._queue_op_blocks(node, attr):
+                return f"queue {attr}() without timeout"
+        return None
+
+    @staticmethod
+    def _queue_op_blocks(node, attr):
+        """True when a queue get/put can block unboundedly: no timeout
+        and no ``block=False`` (positionally or by keyword)."""
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if "timeout" in kw:
+            return False
+        blk = kw.get("block")
+        pos = node.args[1:] if attr == "put" else node.args
+        if pos:
+            if len(pos) >= 2:
+                return False  # (block, timeout) both given
+            blk = blk or pos[0]
+        if isinstance(blk, ast.Constant) and blk.value is False:
+            return False
+        return True
+
+    def _call_desc(self, node):
+        """Resolvable callee description (mirrors the v2 call-event
+        resolution): bare local name, alias-resolved dotted import, or a
+        ``self.``/``cls.`` method of the same module."""
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            target = self.ma.collector.aliases.map.get(fn.id)
+            if target is not None and "." in target:
+                if target.split(".")[0] in _Checker._EXTERNAL_ROOTS:
+                    return None
+                return ("dotted", target)
+            if fn.id in self.ma.collector.defs_by_name:
+                return ("name", fn.id)
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and \
+                    fn.value.id in ("self", "cls") and \
+                    fn.attr in self.ma.collector.defs_by_name:
+                return ("method", fn.attr)
+            d = self.resolve(_dotted(fn))
+            if d is not None and "." in d and \
+                    d.split(".")[0] not in _Checker._EXTERNAL_ROOTS:
+                return ("dotted", d)
+        return None
+
+
+def _queue_tokens_for_scope(scope_body_funcs, resolve):
+    """token -> (bounded, ctor_line) for queue constructors assigned to
+    ``self.X`` or locals anywhere in the given function bodies."""
+    out = {}
+    for fdef in scope_body_funcs:
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            if not _is_ctor(node.value, resolve, _QUEUE_CTOR_LAST):
+                continue
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                tok = "self." + t.attr
+            elif isinstance(t, ast.Name):
+                tok = t.id
+            else:
+                continue
+            out[tok] = (_queue_bounded(node.value, resolve),
+                        node.value.lineno)
+    return out
+
+
+def _queue_bounded(call, resolve):
+    f = resolve(_dotted(call.func)) or ""
+    if f.split(".")[-1] == "SimpleQueue":
+        return False  # SimpleQueue cannot carry a maxsize
+    size = None
+    if call.args:
+        size = call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    if size is None:
+        return False
+    if isinstance(size, ast.Constant):
+        return bool(size.value)
+    return True  # non-literal bound: give the benefit of the doubt
+
+
+def _scopes(ma):
+    """(cls_name, ctor_attrs, queue_tokens, [method defs]) per class,
+    plus one entry for every non-method function."""
+    resolve = ma.collector.aliases.resolve
+    method_ids = set()
+    out = []
+    for cls in [n for n in ast.walk(ma.tree) if isinstance(n, ast.ClassDef)]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        method_ids.update(id(m) for m in methods)
+        out.append((cls.name, _class_lock_attrs(cls, resolve),
+                    _queue_tokens_for_scope(methods, resolve), methods))
+    free = [n for n in ast.walk(ma.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and id(n) not in method_ids]
+    for fdef in free:
+        out.append(("", set(), _queue_tokens_for_scope([fdef], resolve),
+                    [fdef]))
+    return out
+
+
+def collect_lock_facts(ma, module):
+    """Picklable :class:`FuncLocks` list for one module."""
+    resolve = ma.collector.aliases.resolve
+    global_locks = _module_lock_names(ma.tree, resolve)
+    facts = []
+    for cls_name, ctor_attrs, queues, fdefs in _scopes(ma):
+        for fdef in fdefs:
+            fl = _FnScan(ma, module, fdef, cls_name, ctor_attrs,
+                         global_locks, queues).run()
+            if fl.acquires or fl.blocks or fl.calls:
+                facts.append(fl)
+    return facts
+
+
+# --------------------------------------------------------------------------
+# the join: lock-order graph + blocking-under-lock closure
+# --------------------------------------------------------------------------
+
+def _display(fl):
+    return f"{fl.cls}.{fl.name}" if fl.cls else fl.name
+
+
+def _canon(fl, token, subst):
+    """Global lock identity ``module:display`` for a scope-local token,
+    or None when the token is not a lock in this instantiation (a
+    non-lockish parameter nobody passed a lock into)."""
+    if token in subst:
+        return subst[token]
+    if token.startswith("self."):
+        owner = fl.cls if fl.cls else _display(fl)
+        return f"{fl.module}:{owner}.{token[5:]}"
+    if token.startswith("g:"):
+        return f"{fl.module}:{token[2:]}"
+    if token.startswith("i:"):
+        mod, attr = token[2:].rsplit(".", 1)
+        return f"{mod}:{attr}"
+    if token.startswith("l:"):
+        return f"{fl.module}:{_display(fl)}.{token[2:]}"
+    if token.startswith("p:"):
+        name = token[2:]
+        if _LOCKISH_RE.search(name):
+            return f"{fl.module}:{_display(fl)}.{name}"
+        return None
+    return None
+
+
+def _lock_name(lock_id):
+    return lock_id.split(":", 1)[1]
+
+
+class _Join:
+    def __init__(self, all_funcs):
+        self.funcs = all_funcs
+        self.tops = {}      # (module, name) -> FuncLocks  (cls == "")
+        self.methods = {}   # (module, name) -> [FuncLocks] (cls != "")
+        for fl in all_funcs:
+            if fl.cls:
+                self.methods.setdefault((fl.module, fl.name),
+                                        []).append(fl)
+            else:
+                self.tops.setdefault((fl.module, fl.name), fl)
+
+    def resolve(self, caller, desc):
+        kind, val = desc
+        if kind == "name":
+            fl = self.tops.get((caller.module, val))
+            return [fl] if fl is not None else []
+        if kind == "method":
+            return list(self.methods.get((caller.module, val), ()))
+        mod, _, fn = val.rpartition(".")
+        fl = self.tops.get((mod, fn))
+        if fl is not None:
+            return [fl]
+        return list(self.methods.get((mod, fn), ()))
+
+    def _callee_subst(self, caller, csubst, callee, desc, lock_args):
+        offset = 1 if desc[0] == "method" else 0
+        subst = {}
+        for key, tok in lock_args:
+            lock = _canon(caller, tok, csubst)
+            if lock is None:
+                continue
+            if isinstance(key, int):
+                i = key + offset
+                if i < len(callee.params):
+                    subst["p:" + callee.params[i]] = lock
+            else:
+                subst["p:" + key] = lock
+        return subst
+
+    def acq_closure(self, fl, subst, depth, stack):
+        """Every lock this function may acquire (transitively):
+        (lock_id, path, line, chain)."""
+        out = []
+        for _held, tok, line, _col in fl.acquires:
+            lock = _canon(fl, tok, subst)
+            if lock is not None:
+                out.append((lock, fl.path, line, (_display(fl),)))
+        if depth >= _MAX_DEPTH:
+            return out
+        for _held, desc, lock_args, _line, _col in fl.calls:
+            for g in self.resolve(fl, desc):
+                key = (g.module, g.cls, g.name)
+                if key in stack:
+                    continue
+                gsub = self._callee_subst(fl, subst, g, desc, lock_args)
+                for lock, p, ln, ch in self.acq_closure(
+                        g, gsub, depth + 1, stack | {key}):
+                    out.append((lock, p, ln, (_display(fl),) + ch))
+        return out
+
+    def blk_closure(self, fl, subst, depth, stack):
+        """Every blocking call this function may reach (transitively):
+        (label, path, line, chain)."""
+        out = [(label, fl.path, line, (_display(fl),))
+               for _held, label, line, _col in fl.blocks]
+        if depth >= _MAX_DEPTH:
+            return out
+        for _held, desc, lock_args, _line, _col in fl.calls:
+            for g in self.resolve(fl, desc):
+                key = (g.module, g.cls, g.name)
+                if key in stack:
+                    continue
+                gsub = self._callee_subst(fl, subst, g, desc, lock_args)
+                for label, p, ln, ch in self.blk_closure(
+                        g, gsub, depth + 1, stack | {key}):
+                    out.append((label, p, ln, (_display(fl),) + ch))
+        return out
+
+
+def check_concurrency(all_facts, enabled_for, get_lines):
+    """PTL018 + PTL019 project join over per-module FuncLocks facts."""
+    funcs = sorted((fl for facts in all_facts for fl in facts.locks),
+                   key=lambda fl: (fl.path, fl.cls, fl.name))
+    if not funcs:
+        return []
+    join = _Join(funcs)
+    edges = {}     # (outer_id, inner_id) -> (path, line, chain)
+    findings = []
+    seen_blk = set()
+
+    def add_edge(outer, inner, path, line, chain):
+        if outer == inner:
+            return  # reentrant re-acquire — RLock territory, not ordering
+        cur = edges.get((outer, inner))
+        cand = (path, line, chain)
+        if cur is None or cand < cur:
+            edges[(outer, inner)] = cand
+
+    def emit_blk(fl, line, col, lock, label, where, chain):
+        key = (fl.path, line, label)
+        if key in seen_blk or "PTL019" not in enabled_for(fl.path):
+            return
+        seen_blk.add(key)
+        via = f" [via {' -> '.join(chain)}]" if len(chain) > 1 else ""
+        findings.append(Finding(
+            "PTL019", fl.path, line, col,
+            f"blocking `{label}`{where} while holding "
+            f"`{_lock_name(lock)}`{via} — every thread contending for "
+            "the lock stalls for the full blocking duration"))
+
+    for fl in funcs:
+        subst = {}
+        for held, tok, line, col in fl.acquires:
+            inner = _canon(fl, tok, subst)
+            if inner is None:
+                continue
+            for h in held:
+                outer = _canon(fl, h, subst)
+                if outer is not None:
+                    add_edge(outer, inner, fl.path, line, (_display(fl),))
+        for held, label, line, col in fl.blocks:
+            locks = [x for x in (_canon(fl, h, subst) for h in held)
+                     if x is not None]
+            if locks:
+                emit_blk(fl, line, col, locks[-1], label, "",
+                         (_display(fl),))
+        for held, desc, lock_args, line, col in fl.calls:
+            locks = [x for x in (_canon(fl, h, subst) for h in held)
+                     if x is not None]
+            if not locks:
+                continue
+            for g in join.resolve(fl, desc):
+                key = (g.module, g.cls, g.name)
+                gsub = join._callee_subst(fl, subst, g, desc, lock_args)
+                stack = {(fl.module, fl.cls, fl.name), key}
+                for lock, p, ln, ch in join.acq_closure(g, gsub, 1, stack):
+                    for outer in locks:
+                        add_edge(outer, lock, fl.path, line,
+                                 (_display(fl),) + ch)
+                for label, p, ln, ch in join.blk_closure(g, gsub, 1,
+                                                         stack):
+                    emit_blk(fl, line, col, locks[-1], label,
+                             f" (reached at {p}:{ln})",
+                             (_display(fl),) + ch)
+
+    done_pairs = set()
+    for (a, b), (path1, line1, chain1) in sorted(edges.items()):
+        rev = edges.get((b, a))
+        if rev is None:
+            continue
+        pair = (min(a, b), max(a, b))
+        if pair in done_pairs:
+            continue
+        done_pairs.add(pair)
+        path2, line2, chain2 = rev
+        if "PTL018" not in enabled_for(path1):
+            continue
+        findings.append(Finding(
+            "PTL018", path1, line1, 0,
+            f"lock-order inversion: `{_lock_name(a)}` then "
+            f"`{_lock_name(b)}` via `{' -> '.join(chain1)}` "
+            f"({path1}:{line1}), but `{_lock_name(b)}` then "
+            f"`{_lock_name(a)}` via `{' -> '.join(chain2)}` "
+            f"({path2}:{line2}) — two threads interleaving these chains "
+            "deadlock, each holding the lock the other needs"))
+
+    out = []
+    for f in findings:
+        lines = get_lines(f.path)
+        if lines is None or not _suppressed(f, lines):
+            out.append(f)
+    return out
+
+
+# --------------------------------------------------------------------------
+# PTL020 thread lifecycle + PTL021 queue backpressure (per-module)
+# --------------------------------------------------------------------------
+
+def _step_marked(fdef, collector):
+    """ids of nodes inside loops of ``fdef`` that dispatch compiled
+    steps (a step-named call or a module-level jitted callable)."""
+    marked = set()
+    for loop in ast.walk(fdef):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        dispatches = False
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Call):
+                cname = _call_name(n)
+                if cname is not None and (
+                        _is_step_name(cname)
+                        or cname in collector.module_jitted):
+                    dispatches = True
+                    break
+        if dispatches:
+            marked.update(id(n) for n in ast.walk(loop))
+    return marked
+
+
+def _thread_report(ma):
+    """Per-scope thread bookkeeping: flagged constructor sites for the
+    daemon fixit and start()-in-step-loop sites.
+
+    Returns ``(leaks, loop_starts)`` where leaks is
+    ``[(ctor_node, token, start_meth)]`` for non-daemon threads started
+    but never joined in their owning scope, and loop_starts is
+    ``[(start_node, label)]``.
+    """
+    resolve = ma.collector.aliases.resolve
+    leaks, loop_starts = [], []
+    for cls_name, _attrs, _queues, fdefs in _scopes(ma):
+        threads = {}  # token -> [ctor_node, daemon, started_meth, joined]
+        marked = {}
+        for fdef in fdefs:
+            marked[id(fdef)] = _step_marked(fdef, ma.collector)
+        for fdef in fdefs:
+            for node in ast.walk(fdef):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        _is_ctor(node.value, resolve, {"Thread", "Timer"}):
+                    t = node.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        tok = "self." + t.attr
+                    elif isinstance(t, ast.Name):
+                        tok = t.id
+                    else:
+                        continue
+                    threads[tok] = [node.value,
+                                    _ctor_daemon(node.value), None, False]
+                elif isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Attribute) and \
+                        node.targets[0].attr == "daemon":
+                    tok = _recv_token(node.targets[0].value)
+                    if tok in threads and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value:
+                        threads[tok][1] = True
+        for fdef in fdefs:
+            in_loop = marked[id(fdef)]
+            for node in ast.walk(fdef):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                recv = node.func.value
+                if attr == "start":
+                    # inline `threading.Thread(...).start()`
+                    if _is_ctor(recv, resolve, {"Thread", "Timer"}):
+                        if id(node) in in_loop:
+                            loop_starts.append((node, "<inline>"))
+                        elif not _ctor_daemon(recv):
+                            leaks.append((recv, "<inline>", fdef.name))
+                        continue
+                    tok = _recv_token(recv)
+                    if tok in threads:
+                        if threads[tok][2] is None:
+                            threads[tok][2] = fdef.name
+                        if id(node) in in_loop:
+                            loop_starts.append((node, tok))
+                elif attr == "join":
+                    tok = _recv_token(recv)
+                    if tok in threads:
+                        threads[tok][3] = True
+        for tok in sorted(threads):
+            ctor, daemon, started, joined = threads[tok]
+            if started is not None and not daemon and not joined:
+                leaks.append((ctor, tok, started))
+    return leaks, loop_starts
+
+
+def _recv_token(node):
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return "self." + node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _ctor_daemon(call):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return not (isinstance(kw.value, ast.Constant)
+                        and not kw.value.value)
+    return False
+
+
+def check_thread_lifecycle(ma, enabled):
+    """PTL020: non-daemon threads started but never joined in their
+    owning scope, and thread starts inside step-dispatch loops."""
+    if "PTL020" not in enabled:
+        return []
+    leaks, loop_starts = _thread_report(ma)
+    findings = []
+    for ctor, tok, meth in leaks:
+        what = "thread" if tok == "<inline>" else f"`{tok}`"
+        findings.append(Finding(
+            "PTL020", ma.path, ctor.lineno, ctor.col_offset,
+            f"non-daemon {what} started in `{meth}` but never joined in "
+            "its owning scope — interpreter shutdown blocks on it "
+            "forever (a failed launch hangs the parent at exit)"))
+    for node, tok in loop_starts:
+        findings.append(Finding(
+            "PTL020", ma.path, node.lineno, node.col_offset,
+            "thread started inside a step-dispatch loop — a new thread "
+            "per step is an unbounded population; hoist it into one "
+            "long-lived worker"))
+    return [f for f in findings if not _suppressed(f, ma.lines)]
+
+
+def check_queue_discipline(ma, enabled):
+    """PTL021: unbounded queue fed from a step-dispatch loop."""
+    if "PTL021" not in enabled:
+        return []
+    findings = []
+    for cls_name, _attrs, queues, fdefs in _scopes(ma):
+        unbounded = {tok: line for tok, (bounded, line) in queues.items()
+                     if not bounded}
+        if not unbounded:
+            continue
+        for fdef in fdefs:
+            marked = _step_marked(fdef, ma.collector)
+            for node in ast.walk(fdef):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute) or \
+                        node.func.attr not in ("put", "put_nowait") or \
+                        id(node) not in marked:
+                    continue
+                tok = _recv_token(node.func.value)
+                if tok in unbounded:
+                    findings.append(Finding(
+                        "PTL021", ma.path, node.lineno, node.col_offset,
+                        f"`{tok}.{node.func.attr}()` feeds an unbounded "
+                        f"queue (constructed with no maxsize at "
+                        f"{ma.path}:{unbounded[tok]}) from a "
+                        "step-dispatch loop — with no backpressure the "
+                        "producer outruns a stalled consumer until the "
+                        "host OOMs"))
+    return [f for f in findings if not _suppressed(f, ma.lines)]
+
+
+# --------------------------------------------------------------------------
+# PTL020 fixit: add daemon=True to the flagged Thread constructor
+# --------------------------------------------------------------------------
+
+def thread_daemon_fix_edits(source, tree):
+    """Replacement edits (for fixes.fix_source) inserting
+    ``daemon=True`` into every Thread constructor PTL020 flags as
+    started-but-never-joined."""
+    from paddle_tpu.analysis.linter import _Collector
+    ma_like = type("M", (), {})()
+    ma_like.tree = tree
+    ma_like.collector = _Collector().run(tree)
+    ma_like.path = "<fix>"
+    ma_like.lines = source.splitlines()
+    leaks, _ = _thread_report(ma_like)
+    edits = []
+    lines = source.splitlines()
+    for ctor, _tok, _meth in leaks:
+        if any(kw.arg == "daemon" for kw in ctor.keywords):
+            continue  # daemon=False spelled out — an explicit choice
+        line = lines[ctor.end_lineno - 1]
+        close = ctor.end_col_offset - 1
+        if close < 0 or close >= len(line) or line[close] != ")":
+            continue
+        has_args = bool(ctor.args or ctor.keywords)
+        text = (", " if has_args else "") + "daemon=True"
+        # trailing comma before the paren: don't double it
+        before = line[:close].rstrip()
+        if has_args and before.endswith(","):
+            text = " daemon=True"
+        edits.append((ctor.end_lineno, close, ctor.end_lineno, close,
+                      text))
+    return edits

@@ -48,6 +48,7 @@ import re
 from dataclasses import dataclass, field, replace
 
 from paddle_tpu.analysis import config as _config
+from paddle_tpu.analysis import concurrency as _conc
 from paddle_tpu.analysis.linter import (
     Finding, _CallEvent, _Checker, _Collector, _JitInfo, _SYNC_HELPERS,
     _WAIT_SANCTIONED, _call_name, _dotted, _is_step_name, _suppressed,
@@ -480,6 +481,9 @@ class ModuleFacts:
     keys: list = field(default_factory=list)
     binds: list = field(default_factory=list)
     registries: list = field(default_factory=list)
+    # per-function lock-acquisition facts for the v3 concurrency join
+    # (PTL018/PTL019) — picklable like everything else here
+    locks: list = field(default_factory=list)
 
 
 def _arg_desc(node):
@@ -717,7 +721,11 @@ def _analyze_module(source, path, enabled, tree=None):
     local, extern, seen = propagate_local(ma, events, enabled)
     findings.extend(local)
     findings.extend(check_thread_safety(ma, enabled))
+    findings.extend(_conc.check_thread_lifecycle(ma, enabled))
+    findings.extend(_conc.check_queue_discipline(ma, enabled))
     facts = extract_cache_facts(ma)
+    if "PTL018" in enabled or "PTL019" in enabled:
+        facts.locks = _conc.collect_lock_facts(ma, facts.module)
     return findings, extern, facts, seen
 
 
@@ -729,6 +737,8 @@ def lint_module_source(source, path, enabled, tree=None):
         source, path, enabled, tree=tree)
     lines = source.splitlines()
     findings.extend(check_cache_keys(
+        [facts], lambda _p: enabled, lambda _p: lines))
+    findings.extend(_conc.check_concurrency(
         [facts], lambda _p: enabled, lambda _p: lines))
     return findings
 
@@ -853,6 +863,8 @@ def _join_project(results, project, rules):
         seen |= set(file_seen)
     findings.extend(propagate_project(project, extern, rules, seen))
     findings.extend(check_cache_keys(
+        all_facts, lambda p: _config.rules_for(p, rules), project.lines))
+    findings.extend(_conc.check_concurrency(
         all_facts, lambda p: _config.rules_for(p, rules), project.lines))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -12,6 +12,11 @@ fixes the rule table advertises:
 * ``bare-except-to-exception`` (PTL007): rewrite ``except:`` as
   ``except Exception:`` — same dynamic behavior for everything except
   the KeyboardInterrupt/SystemExit it was wrongly swallowing.
+* ``thread-daemon-flag`` (PTL020): insert ``daemon=True`` into a
+  ``threading.Thread(...)`` constructor whose thread is started but
+  never joined in its owning scope, so interpreter shutdown stops
+  blocking on it.  Constructors that spell out ``daemon=False`` are an
+  explicit choice and are left alone.
 
 Fixes are source-span edits applied bottom-up, so positions stay valid;
 the result is idempotent (a fixed file re-fixes to itself) and is
@@ -126,6 +131,10 @@ def fix_source(source, rules=None):
     if rules is None or "PTL007" in rules:
         replacements += [r + ("PTL007",)
                          for r in _bare_except_edits(source, tree)]
+    if rules is None or "PTL020" in rules:
+        from paddle_tpu.analysis.concurrency import thread_daemon_fix_edits
+        replacements += [r + ("PTL020",)
+                         for r in thread_daemon_fix_edits(source, tree)]
     if not replacements and not insertions:
         return source, []
     lines = source.splitlines(keepends=True)
@@ -161,4 +170,5 @@ def preview_diff(path, old, new):
 FIXERS = {
     "mutable-default-to-none": "PTL006",
     "bare-except-to-exception": "PTL007",
+    "thread-daemon-flag": "PTL020",
 }

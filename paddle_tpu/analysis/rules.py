@@ -253,6 +253,63 @@ _RULE_LIST = [
         "`kv_transfer` helper that overlaps the copy with dispatched "
         "work",
     ),
+    Rule(
+        "PTL018", "lock-order-inversion", ERROR,
+        "two locks are acquired in opposite orders on two call chains — "
+        "one thread holding A waiting for B while another holds B "
+        "waiting for A deadlocks both, and in the serving fleet that "
+        "freezes the sender thread and every step queued behind it; the "
+        "lock-acquisition graph is built interprocedurally over the "
+        "call graph (`with lock:` and `.acquire()` spans, "
+        "`threading.Lock/RLock/Condition` attributes, locals, and locks "
+        "passed as arguments), and the finding prints BOTH chains so "
+        "each side of the inversion is auditable",
+        "pick one global acquisition order for the two locks and make "
+        "every chain follow it (serving/ policy: transport lock before "
+        "engine lock, never the reverse); if the second acquisition is "
+        "provably unreachable concurrently, suppress with a justified "
+        "`# tpu-lint: ignore[PTL018]` pragma on the acquisition line",
+    ),
+    Rule(
+        "PTL019", "blocking-call-under-lock", WARNING,
+        "a blocking call — host fetch/device sync, `time.sleep`, a "
+        "blocking socket op (accept/recv/sendall/connect), a "
+        "`queue.Queue` get/put without a timeout, or a `.join()` — runs "
+        "while a `threading` lock is held (directly or through resolved "
+        "callees, with the witness chain in the message): every other "
+        "thread contending for that lock stalls for the full blocking "
+        "duration, the exact shape that wedges the transport sender "
+        "and every decode step behind it",
+        "move the blocking call outside the held region (pop under the "
+        "lock, block outside — the transport sender idiom), carry a "
+        "timeout, or suppress with a justified "
+        "`# tpu-lint: ignore[PTL019]` pragma where the block IS the "
+        "sanctioned seam (a Condition.wait-style handoff)",
+    ),
+    Rule(
+        "PTL020", "thread-lifecycle", WARNING,
+        "a non-daemon `threading.Thread` is started but never joined "
+        "anywhere in its owning scope — interpreter shutdown blocks on "
+        "it forever, so a failed launch leaves the parent hanging at "
+        "exit; also flags `Thread(...).start()` inside a step-dispatch "
+        "loop, which mints an unbounded thread-per-step population",
+        "construct the thread with `daemon=True` (mechanical fix: "
+        "`--fix` adds the flag), or join it on the close/drain path; "
+        "hoist per-step thread creation out of the loop into a "
+        "long-lived worker",
+        fixit="thread-daemon-flag",
+    ),
+    Rule(
+        "PTL021", "unbounded-queue-in-step-loop", WARNING,
+        "a `queue.Queue()` created with no `maxsize` is fed (`.put`) "
+        "from a loop that also dispatches compiled steps — with no "
+        "backpressure the producer outruns every stalled consumer and "
+        "the queue grows until the host OOMs, silently buffering "
+        "latency instead of shedding load",
+        "give the queue a `maxsize` bound (the producer then blocks or "
+        "sheds at the bound, surfacing backpressure where it can be "
+        "handled), or feed it outside the step loop",
+    ),
 ]
 
 RULES = {r.id: r for r in _RULE_LIST}

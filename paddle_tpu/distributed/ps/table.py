@@ -114,8 +114,12 @@ class DenseTable:
             return self._param.copy()
 
     def push(self, grad):
+        # materialize the gradient BEFORE taking the lock: when `grad`
+        # is a device array, np.asarray is a device sync, and holding
+        # the table lock across it would stall every concurrent pull
+        g = np.asarray(grad, np.float32)
         with self._lock:
-            self._param = self._param - self.lr * np.asarray(grad, np.float32)
+            self._param = self._param - self.lr * g
 
 
 class SSDSparseTable(SparseTable):
@@ -270,8 +274,8 @@ class GraphTable:
     def get_degree(self, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
         with self._lock:
-            return np.array([len(self._adj.get(i, ())) for i in ids.tolist()],
-                            np.int64)
+            degs = [len(self._adj.get(i, ())) for i in ids.tolist()]
+        return np.array(degs, np.int64)
 
     def sample_neighbors(self, ids, sample_size):
         """Uniform without-replacement up-to-``sample_size`` neighbors per id.
@@ -291,13 +295,14 @@ class GraphTable:
         return np.asarray(outs, np.int64), np.asarray(counts, np.int64)
 
     def save(self, path):
+        # snapshot under the lock, serialize outside it
         with self._lock:
-            src = np.concatenate([np.full(len(v), k, np.int64)
-                                  for k, v in self._adj.items()]) \
-                if self._adj else np.zeros((0,), np.int64)
-            dst = np.concatenate([np.asarray(v, np.int64)
-                                  for v in self._adj.values()]) \
-                if self._adj else np.zeros((0,), np.int64)
+            adj = [(k, list(v)) for k, v in self._adj.items()]
+        src = np.concatenate([np.full(len(v), k, np.int64)
+                              for k, v in adj]) \
+            if adj else np.zeros((0,), np.int64)
+        dst = np.concatenate([np.asarray(v, np.int64) for _k, v in adj]) \
+            if adj else np.zeros((0,), np.int64)
         np.savez(path, src=src, dst=dst)
 
     def load(self, path):

@@ -24,6 +24,11 @@ as light as before):
   timelines behind ``Request.timeline()``).
 * :mod:`~paddle_tpu.observability.slo` — ``SLOTracker``/``SLObjective``:
   sliding-window per-class SLO attainment and burn-rate gauges.
+* :mod:`~paddle_tpu.observability.watchdog` — ``DeadlockWatchdog``: a
+  daemon thread that samples every thread's stack via
+  ``sys._current_frames()`` when a progress probe goes stale, dumps
+  them through the flight recorder (``auto_dump("stall")``) and bumps
+  ``serving_watchdog_stalls_total``.
 
 The serving engine, the decode/train compile caches and ``TrainStep`` are
 instrumented out of the box; see the README "Observability" and
@@ -44,6 +49,7 @@ from paddle_tpu.observability.trace import span
 
 # name -> defining module, resolved on first access (PEP 562)
 _LAZY = {
+    "DeadlockWatchdog": "paddle_tpu.observability.watchdog",
     "FlightRecorder": "paddle_tpu.observability.flightrecorder",
     "RequestTrace": "paddle_tpu.observability.flightrecorder",
     "SLObjective": "paddle_tpu.observability.slo",

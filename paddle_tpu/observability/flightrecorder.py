@@ -54,7 +54,7 @@ EVENT_KINDS = ("submit", "admit", "prefill_chunk", "dispatch", "retry",
                "drain", "stall", "cancel", "shed", "poison", "retire")
 
 # anomaly-dump triggers (the `reason` label of flight_recorder_dumps_total)
-DUMP_REASONS = ("timed_out", "poisoned", "retry_exhausted")
+DUMP_REASONS = ("timed_out", "poisoned", "retry_exhausted", "stall")
 
 # terminal request phases, mirroring Request.status
 TERMINAL_PHASES = ("done", "timed_out", "cancelled", "poisoned", "shed")

@@ -109,6 +109,7 @@ from paddle_tpu.observability.flightrecorder import (
     FlightRecorder, RequestTrace,
 )
 from paddle_tpu.observability.slo import SLOTracker
+from paddle_tpu.observability.watchdog import DeadlockWatchdog
 from paddle_tpu.ops.decode_attention import _canon_kv_dtype
 from paddle_tpu.serving.faults import InjectedDispatchError
 from paddle_tpu.serving.kv_cache import (
@@ -393,7 +394,7 @@ class ServingEngine:
                  retry_backoff=0.05, faults=None, recorder=True,
                  slo=None, attn_impl=None, weight_dtype=None,
                  prefill_impl=None, tp_overlap=None,
-                 prefill_only=False, on_prefilled=None):
+                 prefill_only=False, on_prefilled=None, watchdog=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -458,6 +459,20 @@ class ServingEngine:
         self._traces = OrderedDict()   # rid -> RequestTrace, newest last
         self._trace_cap = 1024
         self._trace_lock = threading.Lock()
+        # runtime deadlock watchdog (observability/watchdog.py):
+        # ``watchdog=<seconds>`` arms a daemon thread that dumps every
+        # thread's stack through the flight recorder when the step loop
+        # goes stale past the threshold WITH work outstanding.  The
+        # probe reads `_last_step_unix` (stamped 0 until the first
+        # step), so it stays quiet through construction and idle.
+        self._last_step_unix = 0.0
+        self._watchdog = None
+        if watchdog:
+            self._watchdog = DeadlockWatchdog(
+                self._watchdog_probe, stall_after=float(watchdog),
+                recorder=self._fr,
+                registry=self._m.registry if self._m is not None else None,
+                component=policy).start()
         self._B = int(batch_size)
         self._lmax = int(max_len)
         self._mode = mode
@@ -718,6 +733,14 @@ class ServingEngine:
     def has_work(self):
         return (bool(self._queue) or self._kv.any_live()
                 or self._inflight is not None)
+
+    def _watchdog_probe(self):
+        """Watchdog progress probe: last step time while work is
+        outstanding, None when idle (an idle engine is not stalled)."""
+        t = self._last_step_unix
+        if not t or not self.has_work:
+            return None
+        return t
 
     def _headroom(self):
         # greedy may overshoot a retiring slot by < sync_every cache rows;
@@ -1654,11 +1677,12 @@ class ServingEngine:
     def step(self):
         """One scheduler iteration: retire/admit, then one compiled decode
         dispatch over every live slot.  Returns tokens emitted."""
+        self._last_step_unix = time.time()
         m = self._m
         if m is None:
             return self._step_impl()
         m.steps.inc()
-        m.last_step_time.set(time.time())
+        m.last_step_time.set(self._last_step_unix)
         with m.span_step:
             return self._step_impl()
 
@@ -1995,6 +2019,8 @@ class ServingEngine:
         ``"cancelled"``.  Returns ``{rid: terminal status}`` over every
         request the engine ever finished.  Idempotent: a second call
         finds nothing to cancel and returns the same map."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._inflight is not None:
             prev, self._inflight = self._inflight, None
             self._drain(prev)

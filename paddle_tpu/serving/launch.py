@@ -40,6 +40,9 @@ from collections import deque
 
 import numpy as np
 
+from paddle_tpu.observability.flightrecorder import FlightRecorder
+from paddle_tpu.observability.watchdog import DeadlockWatchdog
+
 from .engine import EngineOverloaded, _backoff_sleep
 from .metrics import DisaggMetrics
 from .worker import FrameReader, pump_socket, send_msg
@@ -68,7 +71,8 @@ class FleetConfig:
                  router_policy="least_backlog", workdir=None,
                  heartbeat_s=1.0, ready_timeout_s=120.0,
                  drain_timeout_s=30.0, restart_dead_workers=False,
-                 adoption_timeout_s=20.0, name="fleet0"):
+                 adoption_timeout_s=20.0, watchdog_s=30.0,
+                 name="fleet0"):
         self.engine = dict(engine)
         self.model = dict(model or {"kind": "llama", "preset": "tiny",
                                     "dtype": "float32", "seed": 0})
@@ -88,6 +92,7 @@ class FleetConfig:
         self.drain_timeout_s = float(drain_timeout_s)
         self.restart_dead_workers = bool(restart_dead_workers)
         self.adoption_timeout_s = float(adoption_timeout_s)
+        self.watchdog_s = float(watchdog_s)
         self.name = name
 
     # ---------------------------------------------------------- validation
@@ -128,6 +133,9 @@ class FleetConfig:
             errs.append("heartbeat_s must be > 0")
         if self.adoption_timeout_s <= 0:
             errs.append("adoption_timeout_s must be > 0")
+        if self.watchdog_s < 0:
+            errs.append("watchdog_s must be >= 0 (0 disables the "
+                        "deadlock watchdog)")
         if self.model.get("kind", "llama") != "llama" or \
                 self.model.get("preset", "tiny") != "tiny":
             errs.append(f"unsupported model spec {self.model!r} "
@@ -174,6 +182,7 @@ class FleetConfig:
             "drain_timeout_s": self.drain_timeout_s,
             "restart_dead_workers": self.restart_dead_workers,
             "adoption_timeout_s": self.adoption_timeout_s,
+            "watchdog_s": self.watchdog_s,
         }
 
     @classmethod
@@ -320,6 +329,26 @@ class FleetCoordinator:
         self._step_idx = 0
         self._n_events = 0
         self._respawn_idx = 0
+        # deadlock watchdog on the routing plane: requests outstanding
+        # but no event/finish progress for watchdog_s means the parent
+        # loop (or every worker at once) is wedged — dump all thread
+        # stacks through a coordinator-owned flight recorder.  The
+        # monitor thread is a daemon AND stopped/joined in close().
+        self._last_progress_unix = 0.0
+        self.recorder = None
+        self._watchdog = None
+        wd_s = float(getattr(config, "watchdog_s", 0.0) or 0.0)
+        if wd_s > 0:
+            self.recorder = FlightRecorder(policy=f"fleet:{config.name}")
+            self._watchdog = DeadlockWatchdog(
+                self._watchdog_probe, stall_after=wd_s,
+                recorder=self.recorder, registry=registry,
+                component=f"fleet:{config.name}").start()
+
+    def _watchdog_probe(self):
+        if not self._users:
+            return None  # idle: nothing outstanding, nothing to stall
+        return self._last_progress_unix or None
 
     # ----------------------------------------------------------- topology
     def _live(self, role):
@@ -355,6 +384,7 @@ class FleetCoordinator:
             request._t_deadline = request.t_submit \
                 + request.deadline_ms / 1e3
         self._users[rid] = request
+        self._last_progress_unix = time.time()
         return request
 
     def _send_submit(self, p, d, wire_rid, prompt, max_new, root):
@@ -568,12 +598,14 @@ class FleetCoordinator:
         self._active[root.rid] = arid
         if self._m is not None:
             self._m.orphan_reprefills.inc()
+        self._last_progress_unix = time.time()
         _LOG.info("re-prefilled orphan %r as %r (%d emitted, %d left)",
                   root.rid, arid, k, remaining)
 
     # ---------------------------------------------------------------- step
     def step(self):
         self._step_idx += 1
+        before = self._n_events + len(self._finished)
         if self._faults is not None:
             for name in self._faults.worker_kills_due(self._step_idx):
                 self.kill_worker(name)
@@ -589,6 +621,8 @@ class FleetCoordinator:
             for msg in h.poll_events():
                 emitted += self._on_event(h, msg)
         emitted += self._sweep_handoffs()
+        if self._n_events + len(self._finished) != before:
+            self._last_progress_unix = time.time()
         return emitted
 
     def _sweep_handoffs(self):
@@ -685,6 +719,8 @@ class FleetCoordinator:
 
     # ---------------------------------------------------------------- close
     def close(self, drain_timeout=None):
+        if self._watchdog is not None:
+            self._watchdog.stop()  # monitor thread joined before teardown
         timeout = (self._cfg.drain_timeout_s
                    if drain_timeout is None else drain_timeout)
         for h in self._handles.values():

@@ -90,7 +90,8 @@ class EngineMetrics:
             "flight_recorder_dumps_total",
             "anomaly-triggered flight-recorder snapshots, by trigger",
             ("policy", "reason"))
-        for reason in ("timed_out", "poisoned", "retry_exhausted"):
+        for reason in ("timed_out", "poisoned", "retry_exhausted",
+                       "stall"):
             self._recorder_dumps.labels(policy=policy, reason=reason)
         # wall-clock stamp of the most recent scheduler step: /healthz
         # derives "last-step age" from it, so a wedged engine (stuck

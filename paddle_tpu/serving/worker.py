@@ -451,36 +451,57 @@ class _WorkerProc:
     def serve(self):
         self._closing = False
         hb = float(self.cfg.get("heartbeat_s", 1.0))
-        while True:
-            busy = self._has_work()
-            for key, _ in self._sel.select(0 if busy else 0.05):
-                for msg in pump_socket(key.fileobj, self._reader):
-                    # host-side control plane: the np.asarray it reaches
-                    # converts a submit's prompt list, not device leaves
-                    self._handle(msg)  # tpu-lint: ignore[PTL004]
-            if self._reader.eof:
-                # parent went away: drain what is resident and exit
-                self.draining = True
-                self._closing = True
-            if self.role == "decode":
-                # chain leaves arrive as numpy off the wire; the
-                # np.asarray here wraps them for import, no device sync
-                self._pump_chains()  # tpu-lint: ignore[PTL004]
-            if self.engine.has_work:
-                self.engine.step()
-            if self.role == "decode":
-                self._sweep_decode()
-            else:
-                self._sweep_shadows()
-            now = time.monotonic()
-            if now - self._hb_t >= hb:
-                self._hb_t = now
-                self._event("hb", t=time.time())
-            self._flush_events()
-            if self.draining and not self._has_work():
-                self._event("drained")
+        # deadlock watchdog on the serve loop itself: the loop is
+        # selector-gated (never sleeps more than 50 ms), so a stale
+        # iteration beat means the loop is truly wedged — a deadlocked
+        # step dispatch, a blocking handler — and the watchdog dumps
+        # every thread's stack through the engine's flight recorder
+        from paddle_tpu.observability.watchdog import DeadlockWatchdog
+        wd_s = float(self.cfg.get("watchdog_s", 30.0) or 0.0)
+        self._wd_beat = time.time()
+        wd = None
+        if wd_s > 0:
+            wd = DeadlockWatchdog(
+                lambda: self._wd_beat, stall_after=wd_s,
+                recorder=self.engine.recorder, registry=self.registry,
+                component=self.name).start()
+        try:
+            while True:
+                self._wd_beat = time.time()
+                busy = self._has_work()
+                for key, _ in self._sel.select(0 if busy else 0.05):
+                    for msg in pump_socket(key.fileobj, self._reader):
+                        # host-side control plane: the np.asarray it
+                        # reaches converts a submit's prompt list, not
+                        # device leaves
+                        self._handle(msg)  # tpu-lint: ignore[PTL004]
+                if self._reader.eof:
+                    # parent went away: drain what is resident and exit
+                    self.draining = True
+                    self._closing = True
+                if self.role == "decode":
+                    # chain leaves arrive as numpy off the wire; the
+                    # np.asarray here wraps them for import, no device
+                    # sync
+                    self._pump_chains()  # tpu-lint: ignore[PTL004]
+                if self.engine.has_work:
+                    self.engine.step()
+                if self.role == "decode":
+                    self._sweep_decode()
+                else:
+                    self._sweep_shadows()
+                now = time.monotonic()
+                if now - self._hb_t >= hb:
+                    self._hb_t = now
+                    self._event("hb", t=time.time())
                 self._flush_events()
-                break
+                if self.draining and not self._has_work():
+                    self._event("drained")
+                    self._flush_events()
+                    break
+        finally:
+            if wd is not None:
+                wd.stop()
         self.shutdown()
 
     def shutdown(self):

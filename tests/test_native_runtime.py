@@ -35,10 +35,12 @@ class TestTCPStore:
         try:
             a = TCPStore(port=srv.port)
             b = TCPStore(port=srv.port)
-            threading.Timer(0.2, lambda: a.set("late", b"x")).start()
+            late = threading.Timer(0.2, lambda: a.set("late", b"x"))
+            late.start()
             assert b.wait("late", 5000) == b"x"
             with pytest.raises(TimeoutError):
                 b.wait("missing", 200)
+            late.join()
         finally:
             srv.stop()
 

@@ -1728,6 +1728,594 @@ class TestPTL017:
 
 
 # ---------------------------------------------------------------------------
+# PTL018 — lock-order inversion (interprocedural lock-acquisition graph)
+# ---------------------------------------------------------------------------
+
+class TestPTL018:
+    def test_nested_with_inversion_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL018"
+        # BOTH chains printed, each with file:line evidence
+        assert "C._a" in f.message and "C._b" in f.message
+        assert "C.f" in f.message and "C.g" in f.message
+        assert f.message.count("fix.py:") == 2
+
+    def test_consistent_order_tn(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def g(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_multi_item_with_inversion_tp(self):
+        # `with a, b:` acquires left-to-right — inverted against `with b, a:`
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a, self._b:
+                        pass
+
+                def g(self):
+                    with self._b, self._a:
+                        pass
+        """)
+        assert [f.rule for f in lint_source(src, path="fix.py")] \
+            == ["PTL018"]
+
+    def test_via_call_inversion_tp(self):
+        # one side of the inversion is only reachable through a resolved
+        # call — the chain names every hop
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.a_lock = threading.Lock()
+                    self.b_lock = threading.Lock()
+
+                def _grab(self):
+                    with self.b_lock:
+                        pass
+
+                def f(self):
+                    with self.a_lock:
+                        self._grab()
+
+                def g(self):
+                    with self.b_lock:
+                        with self.a_lock:
+                            pass
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL018"
+        assert "C.f -> C._grab" in f.message
+
+    def test_lock_passed_as_argument_tp(self):
+        # a lock handed to a helper as a parameter still builds edges in
+        # the caller's identity space
+        src = textwrap.dedent("""
+            import threading
+
+            def locked_update(lock, items):
+                with lock:
+                    items.append(1)
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self, items):
+                    with self._a:
+                        locked_update(self._b, items)
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL018"
+        assert "locked_update" in f.message
+
+    def test_alias_reacquire_not_inversion_tn(self):
+        # `lk = self._a` resolves to the SAME lock: a nested re-acquire
+        # is RLock territory, not an ordering edge
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.RLock()
+
+                def f(self):
+                    lk = self._a
+                    with self._a:
+                        with lk:
+                            pass
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_cross_module_inversion_tp(self):
+        # the two halves of the inversion live in different modules;
+        # only the project-level join can see the cycle
+        files = {
+            "pkg/state.py": textwrap.dedent("""
+                import threading
+
+                A_LOCK = threading.Lock()
+                B_LOCK = threading.Lock()
+
+                def forward(items):
+                    with A_LOCK:
+                        with B_LOCK:
+                            items.append(1)
+            """),
+            "pkg/drain.py": textwrap.dedent("""
+                from pkg.state import A_LOCK, B_LOCK
+
+                def backward(items):
+                    with B_LOCK:
+                        with A_LOCK:
+                            items.pop()
+            """),
+        }
+        found = [f for f in lint_project_sources(files)
+                 if f.rule == "PTL018"]
+        assert len(found) == 1
+        assert "forward" in found[0].message
+        assert "backward" in found[0].message
+
+    def test_pragma_suppresses(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:  # tpu-lint: ignore[PTL018]
+                            pass
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL019 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+class TestPTL019:
+    LOCKED = textwrap.dedent("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+
+    def test_sleep_under_lock_tp(self):
+        (f,) = lint_source(self.LOCKED, path="fix.py")
+        assert f.rule == "PTL019"
+        assert "time.sleep" in f.message and "C._lock" in f.message
+
+    def test_socket_recv_under_lock_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self, sock):
+                    with self._lock:
+                        return sock.recv(4096)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL019" and ".recv()" in f.message
+
+    def test_queue_get_no_timeout_under_lock_tp(self):
+        src = textwrap.dedent("""
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def f(self):
+                    with self._lock:
+                        return self._q.get()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL019" and "without timeout" in f.message
+
+    def test_queue_get_with_timeout_tn(self):
+        src = textwrap.dedent("""
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def f(self):
+                    with self._lock:
+                        return self._q.get(timeout=0.5)
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_join_under_lock_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=print, daemon=True)
+
+                def f(self):
+                    with self._lock:
+                        self._t.join()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL019" and ".join()" in f.message
+
+    def test_condition_wait_tn(self):
+        # Condition.wait RELEASES the lock while blocked — the
+        # sanctioned producer/consumer handoff, never flagged
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def f(self):
+                    with self._cv:
+                        while not self.ready:
+                            self._cv.wait()
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_blocking_outside_lock_tn(self):
+        src = textwrap.dedent("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(0.1)
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_propagated_through_helper_tp(self):
+        # the blocking call hides behind a resolved helper: the finding
+        # lands at the call site with the witness chain and the reached
+        # location
+        src = textwrap.dedent("""
+            import threading
+            import time
+
+            def slow_flush(items):
+                time.sleep(0.5)
+                return items
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self, items):
+                    with self._lock:
+                        return slow_flush(items)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL019"
+        assert "[via C.f -> slow_flush]" in f.message
+        assert "(reached at fix.py:" in f.message
+
+    def test_host_sync_under_lock_tp(self):
+        # the table.py pattern this rule caught for real: np.asarray of
+        # a possibly-device value inside the hot-path lock
+        src = textwrap.dedent("""
+            import threading
+            import numpy as np
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def push(self, grad):
+                    with self._lock:
+                        self.w -= np.asarray(grad)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL019" and "np.asarray" in f.message
+
+    def test_pragma_suppresses(self):
+        src = self.LOCKED.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # tpu-lint: ignore[PTL019]")
+        assert lint_source(src, path="fix.py") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL020 — thread lifecycle
+# ---------------------------------------------------------------------------
+
+class TestPTL020:
+    def test_leaked_thread_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL020"
+        assert "self._t" in f.message and "never joined" in f.message
+
+    def test_daemon_ctor_tn(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_daemon_attr_tn(self):
+        src = textwrap.dedent("""
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.daemon = True
+                t.start()
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_joined_tn(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join()
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_inline_start_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            def fire(fn):
+                threading.Thread(target=fn).start()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL020"
+
+    def test_timer_leak_tp(self):
+        # the exact bug this rule caught in tests/test_native_runtime.py
+        src = textwrap.dedent("""
+            import threading
+
+            def later(fn):
+                t = threading.Timer(0.2, fn)
+                t.start()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL020"
+
+    def test_start_in_step_loop_tp(self):
+        src = textwrap.dedent("""
+            import threading
+
+            def drive(reqs, params):
+                for r in reqs:
+                    out = decode_step(params, r)
+                    threading.Thread(target=print, args=(out,)).start()
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL020" and "step-dispatch loop" in f.message
+
+    def test_pragma_suppresses(self):
+        src = textwrap.dedent("""
+            import threading
+
+            def fire(fn):
+                threading.Thread(target=fn).start()  # tpu-lint: ignore[PTL020]
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL021 — unbounded queue fed from a step-dispatch loop
+# ---------------------------------------------------------------------------
+
+class TestPTL021:
+    def test_unbounded_put_in_step_loop_tp(self):
+        src = textwrap.dedent("""
+            import queue
+
+            class S:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def drive(self, reqs, params):
+                    for r in reqs:
+                        out = decode_step(params, r)
+                        self._q.put(out)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL021"
+        assert "self._q" in f.message and "no maxsize" in f.message
+
+    def test_bounded_tn(self):
+        src = textwrap.dedent("""
+            import queue
+
+            class S:
+                def __init__(self):
+                    self._q = queue.Queue(maxsize=64)
+
+                def drive(self, reqs, params):
+                    for r in reqs:
+                        out = decode_step(params, r)
+                        self._q.put(out)
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_non_step_loop_tn(self):
+        # no compiled-step dispatch in the loop: a plain pump may use an
+        # unbounded queue
+        src = textwrap.dedent("""
+            import queue
+
+            class S:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def pump(self, items):
+                    for it in items:
+                        self._q.put(it)
+        """)
+        assert lint_source(src, path="fix.py") == []
+
+    def test_simplequeue_tp(self):
+        # SimpleQueue has no maxsize at all — always unbounded
+        src = textwrap.dedent("""
+            import queue
+
+            class S:
+                def __init__(self):
+                    self._q = queue.SimpleQueue()
+
+                def drive(self, reqs, params):
+                    for r in reqs:
+                        out = decode_step(params, r)
+                        self._q.put(out)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL021"
+
+    def test_maxsize_zero_tp(self):
+        # maxsize=0 is stdlib spelling for "unbounded"
+        src = textwrap.dedent("""
+            import queue
+
+            class S:
+                def __init__(self):
+                    self._q = queue.Queue(maxsize=0)
+
+                def drive(self, reqs, params):
+                    for r in reqs:
+                        out = decode_step(params, r)
+                        self._q.put(out)
+        """)
+        (f,) = lint_source(src, path="fix.py")
+        assert f.rule == "PTL021"
+
+
+# ---------------------------------------------------------------------------
+# concurrency audit regression: the serving plane stays clean under the
+# v3 rules (the pop-under-lock / send-outside transport design, the
+# worker loop, and the fleet parent all hold up)
+# ---------------------------------------------------------------------------
+
+class TestServingConcurrencyClean:
+    SERVING = ["paddle_tpu/serving/transport.py",
+               "paddle_tpu/serving/worker.py",
+               "paddle_tpu/serving/launch.py"]
+
+    def test_serving_modules_clean(self):
+        files = {}
+        for rel in self.SERVING:
+            with open(os.path.join(REPO, rel)) as f:
+                files[rel] = f.read()
+        found = [f for f in lint_project_sources(files)
+                 if f.rule in ("PTL018", "PTL019", "PTL020", "PTL021")]
+        assert found == [], [f.message for f in found]
+
+    def test_ps_table_clean(self):
+        # regression for the real PTL019 catches: DenseTable.push /
+        # GraphTable.get_degree / GraphTable.save now convert outside
+        # the lock
+        with open(os.path.join(REPO,
+                               "paddle_tpu/distributed/ps/table.py")) as f:
+            src = f.read()
+        found = [f for f in lint_source(src, path="table.py")
+                 if f.rule == "PTL019"]
+        assert found == [], [f.message for f in found]
+
+
+# ---------------------------------------------------------------------------
 # SARIF 2.1.0 reporter
 # ---------------------------------------------------------------------------
 
@@ -1859,6 +2447,37 @@ class TestFix:
         fixed, applied = fix_source(src, rules={"PTL007"})
         assert [r for r, _ in applied] == ["PTL007"]
         assert "b=[]" in fixed
+
+    def test_thread_daemon_flag(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+        """)
+        fixed, applied = fix_source(src)
+        assert [r for r, _ in applied] == ["PTL020"]
+        assert "threading.Thread(target=self._run, daemon=True)" in fixed
+        assert lint_source(fixed, path="m.py") == []
+
+    def test_thread_daemon_flag_skips_explicit_false(self):
+        # daemon=False is a deliberate choice — the fixer must not
+        # silently flip it; the finding stays for a human
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=False)
+                    self._t.start()
+        """)
+        fixed, applied = fix_source(src)
+        assert fixed == src and applied == []
+        assert [f.rule for f in lint_source(src, path="m.py")] \
+            == ["PTL020"]
 
     def test_cli_fix_writes(self, tmp_path):
         mod = tmp_path / "m.py"

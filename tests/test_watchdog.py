@@ -1,0 +1,235 @@
+"""DeadlockWatchdog: the runtime companion to tpu-lint v3's static
+concurrency rules (PTL018/PTL019).
+
+Load-bearing properties: (1) a stale progress probe produces EXACTLY ONE
+stall dump per episode — all thread stacks through the flight recorder's
+``auto_dump("stall")`` plus one ``serving_watchdog_stalls_total`` bump —
+and the latch re-arms only on fresh progress; (2) an idle component
+(probe ``None``) never trips; (3) the poll thread is a daemon, stoppable
+and joinable; (4) the serving-engine wiring (``watchdog=<seconds>``)
+demonstrably dumps on an induced stall and tears down in ``close()``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.flightrecorder import FlightRecorder
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.watchdog import DeadlockWatchdog
+
+
+def _counter_value(reg, name, **labels):
+    snap = reg.snapshot()
+    for series in snap.get(name, {}).get("series", []):
+        if all(series["labels"].get(k) == v for k, v in labels.items()):
+            return series["value"]
+    return 0.0
+
+
+class _Probe:
+    """A hand-cranked progress probe."""
+
+    def __init__(self):
+        self.t = None
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ check_now
+class TestCheckNow:
+    def _wd(self, **kw):
+        probe = _Probe()
+        reg = MetricsRegistry()
+        fr = FlightRecorder(policy="wd-test")
+        wd = DeadlockWatchdog(probe, stall_after=kw.pop("stall_after", 5.0),
+                              recorder=fr, registry=reg, component="t", **kw)
+        return wd, probe, fr, reg
+
+    def test_idle_never_trips(self):
+        wd, probe, fr, _ = self._wd()
+        assert wd.check_now() is False          # probe None: idle
+        probe.t = 0.0
+        assert wd.check_now() is False          # never-stepped sentinel
+        assert wd.stalls == 0 and fr.dumps == []
+
+    def test_fresh_progress_never_trips(self):
+        wd, probe, _, _ = self._wd()
+        probe.t = time.time()
+        assert wd.check_now() is False
+        assert wd.stalls == 0
+
+    def test_stale_trips_exactly_once(self):
+        wd, probe, fr, reg = self._wd()
+        probe.t = time.time() - 100.0
+        assert wd.check_now() is True
+        # latched: the same stall episode never dumps again
+        for _ in range(5):
+            assert wd.check_now() is False
+        assert wd.stalls == 1
+        assert [d["reason"] for d in fr.dumps] == ["stall"]
+        assert _counter_value(reg, "serving_watchdog_stalls_total",
+                              component="t") == 1.0
+
+    def test_rearm_on_progress_then_second_episode(self):
+        wd, probe, fr, _ = self._wd()
+        probe.t = time.time() - 100.0
+        assert wd.check_now() is True
+        probe.t = time.time()                   # progress resumed
+        assert wd.check_now() is False          # healthy AND re-armed
+        probe.t = time.time() - 100.0
+        assert wd.check_now() is True           # a NEW episode dumps
+        assert wd.stalls == 2
+        assert [d["reason"] for d in fr.dumps] == ["stall", "stall"]
+
+    def test_rearm_on_idle(self):
+        wd, probe, _, _ = self._wd()
+        probe.t = time.time() - 100.0
+        assert wd.check_now() is True
+        probe.t = None                          # drained: idle re-arms
+        assert wd.check_now() is False
+        probe.t = time.time() - 100.0
+        assert wd.check_now() is True
+        assert wd.stalls == 2
+
+    def test_stall_events_carry_thread_stacks(self):
+        wd, probe, fr, _ = self._wd()
+        probe.t = time.time() - 100.0
+        wd.check_now()
+        stalls = [e for e in fr.events() if e["kind"] == "stall"]
+        assert stalls, "no stall events recorded"
+        names = {e["thread"] for e in stalls}
+        assert threading.current_thread().name in names
+        me = [e for e in stalls
+              if e["thread"] == threading.current_thread().name]
+        # the formatted stack names this very test function
+        assert "test_stall_events_carry_thread_stacks" in me[0]["stack"]
+        assert me[0]["component"] == "t"
+        assert me[0]["seconds"] >= 99.0
+
+    def test_stall_after_validated(self):
+        with pytest.raises(ValueError):
+            DeadlockWatchdog(lambda: None, stall_after=0.0,
+                             registry=MetricsRegistry())
+
+    def test_probe_exception_does_not_kill_poll(self):
+        calls = []
+
+        def probe():
+            calls.append(1)
+            raise RuntimeError("probe boom")
+
+        wd = DeadlockWatchdog(probe, stall_after=10.0, poll=0.01,
+                              registry=MetricsRegistry())
+        wd.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while len(calls) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(calls) >= 3          # still polling after raises
+            assert wd.is_alive
+        finally:
+            wd.stop()
+
+
+# ---------------------------------------------------------- poll thread
+class TestPollThread:
+    def test_daemon_named_and_stoppable(self):
+        wd = DeadlockWatchdog(lambda: None, stall_after=10.0, poll=0.01,
+                              registry=MetricsRegistry(), component="fleet")
+        assert wd.start() is wd
+        assert wd.start() is wd                 # idempotent
+        assert wd.is_alive
+        assert wd._thread.daemon
+        assert wd._thread.name == "fleet-watchdog"
+        wd.stop()
+        wd.stop()                               # idempotent
+        assert not wd.is_alive
+
+    def test_stub_engine_freeze_dumps_exactly_once(self):
+        """The acceptance scenario: a stub engine with outstanding work
+        stops making progress; the background watchdog trips exactly one
+        stall dump + one counter bump, then stays latched."""
+
+        class StubEngine:
+            def __init__(self):
+                self.last_step = time.time()
+                self.has_work = True
+                self.frozen = False
+
+            def probe(self):
+                if not self.has_work:
+                    return None
+                return self.last_step
+
+            def step(self):
+                if not self.frozen:
+                    self.last_step = time.time()
+
+        eng = StubEngine()
+        reg = MetricsRegistry()
+        fr = FlightRecorder(policy="stub")
+        wd = DeadlockWatchdog(eng.probe, stall_after=0.08, poll=0.01,
+                              recorder=fr, registry=reg,
+                              component="stub").start()
+        try:
+            for _ in range(5):                  # healthy serving
+                eng.step()
+                time.sleep(0.01)
+            assert wd.stalls == 0
+            eng.frozen = True                   # wedge the loop
+            deadline = time.monotonic() + 5.0
+            while wd.stalls == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.2)                     # many polls later...
+            assert wd.stalls == 1               # ...still ONE dump
+            assert [d["reason"] for d in fr.dumps] == ["stall"]
+            assert _counter_value(reg, "serving_watchdog_stalls_total",
+                                  component="stub") == 1.0
+        finally:
+            wd.stop()
+
+
+# ------------------------------------------------- serving-engine wiring
+class TestEngineWiring:
+    def test_induced_stall_dumps_and_close_stops(self):
+        from paddle_tpu.serving import Request, ServingEngine
+        from tests.test_serving import _tiny_model
+
+        model = _tiny_model()
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=2, max_len=64, registry=reg,
+                            watchdog=0.05)
+        assert eng._watchdog is not None and eng._watchdog.is_alive
+        eng.submit(Request(np.arange(1, 6), 4))
+        eng.step()                              # stamps progress
+        assert eng._watchdog_probe() is not None  # work outstanding
+        # induce the stall: work resident, nobody stepping
+        deadline = time.monotonic() + 5.0
+        while eng._watchdog.stalls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng._watchdog.stalls == 1
+        assert [d["reason"] for d in eng._fr.dumps] == ["stall"]
+        # the standard on_dump hook fired too: dumps_total{reason=stall}
+        assert _counter_value(
+            reg, "flight_recorder_dumps_total",
+            reason="stall", policy="continuous") == 1.0
+        assert _counter_value(
+            reg, "serving_watchdog_stalls_total",
+            component="continuous") == 1.0
+        # progress re-arms: finish the request, probe goes idle
+        eng.run()
+        assert eng._watchdog_probe() is None
+        wd = eng._watchdog
+        eng.close()
+        assert not wd.is_alive                  # joined in close()
+
+    def test_disabled_by_default(self):
+        from paddle_tpu.serving import ServingEngine
+        from tests.test_serving import _tiny_model
+
+        eng = ServingEngine(_tiny_model(), batch_size=2, max_len=64)
+        assert eng._watchdog is None
+        eng.close()
