@@ -879,6 +879,123 @@ def bench_serving_paged(n_requests=64, batch=8):
     }
 
 
+def bench_serving_tiered(n_families=12, waves=3, batch=2):
+    """Tiered-KV A/B (round 22, serving/kv_cache.BlockStore): a churn
+    workload — ``n_families`` prefix families (each a long shared head
+    plus short unique suffixes) revisited across ``waves`` admission
+    waves, with the registered working set sized to ~3x the device pool
+    so every family is LRU-reclaimed between visits.  The multi-tenant
+    shape where single-tier prefix caching stops working: the device-only
+    arm forgets each family before its next wave and re-prefills the
+    whole head; the tiered arm demotes evicted chains to host RAM and
+    restores them at admission through the ``kv_transfer`` scatter.
+
+    Reported:
+
+    * ``serving_prefix_hit_rate_device_only`` vs ``_tiered`` (and the
+      host-tier share) — read off each engine's own reuse/prompt token
+      counters; the acceptance bar is tiered >= 1.5x device-only.
+    * ``serving_tier_restore_p50_ms`` — admission-side wall time of one
+      chain restore (fetch + CRC validate + device scatter), p50 over
+      every restore in the run, vs ``serving_tier_reprefill_ms_est`` —
+      what the replaced suffix prefill cost, estimated from the arm
+      runtime delta per restore plus the restore itself.  On the CPU
+      host both are smoke numbers; on chip the skipped prefill FLOPs
+      dominate and the restore is a DMA.
+    """
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Request, ServingEngine
+
+    small = os.environ.get("BENCH_SERVING_SMALL") == "1"
+    if small:
+        n_families, batch, lmax, kvb = min(n_families, 12), 2, 512, 64
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=688,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=2, max_position_embeddings=lmax,
+            dtype="float32",
+        )
+        o_lo, o_hi = 16, 33
+    else:
+        lmax, kvb = 2048, 256
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=lmax,
+            dtype="bfloat16",
+        )
+        o_lo, o_hi = 32, 65
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(22)
+    # pool: 2 full-length requests; heads: 4 blocks each, so the
+    # registered working set is n_families * 4 blocks ~= 3x the pool
+    pool = 2 * lmax
+    head_len = 4 * kvb
+    heads = [rng.integers(0, cfg.vocab_size, head_len)
+             for _ in range(n_families)]
+    prompts, olens = [], []
+    for _ in range(waves):
+        for h in heads:
+            sfx = rng.integers(0, cfg.vocab_size,
+                               int(rng.integers(kvb // 4, kvb // 2)))
+            prompts.append(np.concatenate([h, sfx]))
+            olens.append(int(rng.integers(o_lo, o_hi)))
+    total_new = int(sum(olens))
+
+    def mk(tier_bytes=None):
+        return ServingEngine(
+            model, batch_size=batch, max_len=lmax, sync_every=4,
+            decode_chunk=kvb, prefill_chunk=kvb, kv_block=kvb,
+            max_live_tokens=pool, host_tier_bytes=tier_bytes,
+            prompt_buckets=[lmax // 8, lmax // 4, lmax // 2,
+                            3 * lmax // 4],
+            instrument=False, recorder=False)
+
+    def run(eng):
+        for p, o in zip(prompts, olens):
+            eng.submit(Request(p, o))
+        t0 = time.perf_counter()
+        eng.run()
+        return time.perf_counter() - t0, eng.stats(), eng
+
+    run(mk())                              # warm the compiled programs
+    dt_dev, s_dev, _ = run(mk())
+    run(mk(tier_bytes=1 << 30))            # warm incl. restore scatter
+    dt_tier, s_tier, eng = run(mk(tier_bytes=1 << 30))
+
+    hit_dev = s_dev["prefix_reuse_tokens"] / s_dev["prompt_tokens"]
+    hit_tier = s_tier["prefix_reuse_tokens"] / s_tier["prompt_tokens"]
+    hit_host = s_tier["host_reuse_tokens"] / s_tier["prompt_tokens"]
+    restores = sorted(eng._restore_s)
+    n_restores = len(restores)
+    p50 = restores[n_restores // 2] * 1e3 if n_restores else None
+    reprefill_est = (None if not n_restores else
+                     max(0.0, dt_dev - dt_tier) * 1e3 / n_restores
+                     + (p50 or 0.0))
+    host = eng.kv_manager.host_tier
+    return {
+        "serving_tiered_kv_block": kvb,
+        "serving_tiered_pool_tokens": pool,
+        "serving_tiered_working_set_tokens": n_families * head_len,
+        "serving_prefix_hit_rate_device_only": round(hit_dev, 3),
+        "serving_prefix_hit_rate_tiered": round(hit_tier, 3),
+        "serving_prefix_hit_rate_host": round(hit_host, 3),
+        "serving_tier_hit_rate_ratio": (round(hit_tier / hit_dev, 2)
+                                        if hit_dev > 0 else None),
+        "serving_tiered_speedup": round(dt_dev / dt_tier, 2),
+        "serving_tiered_tok_per_sec": round(total_new / dt_tier, 1),
+        "serving_tier_restores": n_restores,
+        "serving_tier_restore_p50_ms": (round(p50, 2)
+                                        if p50 is not None else None),
+        "serving_tier_reprefill_ms_est": (round(reprefill_est, 2)
+                                          if reprefill_est is not None
+                                          else None),
+        "serving_tier_demoted_blocks": host.stats["demoted"],
+        "serving_tier_restored_blocks": host.stats["restored"],
+    }
+
+
 def bench_serving_router(n_requests=64, n_replicas=2, batch=8):
     """Fleet router A/B (round 17, serving/router.Router): prefix-aware
     vs round-robin placement over ``n_replicas`` paged replicas on a
@@ -1561,7 +1678,8 @@ def bench_serving_fleet(n_requests=24, batch=4):
 def main():
     only = os.environ.get("BENCH_ONLY")  # e.g. "bench_serving": one table
     fns = (bench_resnet50, bench_bert, bench_moe, bench_decode,
-           bench_serving, bench_serving_paged, bench_serving_router,
+           bench_serving, bench_serving_paged, bench_serving_tiered,
+           bench_serving_router,
            bench_serving_disagg, bench_serving_fleet, bench_longseq,
            bench_llama_long, bench_eager, bench_collectives)
     if only:

@@ -28,6 +28,12 @@ Round 16 adds ``python -u bench_sweep.py attn_impl``: the
 attention-read implementation axis (reference ``lax.while_loop``
 chunked read vs the fused Pallas gather+dequant+online-softmax kernel)
 crossed with the KV-storage dtype over the same occupancy regimes.
+
+Round 22 adds ``python -u bench_sweep.py host_tier_bytes``: the tiered
+KV cache's host-RAM budget axis over the churn workload (working set
+~3x the device pool) — hit rate and restore p50 per budget, 0 = the
+device-only baseline; the budget where the curve saturates is the host
+RAM the working set actually needs.
 """
 from __future__ import annotations
 
@@ -406,6 +412,80 @@ def sweep_prefill_impl(n_requests=24):
     return rows
 
 
+HOST_TIER_BYTES = [0, 1 << 26, 1 << 28, 1 << 30]
+
+
+def sweep_host_tier_bytes(n_families=12, waves=3):
+    """Host-tier byte-budget sweep for the tiered KV cache: the
+    bench_serving_tiered churn workload (prefix families whose
+    registered working set is ~3x the device pool, revisited across
+    admission waves) at each ``host_tier_bytes`` budget, 0 = the
+    device-only baseline.  End-to-end time, combined hit rate, and the
+    restore p50 — the budget where the hit-rate curve saturates is how
+    much host RAM the working set actually needs; past it the tier's own
+    LRU stops evicting and extra budget buys nothing."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Request, ServingEngine
+
+    lmax, kvb, batch = 2048, 256, 2
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(22)
+    pool, head_len = 2 * lmax, 4 * kvb
+    heads = [rng.integers(0, cfg.vocab_size, head_len)
+             for _ in range(n_families)]
+    reqs = []
+    for _ in range(waves):
+        for h in heads:
+            sfx = rng.integers(0, cfg.vocab_size,
+                               int(rng.integers(kvb // 4, kvb // 2)))
+            reqs.append((np.concatenate([h, sfx]),
+                         int(rng.integers(32, 65))))
+    total_new = sum(o for _, o in reqs)
+
+    def run(tier_bytes):
+        eng = ServingEngine(
+            model, batch_size=batch, max_len=lmax, sync_every=4,
+            decode_chunk=kvb, prefill_chunk=kvb, kv_block=kvb,
+            max_live_tokens=pool,
+            host_tier_bytes=tier_bytes or None,
+            prompt_buckets=[lmax // 8, lmax // 4, lmax // 2,
+                            3 * lmax // 4],
+            instrument=False, recorder=False)
+        for p, o in reqs:
+            eng.submit(Request(p, o))
+        t0 = time.perf_counter()
+        eng.run()
+        return time.perf_counter() - t0, eng
+
+    rows = []
+    for tb in HOST_TIER_BYTES:
+        run(tb)  # warm this configuration's programs
+        dt, eng = run(tb)
+        s = eng.stats()
+        restores = sorted(eng._restore_s)
+        p50 = (round(restores[len(restores) // 2] * 1e3, 2)
+               if restores else None)
+        rows.append({
+            "variant": ("tier_off" if not tb
+                        else f"host_tier_{tb >> 20}mb"),
+            "e2e_s": round(dt, 2),
+            "tok_per_sec": round(total_new / dt, 1),
+            "hit_rate": round(s["prefix_reuse_tokens"]
+                              / max(1, s["prompt_tokens"]), 3),
+            "host_hit_rate": round(s["host_reuse_tokens"]
+                                   / max(1, s["prompt_tokens"]), 3),
+            "restore_p50_ms": p50,
+        })
+        gc.collect()
+    return rows
+
+
 def main():
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "bench_sweep.jsonl")
@@ -430,6 +510,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "attn_impl":
         for rec in sweep_attn_impl():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "host_tier_bytes":
+        for rec in sweep_host_tier_bytes():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

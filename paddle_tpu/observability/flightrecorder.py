@@ -51,7 +51,12 @@ __all__ = ["EVENT_KINDS", "DUMP_REASONS", "FlightRecorder", "RequestTrace",
 
 # the structured event vocabulary — every engine lifecycle edge has a kind
 EVENT_KINDS = ("submit", "admit", "prefill_chunk", "dispatch", "retry",
-               "drain", "stall", "cancel", "shed", "poison", "retire")
+               "drain", "stall", "cancel", "shed", "poison", "retire",
+               # tiered KV cache: eviction-time demotion into the host
+               # store, admission-time restore out of it, the store's own
+               # budget evictions, validation failures, injected damage
+               "demote", "restore", "host_evict", "host_error",
+               "host_corrupt")
 
 # anomaly-dump triggers (the `reason` label of flight_recorder_dumps_total)
 DUMP_REASONS = ("timed_out", "poisoned", "retry_exhausted", "stall")

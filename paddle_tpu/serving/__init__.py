@@ -24,6 +24,7 @@ from paddle_tpu.serving.engine import (
 from paddle_tpu.serving.faults import (
     FaultPlan, InjectedDispatchError, InjectedStreamCbError,
 )
+from paddle_tpu.serving.kv_cache import BlockStore
 from paddle_tpu.serving.launch import (
     Fleet, FleetConfig, FleetCoordinator, launch,
 )
@@ -32,7 +33,8 @@ from paddle_tpu.serving.router import Router
 from paddle_tpu.serving.server import PRIORITY_CLASSES, ServingServer
 from paddle_tpu.serving.transport import SocketTransport
 
-__all__ = ["DecodeWorker", "DisaggCoordinator", "EngineOverloaded",
+__all__ = ["BlockStore", "DecodeWorker", "DisaggCoordinator",
+           "EngineOverloaded",
            "FaultPlan", "Fleet", "FleetConfig", "FleetCoordinator",
            "InProcessTransport", "InjectedDispatchError",
            "InjectedStreamCbError", "KVTransport",

@@ -881,7 +881,8 @@ class DisaggCoordinator:
     def prefix_lookup(self, tokens):
         """Longest cached prefix across the live PREFILL fleet — that is
         the side where a hit skips work (adoption always imports the
-        full chain)."""
+        full chain).  Tier-aware: each engine's probe counts its device
+        radix match plus its host-tier continuation."""
         return max((w.engine.prefix_lookup(tokens)
                     for w in self._live_prefill()), default=0)
 

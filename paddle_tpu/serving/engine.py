@@ -113,7 +113,7 @@ from paddle_tpu.observability.watchdog import DeadlockWatchdog
 from paddle_tpu.ops.decode_attention import _canon_kv_dtype
 from paddle_tpu.serving.faults import InjectedDispatchError
 from paddle_tpu.serving.kv_cache import (
-    KVCacheManager, KVPoolExhausted, PagedKVCacheManager,
+    BlockStore, KVCacheManager, KVPoolExhausted, PagedKVCacheManager,
 )
 from paddle_tpu.serving.metrics import EngineMetrics
 
@@ -318,6 +318,19 @@ class ServingEngine:
     Token streams are byte-identical to the dense engine at f32
     (tested), and the block tables are traced operands — zero retraces
     across appends, prefix hits and evictions.
+    ``host_tier_bytes`` / ``host_tier``: tiered KV cache — LRU eviction
+    DEMOTES registered prefix chains into a byte-budgeted host-RAM
+    ``BlockStore`` (a budget builds a private store; ``host_tier=``
+    shares a caller-built one) instead of destroying them, and
+    admission restores the host continuation of a prompt via a
+    ``kv_transfer`` scatter (a device_put — cheaper than re-prefilling
+    any prefix past ``host_tier_min_blocks`` blocks, the crossover
+    knob).  Demotion copies are staged off the step path and
+    materialized between scheduler steps; restores run at admission,
+    never inside the dispatch loop; restored streams are byte-identical
+    to never-evicted runs and the block tables still only change
+    VALUES — zero retraces across a demote→restore wave.  Requires
+    ``kv_block``.
     ``kv_dtype``: KV cache STORAGE dtype (``None`` = the model dtype).
     ``"int8"`` quantizes the cache — symmetric absmax over the head dim,
     one float16 scale per (position, head) row in a parallel pytree leaf
@@ -394,7 +407,9 @@ class ServingEngine:
                  retry_backoff=0.05, faults=None, recorder=True,
                  slo=None, attn_impl=None, weight_dtype=None,
                  prefill_impl=None, tp_overlap=None,
-                 prefill_only=False, on_prefilled=None, watchdog=None):
+                 prefill_only=False, on_prefilled=None, watchdog=None,
+                 host_tier_bytes=None, host_tier=None,
+                 host_tier_min_blocks=1):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
@@ -622,6 +637,27 @@ class ServingEngine:
                 program_key=self._pk)
             cache_sharding = self._tp.cache_sharding
             scale_sharding = self._tp.scale_sharding
+        # host KV tier: evictions demote into a byte-budgeted host-RAM
+        # BlockStore and admission restores from it (a device_put, not a
+        # suffix prefill).  ``host_tier=`` shares a caller-built store;
+        # ``host_tier_bytes=`` builds a private one.
+        if host_tier is not None and host_tier_bytes is not None:
+            raise ValueError(
+                "pass host_tier= (a BlockStore) OR host_tier_bytes= (a "
+                "budget for a private one), not both")
+        host_store = host_tier
+        if host_store is None and host_tier_bytes:
+            if not self._paged:
+                raise ValueError(
+                    "host_tier_bytes requires paged KV (kv_block=): only "
+                    "a block pool has demotable prefix chains")
+            host_store = BlockStore(int(host_tier_bytes), block=kv_block)
+        if host_store is not None and not self._paged:
+            raise ValueError(
+                "host_tier requires paged KV (kv_block=): only a block "
+                "pool has demotable prefix chains")
+        self._host_min_blocks = max(1, int(host_tier_min_blocks))
+        self._restore_s = []   # per-admission restore wall times (bench)
         if self._paged:
             self._kv = PagedKVCacheManager(
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
@@ -629,7 +665,7 @@ class ServingEngine:
                 max_live_tokens=(int(max_live_tokens) if max_live_tokens
                                  else self._B * self._lmax),
                 sharding=cache_sharding, on_event=self._kv_event,
-                scale_sharding=scale_sharding)
+                scale_sharding=scale_sharding, host_store=host_store)
         else:
             self._kv = KVCacheManager(
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
@@ -727,6 +763,7 @@ class ServingEngine:
         self._n_preempted = 0
         self._n_resume_suffix = 0
         self._n_resume_total = 0
+        self._n_host_reuse_tokens = 0
 
     # ------------------------------------------------------------- scheduling
     @property
@@ -1083,6 +1120,22 @@ class ServingEngine:
                 self._fr.record("poison", step=self._step_idx, rid=r.rid,
                                 slot=slot)
 
+    def _apply_host_corrupt(self):
+        """Inject every due ``FaultPlan(host_tier_corrupt=...)`` payload:
+        damage the host-tier entries along a token chain (or every entry)
+        so the NEXT restore exercises the validation + suffix-prefill
+        fallback path.  No-op without a host tier — the plan's damage
+        lands on stored bytes only, never the device pool."""
+        f = self._faults
+        if (f is None or not f.host_tier_corrupt or not self._paged
+                or self._kv.host_tier is None):
+            return
+        for tokens, mode in f.host_corrupts_due(self._step_idx):
+            n = self._kv.corrupt_host(tokens, mode=mode)
+            if self._fr is not None:
+                self._fr.record("host_corrupt", step=self._step_idx,
+                                mode=mode, entries=n)
+
     def _fault_point(self, kind, attempt):
         if self._faults is not None:
             self._faults.maybe_dispatch_error(kind, self._step_idx,
@@ -1142,15 +1195,26 @@ class ServingEngine:
     # statics baked in at construction).  Both take and return replicated
     # host-facing operands, so every caller is placement-oblivious.
     def _kv_event(self, kind, **info):
-        """PagedKVCacheManager event hook: mirror allocator activity
-        (``block_alloc`` / ``block_free``) into the flight recorder and
-        keep the block-pool gauges current.  Host bookkeeping only — the
-        allocator never touches a device value."""
+        """PagedKVCacheManager event hook: mirror allocator + host-tier
+        activity (``block_alloc`` / ``block_free`` / ``demote`` /
+        ``restore`` / ``host_evict`` / ``host_error``) into the flight
+        recorder and keep the block-pool and host-tier gauges current.
+        Host bookkeeping only — the hook never touches a device value."""
         if self._fr is not None:
             self._fr.record(kind, step=self._step_idx, **info)
         if self._m is not None:
             self._m.kv_blocks_used.set(self._kv.blocks_used())
             self._m.kv_blocks_free.set(self._kv.free_count())
+            host = getattr(self._kv, "host_tier", None)
+            if host is not None:
+                self._m.kv_host_blocks.set(host.n_blocks)
+                self._m.kv_host_bytes.set(host.total_bytes)
+                if kind == "demote":
+                    self._m.tier_demotions.inc(info.get("n_blocks", 1))
+                elif kind == "restore":
+                    self._m.tier_restores.inc(info.get("n_blocks", 1))
+                elif kind == "host_error":
+                    self._m.host_tier_errors.inc()
 
     def _tables(self):
         """The block-table operand for one dispatch: the host mirror
@@ -1304,7 +1368,7 @@ class ServingEngine:
             # runs only the suffix.
             r = max(self._queue, key=lambda q: q.priority)
             tok = self._admission_ids(r)
-            off0, shared, budget, need = 0, [], 0, 0
+            off0, shared, budget, need, host_tok = 0, [], 0, 0, 0
             if self._paged:
                 C = self._kv.block
                 p = int(tok.size)
@@ -1317,9 +1381,29 @@ class ServingEngine:
                     # rides on a dedicated prefill worker
                     need = p
                 off0, shared = self._kv.match_prefix(tok)
+                # restore-on-adopt: when the device radix breaks before
+                # the match cap, rehydrate the host tier's continuation
+                # (a device_put of stored rows, cheaper than suffix
+                # prefill past ~1 block) and re-run the ordinary radix
+                # match — restored blocks park exactly like a released
+                # chain, so admission below is tier-oblivious
+                host = self._kv.host_tier
+                off_dev = off0
+                if (host is not None and host.n_blocks
+                        and len(shared) < (p - 1) // C):
+                    t0 = time.perf_counter()
+                    got = self._kv.restore_from_host(
+                        tok, rid=r.rid, min_blocks=self._host_min_blocks)
+                    if got:
+                        self._restore_s.append(time.perf_counter() - t0)
+                        if m is not None:
+                            m.tier_restore_seconds.observe(
+                                self._restore_s[-1])
+                        off0, shared = self._kv.match_prefix(tok)
                 if P > C:
                     off0 = (off0 // P) * P
                     shared = shared[:off0 // C]
+                host_tok = max(0, off0 - min(off_dev, off0))
                 budget = -(-need // C) - len(shared)
                 if not self._kv.can_reserve(budget):
                     if self._fr is not None:
@@ -1337,6 +1421,7 @@ class ServingEngine:
                 r._adm_ids = tok
                 self._n_prompt_tokens += p
                 self._n_reuse_tokens += off0
+                self._n_host_reuse_tokens += host_tok
             if r._trace is not None:
                 r._trace.mark("prefilling", slot=slot)
             if self._fr is not None:
@@ -1360,9 +1445,14 @@ class ServingEngine:
                 # [0, off0) — prefill starts at the suffix offset
                 if self._fr is not None:
                     self._fr.record("prefix_hit", step=self._step_idx,
-                                    rid=r.rid, slot=slot, tokens=off0)
+                                    rid=r.rid, slot=slot, tokens=off0,
+                                    host_tokens=host_tok)
                 if m is not None:
                     m.prefix_reuse_tokens.inc(off0)
+                    if off0 > host_tok:
+                        m.prefix_hit("device")
+                    if host_tok:
+                        m.prefix_hit("host")
                 if self._mode == "spec":
                     # the skipped chunks would have written hist rows
                     # [0, off0); rebuild the slot's whole prompt row
@@ -1514,6 +1604,7 @@ class ServingEngine:
         if self._m is not None:
             self._m.admitted.inc()
             self._m.prompt_tokens.inc(p)
+            self._m.prefix_hit("fleet")
             self._m.slots_occupied.set(self._kv.occupied())
             self._m.live_tokens.set(self._kv.live_tokens())
         return slot
@@ -1695,6 +1786,7 @@ class ServingEngine:
                                 seconds=stalled, injected=True)
         self._expire_deadlines()
         self._apply_poison()
+        self._apply_host_corrupt()
         self._maybe_preempt()
         self._adm_wave = False
         self._admit()
@@ -1704,16 +1796,24 @@ class ServingEngine:
         adm_active = self._adm_wave or spent > 0 or bool(self._pf)
         if not self._pipeline:
             self._adm_pending.clear()
-            return self._step_sync(adm_active)
-        # the double buffer: stash the record of the PREVIOUS iteration's
-        # dispatch, issue the next dispatch, and only then drain the stash —
-        # step N+1 is outstanding on the device while step N's tokens are
-        # synced and its emit/retire bookkeeping runs.  When _dispatch has
-        # nothing to issue (e.g. every slot retired at the last drain) the
-        # stashed record is still drained, so run() terminates.
-        prev, self._inflight = self._inflight, None
-        self._dispatch(adm_active)
-        return self._drain(prev)
+            out = self._step_sync(adm_active)
+        else:
+            # the double buffer: stash the record of the PREVIOUS
+            # iteration's dispatch, issue the next dispatch, and only then
+            # drain the stash — step N+1 is outstanding on the device while
+            # step N's tokens are synced and its emit/retire bookkeeping
+            # runs.  When _dispatch has nothing to issue (e.g. every slot
+            # retired at the last drain) the stashed record is still
+            # drained, so run() terminates.
+            prev, self._inflight = self._inflight, None
+            self._dispatch(adm_active)
+            out = self._drain(prev)
+        if self._paged:
+            # materialize staged demotions BETWEEN steps: the eviction-time
+            # gathers have long since finished behind the drained dispatch,
+            # so this copies host<-device buffers without stalling the loop
+            self._kv.pump_host_tier()
+        return out
 
     def _observe_interference(self, adm_active, per_slot_tokens):
         """Feed ``serving_tpot_during_admission_seconds``: the per-token
@@ -2047,13 +2147,18 @@ class ServingEngine:
         return len(self._queue)
 
     def prefix_lookup(self, tokens):
-        """Longest cached prefix (in tokens) this engine's radix map
-        holds for ``tokens`` — the router's cache-aware placement probe.
-        0 on dense engines."""
+        """Longest cached prefix (in tokens) this engine holds for
+        ``tokens`` across BOTH tiers — the device radix match plus its
+        contiguous host-tier continuation (a restore at admission makes
+        those tokens just as reusable) — the router's cache-aware
+        placement probe.  Pure probe: no LRU heat on either tier.  0 on
+        dense engines."""
         if not self._paged:
             return 0
-        matched, _ = self._kv.match_prefix(
-            np.asarray(tokens, np.int32).reshape(-1))
+        tok = np.asarray(tokens, np.int32).reshape(-1)
+        matched, _ = self._kv.match_prefix(tok, touch=False)
+        if self._kv.host_tier is not None:
+            matched += self._kv.host_match(tok, matched)
         return int(matched)
 
     def stats(self):
@@ -2070,6 +2175,7 @@ class ServingEngine:
             "live_tokens": int(self._kv.live_tokens()),
             "prompt_tokens": self._n_prompt_tokens,
             "prefix_reuse_tokens": self._n_reuse_tokens,
+            "host_reuse_tokens": self._n_host_reuse_tokens,
             "preempted": self._n_preempted,
             "preempt_resume_suffix_tokens": self._n_resume_suffix,
             "preempt_resume_total_tokens": self._n_resume_total,

@@ -27,6 +27,14 @@ in prose:
 * **stream_cb crashes** — ``cb_crash_steps``: ``maybe_crash_stream_cb``
   raises ``InjectedStreamCbError`` inside the engine's emission callback
   guard, proving a crashing user callback is counted and survived.
+* **host-tier corruption** — ``host_tier_corrupt`` maps ``step ->
+  chain``: at that scheduler step the host KV tier's entries along the
+  chain's token ids are damaged (``None`` or ``"*"`` damages every
+  stored entry; a ``(tokens, mode)`` pair picks ``"truncate"`` — a
+  structural length mismatch — or ``"garble"`` — flipped payload bytes
+  under a stale CRC).  The next restore must detect the damage, drop
+  the entry, count ``serving_host_tier_errors_total`` and fall back to
+  suffix prefill — wrong bytes are never spliced into the pool.
 * **worker deaths** — ``worker_kill`` maps ``step -> worker name`` (or a
   tuple of names): at that coordinator step the named fleet worker is
   declared dead (``DisaggCoordinator(faults=...)`` drops it mid-stream;
@@ -65,7 +73,7 @@ class FaultPlan:
     def __init__(self, seed=0, dispatch_error_steps=(),
                  dispatch_error_rate=0.0, dispatch_error_attempts=1,
                  poison=None, slow_steps=None, cb_crash_steps=(),
-                 worker_kill=None):
+                 worker_kill=None, host_tier_corrupt=None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.dispatch_error_steps = set(dispatch_error_steps)
@@ -75,13 +83,17 @@ class FaultPlan:
         self.slow_steps = dict(slow_steps or {})    # step index -> seconds
         self.cb_crash_steps = set(cb_crash_steps)
         self.worker_kill = dict(worker_kill or {})  # step -> name(s)
+        # step -> chain: token ids, None/"*" (= every entry), or a
+        # (tokens, mode) pair naming "truncate" / "garble"
+        self.host_tier_corrupt = dict(host_tier_corrupt or {})
         self._killed_steps = set()
+        self._corrupted_steps = set()
         self._poisoned = set()
         self._rate_drawn = {}                       # step -> bool (memoized)
         self._fired = {}                            # step -> errors raised
         self.stats = {"dispatch_errors": 0, "poisoned": 0,
                       "slow_steps": 0, "cb_crashes": 0,
-                      "worker_kills": 0}
+                      "worker_kills": 0, "host_corrupts": 0}
 
     # ------------------------------------------------------- dispatch faults
     def _step_faulty(self, step):
@@ -161,6 +173,29 @@ class FaultPlan:
         self.stats["worker_kills"] += len(names)
         return names
 
+    # ------------------------------------------------- host-tier corruption
+    def host_corrupts_due(self, step):
+        """Damage payloads scheduled at or before ``step`` that have not
+        fired yet, as ``(tokens, mode)`` pairs (``tokens`` None = every
+        stored entry; mode defaults to "truncate").  Same at-or-before,
+        fire-once semantics as ``worker_kills_due`` — a payload scheduled
+        for a skipped step lands on the next probe."""
+        out = []
+        for due in sorted(self.host_tier_corrupt):
+            if due > step or due in self._corrupted_steps:
+                continue
+            self._corrupted_steps.add(due)
+            chain, mode = self.host_tier_corrupt[due], "truncate"
+            if (isinstance(chain, tuple) and len(chain) == 2
+                    and isinstance(chain[1], str)
+                    and chain[1] in ("truncate", "garble")):
+                chain, mode = chain
+            if isinstance(chain, str) and chain == "*":
+                chain = None
+            out.append((chain, mode))
+        self.stats["host_corrupts"] += len(out)
+        return out
+
     # -------------------------------------------------------- introspection
     def snapshot(self):
         """JSON-ready plan summary for the engine's ``/debug/*`` views:
@@ -178,6 +213,10 @@ class FaultPlan:
                 int(k): (sorted(v) if isinstance(v, (list, tuple, set))
                          else v)
                 for k, v in self.worker_kill.items()},
+            "host_tier_corrupt": {
+                int(k): ("*" if v is None or (isinstance(v, str)
+                                              and v == "*") else "chain")
+                for k, v in self.host_tier_corrupt.items()},
             "stats": dict(self.stats),
         }
 

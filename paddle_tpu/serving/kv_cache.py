@@ -41,10 +41,28 @@ block tables — the paged geometry of ROADMAP item 2:
   TRACED operands — growing a slot's chain or remapping it to shared
   blocks changes values, never shapes: zero retraces.
 
+``BlockStore`` is the HOST-RAM tier below the pool (ROADMAP item 2,
+the Mooncake/SGLang hierarchical-cache shape): when the allocator
+reclaims a registered EVICTABLE chain, the chain's block data is
+*demoted* — gathered off-pool (``export_chain``, an async device
+dispatch staged at eviction time) and materialized into the store by
+the engine's between-steps pump — instead of destroyed.  Store entries
+are keyed by CONTENT (the nested chunk-key spelling of the full token
+prefix, not device block ids), so a chain whose ancestors still live
+on-device and a chain demoted whole are both matchable.  At admission
+``restore_from_host`` rehydrates the host continuation of a prompt
+into freshly allocated, EVICTABLE-registered blocks — one functional
+``.at[ids].set`` per pool leaf through the sanctioned ``kv_transfer``
+seam — so the ordinary radix match then adopts them: a restore is a
+device_put, never a suffix prefill, and tables change values, never
+shapes (zero retraces across a demote→restore wave, tested).
+
 Everything here is host-side bookkeeping plus ONE eager masking op;
 nothing dispatches a compiled step — that stays the engine's job.
 """
 from __future__ import annotations
+
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -53,7 +71,260 @@ import numpy as np
 from paddle_tpu.ops.decode_attention import (init_kv_cache, init_kv_pool,
                                              masked_lengths)
 
-__all__ = ["KVCacheManager", "PagedKVCacheManager", "KVPoolExhausted"]
+__all__ = ["BlockStore", "KVCacheManager", "PagedKVCacheManager",
+           "KVPoolExhausted", "chunk_keys"]
+
+
+def chunk_keys(tokens, block):
+    """Content keys for every FULL ``block``-sized chunk of ``tokens``:
+    the nested ``(parent_key, chunk)`` spelling — structurally the whole
+    token prefix up to and including each chunk, hashable, with shared
+    structure between a chain and its extensions.  Keying the host tier
+    by content (instead of device block ids) is what lets a chain whose
+    ancestors still live on-device match its demoted continuation."""
+    keys, key = [], None
+    block = int(block)
+    for k in range(len(tokens) // block):
+        chunk = tuple(int(t) for t in tokens[k * block:(k + 1) * block])
+        key = (key, chunk)
+        keys.append(key)
+    return keys
+
+
+def _kv_transfer(leaves):
+    """Materialize staged demotion leaves on the host: block on the
+    eviction-time device gathers (dispatched long before — device
+    program order already ran them ahead of any subsequent pool write)
+    and return numpy copies.  This is the device→host half of the tier
+    boundary and the tpu-lint-sanctioned transfer seam (PTL017): it is
+    called ONLY from ``pump_host_tier`` between scheduler steps, never
+    inside a dispatch loop."""
+    def fetch(x):
+        if isinstance(x, tuple):
+            return tuple(fetch(e) for e in x)
+        # np.asarray of a jax buffer can alias it read-only — the store
+        # owns its bytes (and the corruption seam mutates them), so copy
+        return np.array(x)
+    return [(fetch(k), fetch(v)) for k, v in leaves]
+
+
+def kv_transfer(caches, ids, leaves):
+    """Scatter host-tier block data back into the pool: one functional
+    ``.at[ids].set`` per leaf (a device_put of values into an existing
+    buffer — shapes, shardings and programs are untouched, which is the
+    zero-retrace argument for restore-on-adopt).  The host→device half
+    of the tier boundary and the other sanctioned transfer seam
+    (tpu-lint PTL017): called only from ``restore_from_host``, which
+    the engine runs at admission — between steps, off the dispatch
+    loop."""
+    ids = jnp.asarray(np.asarray(ids, np.int32))
+
+    def put(pool, leaf):
+        if isinstance(pool, tuple):
+            return tuple(put(p, x) for p, x in zip(pool, leaf))
+        return pool.at[ids].set(jnp.asarray(leaf).astype(pool.dtype))
+    return [(put(kc, lk), put(vc, lv))
+            for (kc, vc), (lk, lv) in zip(caches, leaves)]
+
+
+def _leaf_nbytes(leaf):
+    if isinstance(leaf, tuple):
+        return sum(_leaf_nbytes(x) for x in leaf)
+    return int(leaf.nbytes)
+
+
+def _leaf_crc(leaf, crc=0):
+    if isinstance(leaf, tuple):
+        for x in leaf:
+            crc = _leaf_crc(x, crc)
+        return crc
+    return zlib.crc32(np.ascontiguousarray(leaf).tobytes(), crc)
+
+
+def _leaf_spec_of(leaf):
+    if isinstance(leaf, tuple):
+        return tuple(_leaf_spec_of(x) for x in leaf)
+    return (tuple(leaf.shape), str(leaf.dtype))
+
+
+class BlockStore:
+    """Host-RAM demotion tier for evicted prefix chains.
+
+    A radix map over CONTENT keys (``chunk_keys``) holding one KV
+    block's per-layer ``(k, v)`` leaves per entry — ``[C, Hkv, D]``
+    data plus ``[C, Hkv]`` scales on int8 pools, numpy, off-pool — under
+    its own LRU + byte budget:
+
+    * ``put`` inserts one block's leaves; when the budget overflows, the
+      least-recently-used entry AND its registered descendants are
+      evicted first (a child is only matchable through its parent, so a
+      subtree orphaned by its parent's eviction would be dead weight).
+      An entry bigger than the whole budget is rejected.
+    * ``fetch`` validates the entry against the pool's expected leaf
+      structure AND the CRC recorded at insert; a mismatch (truncated /
+      garbled chain — ``FaultPlan(host_tier_corrupt=...)``) drops the
+      entry's subtree, counts ``stats["errors"]`` and returns None, so
+      wrong bytes are NEVER spliced into a pool — the caller falls back
+      to suffix prefill.
+    * ``has`` is a pure probe (no LRU touch): routers may ask often.
+
+    Host bookkeeping only; the device halves of demotion/restore live in
+    the manager's ``_kv_transfer``/``kv_transfer`` seams.  One store may
+    be shared by several managers (engines) as long as their block sizes
+    agree — content keys carry the token bytes, so cross-engine hits are
+    exactly as safe as same-engine ones.
+    """
+
+    def __init__(self, max_bytes, block):
+        self.max_bytes = int(max_bytes)
+        self.block = int(block)
+        if self.max_bytes < 0:
+            raise ValueError("BlockStore max_bytes must be >= 0")
+        if self.block <= 0:
+            raise ValueError("BlockStore block must be > 0")
+        self._data = {}     # key -> per-layer [(k, v)] numpy leaves
+        self._nbytes = {}   # key -> payload bytes
+        self._crc = {}      # key -> crc32 at insert
+        self._kids = {}     # parent key -> set(child keys)
+        self._lru = {}      # key -> tick
+        self._tick = 0
+        self.total_bytes = 0
+        self.stats = {"demoted": 0, "restored": 0, "evicted": 0,
+                      "rejected": 0, "errors": 0}
+
+    @property
+    def n_blocks(self):
+        return len(self._data)
+
+    def __contains__(self, key):
+        return key in self._data
+
+    def has(self, key):
+        """Pure presence probe — no LRU touch (probing must not make an
+        entry look hot; only a restore-bound ``fetch`` does)."""
+        return key in self._data
+
+    @staticmethod
+    def key_digest(key):
+        """Short stable hex digest of a content key for events/logs (the
+        nested key itself spells the whole token prefix)."""
+        return format(zlib.crc32(repr(key).encode()), "08x")
+
+    def nbytes_of(self, key):
+        return self._nbytes.get(key, 0)
+
+    # ------------------------------------------------------------ mutation
+    def _drop_subtree(self, key, stat):
+        """Remove ``key`` and every registered descendant; returns the
+        dropped keys.  ``stat`` names the stats counter to charge."""
+        dropped, stack = [], [key]
+        while stack:
+            k = stack.pop()
+            stack.extend(self._kids.pop(k, ()))
+            if k not in self._data:
+                continue
+            del self._data[k]
+            self.total_bytes -= self._nbytes.pop(k)
+            self._crc.pop(k, None)
+            self._lru.pop(k, None)
+            kids = self._kids.get(k[0])
+            if kids is not None:
+                kids.discard(k)
+            dropped.append(k)
+            self.stats[stat] += 1
+        return dropped
+
+    def put(self, key, leaves):
+        """Insert one block's per-layer leaves under content ``key``.
+        Returns ``(stored, evicted_keys)``: LRU entries (with subtrees)
+        evicted to make room, or ``stored=False`` when the entry alone
+        exceeds the budget (counted ``rejected``).  Re-inserting a
+        present key refreshes its LRU tick and payload."""
+        nb = sum(_leaf_nbytes(k) + _leaf_nbytes(v) for k, v in leaves)
+        evicted = []
+        if nb > self.max_bytes:
+            self.stats["rejected"] += 1
+            return False, evicted
+        if key in self._data:
+            self.total_bytes -= self._nbytes[key]
+        while self.total_bytes + nb > self.max_bytes:
+            victim = min(self._lru, key=self._lru.get)
+            evicted.extend(self._drop_subtree(victim, "evicted"))
+        self._data[key] = leaves
+        self._nbytes[key] = nb
+        self._crc[key] = _leaf_crc(tuple(leaves))
+        self._kids.setdefault(key[0], set()).add(key)
+        self.total_bytes += nb
+        self._tick += 1
+        self._lru[key] = self._tick
+        self.stats["demoted"] += 1
+        return True, evicted
+
+    def fetch(self, key, spec=None):
+        """The entry's leaves, validated — or None (absent, or corrupt:
+        structure/shape/dtype mismatch against ``spec`` or a CRC
+        mismatch; the bad entry's subtree is dropped and ``errors``
+        counted, so a broken chain can never splice wrong bytes)."""
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        ok = True
+        if spec is not None:
+            ok = (len(entry) == len(spec)
+                  and all(_leaf_spec_of(k) == sk and _leaf_spec_of(v) == sv
+                          for (k, v), (sk, sv) in zip(entry, spec)))
+        if ok:
+            ok = _leaf_crc(tuple(entry)) == self._crc.get(key)
+        if not ok:
+            self._drop_subtree(key, "errors")
+            return None
+        self._tick += 1
+        self._lru[key] = self._tick
+        self.stats["restored"] += 1
+        return entry
+
+    # --------------------------------------------------------- fault seam
+    def corrupt(self, key=None, mode="truncate"):
+        """Test-only damage seam (``FaultPlan.host_tier_corrupt``):
+        truncate (drop the last cached row of every leaf — a structural
+        length mismatch ``fetch`` catches against the pool spec) or
+        garble (flip payload bytes in place, leaving the insert-time CRC
+        stale) the entry at ``key``, or every entry when ``key`` is
+        None.  Returns the number of entries damaged."""
+        if mode not in ("truncate", "garble"):
+            raise ValueError(f"unknown corruption mode {mode!r}")
+        keys = [key] if key is not None else list(self._data)
+        n = 0
+        for k in keys:
+            entry = self._data.get(k)
+            if entry is None:
+                continue
+            if mode == "truncate":
+                def cut(leaf):
+                    if isinstance(leaf, tuple):
+                        return tuple(cut(x) for x in leaf)
+                    return leaf[:-1]
+                self._data[k] = [(cut(kk), cut(vv)) for kk, vv in entry]
+            else:
+                def garble(leaf):
+                    if isinstance(leaf, tuple):
+                        return (garble(leaf[0]),) + tuple(leaf[1:])
+                    out = np.array(leaf)
+                    raw = out.reshape(-1).view(np.uint8)
+                    raw[: min(8, raw.size)] ^= 0xFF
+                    return out
+                kk, vv = entry[0]
+                entry[0] = (garble(kk), vv)
+            n += 1
+        return n
+
+    # ------------------------------------------------------- introspection
+    def snapshot(self):
+        """JSON-ready occupancy/stats view for debug endpoints."""
+        return {"max_bytes": self.max_bytes, "block": self.block,
+                "n_blocks": self.n_blocks,
+                "total_bytes": self.total_bytes,
+                "stats": dict(self.stats)}
 
 
 def _place_caches(caches, sharding, scale_sharding):
@@ -173,7 +444,7 @@ class PagedKVCacheManager(KVCacheManager):
 
     def __init__(self, n_layers, batch_size, max_len, num_kv_heads,
                  head_dim, dtype, block, max_live_tokens, sharding=None,
-                 on_event=None, scale_sharding=None):
+                 on_event=None, scale_sharding=None, host_store=None):
         self.batch_size = int(batch_size)
         self.max_len = int(max_len)
         self.block = int(block)
@@ -212,6 +483,16 @@ class PagedKVCacheManager(KVCacheManager):
         self._lru = {}      # evictable block id -> release tick
         self._tick = 0
         self._on_event = on_event
+        # ---- host tier (BlockStore): eviction demotes instead of
+        # destroying; staged (keys, device leaves) pairs wait here for
+        # the engine's between-steps pump to materialize them
+        if host_store is not None and host_store.block != self.block:
+            raise ValueError(
+                f"host tier block size ({host_store.block}) must match "
+                f"the pool block size ({self.block}): content keys are "
+                "chunked at the block width")
+        self._host = host_store
+        self._pending_demote = []
 
     def _emit(self, kind, **info):
         if self._on_event is not None:
@@ -251,24 +532,53 @@ class PagedKVCacheManager(KVCacheManager):
         self._resv_left[slot] = int(n_blocks)
 
     # ---------------------------------------------------------- allocator
+    def _content_key(self, b):
+        """The host-tier content key of registered block ``b``: its chunk
+        path from the radix root, spelled as ``chunk_keys`` nests it."""
+        parts = []
+        while b != -1:
+            parent, chunk = self._key_of[b]
+            parts.append(chunk)
+            b = parent
+        key = None
+        for chunk in reversed(parts):
+            key = (key, chunk)
+        return key
+
     def _evict_subtree(self, root):
         """Reclaim evictable ``root`` and every registered descendant
-        (all refcount-0 by the chain invariant) back to the free list."""
+        (all refcount-0 by the chain invariant) back to the free list.
+
+        With a host tier attached this is DEMOTION, not destruction: the
+        subtree's block data is gathered off-pool here (``export_chain``
+        — an async device dispatch; program order runs it before any
+        later write to the freed blocks) and staged with its content
+        keys for ``pump_host_tier`` to materialize between steps.
+        Nothing blocks on the step path."""
         parent = self._key_of[root][0]
         self._kids.get(parent, set()).discard(root)
-        stack, n = [root], 0
+        demote = self._host is not None
+        stack = [(root, self._content_key(root) if demote else None)]
+        n, order, keys = 0, [], []
         while stack:
-            b = stack.pop()
+            b, ck = stack.pop()
             if self.refcnt[b] != 0:
                 raise RuntimeError(
                     f"prefix chain invariant broken: evicting block {b} "
                     f"with refcount {int(self.refcnt[b])}")
-            stack.extend(self._kids.pop(b, ()))
+            for kid in self._kids.pop(b, ()):
+                stack.append(
+                    (kid, (ck, self._key_of[kid][1]) if demote else None))
+            if demote and not self._host.has(ck):
+                order.append(b)
+                keys.append(ck)
             self._node.pop(self._key_of.pop(b), None)
             self._lru.pop(b, None)
             self._free.append(b)
             n += 1
             self._emit("block_free", block=int(b), evicted=True)
+        if order:
+            self._pending_demote.append((keys, self.export_chain(order)))
         return n
 
     def alloc_block(self):
@@ -321,12 +631,20 @@ class PagedKVCacheManager(KVCacheManager):
         return self._mapped[slot]
 
     # ------------------------------------------------------- prefix reuse
-    def match_prefix(self, tokens):
+    def match_prefix(self, tokens, touch=True):
         """Longest cached prefix of ``tokens`` -> (matched_tokens, blocks).
 
         Only FULL blocks are shareable, and the match is capped at
         ``((p-1)//C)*C`` so at least one suffix token always prefills —
-        the suffix forward is what produces the first-token logits."""
+        the suffix forward is what produces the first-token logits.
+
+        A match is a HIT: with ``touch`` (the admission default) every
+        matched block still parked EVICTABLE gets a fresh LRU tick, so a
+        hot shared prefix cannot be reclaimed ahead of a cold one just
+        because nobody released it recently (before this fix only
+        ``release`` moved the LRU clock).  Pure probes — a router asking
+        every replica, ``prefix_lookup`` — pass ``touch=False`` so
+        asking does not fake heat."""
         cap = max(0, (len(tokens) - 1) // self.block)
         parent, out = -1, []
         for k in range(cap):
@@ -335,6 +653,9 @@ class PagedKVCacheManager(KVCacheManager):
             b = self._node.get((parent, chunk))
             if b is None:
                 break
+            if touch and b in self._lru:
+                self._tick += 1
+                self._lru[b] = self._tick
             out.append(b)
             parent = b
         return len(out) * self.block, out
@@ -384,6 +705,177 @@ class PagedKVCacheManager(KVCacheManager):
                 parent = b
             else:                   # lost the race: keep the rest private
                 break
+
+    # ---------------------------------------------------------- host tier
+    @property
+    def host_tier(self):
+        """The attached ``BlockStore`` demotion target (None = eviction
+        destroys, the pre-tier behavior)."""
+        return self._host
+
+    def _block_spec(self):
+        """Expected per-block leaf structure for host-tier validation:
+        per-layer ``(k, v)`` of ``(shape, dtype)`` descriptors over ONE
+        block's rows (tuple-nested on int8 pools)."""
+        def spec(leaf):
+            if isinstance(leaf, tuple):
+                return tuple(spec(x) for x in leaf)
+            return (tuple(leaf.shape[1:]), str(leaf.dtype))
+        return [(spec(k), spec(v)) for k, v in self.caches]
+
+    def host_match(self, tokens, matched_tokens):
+        """Host-tier tokens CONTINUING a device match of
+        ``matched_tokens``: contiguous chunks present in the store from
+        the device break onward, capped like ``match_prefix`` so at
+        least one suffix token always prefills.  Pure probe — no store
+        LRU touch."""
+        if self._host is None:
+            return 0
+        cap = max(0, (len(tokens) - 1) // self.block)
+        k0 = int(matched_tokens) // self.block
+        n = 0
+        for k, key in enumerate(chunk_keys(tokens[:cap * self.block],
+                                           self.block)):
+            if k < k0:
+                continue
+            if not self._host.has(key):
+                break
+            n += 1
+        return n * self.block
+
+    def restore_from_host(self, tokens, rid=None, min_blocks=1):
+        """Rehydrate the host-tier continuation of ``tokens`` into
+        freshly allocated, EVICTABLE-registered blocks; returns blocks
+        restored.  The caller (admission) simply re-runs
+        ``match_prefix`` afterwards and adopts through the ordinary
+        radix path — restored blocks enter the exact state a released
+        registered chain parks in (refcount 0, fresh LRU tick), so no
+        new invariants exist.
+
+        Chains shorter than ``min_blocks`` are left to suffix prefill
+        (the restore-vs-reprefill crossover knob).  Validation failures
+        (a corrupted store entry) stop the walk at the bad chunk, emit
+        ``host_error`` and leave earlier restored blocks in place —
+        wrong bytes are never spliced.  Allocation stops rather than
+        evict any block of the chain being extended (or just restored):
+        a restore must not cannibalize its own prefix."""
+        if self._host is None:
+            return 0
+        cap = max(0, (len(tokens) - 1) // self.block)
+        keys = chunk_keys(tokens[:cap * self.block], self.block)
+        # device walk: the chain restore continues, protected from the
+        # allocator below (match_prefix's touch already refreshed these
+        # at admission, but a tiny pool can still reach them)
+        parent, k0, protected = -1, 0, set()
+        for k, key in enumerate(keys):
+            b = self._node.get((parent, key[1]))
+            if b is None:
+                break
+            parent = b
+            protected.add(b)
+            k0 = k + 1
+        spec = self._block_spec()
+        entries, errors = [], 0
+        for k in range(k0, cap):
+            if not self._host.has(keys[k]):
+                break
+            leaves = self._host.fetch(keys[k], spec)
+            if leaves is None:
+                errors += 1
+                self._emit("host_error", rid=rid,
+                           key=BlockStore.key_digest(keys[k]))
+                break
+            entries.append((keys[k][1], leaves))
+        if not errors and len(entries) < max(1, int(min_blocks)):
+            return 0
+        if not entries:
+            return 0
+        blocks = []
+        for _ in entries:
+            if not self._free:
+                if not self._lru:
+                    break
+                if min(self._lru, key=self._lru.get) in protected:
+                    break
+            blocks.append(self.alloc_block())
+            protected.add(blocks[-1])
+        entries = entries[:len(blocks)]
+        if not blocks:
+            return 0
+        # one functional scatter per pool leaf for the whole restored run
+        def stack(li, which):
+            parts = [e[1][li][which] for e in entries]
+            if isinstance(parts[0], tuple):
+                return tuple(np.stack([p[j] for p in parts])
+                             for j in range(len(parts[0])))
+            return np.stack(parts)
+        stacked = [(stack(li, 0), stack(li, 1))
+                   for li in range(len(self.caches))]
+        self.caches = kv_transfer(self.caches, blocks, stacked)
+        nbytes = 0
+        for b, (chunk, leaves) in zip(blocks, entries):
+            key = (parent, chunk)
+            self._node[key] = b
+            self._key_of[b] = key
+            self._kids.setdefault(parent, set()).add(b)
+            self.refcnt[b] = 0
+            self._tick += 1
+            self._lru[b] = self._tick
+            parent = b
+            nbytes += sum(_leaf_nbytes(kk) + _leaf_nbytes(vv)
+                          for kk, vv in leaves)
+        self._emit("restore", rid=rid, n_blocks=len(blocks), bytes=nbytes,
+                   key=BlockStore.key_digest(self._content_key(blocks[0])))
+        return len(blocks)
+
+    def pump_host_tier(self):
+        """Materialize every staged demotion into the host store — the
+        engine calls this BETWEEN scheduler steps (never inside the
+        dispatch loop; the ``_kv_transfer`` block lands here, where the
+        eviction-time gathers finished long ago).  Returns blocks
+        demoted."""
+        if self._host is None or not self._pending_demote:
+            return 0
+        staged, self._pending_demote = self._pending_demote, []
+        demoted = 0
+        for keys, leaves in staged:
+            host = _kv_transfer(leaves)
+
+            def cut(leaf, i):
+                if isinstance(leaf, tuple):
+                    return tuple(cut(x, i) for x in leaf)
+                return np.ascontiguousarray(leaf[i])
+            stored_n, stored_bytes = 0, 0
+            for i, key in enumerate(keys):
+                per_block = [(cut(kk, i), cut(vv, i)) for kk, vv in host]
+                stored, evicted = self._host.put(key, per_block)
+                for ek in evicted:
+                    self._emit("host_evict",
+                               key=BlockStore.key_digest(ek))
+                if stored:
+                    stored_n += 1
+                    stored_bytes += self._host.nbytes_of(key)
+            if stored_n:
+                demoted += stored_n
+                self._emit("demote", n_blocks=stored_n,
+                           bytes=stored_bytes,
+                           key=BlockStore.key_digest(keys[0]))
+        return demoted
+
+    def corrupt_host(self, tokens=None, mode="truncate"):
+        """Damage the host-tier entries along ``tokens``'s chunk chain
+        (or every entry when None) — the manager half of the
+        ``FaultPlan(host_tier_corrupt=...)`` seam.  Returns entries
+        damaged."""
+        if self._host is None:
+            return 0
+        if tokens is None:
+            return self._host.corrupt(None, mode=mode)
+        n = 0
+        for key in chunk_keys(tokens, self.block):
+            if self._host.has(key):
+                n += self._host.corrupt(key, mode=mode)
+        return n
 
     # ------------------------------------------------- block-chain transfer
     # The prefill/decode split (serving/disagg.py) ships a finished

@@ -199,6 +199,46 @@ class EngineMetrics:
             "suffix tokens prefilled when preempted requests resumed "
             "(the adopted prefix rows were free — this counter IS the "
             "preemption recompute cost)", L).labels(**lbl)
+        # tiered KV cache (host_tier_bytes=): host-store occupancy
+        # gauges, tier-labeled hit counters (every tier child
+        # pre-registered so a first scrape shows the full zero-valued
+        # set), demotion/restore volumes, validation failures, and the
+        # restore latency the restore-vs-reprefill crossover reads
+        self.kv_host_blocks = reg.gauge(
+            "serving_kv_host_blocks",
+            "KV blocks resident in the host-RAM demotion tier",
+            L).labels(**lbl)
+        self.kv_host_bytes = reg.gauge(
+            "serving_kv_host_bytes",
+            "bytes resident in the host-RAM demotion tier (its LRU "
+            "evicts at the host_tier_bytes budget)", L).labels(**lbl)
+        self._prefix_hits = reg.counter(
+            "serving_prefix_hits_total",
+            "admissions that adopted a cached prefix, by serving tier "
+            "(device = radix blocks already in the pool, host = blocks "
+            "restored from the host tier, fleet = chains imported from "
+            "another engine)", ("policy", "tier"))
+        for tier in ("device", "host", "fleet"):
+            self._prefix_hits.labels(policy=policy, tier=tier)
+        self.tier_demotions = reg.counter(
+            "serving_tier_demotions_total",
+            "KV blocks demoted (evicted device chain copied into the "
+            "host tier off the step path)", L).labels(**lbl)
+        self.tier_restores = reg.counter(
+            "serving_tier_restores_total",
+            "KV blocks restored from the host tier at admission (a "
+            "kv_transfer device_put, not a suffix prefill)",
+            L).labels(**lbl)
+        self.host_tier_errors = reg.counter(
+            "serving_host_tier_errors_total",
+            "host-tier entries dropped by restore-time validation "
+            "(structure or CRC mismatch) — admission fell back to "
+            "suffix prefill instead of splicing wrong bytes",
+            L).labels(**lbl)
+        self.tier_restore_seconds = reg.histogram(
+            "serving_tier_restore_seconds",
+            "admission-side wall time of one host-tier chain restore "
+            "(fetch + validate + device scatter)", L).labels(**lbl)
         # KV quantization (kv_dtype=): an INFO gauge — one child per
         # known mode, the active one reads 1 — so a scrape (and
         # /debug/flightrecorder's kv_quant dispatch detail) states the
@@ -274,6 +314,11 @@ class EngineMetrics:
 
     def prefill(self, bucket):
         self._prefills.labels(policy=self._policy, bucket=bucket).inc()
+
+    def prefix_hit(self, tier):
+        """Count one prefix-adopting admission against ``tier``
+        ('device' | 'host' | 'fleet')."""
+        self._prefix_hits.labels(policy=self._policy, tier=tier).inc()
 
     def set_kv_quant(self, mode):
         """Point the kv-quant info gauge at ``mode`` (exactly one child

@@ -74,9 +74,11 @@ class Replica:
         return self.engine.kv_block
 
     def prefix_match(self, tokens):
-        """Longest prefix of ``tokens`` this replica's radix map already
-        caches, in tokens — the authoritative half of the router's
-        prefix-aware probe (the mirror is the predictive half)."""
+        """Longest prefix of ``tokens`` this replica already caches, in
+        tokens, across BOTH serving tiers (device radix blocks plus the
+        host tier's restorable continuation) — the authoritative half of
+        the router's prefix-aware probe (the mirror is the predictive
+        half)."""
         return self.engine.prefix_lookup(tokens)
 
     def queue_depth(self):

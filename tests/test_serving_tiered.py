@@ -1,0 +1,433 @@
+"""Tiered KV cache: host-RAM demotion with restore-on-adopt (ROADMAP
+item 2, the Mooncake-style capacity tier).
+
+The acceptance properties on the CPU mesh:
+
+* LRU eviction of a registered prefix chain DEMOTES its blocks into the
+  byte-budgeted host ``BlockStore`` (copies staged off the step path,
+  materialized between scheduler steps) instead of destroying them, and
+  admission restores the host continuation through a ``kv_transfer``
+  device scatter — restored token streams are BYTE-IDENTICAL to
+  never-evicted runs across greedy/spec, f32/int8 and the TP cell;
+* a radix HIT refreshes a parked chain's LRU recency (the satellite
+  regression: before the fix only release moved the clock, so a hot
+  shared prefix could be reclaimed ahead of a cold one);
+* the store's own LRU honors its byte budget, rejects oversize chains,
+  and keeps exact byte accounting;
+* a demote -> restore wave runs at ZERO retraces on a warm engine (the
+  restore changes table/pool VALUES, never shapes);
+* ``FaultPlan(host_tier_corrupt=...)`` damage (truncate/garble) is
+  detected at restore time — the entry drops, the error counts, and
+  admission falls back to suffix prefill with byte-identical outputs;
+* every tier metric child exists at construction, zero-valued.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import assert_no_retrace
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.serving import BlockStore, FaultPlan, Request, ServingEngine
+from paddle_tpu.serving.kv_cache import PagedKVCacheManager, chunk_keys
+
+
+def _tiny_model(seed=0, **cfg_kw):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(dtype="float32", **cfg_kw)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _run(model, prompts, new_lens, **kw):
+    eng = ServingEngine(model, **kw)
+    for p, n in zip(prompts, new_lens):
+        eng.submit(Request(p, int(n)))
+    done = eng.run()
+    assert not eng.has_work
+    return {r.rid: list(r.output_ids) for r in done}, eng
+
+
+def _churn_prompts(rng, n_heads=8, head=48, tail=8, waves=3):
+    """Shared-prefix families revisited across waves, working set sized
+    so a small pool must evict every family between visits."""
+    heads = [rng.integers(1, 200, size=head).tolist() for _ in range(n_heads)]
+    prompts = []
+    for _ in range(waves):
+        for h in heads:
+            prompts.append(h + rng.integers(1, 200, size=tail).tolist())
+    return prompts
+
+
+# a pool of 16 blocks (2 * 128 tokens); the 8-family churn working set
+# needs ~24 registered blocks, so every family is reclaimed between waves
+CHURN = dict(batch_size=2, max_len=128, decode_chunk=16, prefill_chunk=16,
+             kv_block=16, max_live_tokens=2 * 128)
+QUIET = dict(instrument=False, recorder=False)
+
+
+def _mgr(**kw):
+    d = dict(n_layers=1, batch_size=2, max_len=32, num_kv_heads=1,
+             head_dim=4, dtype="float32", block=8, max_live_tokens=64)
+    d.update(kw)
+    return PagedKVCacheManager(**d)
+
+
+def _plant_chain(mgr, tokens, scale=1.0):
+    """Map, fill, register and park (EVICTABLE) ``tokens``'s full-block
+    chain under slot 0; returns the block ids."""
+    n = len(tokens) // mgr.block
+    mgr.ensure_rows(0, n * mgr.block)
+    blocks = [int(mgr.block_tables[0, w]) for w in range(n)]
+    ids = np.asarray(blocks)
+    for li in range(len(mgr.caches)):
+        k, v = mgr.caches[li]
+        kv = (np.arange(np.asarray(k[ids]).size, dtype=np.float32)
+              .reshape(np.asarray(k[ids]).shape) * scale + li)
+        mgr.caches[li] = (k.at[ids].set(kv), v.at[ids].set(kv + 0.5))
+    mgr.register_prefix(0, tokens)
+    for b in blocks:
+        mgr.free_block(b)
+    mgr.block_tables[0, :] = mgr.num_blocks
+    mgr._mapped[0] = 0
+    return blocks
+
+
+# ---------------------------------------------------------------------------
+# BlockStore units (pure host — no engine, no device programs)
+# ---------------------------------------------------------------------------
+
+def _leaves(nbytes_per_leaf=64, n_layers=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.random(nbytes_per_leaf // 8).astype(np.float64),
+             rng.random(nbytes_per_leaf // 8).astype(np.float64))
+            for _ in range(n_layers)]
+
+
+class TestBlockStore:
+    def test_put_fetch_round_trip_and_accounting(self):
+        st = BlockStore(max_bytes=1 << 20, block=8)
+        key = (-1, (1, 2, 3, 4, 5, 6, 7, 8))
+        leaves = _leaves()
+        stored, evicted = st.put(key, leaves)
+        assert stored and not evicted
+        assert st.n_blocks == 1
+        assert st.total_bytes == sum(a.nbytes + b.nbytes
+                                     for a, b in leaves)
+        got = st.fetch(key)
+        for (a, b), (ga, gb) in zip(leaves, got):
+            np.testing.assert_array_equal(a, ga)
+            np.testing.assert_array_equal(b, gb)
+        assert st.stats["demoted"] == 1 and st.stats["restored"] == 1
+
+    def test_budget_lru_eviction(self):
+        # each entry is 128 bytes; budget holds exactly 3
+        st = BlockStore(max_bytes=3 * 128, block=8)
+        keys = [(-1, (i,) * 8) for i in range(4)]
+        for i, k in enumerate(keys[:3]):
+            st.put(k, _leaves(64, seed=i))
+        assert st.n_blocks == 3
+        st.fetch(keys[0])                 # refresh 0: 1 is now coldest
+        _, evicted = st.put(keys[3], _leaves(64, seed=3))
+        assert evicted == [keys[1]]
+        assert st.has(keys[0]) and st.has(keys[2]) and st.has(keys[3])
+        assert st.total_bytes == 3 * 128
+        assert st.stats["evicted"] == 1
+
+    def test_oversize_chain_rejected(self):
+        st = BlockStore(max_bytes=64, block=8)
+        stored, evicted = st.put((-1, (1,) * 8), _leaves(128))
+        assert not stored and not evicted and st.n_blocks == 0
+        assert st.stats["rejected"] == 1
+
+    def test_subtree_drops_with_parent(self):
+        # evicting a parent chunk must drop its descendants: a child
+        # whose parent is gone can never be matched again
+        st = BlockStore(max_bytes=2 * 128, block=8)
+        parent = (-1, (1,) * 8)
+        child = (parent, (2,) * 8)
+        st.put(parent, _leaves(64, seed=0))
+        st.put(child, _leaves(64, seed=1))
+        st.fetch(child)                   # parent is the LRU victim
+        _, evicted = st.put((-1, (3,) * 8), _leaves(64, seed=2))
+        assert parent in evicted and child in evicted
+        assert st.n_blocks == 1
+
+    def test_has_is_a_pure_probe(self):
+        st = BlockStore(max_bytes=2 * 128, block=8)
+        a, b = (-1, (1,) * 8), (-1, (2,) * 8)
+        st.put(a, _leaves(64, seed=0))
+        st.put(b, _leaves(64, seed=1))
+        for _ in range(5):
+            assert st.has(a)              # must not fake heat on a
+        _, evicted = st.put((-1, (3,) * 8), _leaves(64, seed=2))
+        assert evicted == [a]             # a was still the coldest
+
+
+# ---------------------------------------------------------------------------
+# manager units: LRU recency, demote -> restore, corruption, crossover
+# ---------------------------------------------------------------------------
+
+class TestLRURecency:
+    def test_radix_hit_refreshes_parked_chain(self):
+        # regression: a hot parked chain matched at admission must
+        # outlive a cold one when the allocator reclaims
+        mgr = _mgr(max_live_tokens=64)    # 8 blocks of 8
+        hot = list(range(1, 17))          # 2 blocks
+        cold = list(range(101, 117))      # 2 blocks
+        hot_blocks = _plant_chain(mgr, hot)
+        cold_blocks = _plant_chain(mgr, cold)
+        # cold released LAST, so pre-fix its recency beats hot's; the
+        # radix hit below must flip that
+        off, _ = mgr.match_prefix(hot + [1, 2, 3, 4, 5, 6, 7, 8, 9])
+        assert off == 16
+        while mgr._free:
+            mgr.alloc_block()
+        mgr.alloc_block()                 # forces one subtree eviction
+        assert all(b not in mgr._key_of for b in cold_blocks)
+        assert any(b in mgr._key_of for b in hot_blocks)
+
+    def test_probe_does_not_touch(self):
+        mgr = _mgr(max_live_tokens=64)
+        hot = list(range(1, 17))
+        cold = list(range(101, 117))
+        hot_blocks = _plant_chain(mgr, hot)
+        _plant_chain(mgr, cold)
+        # a router probe must NOT fake heat: cold stays newer than hot
+        off, _ = mgr.match_prefix(hot + [9] * 9, touch=False)
+        assert off == 16
+        while mgr._free:
+            mgr.alloc_block()
+        mgr.alloc_block()
+        assert all(b not in mgr._key_of for b in hot_blocks)
+
+
+class TestDemoteRestore:
+    def test_round_trip_byte_identity(self):
+        store = BlockStore(max_bytes=1 << 30, block=8)
+        mgr = _mgr(n_layers=2, host_store=store)
+        toks = list(range(1, 25))         # 3 full blocks
+        ext = toks + [99]                 # match cap covers all 3
+        blocks = _plant_chain(mgr, toks)
+        ids = np.asarray(blocks)
+        golden = [tuple(np.array(x[ids]) for x in mgr.caches[li])
+                  for li in range(2)]
+        mgr._evict_subtree(blocks[0])
+        assert mgr.pump_host_tier() == 3
+        assert store.n_blocks == 3
+        assert mgr.restore_from_host(ext) == 3
+        off, mb = mgr.match_prefix(ext)
+        assert off == 24 and len(mb) == 3
+        rid = np.asarray(mb)
+        for li in range(2):
+            for gi, leaf in enumerate(mgr.caches[li]):
+                np.testing.assert_array_equal(np.asarray(leaf[rid]),
+                                              golden[li][gi])
+
+    def test_restore_skips_device_resident_prefix(self):
+        store = BlockStore(max_bytes=1 << 30, block=8)
+        mgr = _mgr(host_store=store)
+        toks = list(range(1, 25))
+        blocks = _plant_chain(mgr, toks)
+        mgr._evict_subtree(blocks[0])
+        mgr.pump_host_tier()
+        mgr.restore_from_host(toks + [99])
+        # everything already resident: a second restore is a no-op
+        assert mgr.restore_from_host(toks + [99]) == 0
+
+    @pytest.mark.parametrize("mode", ["truncate", "garble"])
+    def test_corruption_detected_never_spliced(self, mode):
+        store = BlockStore(max_bytes=1 << 30, block=8)
+        mgr = _mgr(host_store=store)
+        toks = list(range(1, 25))
+        ext = toks + [99]
+        blocks = _plant_chain(mgr, toks)
+        mgr._evict_subtree(blocks[0])
+        mgr.pump_host_tier()
+        assert mgr.corrupt_host(ext, mode=mode) == 3
+        assert mgr.restore_from_host(ext) == 0
+        assert store.stats["errors"] >= 1
+        assert store.n_blocks == 0        # damaged subtree dropped
+        off, _ = mgr.match_prefix(ext)
+        assert off == 0                   # nothing wrong was spliced
+
+    def test_restore_vs_reprefill_crossover(self):
+        # chains below min_blocks are left to suffix prefill: a restore
+        # has fixed device_put overhead, so tiny chains aren't worth it
+        store = BlockStore(max_bytes=1 << 30, block=8)
+        mgr = _mgr(host_store=store)
+        toks = list(range(1, 17))         # a 2-block chain
+        blocks = _plant_chain(mgr, toks)
+        mgr._evict_subtree(blocks[0])
+        mgr.pump_host_tier()
+        assert mgr.restore_from_host(toks + [9], min_blocks=3) == 0
+        assert store.n_blocks == 2        # nothing dropped, nothing moved
+        assert mgr.restore_from_host(toks + [9], min_blocks=2) == 2
+
+    def test_host_match_probe(self):
+        store = BlockStore(max_bytes=1 << 30, block=8)
+        mgr = _mgr(host_store=store)
+        toks = list(range(1, 25))
+        blocks = _plant_chain(mgr, toks)
+        mgr._evict_subtree(blocks[0])
+        mgr.pump_host_tier()
+        off, _ = mgr.match_prefix(toks + [99], touch=False)
+        assert off == 0
+        assert mgr.host_match(toks + [99], off) == 24
+        # keys spell the whole token prefix, so a different head misses
+        other = [7] * 8 + toks[8:]
+        assert mgr.host_match(other + [99], 0) == 0
+
+    def test_chunk_keys_spell_the_prefix(self):
+        keys = chunk_keys(list(range(20)), 8)
+        assert len(keys) == 2             # only full chunks
+        assert keys[0] == (None, tuple(range(8)))
+        assert keys[1] == (keys[0], tuple(range(8, 16)))
+
+
+# ---------------------------------------------------------------------------
+# engine integration: churn hit rate, byte identity, zero retrace, faults
+# ---------------------------------------------------------------------------
+
+class TestTieredEngine:
+    def test_churn_hit_rate_and_byte_identity(self):
+        # working set ~3x pool: device-only forgets every family between
+        # waves; the tier restores them.  Outputs must not change.
+        rng = np.random.default_rng(7)
+        prompts = _churn_prompts(rng)
+        model = _tiny_model()
+        base, e0 = _run(model, prompts, [8] * len(prompts),
+                        **CHURN, **QUIET)
+        tier, e1 = _run(model, prompts, [8] * len(prompts),
+                        host_tier_bytes=1 << 30, **CHURN, **QUIET)
+        assert base == tier
+        s0, s1 = e0.stats(), e1.stats()
+        h0 = s0["prefix_reuse_tokens"] / s0["prompt_tokens"]
+        h1 = s1["prefix_reuse_tokens"] / s1["prompt_tokens"]
+        assert s1["host_reuse_tokens"] > 0
+        assert h1 >= 1.5 * max(h0, 1e-9) or h0 == 0.0
+        assert h1 > 0.5
+        host = e1.kv_manager.host_tier
+        assert host.stats["demoted"] > 0 and host.stats["restored"] > 0
+
+    def test_spec_mode_byte_identity(self):
+        rng = np.random.default_rng(11)
+        prompts = _churn_prompts(rng, n_heads=6, waves=2)
+        model = _tiny_model()
+        kw = dict(mode="spec", spec_k=4, **CHURN, **QUIET)
+        base, _ = _run(model, prompts, [8] * len(prompts), **kw)
+        tier, e1 = _run(model, prompts, [8] * len(prompts),
+                        host_tier_bytes=1 << 30, **kw)
+        assert base == tier
+        assert e1.stats()["host_reuse_tokens"] > 0
+
+    def test_int8_byte_identity_within_q8(self):
+        # int8 streams may drift from f32, but tiered-int8 must equal
+        # untiered-int8 bit for bit (and the (data, scale) leaf pairs
+        # must survive the host round trip)
+        rng = np.random.default_rng(13)
+        prompts = _churn_prompts(rng, n_heads=6, waves=2)
+        model = _tiny_model()
+        kw = dict(kv_dtype="int8", **CHURN, **QUIET)
+        base, _ = _run(model, prompts, [8] * len(prompts), **kw)
+        tier, e1 = _run(model, prompts, [8] * len(prompts),
+                        host_tier_bytes=1 << 30, **kw)
+        assert base == tier
+        assert e1.stats()["host_reuse_tokens"] > 0
+
+    def test_zero_retrace_across_demote_restore_wave(self):
+        # engine 1 warms the compiled programs INCLUDING a demote ->
+        # restore wave; engine 2 re-runs churn under assert_no_retrace —
+        # restores change pool/table values, never shapes
+        rng = np.random.default_rng(17)
+        model = _tiny_model()
+        kw = dict(host_tier_bytes=1 << 30, **CHURN, **QUIET)
+        _, warm = _run(model, _churn_prompts(rng),
+                       [8] * 24, **kw)
+        assert warm.kv_manager.host_tier.stats["restored"] > 0
+        eng2 = ServingEngine(model, **kw)
+        with assert_no_retrace():
+            for p in _churn_prompts(rng):
+                eng2.submit(Request(p, 8))
+            eng2.run()
+        assert eng2.kv_manager.host_tier.stats["restored"] > 0
+
+    def test_fault_corrupt_falls_back_to_prefill(self):
+        # damage every stored entry early: restores hit validation
+        # failures, admission re-prefills, outputs stay byte-identical
+        rng = np.random.default_rng(19)
+        prompts = _churn_prompts(rng)
+        model = _tiny_model()
+        base, _ = _run(model, prompts, [8] * len(prompts),
+                       **CHURN, **QUIET)
+        reg = MetricsRegistry()
+        plan = FaultPlan(host_tier_corrupt={12: ("*", "garble"),
+                                            30: ("*", "truncate")})
+        tier, e1 = _run(model, prompts, [8] * len(prompts),
+                        host_tier_bytes=1 << 30, faults=plan,
+                        registry=reg, instrument=True, recorder=True,
+                        **CHURN)
+        assert base == tier
+        assert plan.stats["host_corrupts"] == 2
+        errs = reg.get("serving_host_tier_errors_total").labels(
+            policy="continuous").value
+        assert errs > 0
+        kinds = {e["kind"] for e in e1.recorder.snapshot(last=4096)
+                 ["events"]}
+        assert "host_corrupt" in kinds and "host_error" in kinds
+
+    def test_prefix_lookup_counts_both_tiers(self):
+        rng = np.random.default_rng(23)
+        prompts = _churn_prompts(rng, n_heads=8, waves=1)
+        model = _tiny_model()
+        _, eng = _run(model, prompts, [8] * len(prompts),
+                      host_tier_bytes=1 << 30, **CHURN, **QUIET)
+        host = eng.kv_manager.host_tier
+        assert host.n_blocks > 0
+        # at least one family's chain was demoted: the tier-aware probe
+        # must still report its full-block prefix as cached
+        best = max(eng.prefix_lookup(p) for p in prompts)
+        assert best >= 48
+        # and probing must not have restored anything
+        assert host.stats["restored"] == 0
+
+    def test_knob_validation(self):
+        model = _tiny_model()
+        with pytest.raises(ValueError, match="not both"):
+            ServingEngine(model, host_tier_bytes=1 << 20,
+                          host_tier=BlockStore(1 << 20, 16),
+                          **CHURN, **QUIET)
+        with pytest.raises(ValueError, match="requires paged"):
+            ServingEngine(model, batch_size=2, max_len=128,
+                          host_tier_bytes=1 << 20, **QUIET)
+
+    def test_metrics_preregistered_at_construction(self):
+        reg = MetricsRegistry()
+        ServingEngine(_tiny_model(), registry=reg, recorder=False,
+                      **CHURN)
+        lbl = dict(policy="continuous")
+        for name in ("serving_kv_host_blocks", "serving_kv_host_bytes",
+                     "serving_tier_demotions_total",
+                     "serving_tier_restores_total",
+                     "serving_host_tier_errors_total"):
+            assert reg.get(name).labels(**lbl).value == 0, name
+        hits = reg.get("serving_prefix_hits_total")
+        for tier in ("device", "host", "fleet"):
+            assert hits.labels(policy="continuous", tier=tier).value == 0
+        assert reg.get("serving_tier_restore_seconds") is not None
+
+    def test_tier_metrics_move_under_churn(self):
+        rng = np.random.default_rng(29)
+        prompts = _churn_prompts(rng)
+        reg = MetricsRegistry()
+        _run(_tiny_model(), prompts, [8] * len(prompts),
+             host_tier_bytes=1 << 30, registry=reg, recorder=False,
+             **CHURN)
+        lbl = dict(policy="continuous")
+        assert reg.get("serving_tier_demotions_total"
+                       ).labels(**lbl).value > 0
+        assert reg.get("serving_tier_restores_total"
+                       ).labels(**lbl).value > 0
+        hits = reg.get("serving_prefix_hits_total")
+        assert hits.labels(policy="continuous", tier="host").value > 0
