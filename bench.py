@@ -654,7 +654,46 @@ def bench_serving(n_requests=64, batch=8):
     run("continuous", "spec")    # warm the spec step
     dt_s, _, reg_s = run("continuous", "spec")
     spec_child = reg_s.get("serving_spec_accept_rate").labels(
-        policy="continuous")
+        policy="continuous", source="prompt_lookup")
+    # A/B 10 (round 23) — resident-draft-model speculation (the draft
+    # forward replaces prompt-lookup as the candidate source; emission
+    # still comes only from the verify forward's own greedy picks, so
+    # both arms stay lossless).  Off the chip the draft forward runs at
+    # host speed next to the target, so the speedup columns are
+    # ratio-only; the accept-rate columns are REAL — counted off the
+    # verify comparison.  Two drafters: ``dm`` is the quarter-depth
+    # shrunk model (realistic shape; random-init, so its acceptance
+    # reflects draft/target agreement on the bench model, NOT a trained
+    # pair — expect near-chance), ``dm_self`` is the target drafting for
+    # itself (acceptance ~1.0 by construction — the upper bound, and the
+    # proof the acceptance plumbing measures agreement rather than
+    # asserting it).  The self-draft arm runs on a PAGED pool so the
+    # draft tenant's accounting rides the bench: the leak column reads
+    # the draft tenant's block gauge after drain and must be 0.
+    from paddle_tpu.serving.engine import SpecConfig
+    dcfg_kw = dict(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=max(1, cfg.num_hidden_layers // 4),
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=lmax, dtype=cfg.dtype)
+    draft = LlamaForCausalLM(LlamaConfig(**dcfg_kw))
+    draft.eval()
+    dm_spec = SpecConfig(source="draft_model", draft_model=draft)
+    run("continuous", "spec", spec=dm_spec)      # warm the draft programs
+    dt_dm, _, reg_dm = run("continuous", "spec", spec=dm_spec)
+    dm_child = reg_dm.get("serving_spec_accept_rate").labels(
+        policy="continuous", source="draft_model")
+    self_spec = SpecConfig(source="draft_model", draft_model=model)
+    sp_kw = dict(kv_block=pchunk, prefill_chunk=pchunk,
+                 max_live_tokens=2 * batch * lmax)
+    run("continuous", "spec", spec=self_spec, **sp_kw)
+    dt_ds, _, reg_ds = run("continuous", "spec", spec=self_spec, **sp_kw)
+    ds_child = reg_ds.get("serving_spec_accept_rate").labels(
+        policy="continuous", source="draft_model")
+    dm_leaked = reg_ds.get("serving_kv_blocks_used").labels(
+        policy="continuous", model="draft")
     stall = reg_c.get("serving_pipeline_stall_seconds").labels(
         policy="continuous")
     ctx_full = float(np.mean(plens + olens / 2))
@@ -675,6 +714,15 @@ def bench_serving(n_requests=64, batch=8):
         "serving_speedup": round(dt_g / dt_c, 2),
         "serving_spec_tok_per_sec": round(total_new / dt_s, 1),
         "serving_spec_speedup": round(dt_g / dt_s, 2),
+        # resident-draft-model arms (round 23): speedups ratio-only
+        # off-chip, accept rates real (see A/B 10 comment)
+        "serving_spec_dm_accept_rate": round(dm_child.value, 3),
+        "serving_spec_dm_tok_per_sec": round(total_new / dt_dm, 1),
+        "serving_spec_dm_speedup": round(dt_g / dt_dm, 2),
+        "serving_spec_dm_self_accept_rate": round(ds_child.value, 3),
+        "serving_spec_dm_self_tok_per_sec": round(total_new / dt_ds, 1),
+        "serving_spec_dm_self_speedup": round(dt_g / dt_ds, 2),
+        "serving_spec_dm_draft_blocks_leaked": int(dm_leaked.value),
         # chunked-vs-full and pipelined-vs-sync A/Bs (round 9)
         "serving_chunked_speedup": round(dt_f / dt_c, 2),
         "serving_pipeline_speedup": round(dt_y / dt_c, 2),

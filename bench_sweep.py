@@ -412,6 +412,86 @@ def sweep_prefill_impl(n_requests=24):
     return rows
 
 
+SPEC_KS = [2, 4, 8]
+
+
+def sweep_spec_k(n_requests=16):
+    """Draft-depth axis for resident-draft-model speculation: fixed
+    ``spec_k`` rungs vs the adaptive ladder (spec_k=8, k_min=1,
+    accept-rate window 8), crossed with two drafters that bracket the
+    acceptance range — ``self`` (the target drafting for itself,
+    accept ~1.0: deep drafts pay off, adaptive should hold the top
+    rung) and ``shrunk`` (a quarter-depth random-init draft, accept
+    near chance: every drafted token is wasted work, adaptive should
+    walk down to k_min).  The point of the axis: no fixed k wins both
+    regimes, the ladder should track the better fixed rung in each.
+    Off-chip the times are ratio-only (the draft forward runs at host
+    speed); accept rates and the settled depth are real."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.serving import Request, ServingEngine
+    from paddle_tpu.serving.engine import SpecConfig
+
+    lmax, batch = 2048, 8
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    dcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=4, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=lmax, dtype="bfloat16",
+    )
+    shrunk = LlamaForCausalLM(dcfg)
+    shrunk.eval()
+    rng = np.random.default_rng(0)
+    plens = rng.integers(64, 513, n_requests)
+    olens = rng.integers(64, 129, n_requests)
+    reqs = [(np.tile(rng.integers(0, cfg.vocab_size, 32),
+                     p // 32 + 1)[:p], int(o)) for p, o in zip(plens, olens)]
+    total_new = int(olens.sum())
+
+    def run(drafter, spec):
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, batch_size=batch, max_len=lmax,
+                            mode="spec", sync_every=4, registry=reg,
+                            spec_k=spec.spec_k, spec=spec,
+                            kv_block=256, prefill_chunk=256,
+                            max_live_tokens=2 * batch * lmax)
+        for p, o in reqs:
+            eng.submit(Request(p, o))
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        rate = reg.get("serving_spec_accept_rate").labels(
+            policy="continuous", source="draft_model").value
+        k_end = reg.get("serving_spec_draft_k").labels(
+            policy="continuous").value
+        return dt, rate, k_end
+
+    rows = []
+    for dname, drafter in (("self", model), ("shrunk", shrunk)):
+        variants = [SpecConfig(source="draft_model", draft_model=drafter,
+                               spec_k=k) for k in SPEC_KS]
+        variants.append(SpecConfig(source="draft_model", draft_model=drafter,
+                                   spec_k=8, k_min=1, adaptive_window=8))
+        for spec in variants:
+            run(dname, spec)  # warm this configuration's programs
+            dt, rate, k_end = run(dname, spec)
+            kname = ("adaptive" if spec.adaptive_window is not None
+                     else f"k{spec.spec_k}")
+            rows.append({"variant": f"spec_{dname}_{kname}",
+                         "e2e_s": round(dt, 2),
+                         "tok_per_sec": round(total_new / dt, 1),
+                         "accept_rate": round(rate, 3),
+                         "draft_k_end": int(k_end)})
+            gc.collect()
+    return rows
+
+
 HOST_TIER_BYTES = [0, 1 << 26, 1 << 28, 1 << 30]
 
 
@@ -516,6 +596,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "host_tier_bytes":
         for rec in sweep_host_tier_bytes():
+            print(json.dumps(rec), flush=True)
+            with open(out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "spec_k":
+        for rec in sweep_spec_k():
             print(json.dumps(rec), flush=True)
             with open(out, "a") as f:
                 f.write(json.dumps(rec) + "\n")

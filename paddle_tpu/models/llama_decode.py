@@ -32,14 +32,14 @@ import jax.numpy as jnp
 
 from paddle_tpu.observability.compilecache import CompileCacheMonitor
 from paddle_tpu.ops.decode_attention import (
-    _Q8_MAX, _Q8_SCALE_DTYPE, _canon_dtype, decode_attention, init_kv_cache,
-    slot_prefill_attention,
+    _Q8_MAX, _Q8_SCALE_DTYPE, _canon_dtype, _kv_data, decode_attention,
+    init_kv_cache, slot_prefill_attention,
 )
 
 __all__ = ["extract_decode_params", "decode_greedy", "decode_speculative",
            "quantize_decode_weights", "serving_prefill_slot",
            "serving_prefill_chunk", "serving_decode_steps",
-           "serving_spec_step"]
+           "serving_spec_step", "serving_spec_draft_step"]
 
 # compile-cache visibility (paddle_tpu/observability): each jitted program
 # marks its traces from inside the traced body (host python there runs once
@@ -207,7 +207,7 @@ def _rope_at(q, k, cos_t, sin_t, positions):
 
 def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
                 chunk_size=None, block_tables=None, attn_impl=None,
-                tp_overlap=None):
+                tp_overlap=None, pos_offsets=None, attn_bias=None):
     """One decoder layer over T new tokens with the static cache.
     h [B, T, hidden] -> (h', k_cache', v_cache').  ``chunk_size`` (static)
     selects the length-adaptive chunked cache read in decode_attention;
@@ -215,18 +215,30 @@ def _layer_step(lp, cfg, h, k_cache, v_cache, lengths, cos_t, sin_t,
     pool geometry; ``attn_impl`` (static) selects the fused Pallas cache
     read (ops/paged_attention_pallas.py) vs the reference chunked loop;
     ``tp_overlap`` (static) segments the row-parallel wo/down matmuls so
-    their TP psums can overlap compute (byte-identical math)."""
+    their TP psums can overlap compute (byte-identical math).
+
+    ``pos_offsets [T]`` overrides the ROPE position of token ``i`` to
+    ``lengths + pos_offsets[i]`` instead of the sequential
+    ``lengths + i`` — the tree-speculation seam, where a branch token
+    physically appended at row ``lengths + T - 1`` must be rotated as if
+    it sat at the branch point.  Cache APPEND rows and the causal window
+    stay sequential (decode_attention knows nothing of the override);
+    ``attn_bias`` (broadcastable to [B, 1, T, Lmax]) carves the tree
+    mask out of that sequential causal window.  Both default to None —
+    the linear-chain path is bitwise untouched."""
     b, t, hidden = h.shape
     nh, nkv, hd, eps = cfg
     x = _rmsnorm(h, lp["ln1"], eps)
     q = _mm(x, lp, "wq").reshape(b, t, nh, hd)
     k = _mm(x, lp, "wk").reshape(b, t, nkv, hd)
     v = _mm(x, lp, "wv").reshape(b, t, nkv, hd)
-    positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    offs = jnp.arange(t, dtype=jnp.int32) if pos_offsets is None \
+        else pos_offsets.astype(jnp.int32)
+    positions = lengths[:, None] + offs[None, :]
     q, k = _rope_at(q, k, cos_t, sin_t, positions)
     out, k_cache, v_cache, _ = decode_attention(
         q, k, v, k_cache, v_cache, lengths, chunk_size=chunk_size,
-        block_table=block_tables, attn_impl=attn_impl)
+        attn_bias=attn_bias, block_table=block_tables, attn_impl=attn_impl)
     h = h + _mm(out.reshape(b, t, nh * hd), lp, "wo", tp_overlap=tp_overlap)
     x2 = _rmsnorm(h, lp["ln2"], eps)
     h = h + _mm(jax.nn.silu(_mm(x2, lp, "gate")) * _mm(x2, lp, "up"),
@@ -245,7 +257,7 @@ def _lm_logits(params, h):
 
 def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
              chunk_size=None, block_tables=None, attn_impl=None,
-             tp_overlap=None):
+             tp_overlap=None, pos_offsets=None, attn_bias=None):
     """Shared decode forward: tokens [B, T] -> (logits, caches',
     lengths + T).  ``last_only`` projects just the final position
     ([B, V], the scan/greedy path); otherwise every position ([B, T, V],
@@ -254,7 +266,9 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
     slot's prompt ends at a different column of the padded block.  One
     ``block_tables`` operand serves every layer — block id ``i`` names
     row ``i`` of EVERY layer's pool (the tables are geometry, the pools
-    are content)."""
+    are content).  ``pos_offsets`` / ``attn_bias`` thread the tree-
+    speculation ROPE override and tree attention mask into every layer
+    (see ``_layer_step``); None keeps the linear path bitwise unchanged."""
     h = params["embed"][tokens]  # [B, T, hidden]
     new_caches = []
     cos_t, sin_t = params["_rope"]
@@ -263,7 +277,9 @@ def _forward(params, cfg, tokens, caches, lengths, last_only, last_idx=None,
                                 chunk_size=chunk_size,
                                 block_tables=block_tables,
                                 attn_impl=attn_impl,
-                                tp_overlap=tp_overlap)
+                                tp_overlap=tp_overlap,
+                                pos_offsets=pos_offsets,
+                                attn_bias=attn_bias)
         new_caches.append((kc, vc))
     h = _rmsnorm(h, params["norm"], cfg[3])
     if last_idx is not None:
@@ -283,13 +299,15 @@ def _forward_step(params, cfg, tokens, caches, lengths, chunk_size=None,
 
 
 def _forward_step_all(params, cfg, tokens, caches, lengths, chunk_size=None,
-                      block_tables=None, attn_impl=None, tp_overlap=None):
+                      block_tables=None, attn_impl=None, tp_overlap=None,
+                      pos_offsets=None, attn_bias=None):
     """Logits for EVERY input position [B, T, V] — the verification pass
     of speculative decoding needs the target's next-token distribution
     after each drafted token."""
     return _forward(params, cfg, tokens, caches, lengths, last_only=False,
                     chunk_size=chunk_size, block_tables=block_tables,
-                    attn_impl=attn_impl, tp_overlap=tp_overlap)
+                    attn_impl=attn_impl, tp_overlap=tp_overlap,
+                    pos_offsets=pos_offsets, attn_bias=attn_bias)
 
 
 def _pick(logits, key, temperature, top_k, sample):
@@ -837,6 +855,156 @@ def _serving_spec_step_impl(params, cfg, cur, caches, dev_lengths, hist,
 serving_spec_step = _mon.wrap("serving_spec_step", jax.jit(
     _serving_spec_step_impl,
     static_argnames=("cfg", "spec_k", "chunk_size", "program_key")))
+
+
+def _serving_spec_draft_step_impl(params, dparams, cfg, dcfg, cur, caches,
+                                  dcaches, dev_lengths, active, spec_k=4,
+                                  chunk_size=None, block_tables=None,
+                                  draft_tables=None, program_key=None):
+    """One DRAFT-MODEL speculative round per slot: the resident draft
+    model decodes ``spec_k`` candidates sequentially through its own
+    compiled scan, the target verifies them in one ``[B, k+1]`` forward,
+    and the longest matched prefix is accepted — the serving twin of
+    ``_spec_jit``'s loop body, sharing ``_verify_and_emit`` so emission
+    is ALWAYS the verify forward's own greedy picks (lossless: byte-
+    identical streams to greedy, same caveat class as prompt-lookup).
+
+    Cache tenancy is pytree-STRUCTURAL: ``dcaches=None`` selects the
+    PAGED layout, where the draft model's KV rides the SAME block pool
+    as the target — draft layer ``l`` reads/writes the pool arrays of
+    target layer ``l`` (``caches[:len(dparams["layers"])]``) through its
+    own ``draft_tables [B, W]`` (blocks are model-agnostic bytes; the
+    manager hands the draft chain disjoint block ids, so the two
+    tenants never collide).  A non-None ``dcaches`` is the DENSE layout:
+    a separate per-draft-layer ``[B, Lmax]`` cache list carried as
+    engine state (dense rows are slot-indexed, so cohabitation would
+    clobber the target).
+
+    Both models run ``spec_k + 1`` appends from the same
+    ``dev_lengths`` (the draft's last step only fills its cache for the
+    full-acceptance case), so ONE shared length operand serves both and
+    the rewind — ``new_len = dev_lengths + j + 1`` for live slots — is a
+    single value: draft rewind is the same length rollback the target
+    does, and the engine's paged block release against ``new_len`` frees
+    both chains' over-allocated rows identically.
+
+    ``program_key.spec_tree == "top2"`` (dense caches only — the row
+    repair below indexes dense rows) verifies a second branch in the
+    SAME forward: the draft's top-2 alternative at the first position
+    rides as an extra trailing token with its ROPE position overridden
+    to the branch point (``pos_offsets``) and the whole linear chain
+    masked from its causal window (``attn_bias``) — a 2-leaf token tree
+    flattened into one [B, k+2] batch.  When the linear chain rejects at
+    position 0 but the target's pick IS the alternative, the round
+    emits (alt, bonus-from-alt's-logits) instead of 1 token, and the
+    alt's K/V — physically appended at row ``L+k+1``, already rotated
+    for ``L+1`` — is scattered into row ``L+1`` so future reads see the
+    accepted branch.  The draft cache keeps the rejected main-chain row
+    (draft KV is advisory: a stale draft row costs acceptance length
+    next round, never output bytes).
+
+    Returns (emitted [B, k+1], j [B], cur' [B], new_len [B], ok [B],
+    caches', dcaches') — the same device-resident carry contract as
+    ``serving_spec_step`` minus the history row (model drafting needs no
+    n-gram history)."""
+    _mon.mark_trace("serving_spec_draft_step")
+    b = cur.shape[0]
+    tree = _pk_axis(program_key, "spec_tree") == "top2"
+    paged = dcaches is None
+    d = len(dparams["layers"])
+    dc = list(caches[:d]) if paged else dcaches
+    dlen = dev_lengths.astype(jnp.int32)
+    attn_impl = _pk_axis(program_key, "attn_impl")
+    tp_overlap = _pk_axis(program_key, "tp_overlap")
+
+    # ---- draft: spec_k + 1 sequential steps through the draft program
+    def dbody(c, _):
+        tok, dc, dl = c
+        dlg, dc, dl = _forward_step(
+            dparams, dcfg, tok[:, None], dc, dl, chunk_size=chunk_size,
+            block_tables=draft_tables if paged else None,
+            attn_impl=attn_impl, tp_overlap=tp_overlap)
+        nxt = jnp.argmax(dlg, axis=-1).astype(jnp.int32)
+        alt = jax.lax.top_k(dlg, 2)[1][:, 1].astype(jnp.int32) if tree \
+            else nxt
+        return (nxt, dc, dl), (nxt, alt)
+
+    (_, dc, _), (dseq, alts) = jax.lax.scan(
+        dbody, (cur, dc, dlen), None, length=spec_k + 1)
+    drafts = dseq[:spec_k].T                                  # [B, k]
+    if paged:
+        caches = list(dc) + list(caches[d:])
+        dc = None
+
+    # ---- verify: one target forward over (cur, d1..dk[, alt1])
+    if tree:
+        alt1 = alts[0]                                        # [B]
+        toks = jnp.concatenate(
+            [cur[:, None], drafts, alt1[:, None]], axis=1)    # [B, k+2]
+        # the branch token sits physically at row L+k+1 but logically at
+        # the branch point L+1: override its rope position and mask the
+        # linear chain rows (L+2 .. L+k) out of its causal window
+        pos_offsets = jnp.concatenate(
+            [jnp.arange(spec_k + 1, dtype=jnp.int32),
+             jnp.ones((1,), jnp.int32)])
+        lmax_c = _kv_data(caches[0][0]).shape[1] if block_tables is None \
+            else None
+        if lmax_c is None:
+            raise ValueError(
+                "spec_tree='top2' requires dense caches — the branch-row "
+                "repair scatter indexes dense cache rows")
+        p = jnp.arange(lmax_c, dtype=jnp.int32)
+        # rows dlen..dlen+k+1 hold (cur, d1..dk, alt): the branch query's
+        # committed context is rows <= dlen plus itself, so the WHOLE
+        # linear chain d1..dk (rows dlen+1 .. dlen+k) is masked out
+        hide = (p[None, :] >= (dlen + 1)[:, None]) \
+            & (p[None, :] <= (dlen + jnp.int32(spec_k))[:, None])  # [B, L]
+        bias = jnp.zeros((b, 1, spec_k + 2, lmax_c), jnp.float32)
+        bias = bias.at[:, 0, spec_k + 1, :].set(
+            jnp.where(hide, -1e30, 0.0))
+        logits, caches, _ = _forward_step_all(
+            params, cfg, toks, caches, dlen, chunk_size=chunk_size,
+            block_tables=None, attn_impl=attn_impl, tp_overlap=tp_overlap,
+            pos_offsets=pos_offsets, attn_bias=bias)
+    else:
+        toks = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        logits, caches, _ = _forward_step_all(
+            params, cfg, toks, caches, dlen, chunk_size=chunk_size,
+            block_tables=block_tables, attn_impl=attn_impl,
+            tp_overlap=tp_overlap)
+    ok = jnp.all(jnp.isfinite(logits), axis=(-2, -1))         # [B]
+    emitted, cur2, j, _ = _verify_and_emit(
+        logits[:, :spec_k + 1], drafts, jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b, spec_k + 1), jnp.int32), spec_k + 1, spec_k)
+    if tree:
+        picks0 = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        bonus = jnp.argmax(logits[:, spec_k + 1], axis=-1).astype(jnp.int32)
+        br = (j == jnp.int32(0)) & (picks0 == alt1) & active   # [B]
+        btok = jnp.concatenate(
+            [alt1[:, None], bonus[:, None],
+             jnp.zeros((b, spec_k - 1), jnp.int32)], axis=1)   # [B, k+1]
+        emitted = jnp.where(br[:, None], btok, emitted)
+        j = jnp.where(br, jnp.int32(1), j)
+        cur2 = jnp.where(br, bonus, cur2)
+        # branch accepted: its K/V (rotated for L+1) lives at row L+k+1 —
+        # scatter it into row L+1; non-accepting rows route past capacity
+        src = jnp.clip(dlen + jnp.int32(spec_k + 1), 0, lmax_c - 1)
+        dst = jnp.where(br, dlen + jnp.int32(1), jnp.int32(lmax_c))
+        b_idx = jnp.arange(b)
+
+        def repair(c):
+            if isinstance(c, tuple):
+                return tuple(repair(x) for x in c)
+            return c.at[b_idx, dst].set(c[b_idx, src], mode="drop")
+
+        caches = [(repair(kc), repair(vc)) for kc, vc in caches]
+    new_len = dlen + jnp.where(active, j + jnp.int32(1), jnp.int32(0))
+    return emitted, j, cur2, new_len, ok, caches, dc
+
+
+serving_spec_draft_step = _mon.wrap("serving_spec_draft_step", jax.jit(
+    _serving_spec_draft_step_impl,
+    static_argnames=("cfg", "dcfg", "spec_k", "chunk_size", "program_key")))
 
 
 def _decode_params_of(model, lmax):

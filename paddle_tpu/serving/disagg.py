@@ -207,6 +207,9 @@ class PrefillWorker:
 
     def __init__(self, model, name="prefill0", **engine_kw):
         engine_kw.setdefault("mode", "greedy")
+        # drafting is a DECODE concern: the prefill worker runs greedy
+        # first-token-only, so a fleet-level spec config never reaches it
+        engine_kw.pop("spec", None)
         engine_kw["prefill_only"] = True
         engine_kw["on_prefilled"] = self._fire
         self.name = name

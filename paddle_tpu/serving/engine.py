@@ -90,6 +90,7 @@ from __future__ import annotations
 
 import bisect
 import contextlib
+import dataclasses
 import logging
 import threading
 import time
@@ -103,7 +104,7 @@ import jax.numpy as jnp
 from paddle_tpu.models.llama_decode import (
     _canon_weight_dtype, _decode_params_of, quantize_decode_weights,
     serving_decode_steps, serving_prefill_chunk, serving_prefill_slot,
-    serving_spec_step,
+    serving_spec_draft_step, serving_spec_step,
 )
 from paddle_tpu.observability.flightrecorder import (
     FlightRecorder, RequestTrace,
@@ -123,8 +124,8 @@ from paddle_tpu.serving.metrics import EngineMetrics
 warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable")
 
-__all__ = ["EngineOverloaded", "KVPoolExhausted", "Request",
-           "ServingEngine"]
+__all__ = ["AcceptWindow", "EngineOverloaded", "KVPoolExhausted",
+           "Request", "ServingEngine", "SpecConfig"]
 
 _NULL_CTX = contextlib.nullcontext()
 
@@ -170,6 +171,129 @@ def _host_fetch(*arrays):
     PTL004 rule keep flagging raw ``np.asarray`` added inside step loops
     without false-positiving on the pipelined drain."""
     return [np.asarray(a) for a in arrays]
+
+
+# warn-once latch for the SpecConfig draft-model fallback (satellite
+# contract: asking for model drafting without a model degrades to
+# prompt-lookup LOUDLY, but only once per process — a fleet of workers
+# constructing engines in a loop must not spam the log)
+_SPEC_FALLBACK_WARNED = False
+
+
+def _warn_spec_fallback():
+    global _SPEC_FALLBACK_WARNED
+    if _SPEC_FALLBACK_WARNED:
+        return
+    _SPEC_FALLBACK_WARNED = True
+    warnings.warn(
+        "SpecConfig(source='draft_model') with no draft_model supplied — "
+        "falling back to prompt-lookup drafting (this warning fires once "
+        "per process)", RuntimeWarning, stacklevel=3)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """THE validated speculative-decoding config: every drafting knob in
+    one frozen value, checked loudly at construction instead of free-form
+    kwargs failing deep inside the first compiled dispatch.
+
+    ``source``: ``"prompt_lookup"`` (model-free n-gram mining from the
+    slot's token history) or ``"draft_model"`` (a resident shrunk-llama
+    draft model decoding ``spec_k`` candidates through its own compiled
+    program).  ``draft_model``: the draft ``LlamaForCausalLM`` — required
+    for model drafting; ``source="draft_model"`` WITHOUT one falls back
+    to prompt-lookup with a once-per-process RuntimeWarning (the engine
+    must keep serving when a deployment forgets to ship draft weights).
+    ``spec_k``: draft tokens per verify round (``None`` inherits the
+    engine's ``spec_k`` kwarg); under the adaptive policy this is the
+    depth CEILING.  ``adaptive_window``: ``None`` = fixed k; an int >= 1
+    sizes the per-slot sliding window of verify rounds whose accept rate
+    drives the adaptive-k ladder (hard slots degrade toward ``k_min``
+    instead of paying dead verify lanes).  ``k_min``: the adaptive
+    floor.  ``tree``: ``None`` or ``"top2"`` — top-2 branching at the
+    first draft position, verified in the same batched forward through a
+    tree attention mask (draft-model source + dense caches only)."""
+
+    source: str = "prompt_lookup"
+    draft_model: object = None
+    spec_k: object = None
+    adaptive_window: object = None
+    k_min: int = 1
+    tree: object = None
+
+    def __post_init__(self):
+        if self.source not in ("prompt_lookup", "draft_model"):
+            raise ValueError(
+                f"SpecConfig: unknown source {self.source!r} — expected "
+                "'prompt_lookup' or 'draft_model'")
+        if self.spec_k is not None and (
+                isinstance(self.spec_k, bool)
+                or not isinstance(self.spec_k, int) or self.spec_k < 1):
+            raise ValueError(
+                f"SpecConfig: spec_k must be None (inherit the engine "
+                f"knob) or an int >= 1, got {self.spec_k!r}")
+        if self.adaptive_window is not None and (
+                isinstance(self.adaptive_window, bool)
+                or not isinstance(self.adaptive_window, int)
+                or self.adaptive_window < 1):
+            raise ValueError(
+                f"SpecConfig: adaptive_window must be None (fixed k) or "
+                f"an int >= 1 (verify rounds in the accept-rate window), "
+                f"got {self.adaptive_window!r}")
+        if isinstance(self.k_min, bool) or not isinstance(self.k_min, int) \
+                or self.k_min < 1:
+            raise ValueError(
+                f"SpecConfig: k_min must be an int >= 1, got "
+                f"{self.k_min!r}")
+        if self.spec_k is not None and self.k_min > self.spec_k:
+            raise ValueError(
+                f"SpecConfig: k_min ({self.k_min}) exceeds spec_k "
+                f"({self.spec_k})")
+        if self.tree not in (None, "top2"):
+            raise ValueError(
+                f"SpecConfig: unknown tree {self.tree!r} — expected None "
+                "(linear chain) or 'top2'")
+        if self.tree is not None and self.source != "draft_model":
+            raise ValueError(
+                "SpecConfig: tree='top2' branches on the draft model's "
+                "top-2 — it requires source='draft_model'")
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+class AcceptWindow:
+    """Sliding window of (drafted, accepted) verify rounds — the accept
+    rate that drives one slot's adaptive-k rung.  ``rate()`` is
+    ``sum(accepted) / sum(drafted)`` over the last ``window`` rounds, or
+    ``None`` while empty (a fresh slot holds its rung until evidence
+    arrives).  Pure host arithmetic; one instance per slot."""
+
+    def __init__(self, window):
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError(
+                f"AcceptWindow: window must be >= 1, got {window!r}")
+        self._q = deque(maxlen=self.window)
+
+    def push(self, drafted, accepted):
+        if drafted < 0 or accepted < 0 or accepted > drafted:
+            raise ValueError(
+                f"AcceptWindow: need 0 <= accepted <= drafted, got "
+                f"accepted={accepted} drafted={drafted}")
+        self._q.append((int(drafted), int(accepted)))
+
+    def rate(self):
+        drafted = sum(d for d, _ in self._q)
+        if not drafted:
+            return None
+        return sum(a for _, a in self._q) / drafted
+
+    def reset(self):
+        self._q.clear()
+
+    def __len__(self):
+        return len(self._q)
 
 
 class Request:
@@ -409,11 +533,46 @@ class ServingEngine:
                  prefill_impl=None, tp_overlap=None,
                  prefill_only=False, on_prefilled=None, watchdog=None,
                  host_tier_bytes=None, host_tier=None,
-                 host_tier_min_blocks=1):
+                 host_tier_min_blocks=1, spec=None):
         if mode not in ("greedy", "spec"):
             raise ValueError(f"unknown mode {mode!r}")
         if policy not in ("continuous", "gang"):
             raise ValueError(f"unknown policy {policy!r}")
+        # ONE validated config for every drafting knob (SpecConfig): the
+        # engine's legacy ``spec_k`` kwarg survives as the default depth,
+        # everything else — draft source, draft model, adaptive window,
+        # tree mode — routes through ``spec=``.  Asking for model
+        # drafting without a model degrades to prompt-lookup with a
+        # once-per-process warning; every other inconsistency is a loud
+        # ValueError here, never a trace error inside the first dispatch.
+        if spec is not None and mode != "spec":
+            raise ValueError(
+                "spec= carries speculative-drafting knobs — construct "
+                f"the engine with mode='spec' (got mode={mode!r})")
+        if mode == "spec":
+            if spec is None:
+                spec = SpecConfig()
+            elif isinstance(spec, dict):
+                spec = SpecConfig(**spec)
+            elif not isinstance(spec, SpecConfig):
+                raise ValueError(
+                    f"spec= must be a SpecConfig or a kwargs dict, got "
+                    f"{type(spec).__name__}")
+            if spec.spec_k is None:
+                spec = spec.replace(spec_k=int(spec_k))
+            if spec.source == "draft_model" and spec.draft_model is None:
+                _warn_spec_fallback()
+                spec = spec.replace(source="prompt_lookup", tree=None)
+            if spec.tree is not None and kv_block is not None:
+                raise ValueError(
+                    "spec tree='top2' requires dense caches (kv_block="
+                    "None): the accepted-branch row repair scatters into "
+                    "dense per-slot cache rows")
+            spec_k = spec.spec_k
+        else:
+            spec = None
+        self._spec = spec
+        self._dspec = spec is not None and spec.source == "draft_model"
         # prefill/decode disaggregation seams (serving/disagg.py).  A
         # prefill-only engine owns admission + chunked prefill and NEVER
         # dispatches a decode program: every request carries max_new=1
@@ -504,6 +663,12 @@ class ServingEngine:
         if self._pchunk is not None and self._pchunk < 1:
             raise ValueError("prefill_chunk must be >= 1 or None")
         self._pbudget = max(1, int(prefill_budget))
+        if self._dspec and self._pchunk is None:
+            raise ValueError(
+                "SpecConfig(source='draft_model') requires chunked "
+                "prefill (prefill_chunk=): the draft model's prompt KV "
+                "is built by per-chunk draft prefill dispatches riding "
+                "the admission path")
         # paged KV geometry: ``kv_block`` switches the cache to a global
         # block pool + per-slot block tables with radix prefix reuse, and
         # admission to total-live-TOKEN budgeting (``max_live_tokens``).
@@ -597,6 +762,51 @@ class ServingEngine:
             # any mesh placement so the int8 leaves shard directly
             self._params = quantize_decode_weights(
                 self._params, self._weight_dtype)
+        # resident draft model (SpecConfig source="draft_model"): its
+        # decode pytree lives alongside the target's and rides the same
+        # weight-quantization / mesh-placement path.  Paged engines share
+        # ONE block pool across both tenants — draft layer l reads/writes
+        # target layer l's pool arrays through its own block tables — so
+        # the geometries that alias (kv heads, head dim, dtype, layer
+        # count <= target's) are validated here, loudly.
+        self._dparams = self._dcfg = None
+        self._dcaches = None
+        if self._dspec:
+            self._dparams, self._dcfg = _decode_params_of(
+                spec.draft_model, self._lmax)
+            dnh, dnkv, dhd, _ = self._dcfg
+            if int(self._dparams["embed"].shape[0]) \
+                    != int(self._params["embed"].shape[0]):
+                raise ValueError(
+                    f"draft model vocab "
+                    f"{int(self._dparams['embed'].shape[0])} != target "
+                    f"vocab {int(self._params['embed'].shape[0])} — the "
+                    "verify forward compares token ids, so the vocabs "
+                    "must match")
+            if self._paged:
+                if len(self._dparams["layers"]) > len(
+                        self._params["layers"]):
+                    raise ValueError(
+                        f"paged draft sharing: draft layer count "
+                        f"{len(self._dparams['layers'])} exceeds target "
+                        f"{len(self._params['layers'])} (draft layer l "
+                        "rides target layer l's pool array)")
+                if (dnkv, dhd) != (nkv, hd):
+                    raise ValueError(
+                        f"paged draft sharing: draft KV geometry "
+                        f"(kv_heads={dnkv}, head_dim={dhd}) != target "
+                        f"({nkv}, {hd}) — blocks are model-agnostic "
+                        "bytes only when the per-row shapes match; use "
+                        "a dense engine for mismatched drafters")
+                if self._dparams["embed"].dtype \
+                        != self._params["embed"].dtype:
+                    raise ValueError(
+                        f"paged draft sharing: draft dtype "
+                        f"{self._dparams['embed'].dtype} != target "
+                        f"{self._params['embed'].dtype}")
+            if self._w8:
+                self._dparams = quantize_decode_weights(
+                    self._dparams, self._weight_dtype)
         # the declarative program identity: every static kernel/precision
         # knob flows through this ONE frozen registry value — the four
         # serving impls, the TP program cache and the jit static axes all
@@ -606,7 +816,30 @@ class ServingEngine:
         self._pk = ProgramKey(
             attn_impl=self._attn_impl, prefill_impl=self._prefill_impl,
             kv_dtype=self._kv_dtype, weight_dtype=self._weight_dtype,
-            tp_overlap=self._tp_overlap)
+            tp_overlap=self._tp_overlap,
+            draft_source=spec.source if spec is not None else None,
+            spec_depth=self._spec_k if spec is not None else None,
+            spec_tree=spec.tree if spec is not None else None)
+        # adaptive draft length: per-slot AcceptWindows drive a rung on a
+        # power-of-two ladder [k_min .. spec_k]; the batch runs ONE
+        # program per round at min(live slots' rungs), moving one rung
+        # per round (each depth is its own compiled program — the ladder
+        # is what bounds how many the warm set holds)
+        if spec is not None and spec.adaptive_window is not None:
+            rungs = {spec.k_min, self._spec_k}
+            r = 1
+            while r < self._spec_k:
+                if r > spec.k_min:
+                    rungs.add(r)
+                r *= 2
+            self._k_rungs = sorted(rungs)
+            self._awin = [AcceptWindow(spec.adaptive_window)
+                          for _ in range(self._B)]
+        else:
+            self._k_rungs = [self._spec_k]
+            self._awin = None
+        self._k_cur = self._k_rungs[-1]
+        self._k_want = [len(self._k_rungs) - 1] * self._B
         dtype = (self._kv_dtype if self._kv_dtype is not None
                  else self._params["embed"].dtype)
         # mesh=None: single-device engine, module-level jitted programs,
@@ -616,6 +849,7 @@ class ServingEngine:
         # process-wide cached TP programs (serving/sharding.py).  Host
         # scheduler state (cur/lengths/queues) stays replicated either way.
         self._tp = None
+        self._tp_spec = None   # adaptive-k ladder: {rung k: TPPrograms}
         cache_sharding = None
         scale_sharding = None
         if mesh is not None:
@@ -629,12 +863,40 @@ class ServingEngine:
                     f"(the KV cache shards along heads)")
             self._params, pspecs = shard_decode_params(
                 self._params, mesh, axis=tp_axis)
+            dspecs = None
+            if self._dspec:
+                dnh, dnkv, _, _ = self._dcfg
+                if dnkv % n or dnh % n:
+                    raise ValueError(
+                        f"draft heads not shardable {n}-way along "
+                        f"{tp_axis!r}: num_attention_heads={dnh}, "
+                        f"num_key_value_heads={dnkv} (the draft KV "
+                        "shards along heads like the target's)")
+                self._dparams, dspecs = shard_decode_params(
+                    self._dparams, mesh, axis=tp_axis)
+            d_layers = (len(self._dparams["layers"]) if self._dspec
+                        else 0)
             self._tp = serving_tp_programs(
                 mesh, tp_axis, self._cfg, pspecs,
                 len(self._params["layers"]), sync_every=self._sync,
                 spec_k=self._spec_k, with_hist=mode == "spec",
                 chunk_size=self._chunk, paged=self._paged,
-                program_key=self._pk)
+                program_key=self._pk, dcfg=self._dcfg,
+                dparam_specs=dspecs, d_layers=d_layers)
+            if mode == "spec":
+                # one compiled spec program per ladder rung (a depth IS
+                # a program shape); the top rung is the base TPPrograms
+                self._tp_spec = {self._spec_k: self._tp}
+                for k in self._k_rungs[:-1]:
+                    self._tp_spec[k] = serving_tp_programs(
+                        mesh, tp_axis, self._cfg, pspecs,
+                        len(self._params["layers"]),
+                        sync_every=self._sync, spec_k=k,
+                        with_hist=True, chunk_size=self._chunk,
+                        paged=self._paged,
+                        program_key=self._pk.replace(spec_depth=k),
+                        dcfg=self._dcfg, dparam_specs=dspecs,
+                        d_layers=d_layers)
             cache_sharding = self._tp.cache_sharding
             scale_sharding = self._tp.scale_sharding
         # host KV tier: evictions demote into a byte-budgeted host-RAM
@@ -659,11 +921,16 @@ class ServingEngine:
         self._host_min_blocks = max(1, int(host_tier_min_blocks))
         self._restore_s = []   # per-admission restore wall times (bench)
         if self._paged:
+            # a resident draft model is a second pool tenant: its chains
+            # grow in lockstep with the target's, so the default pool
+            # doubles (an explicit max_live_tokens is the caller's
+            # sizing decision and is respected as-is)
             self._kv = PagedKVCacheManager(
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
                 dtype, block=kv_block,
                 max_live_tokens=(int(max_live_tokens) if max_live_tokens
-                                 else self._B * self._lmax),
+                                 else (2 if self._dspec else 1)
+                                 * self._B * self._lmax),
                 sharding=cache_sharding, on_event=self._kv_event,
                 scale_sharding=scale_sharding, host_store=host_store)
         else:
@@ -671,12 +938,31 @@ class ServingEngine:
                 len(self._params["layers"]), self._B, self._lmax, nkv, hd,
                 dtype, sharding=cache_sharding,
                 scale_sharding=scale_sharding)
+            if self._dspec:
+                # dense draft tenancy: a SEPARATE per-draft-layer cache
+                # list (dense rows are slot-indexed — cohabitation in the
+                # target's arrays would clobber it), same storage dtype
+                # rules and head sharding as the target's
+                from paddle_tpu.ops.decode_attention import init_kv_cache
+                from paddle_tpu.serving.kv_cache import _place_caches
+                _, dnkv, dhd, _ = self._dcfg
+                ddtype = (self._kv_dtype if self._kv_dtype is not None
+                          else self._dparams["embed"].dtype)
+                self._dcaches = [
+                    init_kv_cache(self._B, self._lmax, dnkv, dhd, ddtype)
+                    for _ in range(len(self._dparams["layers"]))]
+                if cache_sharding is not None:
+                    self._dcaches = _place_caches(
+                        self._dcaches, cache_sharding, scale_sharding)
         if self._m is not None:
             self._m.set_kv_quant(self._kvq)
             self._m.set_decode_kernel(self._attn_label)
             self._m.set_prefill_kernel(self._prefill_label)
             self._m.set_tp_overlap(self._tp_overlap or 0)
             self._m.set_weight_quant(self._wq_label)
+            if spec is not None:
+                self._m.set_spec_source(spec.source)
+                self._m.spec_draft_k.set(self._spec_k)
             if self._q8:
                 # analytic per-context-token KV traffic at int8: 1 data
                 # byte per (head, dim) element + 2 f16 scale bytes per
@@ -782,7 +1068,12 @@ class ServingEngine:
     def _headroom(self):
         # greedy may overshoot a retiring slot by < sync_every cache rows;
         # spec's verify forward writes spec_k+1 rows before the rewind
-        per = self._spec_k + 1 if self._mode == "spec" else self._sync
+        # (+1 more under tree mode: the branch token appends at L+k+1)
+        if self._mode == "spec":
+            per = self._spec_k + (
+                2 if self._spec is not None and self._spec.tree else 1)
+        else:
+            per = self._sync
         # a pipelined engine discovers retirement one drain late, so one
         # extra full dispatch of cache writes can land past the emission
         # point before the slot's offset is masked to lmax
@@ -1203,8 +1494,10 @@ class ServingEngine:
         if self._fr is not None:
             self._fr.record(kind, step=self._step_idx, **info)
         if self._m is not None:
-            self._m.kv_blocks_used.set(self._kv.blocks_used())
-            self._m.kv_blocks_free.set(self._kv.free_count())
+            draft_used = self._kv.draft_blocks_used()
+            self._m.set_kv_blocks(
+                self._kv.blocks_used() - draft_used, draft_used,
+                self._kv.free_count())
             host = getattr(self._kv, "host_tier", None)
             if host is not None:
                 self._m.kv_host_blocks.set(host.n_blocks)
@@ -1236,22 +1529,59 @@ class ServingEngine:
             block_tables=self._tables() if self._paged else None,
             program_key=self._pk)
 
-    def _call_spec(self, cur, dev_len, active):
+    def _call_spec(self, cur, dev_len, active, k=None):
+        """One speculative round at draft depth ``k`` (``None`` = the
+        configured ceiling).  Returns the SAME 8-tuple for both draft
+        sources — (emitted, j, cur', new_len, ok, caches, hist,
+        hist_len) — so the two call sites stay source-oblivious: the
+        draft-model path stashes its dense draft caches as engine state
+        and passes the (unused) history straight through."""
+        k = self._spec_k if k is None else k
+        pk = (self._pk if k == self._spec_k
+              else self._pk.replace(spec_depth=k))
+        if self._dspec:
+            if self._tp is not None:
+                tp = self._tp_spec[k]
+                if self._paged:
+                    out = tp.spec_draft_step(
+                        self._params, self._dparams, cur, self._kv.caches,
+                        dev_len, active, self._tables(),
+                        self._kv.device_draft_tables())
+                else:
+                    out = tp.spec_draft_step(
+                        self._params, self._dparams, cur, self._kv.caches,
+                        self._dcaches, dev_len, active)
+            else:
+                out = serving_spec_draft_step(
+                    self._params, self._dparams, self._cfg, self._dcfg,
+                    cur, self._kv.caches,
+                    None if self._paged else self._dcaches, dev_len,
+                    active, spec_k=k, chunk_size=self._chunk,
+                    block_tables=self._tables() if self._paged else None,
+                    draft_tables=(self._kv.device_draft_tables()
+                                  if self._paged else None),
+                    program_key=pk)
+            emitted, j, cur2, new_len, ok, caches, dc = out
+            if not self._paged:
+                self._dcaches = list(dc)
+            return (emitted, j, cur2, new_len, ok, caches, self._hist,
+                    self._hist_len)
         if self._tp is not None:
+            tp = self._tp_spec[k]
             if self._paged:
-                return self._tp.spec_step(self._params, cur,
-                                          self._kv.caches, dev_len,
-                                          self._hist, self._hist_len,
-                                          active, self._tables())
-            return self._tp.spec_step(self._params, cur, self._kv.caches,
-                                      dev_len, self._hist, self._hist_len,
-                                      active)
+                return tp.spec_step(self._params, cur,
+                                    self._kv.caches, dev_len,
+                                    self._hist, self._hist_len,
+                                    active, self._tables())
+            return tp.spec_step(self._params, cur, self._kv.caches,
+                                dev_len, self._hist, self._hist_len,
+                                active)
         return serving_spec_step(
             self._params, self._cfg, cur, self._kv.caches, dev_len,
-            self._hist, self._hist_len, active, spec_k=self._spec_k,
+            self._hist, self._hist_len, active, spec_k=k,
             chunk_size=self._chunk,
             block_tables=self._tables() if self._paged else None,
-            program_key=self._pk)
+            program_key=pk)
 
     def _call_prefill_slot(self, tokens, prompt_len, slot):
         if self._tp is not None:
@@ -1283,6 +1613,89 @@ class ServingEngine:
             block_tables=self._tables() if self._paged else None,
             program_key=self._pk)
 
+    def _call_draft_prefill_chunk(self, chunk, off, plen, slot):
+        """One DRAFT-model prefill chunk: fills the draft tenant's KV for
+        the prompt rows the draft decode scan will attend.  Paged engines
+        run it over the shared pool's first ``d`` layer arrays through
+        the draft block tables (the target's pool list is re-assembled
+        around the returned layers — serving_prefill_chunk donates its
+        cache operand); dense engines write the separate ``_dcaches``.
+        The chunk's first-token/finite outputs are dropped: draft KV is
+        advisory (a bad draft row costs accept rate, never output
+        bytes)."""
+        d = len(self._dparams["layers"])
+        if self._tp is not None:
+            if self._paged:
+                _, _, new_dc, _, _ = self._tp.draft_prefill_chunk(
+                    self._dparams, jnp.asarray(chunk),
+                    jnp.asarray(off, jnp.int32), plen,
+                    self._kv.caches[:d], jnp.asarray(slot, jnp.int32),
+                    self._kv.device_draft_tables())
+            else:
+                _, _, new_dc, _, _ = self._tp.draft_prefill_chunk(
+                    self._dparams, jnp.asarray(chunk),
+                    jnp.asarray(off, jnp.int32), plen,
+                    self._dcaches, jnp.asarray(slot, jnp.int32))
+        else:
+            _, _, new_dc, _, _ = serving_prefill_chunk(
+                self._dparams, self._dcfg, jnp.asarray(chunk),
+                jnp.asarray(off, jnp.int32), plen,
+                self._kv.caches[:d] if self._paged else self._dcaches,
+                jnp.asarray(slot, jnp.int32), with_hist=False,
+                chunk_size=self._chunk,
+                block_tables=(self._kv.device_draft_tables()
+                              if self._paged else None),
+                program_key=self._pk)
+        if self._paged:
+            self._kv.caches = list(new_dc) + self._kv.caches[d:]
+        else:
+            self._dcaches = list(new_dc)
+
+    # ------------------------------------------------ adaptive draft depth
+    def _reset_spec_slot(self, slot):
+        """Fresh request in ``slot``: restart its accept-rate window and
+        return its desired rung to the ceiling (a new prompt's
+        draftability is unknown — start at full depth, degrade on
+        evidence)."""
+        if self._awin is not None:
+            self._awin[slot].reset()
+            self._k_want[slot] = len(self._k_rungs) - 1
+
+    def _adapt_k(self, rounds, k):
+        """Feed one drained verify round into the adaptive-k policy:
+        per-slot windows absorb (k drafted, j accepted), hysteresis
+        moves each slot's desired rung (>= 80% of the window accepted:
+        one rung deeper; <= 40%: one rung shallower).  Host arithmetic
+        only — the chosen batch depth is read at the NEXT dispatch."""
+        if self._awin is None:
+            return
+        for slot, j in rounds:
+            w = self._awin[slot]
+            w.push(k, j)
+            r = w.rate()
+            if r is None or len(w) < w.window:
+                continue
+            if r >= 0.8 and self._k_want[slot] < len(self._k_rungs) - 1:
+                self._k_want[slot] += 1
+            elif r <= 0.4 and self._k_want[slot] > 0:
+                self._k_want[slot] -= 1
+
+    def _next_k(self, live):
+        """The batch depth for the NEXT spec dispatch: the most
+        conservative live slot's desired rung (one program serves the
+        whole batch — a deep k wastes dead verify lanes on every hard
+        slot), approached ONE rung per round so a retiring pessimist
+        never yanks the batch straight to the ceiling."""
+        if self._awin is None or not live:
+            return self._k_cur
+        want = min(self._k_want[i] for i in live)
+        cur = self._k_rungs.index(self._k_cur)
+        nxt = cur + (1 if want > cur else -1 if want < cur else 0)
+        self._k_cur = self._k_rungs[nxt]
+        if self._m is not None:
+            self._m.spec_draft_k.set(self._k_cur)
+        return self._k_cur
+
     def _admit(self):
         free = self._kv.free_slots()
         if not free or not self._queue:
@@ -1299,6 +1712,7 @@ class ServingEngine:
             r = self._queue.popleft()
             slot = free.pop(0)
             self._kv.assign(slot, r)
+            self._reset_spec_slot(slot)
             p = r.prompt_ids.size
             if r._trace is not None:
                 r._trace.mark("prefilling", slot=slot)
@@ -1369,6 +1783,7 @@ class ServingEngine:
             r = max(self._queue, key=lambda q: q.priority)
             tok = self._admission_ids(r)
             off0, shared, budget, need, host_tok = 0, [], 0, 0, 0
+            doff0, dshared, dbudget = 0, [], 0
             if self._paged:
                 C = self._kv.block
                 p = int(tok.size)
@@ -1405,18 +1820,32 @@ class ServingEngine:
                     shared = shared[:off0 // C]
                 host_tok = max(0, off0 - min(off_dev, off0))
                 budget = -(-need // C) - len(shared)
-                if not self._kv.can_reserve(budget):
+                if self._dspec:
+                    # draft tenancy: the draft chain needs the same block
+                    # count (shared pool, own tables/namespace), reserved
+                    # up front so a mid-stream OOM can't strand a slot
+                    # with target KV but no draft KV
+                    doff0, dshared = self._kv.match_draft_prefix(tok)
+                    if P > C:
+                        doff0 = (doff0 // P) * P
+                        dshared = dshared[:doff0 // C]
+                    dbudget = -(-need // C) - len(dshared)
+                if not self._kv.can_reserve(budget + dbudget):
                     if self._fr is not None:
                         self._fr.record("admit_defer", step=self._step_idx,
-                                        rid=r.rid, need_blocks=budget)
+                                        rid=r.rid,
+                                        need_blocks=budget + dbudget)
                     break
             self._queue.remove(r)
             slot = free.pop(0)
             self._kv.assign(slot, r)
+            self._reset_spec_slot(slot)
             p = int(tok.size)
             if self._paged:
                 self._kv.adopt_prefix(slot, shared)
-                self._kv.reserve(slot, budget)
+                if self._dspec:
+                    self._kv.adopt_draft_prefix(slot, dshared)
+                self._kv.reserve(slot, budget + dbudget)
                 self._need_rows[slot] = need
                 r._adm_ids = tok
                 self._n_prompt_tokens += p
@@ -1466,6 +1895,7 @@ class ServingEngine:
             # device-ready prompt length, built here (outside the chunk
             # dispatch loop) so _spend_prefill stays sync-free
             self._pf[slot] = {"req": r, "tok": padded, "p": p, "off": off0,
+                              "doff": doff0, "first": None, "okf": None,
                               "plen": jnp.asarray(np.array([p], np.int32))}
             if m is not None:
                 m.admitted.inc()
@@ -1500,7 +1930,8 @@ class ServingEngine:
         p = int(request.prompt_ids.size)
         rem = max(1, request.max_new_tokens - len(request.output_ids))
         need = min(self._lmax, p + rem + self._headroom())
-        return self._kv.can_reserve(-(-need // self._kv.block))
+        return self._kv.can_reserve(
+            -(-need // self._kv.block) * (2 if self._dspec else 1))
 
     def adoption_viable(self, request):
         """The static half of ``can_adopt``: could this request EVER fit
@@ -1568,8 +1999,23 @@ class ServingEngine:
         slot = free[0]
         blocks = self._kv.import_chain(leaves)  # all-or-nothing
         self._kv.assign(slot, request)
+        self._reset_spec_slot(slot)
         self._kv.splice_chain(slot, blocks)
-        self._kv.reserve(slot, -(-need // self._kv.block) - len(blocks))
+        resv = -(-need // self._kv.block) - len(blocks)
+        doff0, dshared = 0, []
+        if self._dspec:
+            # the transfer carries only TARGET KV (the draft's is cheap
+            # to rebuild and model-specific); the draft chain starts from
+            # whatever its own radix namespace already holds
+            C = self._kv.block
+            doff0, dshared = self._kv.match_draft_prefix(tok)
+            P = self._pchunk
+            if P > C:
+                doff0 = (doff0 // P) * P
+                dshared = dshared[:doff0 // C]
+            resv += -(-need // C) - len(dshared)
+            self._kv.adopt_draft_prefix(slot, dshared)
+        self._kv.reserve(slot, resv)
         self._need_rows[slot] = need
         self._kv.lengths[slot] = p
         request._adm_ids = tok
@@ -1588,6 +2034,21 @@ class ServingEngine:
                 row[p] = int(first)
             self._hist = self._hist.at[slot].set(jnp.asarray(row))
             self._hist_len = self._hist_len.at[slot].set(p + 1)
+        if self._dspec:
+            # rebuild the draft model's prompt KV locally, off the step
+            # path (adoption is already a slow-path handoff): chunked
+            # draft prefill over the suffix the draft radix didn't cover
+            P = self._pchunk
+            padded = np.zeros((-(-p // P) * P,), np.int32)
+            padded[:p] = tok
+            plen = jnp.asarray(np.array([p], np.int32))
+            off = doff0
+            while off < p:
+                self._kv.ensure_draft_rows(slot, min(off + P, p))
+                self._call_draft_prefill_chunk(
+                    padded[off:off + P][None, :], off, plen, slot)
+                off += P
+            self._kv.register_draft_prefix(slot, tok)
         # the imported chain is as good as a local prefill's (its finite
         # check passed before export): publish it so later identical
         # prompts on THIS worker reuse it — prefix reuse survives
@@ -1630,44 +2091,69 @@ class ServingEngine:
                 break
             st = self._pf[slot]
             while budget:
-                k = st["off"] // P
-                if st["req"]._trace is not None:
-                    st["req"]._trace.mark("prefilling", chunk=k, slot=slot)
-                if self._fr is not None:
-                    self._fr.record("prefill_chunk", step=self._step_idx,
-                                    rid=st["req"].rid, slot=slot, chunk=k)
-                if self._paged:
-                    # map the chunk's REAL rows before its writes dispatch
-                    # (pad columns past the prompt drop on the sentinel);
-                    # draws down the reservation made at admission
-                    self._kv.ensure_rows(slot, min(st["off"] + P, st["p"]))
-                chunk = st["tok"][st["off"]:st["off"] + P][None, :]
-                with m.span_prefill if m is not None else _NULL_CTX:
-                    first, okf, self._kv.caches, hist, hist_len = \
-                        self._call_prefill_chunk(
-                            jnp.asarray(chunk),
-                            jnp.asarray(st["off"], jnp.int32), st["plen"],
-                            jnp.asarray(slot, jnp.int32))
-                if self._mode == "spec":
-                    self._hist, self._hist_len = hist, hist_len
-                st["off"] += P
+                if st["off"] < st["p"]:
+                    k = st["off"] // P
+                    if st["req"]._trace is not None:
+                        st["req"]._trace.mark("prefilling", chunk=k,
+                                              slot=slot)
+                    if self._fr is not None:
+                        self._fr.record("prefill_chunk",
+                                        step=self._step_idx,
+                                        rid=st["req"].rid, slot=slot,
+                                        chunk=k)
+                    if self._paged:
+                        # map the chunk's REAL rows before its writes
+                        # dispatch (pad columns past the prompt drop on
+                        # the sentinel); draws down the reservation made
+                        # at admission
+                        self._kv.ensure_rows(
+                            slot, min(st["off"] + P, st["p"]))
+                    chunk = st["tok"][st["off"]:st["off"] + P][None, :]
+                    with m.span_prefill if m is not None else _NULL_CTX:
+                        first, okf, self._kv.caches, hist, hist_len = \
+                            self._call_prefill_chunk(
+                                jnp.asarray(chunk),
+                                jnp.asarray(st["off"], jnp.int32),
+                                st["plen"],
+                                jnp.asarray(slot, jnp.int32))
+                    if self._mode == "spec":
+                        self._hist, self._hist_len = hist, hist_len
+                    st["off"] += P
+                    if m is not None:
+                        m.prefill_chunks.inc()
+                    if st["off"] >= st["p"]:
+                        # only the FINAL chunk's finite flag is meaningful
+                        # (its query attends the whole prefix) — it rides
+                        # with the first token and is checked at emission
+                        st["first"], st["okf"] = first, okf
+                if self._dspec and st["doff"] < st["p"]:
+                    # the draft model's prompt KV rides the same budget
+                    # unit: one target chunk + one draft chunk per spend
+                    # (the draft forward is a fraction of the target's
+                    # cost).  Its cursor is independent — a target-side
+                    # radix hit skips chunks the draft may still need
+                    if self._paged:
+                        self._kv.ensure_draft_rows(
+                            slot, min(st["doff"] + P, st["p"]))
+                    dchunk = st["tok"][st["doff"]:st["doff"] + P][None, :]
+                    with m.span_prefill if m is not None else _NULL_CTX:
+                        self._call_draft_prefill_chunk(
+                            dchunk, st["doff"], st["plen"], slot)
+                    st["doff"] += P
                 budget -= 1
                 spent += 1
-                if m is not None:
-                    m.prefill_chunks.inc()
-                if st["off"] >= st["p"]:
-                    # only the FINAL chunk's finite flag is meaningful
-                    # (its query attends the whole prefix) — it rides
-                    # with the first token and is checked at emission
+                if st["off"] >= st["p"] and (
+                        not self._dspec or st["doff"] >= st["p"]):
                     del self._pf[slot]
                     self._kv.lengths[slot] = st["p"]
-                    self._dev_first[slot] = first
+                    self._dev_first[slot] = st["first"]
                     self._pending_firsts.append(
-                        (slot, st["req"], first, okf))
+                        (slot, st["req"], st["first"], st["okf"]))
                     break
         if m is not None:
             m.prefill_backlog.set(sum(
-                -(-(st["p"] - st["off"]) // P) for st in self._pf.values()))
+                -(-max(0, st["p"] - st["off"]) // P)
+                for st in self._pf.values()))
         return spent
 
     def _flush_firsts(self):
@@ -1697,6 +2183,8 @@ class ServingEngine:
                 # prompt — a preemption resume's chain also covers the
                 # tokens it re-prefilled
                 self._kv.register_prefix(slot, r._adm_ids)
+                if self._dspec:
+                    self._kv.register_draft_prefix(slot, r._adm_ids)
             if self._on_prefilled is not None:
                 # disagg handoff: the chain is registered and still
                 # mapped — the coordinator exports it here; _emit
@@ -1839,9 +2327,14 @@ class ServingEngine:
         if not self._paged:
             return
         for i in live:
-            self._kv.ensure_rows(i, min(int(self._need_rows[i]),
-                                        int(self._kv.lengths[i])
-                                        + self._headroom()))
+            upto = min(int(self._need_rows[i]),
+                       int(self._kv.lengths[i]) + self._headroom())
+            self._kv.ensure_rows(i, upto)
+            if self._dspec:
+                # the draft chain writes the same rows this round (its
+                # append rides the identical dev_lengths), so it grows in
+                # lockstep from the admission-time draft reservation
+                self._kv.ensure_draft_rows(i, upto)
 
     # ------------------------------------------------- synchronous baseline
     def _step_sync(self, adm_active=False):
@@ -1887,10 +2380,16 @@ class ServingEngine:
                 self._kv.lengths[i] += self._sync
                 self._cur[i] = toks[i, -1]
         else:
+            k = self._next_k(live)
+            if self._fr is not None:
+                self._fr.record("draft", step=self._step_idx,
+                                source=self._spec.source, k=k,
+                                n_live=len(live))
+
             def go(attempt):
                 self._fault_point("dispatch", attempt)
                 return self._call_spec(jnp.asarray(self._cur), dev_len,
-                                       jnp.asarray(active))
+                                       jnp.asarray(active), k)
             with m.span_spec if m is not None else _NULL_CTX:
                 blk, j, cur, _, oks, self._kv.caches, self._hist, \
                     self._hist_len = self._retry(go, "spec dispatch")
@@ -1899,6 +2398,7 @@ class ServingEngine:
                 self._fr.record("drain", step=self._step_idx, mode="spec",
                                 n_live=len(live))
             accepted = 0
+            rounds = []
             for i in live:
                 if not bool(oks[i]):
                     self._retire(i, "poisoned")
@@ -1907,13 +2407,20 @@ class ServingEngine:
                 self._kv.lengths[i] += int(j[i]) + 1
                 self._cur[i] = cur[i]
                 accepted += int(j[i])
+                rounds.append((i, int(j[i])))
+            if self._fr is not None:
+                self._fr.record("verify", step=self._step_idx, k=k,
+                                drafted=k * len(rounds), accepted=accepted)
+                self._fr.record("rewind", step=self._step_idx,
+                                tokens=k * len(rounds) - accepted)
+            self._adapt_k(rounds, k)
             self._observe_interference(
                 adm_active, 1.0 + accepted / len(live))
             if m is not None:
-                # per verify round each live slot drafts spec_k and accepts
+                # per verify round each live slot drafts k and accepts
                 # j of them (the +1 bonus token is the verify forward's own
                 # pick, not a draft)
-                m.spec_round(self._spec_k * len(live), accepted)
+                m.spec_round(k * len(live), accepted)
         return emitted
 
     # --------------------------------------------------- pipelined dispatch
@@ -1984,15 +2491,22 @@ class ServingEngine:
                 dev_len = jnp.where(jnp.asarray(use_host_len), host_len,
                                     self._dev_len)
 
+            k = self._next_k(live)
+            if self._fr is not None:
+                self._fr.record("draft", step=self._step_idx,
+                                source=self._spec.source, k=k,
+                                n_live=len(live))
+
             def go(attempt):
                 self._fault_point("dispatch", attempt)
-                return self._call_spec(cur, dev_len, jnp.asarray(active))
+                return self._call_spec(cur, dev_len, jnp.asarray(active),
+                                       k)
             with m.span_spec if m is not None else _NULL_CTX:
                 blk, j, cur2, new_len, oks, self._kv.caches, self._hist, \
                     self._hist_len = self._retry(go, "spec dispatch")
             self._dev_cur, self._dev_len = cur2, new_len
             self._inflight = {"kind": "spec", "blk": blk, "j": j,
-                              "ok": oks,
+                              "ok": oks, "k": k,
                               "reqs": list(self._kv.reqs), "live": live,
                               "firsts": firsts, "adm": adm_active}
         self._adm_pending.clear()
@@ -2076,10 +2590,14 @@ class ServingEngine:
                     # post-finite-check, pre-_emit (which may release):
                     # same registration rule as _flush_firsts
                     self._kv.register_prefix(slot, r._adm_ids)
+                    if self._dspec:
+                        self._kv.register_draft_prefix(slot, r._adm_ids)
                 self._cur[slot] = int(fv[0])
                 emitted += self._emit(slot, [int(fv[0])])
+            k = rec.get("k", self._spec_k)
             accepted = 0
             drained = 0
+            rounds = []
             for i in rec["live"]:
                 if self._kv.reqs[i] is not rec["reqs"][i]:
                     continue
@@ -2090,10 +2608,17 @@ class ServingEngine:
                 emitted += self._emit(i, blk[i, :int(j[i]) + 1].tolist())
                 self._kv.lengths[i] += int(j[i]) + 1
                 accepted += int(j[i])
+                rounds.append((i, int(j[i])))
+            if self._fr is not None:
+                self._fr.record("verify", step=self._step_idx, k=k,
+                                drafted=k * drained, accepted=accepted)
+                self._fr.record("rewind", step=self._step_idx,
+                                tokens=k * drained - accepted)
+            self._adapt_k(rounds, k)
             self._observe_interference(
                 rec.get("adm", False), 1.0 + accepted / max(1, drained))
             if m is not None and drained:
-                m.spec_round(self._spec_k * drained, accepted)
+                m.spec_round(k * drained, accepted)
         return emitted
 
     def run(self):

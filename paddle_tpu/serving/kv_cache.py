@@ -476,6 +476,18 @@ class PagedKVCacheManager(KVCacheManager):
         self._free = list(range(self.num_blocks - 1, -1, -1))  # pop() -> 0
         self._mapped = [0] * self.batch_size       # chunks mapped per slot
         self._resv_left = [0] * self.batch_size    # reserved, unallocated
+        # ---- draft tenancy: a SECOND chain per slot for the resident
+        # draft model's KV.  Blocks are model-agnostic bytes, so draft
+        # chains draw from the same free list / refcounts / allocator —
+        # the manager only keeps the chains (and the radix namespace,
+        # below) apart.  Draft blocks are freed OUTRIGHT at refcount 0
+        # (never LRU-parked, never host-demoted): draft KV is the small
+        # model's — cheap to recompute — and parking it would displace
+        # target prefixes from the LRU and the host tier.
+        self.draft_tables = np.full((self.batch_size, self.width),
+                                    self.num_blocks, np.int32)
+        self._dmapped = [0] * self.batch_size      # draft chunks per slot
+        self._draft_blocks = set()                 # live draft block ids
         # ---- radix prefix map (root parent id = -1)
         self._node = {}     # (parent_block, chunk tokens) -> block id
         self._key_of = {}   # registered block id -> its key
@@ -513,6 +525,13 @@ class PagedKVCacheManager(KVCacheManager):
     def blocks_used(self):
         """Blocks that are live OR holding an evictable cached prefix."""
         return self.num_blocks - len(self._free)
+
+    def draft_blocks_used(self):
+        """LIVE draft-chain blocks.  Draft blocks are freed outright at
+        refcount 0 (see ``__init__``), so this returns to 0 once every
+        spec request drains — the ``serving_kv_blocks_used{model=draft}``
+        accounting invariant."""
+        return len(self._draft_blocks)
 
     def outstanding(self):
         """Blocks promised to admitted slots but not yet allocated."""
@@ -609,7 +628,17 @@ class PagedKVCacheManager(KVCacheManager):
                 "(double-free corrupts the pool)")
         self.refcnt[b] -= 1
         if self.refcnt[b] == 0:
-            if b in self._key_of:
+            if b in self._draft_blocks:
+                # draft policy: unregister from the draft radix namespace
+                # and free outright — never LRU-park, never demote
+                key = self._key_of.pop(b, None)
+                if key is not None:
+                    self._node.pop(key, None)
+                    self._kids.get(key[0], set()).discard(b)
+                    self._kids.pop(b, None)
+                self._draft_blocks.discard(b)
+                self._free.append(b)
+            elif b in self._key_of:
                 self._tick += 1
                 self._lru[b] = self._tick
             else:
@@ -629,6 +658,21 @@ class PagedKVCacheManager(KVCacheManager):
             if self._resv_left[slot] > 0:
                 self._resv_left[slot] -= 1
         return self._mapped[slot]
+
+    def ensure_draft_rows(self, slot, upto):
+        """Grow ``slot``'s DRAFT chain to cover logical rows
+        ``[0, upto)`` — the draft-model twin of ``ensure_rows``, drawing
+        the same free list and the same admission reservation (a spec
+        engine reserves both chains' worst case up front)."""
+        need = min(-(-int(upto) // self.block), self.width)
+        while self._dmapped[slot] < need:
+            b = self.alloc_block()
+            self._draft_blocks.add(b)
+            self.draft_tables[slot, self._dmapped[slot]] = b
+            self._dmapped[slot] += 1
+            if self._resv_left[slot] > 0:
+                self._resv_left[slot] -= 1
+        return self._dmapped[slot]
 
     # ------------------------------------------------------- prefix reuse
     def match_prefix(self, tokens, touch=True):
@@ -705,6 +749,73 @@ class PagedKVCacheManager(KVCacheManager):
                 parent = b
             else:                   # lost the race: keep the rest private
                 break
+
+    # ------------------------------------------------ draft radix namespace
+    # The draft model's prefix chains live in the SAME radix structures
+    # (_node/_key_of/_kids) under a salted root chunk, so two concurrent
+    # requests with an identical prompt share one draft prefix chain the
+    # same way they share the target's — while a draft chunk can never
+    # collide with (or be adopted as) a target chunk, and its host-tier
+    # content key is salted by construction.  Unlike target chunks, draft
+    # chunks are only shareable while some slot still references them:
+    # refcount 0 frees a draft block outright (see ``free_block``).
+
+    _DRAFT_SALT = "__draft__"
+
+    def _draft_chunk(self, tokens, k):
+        chunk = tuple(int(t) for t in
+                      tokens[k * self.block:(k + 1) * self.block])
+        return ((self._DRAFT_SALT,) + chunk) if k == 0 else chunk
+
+    def match_draft_prefix(self, tokens, touch=True):
+        """Longest LIVE draft-namespace prefix of ``tokens`` ->
+        (matched_tokens, blocks) — ``match_prefix`` over the salted
+        namespace (``touch`` kept for interface symmetry; draft blocks
+        never sit in the LRU, so there is no heat to fake)."""
+        cap = max(0, (len(tokens) - 1) // self.block)
+        parent, out = -1, []
+        for k in range(cap):
+            b = self._node.get((parent, self._draft_chunk(tokens, k)))
+            if b is None:
+                break
+            out.append(b)
+            parent = b
+        return len(out) * self.block, out
+
+    def register_draft_prefix(self, slot, tokens):
+        """Publish ``slot``'s full-block DRAFT chain into the salted
+        namespace — ``register_prefix``'s first-writer-wins walk over
+        ``draft_tables``."""
+        parent = -1
+        n_full = min(len(tokens) // self.block, self._dmapped[slot])
+        for k in range(n_full):
+            key = (parent, self._draft_chunk(tokens, k))
+            b = int(self.draft_tables[slot, k])
+            cur = self._node.get(key)
+            if cur is None:
+                self._node[key] = b
+                self._key_of[b] = key
+                self._kids.setdefault(parent, set()).add(b)
+                parent = b
+            elif cur == b:
+                parent = b
+            else:
+                break
+
+    def adopt_draft_prefix(self, slot, blocks):
+        """Map shared draft ``blocks`` at the head of ``slot``'s fresh
+        draft chain (admission after a ``match_draft_prefix`` hit) —
+        refcounts bump exactly like ``adopt_prefix``."""
+        if self._dmapped[slot]:
+            raise ValueError(
+                f"adopt_draft_prefix: slot {slot} already maps "
+                f"{self._dmapped[slot]} draft blocks")
+        for w, b in enumerate(blocks):
+            b = int(b)
+            self._check_block(b)
+            self.refcnt[b] += 1
+            self.draft_tables[slot, w] = b
+        self._dmapped[slot] = len(blocks)
 
     # ---------------------------------------------------------- host tier
     @property
@@ -981,15 +1092,27 @@ class PagedKVCacheManager(KVCacheManager):
     def release(self, slot):
         """Retire ``slot``: unreference its whole chain (shared prefix
         blocks may stay EVICTABLE for the next identical prompt), reset
-        the table row to the sentinel, clear the reservation."""
+        the table row to the sentinel, clear the reservation.  The draft
+        chain is unreferenced LEAF-FIRST so a shared draft parent stays
+        registered until its registered children are gone (draft blocks
+        free outright at refcount 0, unregistering as they go)."""
         super().release(slot)
         for w in range(self._mapped[slot]):
             self.free_block(int(self.block_tables[slot, w]))
         self.block_tables[slot, :] = self.num_blocks
         self._mapped[slot] = 0
+        for w in range(self._dmapped[slot] - 1, -1, -1):
+            self.free_block(int(self.draft_tables[slot, w]))
+        self.draft_tables[slot, :] = self.num_blocks
+        self._dmapped[slot] = 0
         self._resv_left[slot] = 0
 
     # -------------------------------------------------------------- device
     def device_tables(self):
         """The traced ``[B, W]`` block-table operand for one dispatch."""
         return jnp.asarray(self.block_tables)
+
+    def device_draft_tables(self):
+        """The traced ``[B, W]`` DRAFT block-table operand — same pool,
+        second tenant."""
+        return jnp.asarray(self.draft_tables)

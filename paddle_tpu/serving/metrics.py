@@ -134,13 +134,37 @@ class EngineMetrics:
             L).labels(**lbl)
         self.spec_drafted = reg.counter(
             "serving_spec_drafted_total",
-            "draft tokens proposed by prompt-lookup", L).labels(**lbl)
+            "draft tokens proposed per speculative round", L).labels(**lbl)
         self.spec_accepted = reg.counter(
             "serving_spec_accepted_total",
             "draft tokens accepted by the verify forward", L).labels(**lbl)
+        # speculative drafting series, source-labeled: the accept-rate
+        # gauge is keyed by the DRAFT SOURCE (prompt_lookup = n-gram
+        # history mining, draft_model = the resident shrunk-llama
+        # drafter) so an A/B scrape separates the two policies; both
+        # children pre-registered, the engine points ``set_spec_source``
+        # at its active one.  ``spec_draft_k`` tracks the depth actually
+        # in effect — it MOVES under the adaptive-k ladder
         self.spec_accept_rate = reg.gauge(
             "serving_spec_accept_rate",
-            "cumulative accepted/drafted ratio", L).labels(**lbl)
+            "cumulative accepted/drafted ratio, by draft source",
+            ("policy", "source"))
+        for source in ("prompt_lookup", "draft_model"):
+            self.spec_accept_rate.labels(policy=policy, source=source)
+        self._spec_source = "prompt_lookup"
+        self._spec_draft_source = reg.gauge(
+            "serving_spec_draft_source",
+            "draft-source info gauge: the child whose source label names "
+            "the engine's drafting policy reads 1, the other "
+            "pre-registered child 0", ("policy", "source"))
+        for source in ("prompt_lookup", "draft_model"):
+            self._spec_draft_source.labels(policy=policy, source=source) \
+                .set(0)
+        self.spec_draft_k = reg.gauge(
+            "serving_spec_draft_k",
+            "draft tokens per speculative round currently in effect "
+            "(moves under the adaptive-k policy; fixed-k engines hold "
+            "the constructor knob)", L).labels(**lbl)
         self.prefill_chunks = reg.counter(
             "serving_prefill_chunks_total",
             "prompt chunks dispatched by the chunked-prefill path",
@@ -166,10 +190,17 @@ class EngineMetrics:
         # counters the shared-prefix bench derives its hit rate from
         # (reuse / prompt tokens).  Zero-valued on dense engines —
         # pre-registered like every other family
+        # pool occupancy is TENANT-split: target = the served model's
+        # chains plus evictable cached prefixes, draft = the resident
+        # draft model's live chains (freed outright at refcount 0, so
+        # the draft child returns to 0 after drain — the tenancy
+        # accounting invariant tests pin)
         self.kv_blocks_used = reg.gauge(
             "serving_kv_blocks_used",
-            "KV pool blocks live or holding an evictable cached prefix",
-            L).labels(**lbl)
+            "KV pool blocks live or holding an evictable cached prefix, "
+            "by tenant model", ("policy", "model"))
+        for model in ("target", "draft"):
+            self.kv_blocks_used.labels(policy=policy, model=model)
         self.kv_blocks_free = reg.gauge(
             "serving_kv_blocks_free",
             "KV pool blocks on the free list", L).labels(**lbl)
@@ -385,12 +416,32 @@ class EngineMetrics:
         if c is not None:
             c.inc()
 
+    def set_spec_source(self, source):
+        """Point the draft-source info gauge at ``source`` and route
+        subsequent ``spec_round`` accept-rate updates to that child —
+        the engine calls it once at construction."""
+        self._spec_source = source
+        for s in ("prompt_lookup", "draft_model"):
+            self._spec_draft_source.labels(
+                policy=self._policy, source=s).set(1 if s == source else 0)
+
+    def set_kv_blocks(self, target_used, draft_used, free):
+        """Post the tenant-split pool occupancy in one call (the
+        engine's ``_kv_event`` hook)."""
+        self.kv_blocks_used.labels(
+            policy=self._policy, model="target").set(target_used)
+        self.kv_blocks_used.labels(
+            policy=self._policy, model="draft").set(draft_used)
+        self.kv_blocks_free.set(free)
+
     def spec_round(self, drafted, accepted):
         self.spec_drafted.inc(drafted)
         self.spec_accepted.inc(accepted)
         total = self.spec_drafted.value
         if total:
-            self.spec_accept_rate.set(self.spec_accepted.value / total)
+            self.spec_accept_rate.labels(
+                policy=self._policy, source=self._spec_source).set(
+                self.spec_accepted.value / total)
 
 
 class DisaggMetrics:

@@ -35,7 +35,9 @@ class StaticAxis:
 
     ``values`` is the closed enum of allowed settings when ``kind`` is
     ``"enum"``; ``kind="segments"`` instead accepts ``None`` (off) or an
-    ``int >= 2`` (the number of per-layer reduction segments).
+    ``int >= 2`` (the number of per-layer reduction segments);
+    ``kind="depth"`` accepts ``None`` (off) or an ``int >= 1`` (a draft
+    depth — the number of speculative candidate tokens per round).
     """
 
     name: str
@@ -59,6 +61,15 @@ class StaticAxis:
                 raise ValueError(
                     f"ProgramKey: {self.name} must be None (off) or an "
                     f"int >= 2 (segments per row-parallel reduction), got "
+                    f"{value!r}.  {self.doc}")
+            return value
+        if self.kind == "depth":
+            if value is None:
+                return None
+            if isinstance(value, bool) or not isinstance(value, int) or value < 1:
+                raise ValueError(
+                    f"ProgramKey: {self.name} must be None (off) or an "
+                    f"int >= 1 (draft tokens per speculative round), got "
                     f"{value!r}.  {self.doc}")
             return value
         raise AssertionError(f"unknown StaticAxis kind {self.kind!r}")
@@ -97,6 +108,26 @@ PROGRAM_AXES = (
         "output-feature axis so per-segment collectives can overlap "
         "trailing compute; byte-identical math, different schedule.",
         kind="segments"),
+    StaticAxis(
+        "draft_source", None,
+        "speculative draft generator: None = not speculating (greedy), "
+        "'prompt_lookup' = n-gram continuation mined from the slot's "
+        "token history, 'draft_model' = a resident shrunk-llama draft "
+        "model decoding k candidates through its own compiled program.",
+        values=(None, "prompt_lookup", "draft_model")),
+    StaticAxis(
+        "spec_depth", None,
+        "draft tokens verified per speculative round (the k in the "
+        "[B, k+1] verify forward); each depth is its own compiled "
+        "program, so the adaptive-k ladder pre-warms one entry per rung.",
+        kind="depth"),
+    StaticAxis(
+        "spec_tree", None,
+        "tree-structured candidates: None = linear draft chain, 'top2' = "
+        "top-2 branch at the first draft position verified in the same "
+        "batched forward through a tree attention mask (draft_model + "
+        "dense caches only).",
+        values=(None, "top2")),
 )
 
 _AXES_BY_NAME = {ax.name: ax for ax in PROGRAM_AXES}
@@ -116,6 +147,9 @@ class ProgramKey:
     kv_dtype: object = None
     weight_dtype: object = None
     tp_overlap: object = None
+    draft_source: object = None
+    spec_depth: object = None
+    spec_tree: object = None
 
     def __post_init__(self):
         for ax in PROGRAM_AXES:

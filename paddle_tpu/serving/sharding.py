@@ -57,7 +57,8 @@ from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from paddle_tpu.models.llama_decode import (
     _mon, _serving_decode_steps_impl, _serving_prefill_chunk_impl,
-    _serving_prefill_slot_impl, _serving_spec_step_impl,
+    _serving_prefill_slot_impl, _serving_spec_draft_step_impl,
+    _serving_spec_step_impl,
 )
 
 __all__ = ["match_partition_rules", "llama_tp_rules", "kv_cache_pspec",
@@ -210,7 +211,8 @@ class TPPrograms:
 
     def __init__(self, mesh, axis, cfg, param_specs, n_layers, *,
                  sync_every, spec_k, with_hist, chunk_size, paged=False,
-                 program_key=None):
+                 program_key=None, dcfg=None, dparam_specs=None,
+                 d_layers=0):
         repl = NamedSharding(mesh, PS())
         pshard = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), param_specs,
@@ -229,6 +231,16 @@ class TPPrograms:
         self.n_devices = int(mesh.shape[axis])
         self.cache_sharding = dsh if n_layers else repl
         self.scale_sharding = ssh
+        # resident draft model: its params shard under the same TP rules,
+        # and its caches — whether the shared pool's first d_layers arrays
+        # (paged) or the separate dense twins — keep the head axis at the
+        # same index, so the target's cache leaf sharding applies verbatim
+        dpshard = None
+        if dparam_specs is not None:
+            dpshard = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), dparam_specs,
+                is_leaf=lambda x: isinstance(x, PS))
+        dcshard = [(leaf,) * 2 for _ in range(d_layers)]
 
         if paged:
             # paged programs take one extra trailing operand: the [B, W]
@@ -259,6 +271,43 @@ class TPPrograms:
                               repl, repl),
                 out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
                                repl)))
+
+            if dpshard is not None:
+                # draft-model speculative round over the SHARED pool: the
+                # draft's k decode steps ride the first d_layers pool
+                # arrays through their own block tables, then the verify
+                # forward reads the full target caches — one program, no
+                # host hop between draft and verify.  dcaches is None
+                # (paged), so the trailing output subtree is empty and its
+                # repl spec binds nothing.
+                def dspec(params, dparams, cur, caches, dev_lengths,
+                          active, tables, dtables):
+                    return _serving_spec_draft_step_impl(
+                        params, dparams, cfg, dcfg, cur, caches, None,
+                        dev_lengths, active, spec_k=spec_k,
+                        chunk_size=chunk_size, block_tables=tables,
+                        draft_tables=dtables, program_key=program_key)
+                self.spec_draft_step = _mon.wrap(
+                    "serving_spec_draft_step", jax.jit(
+                        dspec,
+                        in_shardings=(pshard, dpshard, repl, cshard, repl,
+                                      repl, repl, repl),
+                        out_shardings=(repl, repl, repl, repl, repl,
+                                       cshard, repl)))
+
+                def dpchunk(params, tokens, offset, prompt_len, caches,
+                            slot, tables):
+                    return _serving_prefill_chunk_impl(
+                        params, dcfg, tokens, offset, prompt_len, caches,
+                        slot, with_hist=False, chunk_size=chunk_size,
+                        block_tables=tables, program_key=program_key)
+                self.draft_prefill_chunk = _mon.wrap(
+                    "serving_prefill_chunk", jax.jit(
+                        dpchunk,
+                        in_shardings=(dpshard, repl, repl, repl, dcshard,
+                                      repl, repl),
+                        out_shardings=(repl, repl, dcshard, repl, repl),
+                        donate_argnums=(4,)))
 
             def pchunk(params, tokens, offset, prompt_len, caches, slot,
                        hist, hist_len, tables):
@@ -298,6 +347,39 @@ class TPPrograms:
                 out_shardings=(repl, repl, repl, repl, repl, cshard, repl,
                                repl)))
 
+            if dpshard is not None:
+                # dense twin: the draft's separate [B, Lmax, Hkv, D]
+                # caches travel as an explicit operand and come back
+                # updated (no donation — spec programs never donate, the
+                # engine re-dispatches on transient device errors)
+                def dspec(params, dparams, cur, caches, dcaches,
+                          dev_lengths, active):
+                    return _serving_spec_draft_step_impl(
+                        params, dparams, cfg, dcfg, cur, caches, dcaches,
+                        dev_lengths, active, spec_k=spec_k,
+                        chunk_size=chunk_size, program_key=program_key)
+                self.spec_draft_step = _mon.wrap(
+                    "serving_spec_draft_step", jax.jit(
+                        dspec,
+                        in_shardings=(pshard, dpshard, repl, cshard,
+                                      dcshard, repl, repl),
+                        out_shardings=(repl, repl, repl, repl, repl,
+                                       cshard, dcshard)))
+
+                def dpchunk(params, tokens, offset, prompt_len, caches,
+                            slot):
+                    return _serving_prefill_chunk_impl(
+                        params, dcfg, tokens, offset, prompt_len, caches,
+                        slot, with_hist=False, chunk_size=chunk_size,
+                        program_key=program_key)
+                self.draft_prefill_chunk = _mon.wrap(
+                    "serving_prefill_chunk", jax.jit(
+                        dpchunk,
+                        in_shardings=(dpshard, repl, repl, repl, dcshard,
+                                      repl),
+                        out_shardings=(repl, repl, dcshard, repl, repl),
+                        donate_argnums=(4,)))
+
             def pchunk(params, tokens, offset, prompt_len, caches, slot,
                        hist, hist_len):
                 return _serving_prefill_chunk_impl(
@@ -331,23 +413,31 @@ _PROGRAMS = {}
 
 def serving_tp_programs(mesh, axis, cfg, param_specs, n_layers, *,
                         sync_every, spec_k, with_hist, chunk_size,
-                        paged=False, program_key=None):
+                        paged=False, program_key=None, dcfg=None,
+                        dparam_specs=None, d_layers=0):
     """Cached ``TPPrograms`` factory (see class docstring).
 
     ``program_key`` is the frozen :class:`~paddle_tpu.serving.program_key.
     ProgramKey` of static kernel/precision axes — one hashable value in
     the cache key covers every registry axis (attn_impl, prefill_impl,
-    kv_dtype, weight_dtype, tp_overlap), so two engines differing in any
-    axis compile separate program families while identical engines share.
+    kv_dtype, weight_dtype, tp_overlap, draft_source, spec_depth,
+    spec_tree), so two engines differing in any axis compile separate
+    program families while identical engines share.  ``dcfg`` /
+    ``dparam_specs`` / ``d_layers`` describe the resident draft model
+    (draft_model source only) and fork the key like any other static.
     """
     leaves, treedef = jax.tree_util.tree_flatten(
         param_specs, is_leaf=lambda x: isinstance(x, PS))
+    dleaves, dtreedef = jax.tree_util.tree_flatten(
+        dparam_specs, is_leaf=lambda x: isinstance(x, PS))
     key = (mesh, axis, cfg, tuple(leaves), treedef, n_layers,
-           sync_every, spec_k, with_hist, chunk_size, paged, program_key)
+           sync_every, spec_k, with_hist, chunk_size, paged, program_key,
+           dcfg, tuple(dleaves), dtreedef, d_layers)
     progs = _PROGRAMS.get(key)
     if progs is None:
         progs = _PROGRAMS[key] = TPPrograms(
             mesh, axis, cfg, param_specs, n_layers, sync_every=sync_every,
             spec_k=spec_k, with_hist=with_hist, chunk_size=chunk_size,
-            paged=paged, program_key=program_key)
+            paged=paged, program_key=program_key, dcfg=dcfg,
+            dparam_specs=dparam_specs, d_layers=d_layers)
     return progs

@@ -158,15 +158,19 @@ class _WorkerProc:
                     pool=self._pool)
         self._flush_events()
 
-    def _build_model(self):
+    def _build_model(self, m=None):
         import paddle_tpu as paddle
         from ..models.llama import LlamaConfig, LlamaForCausalLM
-        m = self.cfg.get("model", {})
+        if m is None:
+            m = self.cfg.get("model", {})
         if m.get("kind", "llama") != "llama" or \
                 m.get("preset", "tiny") != "tiny":
             raise ValueError(f"unsupported model spec {m!r}")
         paddle.seed(int(m.get("seed", 0)))
-        cfg = LlamaConfig.tiny(dtype=m.get("dtype", "float32"))
+        kw = {}
+        if m.get("num_hidden_layers") is not None:
+            kw["num_hidden_layers"] = int(m["num_hidden_layers"])
+        cfg = LlamaConfig.tiny(dtype=m.get("dtype", "float32"), **kw)
         model = LlamaForCausalLM(cfg)
         model.eval()
         return model
@@ -178,6 +182,20 @@ class _WorkerProc:
         kw = dict(self.cfg.get("engine", {}))
         kw.update(self.cfg.get(self.role, {}) or {})
         kw["registry"] = self.registry
+        spec = kw.pop("spec", None)
+        if self.role != "prefill" and spec is not None:
+            # launch-config spec block: {"source": ..., "spec_k": ...,
+            # "draft_model": {<model spec>}} — the draft model is BUILT
+            # here, in the worker process (model objects don't cross the
+            # config pipe)
+            from .engine import SpecConfig
+            if isinstance(spec, dict):
+                spec = dict(spec)
+                dm = spec.pop("draft_model", None)
+                if dm is not None:
+                    dm = self._build_model(dict(dm))
+                spec = SpecConfig(draft_model=dm, **spec)
+            kw["spec"] = spec
         if self.role == "prefill":
             kw.pop("mode", None)
             kw.pop("spec_k", None)

@@ -328,7 +328,7 @@ class TestServingInstrumentation:
         accepted = reg.get("serving_spec_accepted_total").labels(
             policy="continuous").value
         rate = reg.get("serving_spec_accept_rate").labels(
-            policy="continuous").value
+            policy="continuous", source="prompt_lookup").value
         assert drafted > 0 and 0 <= accepted <= drafted
         assert rate == pytest.approx(accepted / drafted)
 

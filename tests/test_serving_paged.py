@@ -201,6 +201,13 @@ class TestPagedEngineSmoke:
                 # chains may park evictable for the next identical prompt
                 assert eng._kv.live_tokens() == 0
                 assert eng._kv.blocks_used() == eng._kv.evictable_count()
+                # n-gram spec rewind invariant: every rejected draft
+                # row's over-allocation was rolled back by the length
+                # rewind — no outstanding reservations survive the
+                # drain, and prompt-lookup drafting (no resident draft
+                # model) never touches the draft tenant's accounting
+                assert eng._kv.outstanding() == 0
+                assert eng._kv.draft_blocks_used() == 0
 
     def test_token_budget_defers_then_completes(self):
         # pool = ONE full-length request (8 blocks): each 60-token prompt
@@ -237,8 +244,8 @@ class TestPagedEngineSmoke:
         # yet), so only the two late admissions can adopt the 40-token
         # system prefix — 2 full blocks of 16 each
         assert reuse >= 2 * 32 and total == sum(len(p) for p in prompts)
-        assert reg.get("serving_kv_blocks_used").labels(**lbl).value \
-            == eng._kv.blocks_used() > 0
+        assert reg.get("serving_kv_blocks_used").labels(
+            model="target", **lbl).value == eng._kv.blocks_used() > 0
         assert reg.get("serving_kv_blocks_free").labels(**lbl).value \
             == eng._kv.free_count()
         assert reg.get("serving_live_tokens").labels(**lbl).value == 0
